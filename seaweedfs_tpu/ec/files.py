@@ -1,0 +1,143 @@
+"""EC sidecar files: .ecx (sorted index), .ecj (delete journal), .vif (info).
+
+Reference: weed/storage/erasure_coding/ec_encoder.go:27
+(`WriteSortedFileFromIdx`), ec_decoder.go:18/:121 (.ecx+.ecj -> .idx),
+ec_volume.go:47 (.vif carries version + fork's DestroyTime). Our .vif is JSON
+rather than a VolumeInfo protobuf — same fields, human-debuggable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..storage import types as t
+from ..storage.needle_map import idx_entries_numpy, write_idx_entries
+
+
+def shard_ext(i: int) -> str:
+    """'.ec00' ... (reference ec_encoder.go:65 ToExt)."""
+    return f".ec{i:02d}"
+
+
+def write_ecx_from_idx(idx_path: str, ecx_path: str) -> int:
+    """Sort the .idx's final state by key and write it as .ecx.
+
+    Deleted keys keep a tombstone entry (size 0xFFFFFFFF) so lookups can
+    distinguish 'deleted' from 'never existed', matching the reference's
+    memdb-then-sort approach. Returns entry count.
+    """
+    keys, offs, sizes = idx_entries_numpy(idx_path)
+    if keys.size == 0:
+        write_idx_entries(ecx_path, [], [], [])
+        return 0
+    # last write per key wins
+    order = np.argsort(keys, kind="stable")
+    keys, offs, sizes = keys[order], offs[order], sizes[order]
+    last = np.ones(keys.size, dtype=bool)
+    last[:-1] = keys[:-1] != keys[1:]
+    keys, offs, sizes = keys[last], offs[last], sizes[last]
+    write_idx_entries(ecx_path, keys, offs, sizes)
+    return int(keys.size)
+
+
+def search_ecx(ecx_path: str, needle_id: int) -> tuple[int, int] | None:
+    """Binary-search one key -> (actual_offset, size) or None.
+
+    Reference ec_volume.go:321 SearchNeedleFromSortedIndex — file-backed
+    binary search, O(log n) 16-byte reads; we mmap lazily instead.
+    """
+    size = os.path.getsize(ecx_path)
+    count = size // t.IDX_ENTRY_SIZE
+    if count == 0:
+        return None
+    with open(ecx_path, "rb") as f:
+        lo, hi = 0, count - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            f.seek(mid * t.IDX_ENTRY_SIZE)
+            key, off, sz = struct.unpack("<QII", f.read(t.IDX_ENTRY_SIZE))
+            if key == needle_id:
+                if t.is_tombstone(sz):
+                    return None
+                return t.stored_to_offset(off), sz
+            if key < needle_id:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+    return None
+
+
+def mark_deleted_in_ecx(ecx_path: str, needle_id: int) -> bool:
+    """Flip the entry's size to tombstone in place (reference ec_decoder-style
+    update during VolumeEcBlobDelete)."""
+    size = os.path.getsize(ecx_path)
+    count = size // t.IDX_ENTRY_SIZE
+    with open(ecx_path, "r+b") as f:
+        lo, hi = 0, count - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            f.seek(mid * t.IDX_ENTRY_SIZE)
+            key, off, sz = struct.unpack("<QII", f.read(t.IDX_ENTRY_SIZE))
+            if key == needle_id:
+                f.seek(mid * t.IDX_ENTRY_SIZE)
+                f.write(struct.pack("<QII", key, off, t.TOMBSTONE_SIZE))
+                return True
+            if key < needle_id:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+    return False
+
+
+def append_ecj(ecj_path: str, needle_id: int) -> None:
+    with open(ecj_path, "ab") as f:
+        f.write(struct.pack("<Q", needle_id))
+
+
+def read_ecj(ecj_path: str) -> list[int]:
+    if not os.path.exists(ecj_path):
+        return []
+    raw = np.fromfile(ecj_path, dtype="<u8")
+    return [int(x) for x in raw]
+
+
+def write_idx_from_ecx(ecx_path: str, ecj_path: str, idx_path: str) -> None:
+    """Rebuild a .idx for decode-to-volume (reference ec_decoder.go:18)."""
+    keys, offs, sizes = idx_entries_numpy(ecx_path)
+    deleted = set(read_ecj(ecj_path))
+    if deleted:
+        mask = np.isin(keys, np.fromiter(deleted, dtype=np.uint64))
+        sizes = sizes.copy()
+        sizes[mask] = t.TOMBSTONE_SIZE
+    write_idx_entries(idx_path, keys, offs, sizes)
+
+
+def max_ecx_extent(ecx_path: str) -> int:
+    """Logical .dat size implied by the highest needle end (ec_decoder.go:48)."""
+    from ..storage.needle import record_size_from_header
+    keys, offs, sizes = idx_entries_numpy(ecx_path)
+    live = sizes != np.uint32(t.TOMBSTONE_SIZE)
+    if not live.any():
+        return 0
+    ends = offs[live].astype(np.int64) * t.NEEDLE_PADDING
+    # add padded record size per entry
+    best = 0
+    for off, sz in zip(ends, sizes[live]):
+        best = max(best, int(off) + record_size_from_header(int(sz)))
+    return best
+
+
+def write_vif(path: str, **info) -> None:
+    with open(path, "w") as f:
+        json.dump(info, f)
+
+
+def read_vif(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,98 @@
+"""Stripe geometry: map logical volume bytes to (shard, offset) intervals.
+
+Re-derivation of reference weed/storage/erasure_coding/ec_locate.go:15
+(`LocateData`) and :77 (`ToShardIdAndOffset`), generalized to configurable
+geometry. A volume's bytes are striped row-major over d data shards in two
+tiers: while >= d * large_block bytes remain, a row of d large blocks; then
+rows of d small blocks (tail row zero-padded). Shard file i concatenates its
+block from every row, so each shard stays byte-contiguous per row — the
+property that lets encode stream 256 KB-aligned slabs and lets reads hit one
+shard for most needles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Defaults match the reference (ec_encoder.go:17-23): 1 GB / 1 MB.
+LARGE_BLOCK = 1 << 30
+SMALL_BLOCK = 1 << 20
+
+
+@dataclass(frozen=True)
+class EcGeometry:
+    d: int = 10
+    p: int = 4
+    large_block: int = LARGE_BLOCK
+    small_block: int = SMALL_BLOCK
+
+    @property
+    def n(self) -> int:
+        return self.d + self.p
+
+    def large_rows(self, dat_size: int) -> int:
+        """Number of large rows (reference encodeDatFile loop :218-233)."""
+        rows = 0
+        remaining = dat_size
+        while remaining > self.large_block * self.d:
+            rows += 1
+            remaining -= self.large_block * self.d
+        return rows
+
+    def small_rows(self, dat_size: int) -> int:
+        remaining = dat_size - self.large_rows(dat_size) * self.large_block * self.d
+        per_row = self.small_block * self.d
+        return (remaining + per_row - 1) // per_row
+
+    def shard_file_size(self, dat_size: int) -> int:
+        return (self.large_rows(dat_size) * self.large_block
+                + self.small_rows(dat_size) * self.small_block)
+
+    def padded_size(self, dat_size: int) -> int:
+        """Logical size after zero-padding the final small row."""
+        return self.shard_file_size(dat_size) * self.d
+
+
+@dataclass(frozen=True)
+class Interval:
+    """One contiguous span inside a single block of the stripe layout."""
+    block_index: int      # global block number in row-major order
+    inner_offset: int
+    size: int
+    is_large: bool
+    large_rows: int       # context needed to map to shard offsets
+
+    def shard_and_offset(self, geo: EcGeometry) -> tuple[int, int]:
+        """(shard_id, byte offset within that shard's file).
+
+        Reference ec_locate.go:77 ToShardIdAndOffset.
+        """
+        shard = self.block_index % geo.d
+        row = self.block_index // geo.d
+        if self.is_large:
+            return shard, row * geo.large_block + self.inner_offset
+        base = self.large_rows * geo.large_block
+        small_row = row - self.large_rows  # rows count continues after large rows
+        return shard, base + small_row * geo.small_block + self.inner_offset
+
+
+def locate(geo: EcGeometry, dat_size: int, offset: int, size: int) -> list[Interval]:
+    """Split [offset, offset+size) of the logical volume into block intervals."""
+    n_large = geo.large_rows(dat_size)
+    large_zone = n_large * geo.large_block * geo.d
+    out: list[Interval] = []
+    pos, remaining = offset, size
+    while remaining > 0:
+        if pos < large_zone:
+            block, inner = divmod(pos, geo.large_block)
+            take = min(remaining, geo.large_block - inner)
+            out.append(Interval(block, inner, take, True, n_large))
+        else:
+            rel = pos - large_zone
+            sblock, inner = divmod(rel, geo.small_block)
+            take = min(remaining, geo.small_block - inner)
+            # global block index continues: small blocks sit after large rows
+            out.append(Interval(n_large * geo.d + sblock, inner, take, False, n_large))
+        pos += take
+        remaining -= take
+    return out

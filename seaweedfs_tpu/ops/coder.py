@@ -1,0 +1,91 @@
+"""Pluggable ErasureCoder interface — the north-star seam.
+
+BASELINE.json: "...gated behind a new pluggable ErasureCoder interface so the
+default [CPU] path is untouched". Implementations:
+
+* ``NumpyCoder`` — pure-numpy GF tables; correctness oracle, slow.
+* ``NativeCoder`` — C++ sidecar (seaweedfs_tpu/native), AVX2 PSHUFB split
+  tables: the faithful stand-in for klauspost/reedsolomon's asm, used as the
+  CPU baseline that `vs_baseline` is measured against.
+* ``JaxCoder`` — the TPU path (ops/rs_jax bit-matmul; Pallas kernel when
+  available), batching [B, d, L] stripe tensors through the device.
+
+All coders operate on uint8 arrays shaped [d, L] / [B, d, L] and are
+stateless w.r.t. data; geometry is fixed per instance.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from . import gf8
+
+
+class ErasureCoder(abc.ABC):
+    def __init__(self, d: int, p: int):
+        if d <= 0 or p <= 0 or d + p > 256:
+            raise ValueError(f"invalid RS geometry ({d},{p})")
+        self.d = d
+        self.p = p
+        self.n = d + p
+
+    @abc.abstractmethod
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [..., d, L] uint8 -> parity [..., p, L] uint8."""
+
+    @abc.abstractmethod
+    def reconstruct(self, survivors: np.ndarray, present: tuple[int, ...],
+                    wanted: tuple[int, ...]) -> np.ndarray:
+        """survivors [..., d, L] = shards sorted(present)[:d] -> [..., |wanted|, L]."""
+
+    def verify(self, shards: np.ndarray) -> bool:
+        """shards [..., n, L]: recompute parity from data rows and compare."""
+        data = shards[..., : self.d, :]
+        parity = shards[..., self.d:, :]
+        return bool(np.array_equal(np.asarray(self.encode(data)), np.asarray(parity)))
+
+
+class NumpyCoder(ErasureCoder):
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        data = np.asarray(data, dtype=np.uint8)
+        if data.ndim == 2:
+            return gf8.np_encode(data, self.p)
+        return np.stack([gf8.np_encode(b, self.p) for b in data])
+
+    def reconstruct(self, survivors, present, wanted):
+        survivors = np.asarray(survivors, dtype=np.uint8)
+        rec = gf8.decode_matrix(self.d, self.p, list(present))[list(wanted), :]
+        if survivors.ndim == 2:
+            return gf8.np_gf_apply(rec, survivors)
+        return np.stack([gf8.np_gf_apply(rec, b) for b in survivors])
+
+
+class JaxCoder(ErasureCoder):
+    """Device coder. Accepts numpy or jax arrays; returns device arrays
+    (callers `np.asarray` when they need host bytes)."""
+
+    def encode(self, data):
+        from . import rs_jax
+        return rs_jax.encode_jit(data, self.d, self.p)
+
+    def reconstruct(self, survivors, present, wanted):
+        from . import rs_jax
+        return rs_jax.reconstruct_jit(
+            survivors, tuple(sorted(present)), tuple(wanted), self.d, self.p)
+
+
+_REGISTRY = {"numpy": NumpyCoder, "jax": JaxCoder}
+
+
+def get_coder(name: str, d: int, p: int) -> ErasureCoder:
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown coder {name!r}; have {sorted(_REGISTRY)}") from None
+    return cls(d, p)
+
+
+def register_coder(name: str, cls) -> None:
+    _REGISTRY[name] = cls

@@ -182,7 +182,13 @@ def device_crc_states(blocks, chunk: int = 512):
         ) & 1
         return nxt.astype(jnp.int8), None
 
-    init = jnp.zeros((b, 32), dtype=jnp.int8)
-    state, _ = jax.lax.scan(step, init, steps)
+    if steps.shape[0] == 0:
+        # no chunks: state stays zero (plain zeros are fine; scan never runs)
+        state = jnp.zeros((b, 32), dtype=jnp.int8)
+    else:
+        # derive the zero init from the input so it carries the same
+        # varying-axes marking under shard_map (scan needs matching carry types)
+        init = jnp.tile((steps[0, :, :1] & 0).astype(jnp.int8), (1, 32))
+        state, _ = jax.lax.scan(step, init, steps)
     weights = jnp.asarray([np.uint32(1 << i) for i in range(32)], dtype=jnp.uint32)
     return jnp.sum(state.astype(jnp.uint32) * weights, axis=1)

@@ -1,0 +1,139 @@
+"""Sharded EC compute steps over a ('data', 'shard') mesh via shard_map.
+
+The multi-chip execution plan (SURVEY.md §5): stripe batches ride the
+``data`` axis (pure data parallelism — volumes are independent), the n
+output shards are partitioned along the ``shard`` axis (each device computes
+and "owns" a subset of shards, like servers own shards in the reference), and
+rebuild all_gathers survivors along ``shard`` over ICI before the masked
+inverse matmul — the device-side analogue of store_ec.go:367-400's fan-out
+shard fetch. Scrub reduces mismatch counts with a psum over the whole mesh.
+
+All entry points take/return global arrays with NamedShardings; shapes are
+static per (geometry, batch) so XLA compiles each once.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import gf8
+from ..ops.crc32c import device_crc_states
+from ..ops.rs_jax import pack_bits, unpack_bits
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# -- encode -----------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _padded_parity_bitmatrix(d: int, p: int, p_pad: int) -> np.ndarray:
+    full = gf8.expand_to_bits(gf8.parity_matrix(d, p)).astype(np.int8)
+    out = np.zeros((8 * p_pad, 8 * d), dtype=np.int8)
+    out[: 8 * p, :] = full
+    out.setflags(write=False)
+    return out
+
+
+def encode_sharded(mesh: Mesh, data: jax.Array, d: int, p: int) -> jax.Array:
+    """data [B, d, L] -> parity [B, p_pad, L]; B over 'data', parity rows
+    partitioned over 'shard' (p padded up to the shard-axis size)."""
+    n_shard = mesh.shape["shard"]
+    p_pad = _ceil_to(p, n_shard)
+    rows_per = p_pad // n_shard
+    bmat = jnp.asarray(_padded_parity_bitmatrix(d, p, p_pad))
+
+    def kernel(x):  # x: [B_loc, d, L] replicated over 'shard'
+        idx = jax.lax.axis_index("shard")
+        sub = jax.lax.dynamic_slice_in_dim(bmat, idx * rows_per * 8, rows_per * 8, 0)
+        bits = unpack_bits(x)  # [B_loc, 8d, L]
+        acc = jnp.einsum("pk,bkl->bpl", sub, bits,
+                         preferred_element_type=jnp.int32)
+        return pack_bits(acc & 1)  # [B_loc, rows_per, L]
+
+    fn = jax.shard_map(kernel, mesh=mesh,
+                       in_specs=P("data", None, None),
+                       out_specs=P("data", "shard", None))
+    return fn(data)
+
+
+# -- rebuild ----------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _padded_decode_bitmatrix(d: int, p: int, present: tuple[int, ...],
+                             n_pad: int) -> np.ndarray:
+    """Decode matrix producing ALL n_pad shard slots from d survivors."""
+    rec = gf8.decode_matrix(d, p, list(present))  # [n, d]
+    full = gf8.expand_to_bits(rec).astype(np.int8)
+    out = np.zeros((8 * n_pad, 8 * d), dtype=np.int8)
+    out[: 8 * (d + p), :] = full
+    out.setflags(write=False)
+    return out
+
+
+def rebuild_sharded(mesh: Mesh, shards: jax.Array,
+                    present: tuple[int, ...], d: int, p: int) -> jax.Array:
+    """shards [B, n_pad, L] (shard axis partitioned over 'shard'; lost rows
+    are garbage) -> all n_pad shards recomputed, same layout.
+
+    Each device all_gathers the survivor rows along 'shard' (ICI) and then
+    reconstructs only the shard rows it owns.
+    """
+    n = d + p
+    n_shard = mesh.shape["shard"]
+    n_pad = shards.shape[1]
+    assert n_pad % n_shard == 0 and n_pad >= n
+    rows_per = n_pad // n_shard
+    use = tuple(sorted(present)[:d])
+    bmat = jnp.asarray(_padded_decode_bitmatrix(d, p, use, n_pad))
+    sel = jnp.asarray(np.array(use, dtype=np.int32))
+
+    def kernel(x):  # x: [B_loc, rows_per, L] — this device's shard rows
+        allsh = jax.lax.all_gather(x, "shard", axis=1, tiled=True)  # [B, n_pad, L]
+        survivors = jnp.take(allsh, sel, axis=1)  # [B, d, L]
+        idx = jax.lax.axis_index("shard")
+        sub = jax.lax.dynamic_slice_in_dim(bmat, idx * rows_per * 8, rows_per * 8, 0)
+        bits = unpack_bits(survivors)
+        acc = jnp.einsum("pk,bkl->bpl", sub, bits,
+                         preferred_element_type=jnp.int32)
+        return pack_bits(acc & 1)
+
+    fn = jax.shard_map(kernel, mesh=mesh,
+                       in_specs=P("data", "shard", None),
+                       out_specs=P("data", "shard", None))
+    return fn(shards)
+
+
+# -- scrub ------------------------------------------------------------------
+
+def scrub_sharded(mesh: Mesh, blocks: jax.Array, expected_states: jax.Array,
+                  chunk: int = 256) -> jax.Array:
+    """Batched CRC scrub: blocks [B, L] (left-zero-padded needles), expected
+    raw CRC states [B] uint32. Returns global mismatch count (replicated).
+
+    B is sharded across the entire mesh (both axes) — scrub is pure dp; the
+    reduction is one psum. Reference analogue: volume_checking.go:91 per
+    needle, volume.check.disk over replicas.
+    """
+
+    def kernel(x, exp):
+        states = device_crc_states(x, chunk)
+        bad = jnp.sum((states != exp).astype(jnp.int32))
+        return jax.lax.psum(bad, ("data", "shard"))
+
+    fn = jax.shard_map(kernel, mesh=mesh,
+                       in_specs=(P(("data", "shard"), None), P(("data", "shard"))),
+                       out_specs=P())
+    return fn(blocks, expected_states)
+
+
+# -- helpers ----------------------------------------------------------------
+
+def shard_put(mesh: Mesh, arr: np.ndarray, spec: P) -> jax.Array:
+    return jax.device_put(arr, NamedSharding(mesh, spec))

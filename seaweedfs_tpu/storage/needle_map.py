@@ -1,0 +1,213 @@
+"""In-memory needle index: id -> (offset, size), plus the .idx append log.
+
+Reference equivalents: weed/storage/needle_map.go (NeedleMapper),
+compact_map.go:202-268 (CompactMap: 16 B/entry sectioned sorted arrays),
+idx/walk.go (WalkIndexFile). Our CompactMap keeps the same asymptotics with a
+numpy flavor: a sorted base (three parallel arrays, binary-searched) plus a
+small dict overlay for recent writes that is merged down when it grows. This
+keeps steady-state memory near 20 B/needle and lookups O(log n).
+
+.idx entry (16 B, little-endian): needle_id u64 | offset u32 (/8) | size u32.
+Tombstones are written as size = 0xFFFFFFFF with offset 0 (reference writes
+deletes to the idx the same way, needle_map.go).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+import numpy as np
+
+from . import types as t
+
+_ENTRY = struct.Struct("<QII")
+
+
+@dataclass
+class NeedleValue:
+    key: int
+    offset: int  # actual byte offset in .dat
+    size: int    # body size from header (not padded record size)
+
+
+class CompactMap:
+    """id -> (offset/8 stored, size) with numpy sorted base + dict overlay."""
+
+    MERGE_THRESHOLD = 65536
+
+    def __init__(self) -> None:
+        self._keys = np.empty(0, dtype=np.uint64)
+        self._offsets = np.empty(0, dtype=np.uint32)
+        self._sizes = np.empty(0, dtype=np.uint32)
+        self._overlay: dict[int, tuple[int, int]] = {}
+
+    def __len__(self) -> int:
+        # approximate live count: base + overlay (minus overlap, ignored)
+        return int(self._keys.size) + len(self._overlay)
+
+    def _merge(self) -> None:
+        if not self._overlay:
+            return
+        ok = np.fromiter(self._overlay.keys(), dtype=np.uint64, count=len(self._overlay))
+        ov = np.array(list(self._overlay.values()), dtype=np.uint32).reshape(-1, 2)
+        keys = np.concatenate([self._keys, ok])
+        offsets = np.concatenate([self._offsets, ov[:, 0]])
+        sizes = np.concatenate([self._sizes, ov[:, 1]])
+        # stable sort; later (overlay) entries win on duplicates
+        order = np.argsort(keys, kind="stable")
+        keys, offsets, sizes = keys[order], offsets[order], sizes[order]
+        if keys.size:
+            last = np.ones(keys.size, dtype=bool)
+            last[:-1] = keys[:-1] != keys[1:]
+            keys, offsets, sizes = keys[last], offsets[last], sizes[last]
+        self._keys, self._offsets, self._sizes = keys, offsets, sizes
+        self._overlay.clear()
+
+    def set(self, key: int, stored_offset: int, size: int) -> None:
+        self._overlay[key] = (stored_offset, size & 0xFFFFFFFF)
+        if len(self._overlay) >= self.MERGE_THRESHOLD:
+            self._merge()
+
+    def delete(self, key: int) -> bool:
+        existed = self.get(key) is not None
+        self._overlay[key] = (0, t.TOMBSTONE_SIZE)
+        if len(self._overlay) >= self.MERGE_THRESHOLD:
+            self._merge()
+        return existed
+
+    def get(self, key: int) -> NeedleValue | None:
+        v = self._overlay.get(key)
+        if v is None and self._keys.size:
+            i = int(np.searchsorted(self._keys, np.uint64(key)))
+            if i < self._keys.size and int(self._keys[i]) == key:
+                v = (int(self._offsets[i]), int(self._sizes[i]))
+        if v is None or t.is_tombstone(v[1]):
+            return None
+        return NeedleValue(key, t.stored_to_offset(v[0]), v[1])
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        self._merge()
+        for i in range(self._keys.size):
+            sz = int(self._sizes[i])
+            if not t.is_tombstone(sz):
+                fn(NeedleValue(int(self._keys[i]), t.stored_to_offset(int(self._offsets[i])), sz))
+
+    def items_arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Sorted live (keys, stored_offsets, sizes) — feeds the EC .ecx writer
+        and device batch pipelines without per-entry Python overhead."""
+        self._merge()
+        live = ~np.equal(self._sizes, np.uint32(t.TOMBSTONE_SIZE))
+        return self._keys[live], self._offsets[live], self._sizes[live]
+
+
+class NeedleMap:
+    """CompactMap + .idx append log + live-bytes accounting.
+
+    Mirrors reference NeedleMap (needle_map_memory.go): every set/delete is
+    appended to the .idx so the map can be rebuilt on restart.
+    """
+
+    def __init__(self, idx_path: str):
+        self.idx_path = idx_path
+        self.map = CompactMap()
+        self.file_counter = 0
+        self.deleted_counter = 0
+        self.data_size = 0          # bytes of live needle bodies
+        self.deleted_size = 0
+        self.max_key = 0
+        self._idx = open(idx_path, "ab")
+        if os.path.getsize(idx_path):
+            self._load()
+
+    def _load(self) -> None:
+        for key, stored_off, size in walk_idx_file(self.idx_path):
+            self.max_key = max(self.max_key, key)
+            if t.is_tombstone(size):
+                old = self.map.get(key)
+                if old is not None:
+                    self.deleted_counter += 1
+                    self.deleted_size += old.size
+                self.map.delete(key)
+            else:
+                old = self.map.get(key)
+                if old is not None:
+                    self.deleted_counter += 1
+                    self.deleted_size += old.size
+                self.map.set(key, stored_off, size)
+                self.file_counter += 1
+                self.data_size += size
+
+    def put(self, key: int, actual_offset: int, size: int) -> None:
+        old = self.map.get(key)
+        if old is not None:
+            # overwrite: the previous record becomes garbage (reference
+            # needle_map_memory.go counts it toward deletion accounting)
+            self.deleted_counter += 1
+            self.deleted_size += old.size
+        stored = t.offset_to_stored(actual_offset)
+        self.map.set(key, stored, size)
+        self.file_counter += 1
+        self.data_size += size
+        self.max_key = max(self.max_key, key)
+        self._idx.write(_ENTRY.pack(key, stored, size & 0xFFFFFFFF))
+
+    def delete(self, key: int) -> bool:
+        old = self.map.get(key)
+        if old is None:
+            return False
+        self.map.delete(key)
+        self.deleted_counter += 1
+        self.deleted_size += old.size
+        self._idx.write(_ENTRY.pack(key, 0, t.TOMBSTONE_SIZE))
+        return True
+
+    def get(self, key: int) -> NeedleValue | None:
+        return self.map.get(key)
+
+    def flush(self) -> None:
+        self._idx.flush()
+        os.fsync(self._idx.fileno())
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._idx.close()
+
+    @property
+    def live_count(self) -> int:
+        return self.file_counter - self.deleted_counter
+
+
+def walk_idx_file(path: str) -> Iterator[tuple[int, int, int]]:
+    """Yield (key, stored_offset, size) for every entry (reference idx/walk.go)."""
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(t.IDX_ENTRY_SIZE * 4096)
+            if not chunk:
+                return
+            usable = len(chunk) - len(chunk) % t.IDX_ENTRY_SIZE
+            for i in range(0, usable, t.IDX_ENTRY_SIZE):
+                yield _ENTRY.unpack_from(chunk, i)
+
+
+def idx_entries_numpy(path: str) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized .idx read -> (keys u64, stored_offsets u32, sizes u32)."""
+    raw = np.fromfile(path, dtype=np.uint8)
+    usable = raw.size - raw.size % t.IDX_ENTRY_SIZE
+    raw = raw[:usable].reshape(-1, t.IDX_ENTRY_SIZE)
+    keys = raw[:, 0:8].copy().view("<u8").ravel()
+    offs = raw[:, 8:12].copy().view("<u4").ravel()
+    sizes = raw[:, 12:16].copy().view("<u4").ravel()
+    return keys, offs, sizes
+
+
+def write_idx_entries(path: str, keys, stored_offsets, sizes) -> None:
+    arr = np.empty((len(keys), t.IDX_ENTRY_SIZE), dtype=np.uint8)
+    arr[:, 0:8] = np.asarray(keys, dtype="<u8").reshape(-1, 1).view(np.uint8).reshape(-1, 8)
+    arr[:, 8:12] = np.asarray(stored_offsets, dtype="<u4").reshape(-1, 1).view(np.uint8).reshape(-1, 4)
+    arr[:, 12:16] = np.asarray(sizes, dtype="<u4").reshape(-1, 1).view(np.uint8).reshape(-1, 4)
+    arr.tofile(path)

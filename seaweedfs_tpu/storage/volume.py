@@ -1,0 +1,201 @@
+"""Volume: one append-only .dat file + its .idx needle index.
+
+Reference equivalents: weed/storage/volume.go, volume_write.go:111-180,
+volume_read.go, volume_loading.go, volume_checking.go:17
+(CheckAndFixVolumeDataIntegrity: validate the last idx entry against the .dat,
+truncate torn tails).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from . import types as t
+from .needle import Needle, record_size_from_header
+from .needle_map import NeedleMap, idx_entries_numpy
+from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+
+class Volume:
+    def __init__(self, dirname: str, collection: str, vid: int,
+                 replica_placement: t.ReplicaPlacement | None = None,
+                 ttl: t.TTL | None = None,
+                 create_if_missing: bool = True):
+        self.dir = dirname
+        self.collection = collection
+        self.id = vid
+        self.read_only = False
+        self.last_append_at_ns = 0
+        self._lock = threading.RLock()
+
+        base = self.file_name()
+        self.dat_path = base + ".dat"
+        self.idx_path = base + ".idx"
+        exists = os.path.exists(self.dat_path)
+        if not exists and not create_if_missing:
+            raise FileNotFoundError(self.dat_path)
+        if not exists:
+            self.super_block = SuperBlock(
+                replica_placement=replica_placement or t.ReplicaPlacement(),
+                ttl=ttl or t.TTL())
+            with open(self.dat_path, "wb") as f:
+                f.write(self.super_block.to_bytes())
+        self._dat = open(self.dat_path, "r+b")
+        self.super_block = SuperBlock.from_bytes(self._dat.read(SUPER_BLOCK_SIZE))
+        self.nm = NeedleMap(self.idx_path)
+        self._check_integrity()
+
+    # -- naming ------------------------------------------------------------
+    def file_name(self) -> str:
+        name = f"{self.collection}_{self.id}" if self.collection else str(self.id)
+        return os.path.join(self.dir, name)
+
+    # -- integrity (reference volume_checking.go:17) -----------------------
+    def _check_integrity(self) -> None:
+        """Find the end of the last whole record; truncate any torn tail.
+
+        Starts from the highest offset the .idx knows about (cheap), then
+        walks record headers forward — the same repair the reference does at
+        load (volume_checking.go:17), generalized to also cover appended
+        tombstones whose idx entries carry no offset.
+        """
+        dat_size = os.path.getsize(self.dat_path)
+        start = SUPER_BLOCK_SIZE
+        if os.path.getsize(self.idx_path):
+            _, offs, sizes = idx_entries_numpy(self.idx_path)
+            live = sizes != t.TOMBSTONE_SIZE
+            if live.any():
+                starts = offs[live].astype("int64") * t.NEEDLE_PADDING
+                i = int(starts.argmax())
+                off = int(starts[i])
+                rec = record_size_from_header(int(sizes[live][i]))
+                if off + rec <= dat_size:
+                    start = off + rec
+                else:
+                    start = off  # torn final record: rescan will drop it
+        end = self._scan_forward(start, dat_size)
+        if end < dat_size:
+            self._dat.truncate(end)
+            # drop idx entries pointing past the truncation point
+            for key in list(self._keys_past(end)):
+                self.nm.delete(key)
+        self._append_offset = max(end, SUPER_BLOCK_SIZE)
+
+    def _keys_past(self, end: int):
+        keys, offs, sizes = self.nm.map.items_arrays()
+        for i in range(keys.size):
+            if t.stored_to_offset(int(offs[i])) >= end:
+                yield int(keys[i])
+
+    def _scan_forward(self, start: int, dat_size: int) -> int:
+        """Walk records from `start`; return the end of the last whole record."""
+        pos = start
+        while pos + t.NEEDLE_HEADER_SIZE <= dat_size:
+            self._dat.seek(pos)
+            hdr = self._dat.read(t.NEEDLE_HEADER_SIZE)
+            if len(hdr) < t.NEEDLE_HEADER_SIZE:
+                break
+            import struct
+            _, _, size = struct.unpack("<IQI", hdr)
+            rec = record_size_from_header(size)
+            if pos + rec > dat_size:
+                break
+            pos += rec
+        return pos
+
+    # -- write path (reference volume_write.go:119 writeNeedle2) -----------
+    def write_needle(self, n: Needle) -> int:
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read-only")
+            rec = n.to_bytes()
+            off = self._append_offset
+            if off + len(rec) > t.MAX_VOLUME_SIZE:
+                raise OSError(f"volume {self.id} exceeds max size")
+            self._dat.seek(off)
+            self._dat.write(rec)
+            self._append_offset = off + len(rec)
+            self.nm.put(n.id, off, self._body_size(rec))
+            self.last_append_at_ns = n.append_at_ns
+            return off
+
+    @staticmethod
+    def _body_size(rec: bytes) -> int:
+        import struct
+        _, _, size = struct.unpack_from("<IQI", rec, 0)
+        return size
+
+    def delete_needle(self, needle_id: int, cookie: int = 0) -> bool:
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read-only")
+            if self.nm.get(needle_id) is None:
+                return False
+            rec = Needle.tombstone(needle_id, cookie)
+            self._dat.seek(self._append_offset)
+            self._dat.write(rec)
+            self._append_offset += len(rec)
+            return self.nm.delete(needle_id)
+
+    # -- read path (reference volume_read.go) ------------------------------
+    def read_needle(self, needle_id: int, cookie: int | None = None,
+                    verify_crc: bool = True) -> Needle:
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is None:
+                raise KeyError(f"needle {needle_id:x} not found in volume {self.id}")
+            rec_len = record_size_from_header(nv.size)
+            self._dat.seek(nv.offset)
+            buf = self._dat.read(rec_len)
+        n = Needle.from_bytes(buf, verify_crc=verify_crc)
+        if n.id != needle_id:
+            raise ValueError(f"needle id mismatch at offset {nv.offset}")
+        if cookie is not None and n.cookie != cookie:
+            raise PermissionError(f"cookie mismatch for needle {needle_id:x}")
+        return n
+
+    def read_raw(self, offset: int, length: int) -> bytes:
+        with self._lock:
+            self._dat.seek(offset)
+            return self._dat.read(length)
+
+    # -- stats -------------------------------------------------------------
+    @property
+    def content_size(self) -> int:
+        return self._append_offset
+
+    @property
+    def file_count(self) -> int:
+        return self.nm.live_count
+
+    @property
+    def deleted_count(self) -> int:
+        return self.nm.deleted_counter
+
+    def garbage_ratio(self) -> float:
+        used = self._append_offset - SUPER_BLOCK_SIZE
+        if used <= 0:
+            return 0.0
+        return self.nm.deleted_size / max(used, 1)
+
+    def sync(self) -> None:
+        with self._lock:
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self.nm.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._dat.flush()
+            finally:
+                self._dat.close()
+                self.nm.close()
+
+    def destroy(self) -> None:
+        self.close()
+        for ext in (".dat", ".idx"):
+            p = self.file_name() + ext
+            if os.path.exists(p):
+                os.remove(p)

@@ -1,15 +1,22 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force an 8-device virtual CPU mesh before any test runs.
 
-Multi-chip sharding (parallel/) is validated on virtual CPU devices; the real
-TPU path is exercised by bench.py and the driver's __graft_entry__ checks.
+The axon TPU plugin (sitecustomize) programmatically sets
+jax_platforms="axon,cpu" at interpreter start, overriding the JAX_PLATFORMS
+env var — so we must update jax.config AFTER importing jax, before any
+backend initializes. Multi-chip sharding (parallel/) is then validated on
+virtual CPU devices; the real TPU path is exercised by bench.py and the
+driver's __graft_entry__ checks.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
