@@ -1,0 +1,224 @@
+"""CLI entrypoint: python -m seaweedfs_tpu <verb> (reference weed/command).
+
+Verbs (subset of reference command/command.go:12-44, growing):
+  master   - run a master server
+  volume   - run a volume server
+  server   - master + volume (+filer later) in one process (command/server.go)
+  shell    - admin REPL (weed shell)
+  upload   - assign + upload files
+  download - fetch by fid
+  fix      - rebuild a .idx from a .dat (reference command/fix.go:74)
+  benchmark- built-in load test (reference command/benchmark.go)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _add_master_flags(p):
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
+    p.add_argument("-defaultReplication", default="000")
+
+
+def _add_volume_flags(p):
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-grpcPort", type=int, default=0)
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-dir", default="./data", nargs="?")
+    p.add_argument("-max", type=int, default=8)
+    p.add_argument("-dataCenter", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-disk", default="hdd")
+    p.add_argument("-coder", default="auto",
+                   help="erasure coder: auto|jax|native|numpy")
+
+
+def run_master(argv):
+    from .master.master_server import MasterServer
+    p = argparse.ArgumentParser(prog="master")
+    _add_master_flags(p)
+    opt = p.parse_args(argv)
+    ms = MasterServer(ip=opt.ip, port=opt.port,
+                      volume_size_limit_mb=opt.volumeSizeLimitMB,
+                      default_replication=opt.defaultReplication)
+    ms.start()
+    _wait_forever()
+
+
+def run_volume(argv):
+    from .server.volume_server import VolumeServer
+    from .storage.disk_location import DiskLocation
+    from .storage.store import Store
+    p = argparse.ArgumentParser(prog="volume")
+    _add_volume_flags(p)
+    opt = p.parse_args(argv)
+    store = Store(opt.ip, opt.port, f"{opt.ip}:{opt.port}",
+                  [DiskLocation(opt.dir, opt.disk, opt.max)],
+                  coder_name=opt.coder)
+    vs = VolumeServer(store, opt.mserver, ip=opt.ip, port=opt.port,
+                      grpc_port=opt.grpcPort or None,
+                      data_center=opt.dataCenter, rack=opt.rack)
+    vs.start()
+    _wait_forever()
+
+
+def run_server(argv):
+    """Single-binary dev mode (reference command/server.go:176)."""
+    from .master.master_server import MasterServer
+    from .server.volume_server import VolumeServer
+    from .storage.disk_location import DiskLocation
+    from .storage.store import Store
+    p = argparse.ArgumentParser(prog="server")
+    _add_master_flags(p)
+    p.add_argument("-volumePort", type=int, default=8080)
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-max", type=int, default=8)
+    p.add_argument("-coder", default="auto")
+    p.add_argument("-filer", action="store_true")
+    p.add_argument("-filerPort", type=int, default=8888)
+    p.add_argument("-s3", action="store_true")
+    p.add_argument("-s3Port", type=int, default=8333)
+    opt = p.parse_args(argv)
+    ms = MasterServer(ip=opt.ip, port=opt.port,
+                      volume_size_limit_mb=opt.volumeSizeLimitMB,
+                      default_replication=opt.defaultReplication)
+    ms.start()
+    store = Store(opt.ip, opt.volumePort, f"{opt.ip}:{opt.volumePort}",
+                  [DiskLocation(opt.dir, "hdd", opt.max)],
+                  coder_name=opt.coder)
+    vs = VolumeServer(store, f"{opt.ip}:{opt.port}", ip=opt.ip,
+                      port=opt.volumePort)
+    vs.start()
+    if opt.filer or opt.s3:
+        from .filer.filer_server import FilerServer
+        fs = FilerServer(master_address=f"{opt.ip}:{opt.port}",
+                         ip=opt.ip, port=opt.filerPort,
+                         store_dir=opt.dir + "/filer")
+        fs.start()
+        if opt.s3:
+            from .s3.s3_server import S3Server
+            s3 = S3Server(filer=fs, ip=opt.ip, port=opt.s3Port)
+            s3.start()
+    _wait_forever()
+
+
+def run_shell(argv):
+    from .shell import ec_commands, volume_commands  # noqa: F401 (register)
+    from .shell.commands import CommandEnv, repl, run_command
+    p = argparse.ArgumentParser(prog="shell")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-c", dest="script", default="",
+                   help="run semicolon-separated commands and exit")
+    opt = p.parse_args(argv)
+    env = CommandEnv(opt.master)
+    if opt.script:
+        for line in opt.script.split(";"):
+            if not run_command(env, line):
+                break
+        env.release_lock()
+    else:
+        repl(env)
+
+
+def run_upload(argv):
+    from .client import operation
+    from .client.master_client import MasterClient
+    p = argparse.ArgumentParser(prog="upload")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("files", nargs="+")
+    opt = p.parse_args(argv)
+    mc = MasterClient(opt.master)
+    import json
+    import mimetypes
+    import os
+    for path in opt.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        mime = mimetypes.guess_type(path)[0] or ""
+        res = operation.submit(mc, data, name=os.path.basename(path),
+                               mime=mime, collection=opt.collection,
+                               replication=opt.replication)
+        print(json.dumps({"file": path, "fid": res.fid, "size": res.size,
+                          "url": f"{res.url}/{res.fid}"}))
+
+
+def run_download(argv):
+    from .client import operation
+    from .client.master_client import MasterClient
+    p = argparse.ArgumentParser(prog="download")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-o", dest="output", default="")
+    p.add_argument("fids", nargs="+")
+    opt = p.parse_args(argv)
+    mc = MasterClient(opt.master)
+    for fid in opt.fids:
+        data = operation.read(mc, fid)
+        out = opt.output or fid.replace(",", "_")
+        with open(out, "wb") as f:
+            f.write(data)
+        print(f"{fid} -> {out} ({len(data)} bytes)")
+
+
+def run_fix(argv):
+    """Rebuild .idx by scanning the .dat (reference command/fix.go:74)."""
+    from .storage.volume import rebuild_idx_from_dat
+    p = argparse.ArgumentParser(prog="fix")
+    p.add_argument("dat_path")
+    opt = p.parse_args(argv)
+    if not opt.dat_path.endswith(".dat"):
+        p.error(f"{opt.dat_path!r} is not a .dat file")
+    idx = opt.dat_path[:-4] + ".idx"
+    n = rebuild_idx_from_dat(opt.dat_path, idx)
+    print(f"wrote {n} entries to {idx}")
+
+
+def run_benchmark(argv):
+    from .bench_tool import run as bench_run
+    bench_run(argv)
+
+
+def _wait_forever():
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("bye")
+
+
+VERBS = {
+    "master": run_master,
+    "volume": run_volume,
+    "server": run_server,
+    "shell": run_shell,
+    "upload": run_upload,
+    "download": run_download,
+    "fix": run_fix,
+    "benchmark": run_benchmark,
+}
+
+
+def main():
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
+        print("usage: python -m seaweedfs_tpu <verb> [flags]\n\nverbs:")
+        for v in VERBS:
+            print(f"  {v}")
+        return 0
+    verb = sys.argv[1]
+    fn = VERBS.get(verb)
+    if fn is None:
+        print(f"unknown verb {verb!r}", file=sys.stderr)
+        return 1
+    fn(sys.argv[2:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

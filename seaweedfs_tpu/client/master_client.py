@@ -1,0 +1,162 @@
+"""MasterClient: cached vid->locations map fed by the KeepConnected stream.
+
+Reference: weed/wdclient/masterclient.go (+ vid_map.go:72,191). Falls back to
+a LookupVolume RPC on cache miss (LookupFileIdWithFallback masterclient.go:59).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..pb import master_pb2 as pb
+from ..storage.types import parse_file_id
+from ..utils.log import logger
+from ..utils.rpc import MASTER_SERVICE, Stub
+
+log = logger("wdclient")
+
+
+class VidMap:
+    def __init__(self):
+        self.locations: dict[int, list[dict]] = {}
+        self.ec_locations: dict[int, list[dict]] = {}
+        self.lock = threading.RLock()
+
+    def add(self, vid: int, loc: dict, ec: bool = False) -> None:
+        with self.lock:
+            table = self.ec_locations if ec else self.locations
+            cur = table.setdefault(vid, [])
+            if not any(c["url"] == loc["url"] for c in cur):
+                cur.append(loc)
+
+    def remove(self, vid: int, url: str, ec: bool = False) -> None:
+        with self.lock:
+            table = self.ec_locations if ec else self.locations
+            cur = table.get(vid)
+            if cur:
+                table[vid] = [c for c in cur if c["url"] != url]
+                if not table[vid]:
+                    table.pop(vid, None)
+
+    def get(self, vid: int) -> list[dict]:
+        with self.lock:
+            return list(self.locations.get(vid, [])) or list(
+                self.ec_locations.get(vid, []))
+
+
+class MasterClient:
+    def __init__(self, master_address: str, client_type: str = "client",
+                 client_address: str = ""):
+        self.master_address = master_address
+        self.leader = master_address
+        self.client_type = client_type
+        self.client_address = client_address or f"pyclient-{random.getrandbits(24):x}"
+        self.vid_map = VidMap()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._connected = threading.Event()
+
+    # -- background vid-map subscription ------------------------------------
+    def start(self) -> "MasterClient":
+        self._thread = threading.Thread(target=self._keep_connected,
+                                        daemon=True, name="wdclient-kc")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_connected(self, timeout: float = 5.0) -> bool:
+        return self._connected.wait(timeout)
+
+    def _keep_connected(self) -> None:
+        while not self._stop.is_set():
+            try:
+                stub = Stub(self.leader, MASTER_SERVICE)
+
+                def reqs():
+                    yield pb.KeepConnectedRequest(
+                        client_type=self.client_type,
+                        client_address=self.client_address, version="swtpu")
+                    while not self._stop.is_set():
+                        time.sleep(1)
+                        return  # half-close after initial message
+
+                stream = stub.stream_stream("KeepConnected", reqs(),
+                                            pb.KeepConnectedRequest,
+                                            pb.KeepConnectedResponse)
+                self._connected.set()
+                for resp in stream:
+                    if self._stop.is_set():
+                        return
+                    vl = resp.volume_location
+                    if vl.leader and vl.leader != self.leader:
+                        self.leader = vl.leader
+                    if not vl.url:
+                        continue
+                    loc = {"url": vl.url, "public_url": vl.public_url,
+                           "grpc_port": vl.grpc_port}
+                    for vid in vl.new_vids:
+                        self.vid_map.add(vid, loc)
+                    for vid in vl.deleted_vids:
+                        self.vid_map.remove(vid, vl.url)
+                    for vid in vl.new_ec_vids:
+                        self.vid_map.add(vid, loc, ec=True)
+                    for vid in vl.deleted_ec_vids:
+                        self.vid_map.remove(vid, vl.url, ec=True)
+            except Exception as e:  # noqa: BLE001
+                if not self._stop.is_set():
+                    log.warning("keepconnected to %s: %s; retrying", self.leader, e)
+                    self._connected.clear()
+                    time.sleep(1)
+
+    # -- RPC helpers ---------------------------------------------------------
+    def _stub(self) -> Stub:
+        return Stub(self.leader, MASTER_SERVICE)
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl: str = "",
+               disk_type: str = "") -> pb.AssignResponse:
+        resp = self._stub().call("Assign", pb.AssignRequest(
+            count=count, collection=collection, replication=replication,
+            ttl=ttl, disk_type=disk_type), pb.AssignResponse)
+        if resp.error:
+            raise RuntimeError(f"assign: {resp.error}")
+        return resp
+
+    def lookup(self, vid: int) -> list[dict]:
+        cached = self.vid_map.get(vid)
+        if cached:
+            return cached
+        resp = self._stub().call("LookupVolume", pb.LookupVolumeRequest(
+            volume_or_file_ids=[str(vid)]), pb.LookupVolumeResponse)
+        for e in resp.volume_id_locations:
+            if e.error:
+                raise KeyError(e.error)
+            for l in e.locations:
+                self.vid_map.add(vid, {"url": l.url, "public_url": l.public_url,
+                                       "grpc_port": l.grpc_port})
+        return self.vid_map.get(vid)
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        vid, _, _ = parse_file_id(fid)
+        return [f"http://{l['public_url'] or l['url']}/{fid}"
+                for l in self.lookup(vid)]
+
+    def lookup_ec(self, vid: int) -> dict[int, list[str]]:
+        resp = self._stub().call("LookupEcVolume",
+                                 pb.LookupEcVolumeRequest(volume_id=vid),
+                                 pb.LookupEcVolumeResponse)
+        return {e.shard_id: [l.url for l in e.locations]
+                for e in resp.shard_id_locations}
+
+    def collection_list(self) -> list[str]:
+        resp = self._stub().call("CollectionList", pb.CollectionListRequest(),
+                                 pb.CollectionListResponse)
+        return [c.name for c in resp.collections]
+
+    def volume_list(self) -> pb.VolumeListResponse:
+        return self._stub().call("VolumeList", pb.VolumeListRequest(),
+                                 pb.VolumeListResponse)

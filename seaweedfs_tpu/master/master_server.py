@@ -1,0 +1,394 @@
+"""Master server: heartbeat ingest, client vid-map push, assign/lookup.
+
+Reference: weed/server/master_server.go:83, master_grpc_server.go:62
+(SendHeartbeat), :253 (KeepConnected), master_grpc_server_assign.go:38
+(Assign), master_grpc_server_volume.go:186 (LookupEcVolume). Single-leader
+for now (the raft seam is `is_leader`; a lease/raft backend plugs in there —
+reference runs seaweedfs/raft or hashicorp/raft).
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+
+from ..pb import master_pb2 as pb
+from ..storage.types import TTL, ReplicaPlacement, file_id
+from ..utils.log import logger
+from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
+from .sequencer import MemorySequencer, SnowflakeSequencer
+from .topology import EcShardInfo, Topology, VolumeInfo
+from .volume_growth import GrowRequest, VolumeGrowth
+from .volume_layout import LayoutRegistry
+
+log = logger("master")
+
+
+class MasterServer:
+    def __init__(self, ip: str = "127.0.0.1", port: int = 9333,
+                 volume_size_limit_mb: int = 30_000,
+                 default_replication: str = "000",
+                 sequencer: str = "memory",
+                 pulse_seconds: float = 5.0,
+                 garbage_threshold: float = 0.3):
+        self.ip = ip
+        self.port = port
+        self.address = f"{ip}:{port}"
+        self.topo = Topology(volume_size_limit_mb * 1024 * 1024)
+        self.layouts = LayoutRegistry(self.topo)
+        self.growth = VolumeGrowth(self.topo, allocate_fn=self._allocate_volume)
+        self.sequencer = (SnowflakeSequencer() if sequencer == "snowflake"
+                          else MemorySequencer())
+        self.default_replication = default_replication
+        self.pulse_seconds = pulse_seconds
+        self.garbage_threshold = garbage_threshold
+        self.is_leader = True
+        self._subscribers: dict[int, tuple[str, queue.Queue]] = {}
+        self._sub_seq = 0
+        self._sub_lock = threading.Lock()
+        self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
+        self._grpc = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        svc = self._build_service()
+        self._grpc = serve(f"{self.ip}:{self.port}", [svc])
+        threading.Thread(target=self._janitor, daemon=True,
+                         name="master-janitor").start()
+        log.info("master up at %s (leader)", self.address)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._grpc:
+            self._grpc.stop(grace=0.5)
+
+    # -- volume allocation RPC out to volume servers ------------------------
+    def _allocate_volume(self, node, vid: int, req: GrowRequest) -> None:
+        stub = Stub(node.grpc_address, VOLUME_SERVICE)
+        from ..pb import volume_server_pb2 as vpb
+        stub.call("AllocateVolume", vpb.AllocateVolumeRequest(
+            volume_id=vid, collection=req.collection,
+            replication=req.replication, ttl=req.ttl,
+            disk_type=req.disk_type), vpb.AllocateVolumeResponse)
+        # optimistic local registration; the next heartbeat confirms
+        v = VolumeInfo(id=vid, collection=req.collection,
+                       replica_placement=ReplicaPlacement.parse(req.replication),
+                       ttl=TTL.parse(req.ttl), disk_type=req.disk_type)
+        self.topo.incremental_volumes(node, [v], [])
+        self.layouts.register_volume(v)
+        self._broadcast_location(node, new_vids=[vid])
+
+    # -- broadcast to KeepConnected subscribers ------------------------------
+    def _broadcast(self, msg: pb.KeepConnectedResponse) -> None:
+        with self._sub_lock:
+            for _, q in self._subscribers.values():
+                try:
+                    q.put_nowait(msg)
+                except queue.Full:
+                    pass
+
+    def _broadcast_location(self, node, new_vids=(), deleted_vids=(),
+                            new_ec=(), deleted_ec=()) -> None:
+        self._broadcast(pb.KeepConnectedResponse(volume_location=pb.VolumeLocation(
+            url=node.url, public_url=node.public_url, grpc_port=node.grpc_port,
+            data_center=node.rack.dc.id if node.rack else "",
+            new_vids=list(new_vids), deleted_vids=list(deleted_vids),
+            new_ec_vids=list(new_ec), deleted_ec_vids=list(deleted_ec))))
+
+    # -- gRPC service --------------------------------------------------------
+    def _build_service(self) -> RpcService:
+        svc = RpcService(MASTER_SERVICE)
+        ms = self
+
+        @svc.stream_stream("SendHeartbeat", pb.Heartbeat, pb.HeartbeatResponse)
+        def send_heartbeat(request_iter, context):
+            node = None
+            try:
+                for hb in request_iter:
+                    node = ms._handle_heartbeat(hb, node)
+                    yield pb.HeartbeatResponse(
+                        volume_size_limit=ms.topo.volume_size_limit,
+                        leader=ms.address)
+            finally:
+                if node is not None:
+                    vids, ec_vids = ms.topo.unregister_node(node)
+                    log.info("node %s disconnected; dropped %d vols %d ec",
+                             node.id, len(vids), len(ec_vids))
+                    ms._broadcast_location(node, deleted_vids=vids,
+                                           deleted_ec=ec_vids)
+
+        @svc.stream_stream("KeepConnected", pb.KeepConnectedRequest,
+                           pb.KeepConnectedResponse)
+        def keep_connected(request_iter, context):
+            first = next(iter(request_iter))
+            q: queue.Queue = queue.Queue(maxsize=1024)
+            with ms._sub_lock:
+                ms._sub_seq += 1
+                sid = ms._sub_seq
+                ms._subscribers[sid] = (first.client_address, q)
+            log.info("client %s (%s) subscribed", first.client_address,
+                     first.client_type)
+            try:
+                # initial full vid map
+                for node in ms.topo.all_nodes():
+                    vids = sorted({v.id for v in node.all_volumes()})
+                    ec_vids = sorted({s.volume_id for s in node.all_ec_shards()})
+                    if vids or ec_vids:
+                        yield pb.KeepConnectedResponse(
+                            volume_location=pb.VolumeLocation(
+                                url=node.url, public_url=node.public_url,
+                                grpc_port=node.grpc_port,
+                                new_vids=vids, new_ec_vids=ec_vids,
+                                leader=ms.address))
+                while not ms._stop.is_set() and context.is_active():
+                    try:
+                        yield q.get(timeout=1.0)
+                    except queue.Empty:
+                        continue
+            finally:
+                with ms._sub_lock:
+                    ms._subscribers.pop(sid, None)
+
+        @svc.unary("Assign", pb.AssignRequest, pb.AssignResponse)
+        def assign(req, context):
+            return ms.do_assign(req)
+
+        @svc.unary("LookupVolume", pb.LookupVolumeRequest, pb.LookupVolumeResponse)
+        def lookup(req, context):
+            resp = pb.LookupVolumeResponse()
+            for vf in req.volume_or_file_ids:
+                entry = resp.volume_id_locations.add(volume_or_file_id=vf)
+                try:
+                    vid = int(vf.split(",")[0])
+                except ValueError:
+                    entry.error = f"bad volume id {vf!r}"
+                    continue
+                nodes = ms.topo.lookup(vid)
+                if not nodes and vid in ms.topo.ec_locations:
+                    seen = set()
+                    for sid_nodes in ms.topo.lookup_ec(vid).values():
+                        for n in sid_nodes:
+                            if n.id not in seen:
+                                seen.add(n.id)
+                                entry.locations.add(url=n.url,
+                                                    public_url=n.public_url,
+                                                    grpc_port=n.grpc_port)
+                    if not seen:
+                        entry.error = f"volume {vid} not found"
+                    continue
+                if not nodes:
+                    entry.error = f"volume {vid} not found"
+                    continue
+                for n in nodes:
+                    entry.locations.add(url=n.url, public_url=n.public_url,
+                                        grpc_port=n.grpc_port)
+            return resp
+
+        @svc.unary("LookupEcVolume", pb.LookupEcVolumeRequest,
+                   pb.LookupEcVolumeResponse)
+        def lookup_ec(req, context):
+            resp = pb.LookupEcVolumeResponse(volume_id=req.volume_id)
+            for sid, nodes in sorted(ms.topo.lookup_ec(req.volume_id).items()):
+                e = resp.shard_id_locations.add(shard_id=sid)
+                for n in nodes:
+                    e.locations.add(url=n.url, public_url=n.public_url,
+                                    grpc_port=n.grpc_port)
+            return resp
+
+        @svc.unary("Statistics", pb.StatisticsRequest, pb.StatisticsResponse)
+        def statistics(req, context):
+            total = used = files = 0
+            for node in ms.topo.all_nodes():
+                for v in node.all_volumes():
+                    if req.collection and v.collection != req.collection:
+                        continue
+                    used += v.size
+                    files += v.file_count
+                for d in node.disks.values():
+                    total += d.max_volume_count * ms.topo.volume_size_limit
+            return pb.StatisticsResponse(total_size=total, used_size=used,
+                                         file_count=files)
+
+        @svc.unary("CollectionList", pb.CollectionListRequest,
+                   pb.CollectionListResponse)
+        def collection_list(req, context):
+            resp = pb.CollectionListResponse()
+            for c in sorted(ms.topo.collections()):
+                resp.collections.add(name=c)
+            return resp
+
+        @svc.unary("EcCollectList", pb.EcCollectListRequest,
+                   pb.EcCollectListResponse)
+        def ec_collect_list(req, context):  # fork RPC (master.proto:28)
+            cols = sorted({c for c in ms.topo.ec_collections.values()})
+            return pb.EcCollectListResponse(collections=cols)
+
+        @svc.unary("VolumeList", pb.VolumeListRequest, pb.VolumeListResponse)
+        def volume_list(req, context):
+            return pb.VolumeListResponse(
+                topology_info=ms.topology_info(),
+                volume_size_limit_mb=ms.topo.volume_size_limit >> 20)
+
+        @svc.unary("VolumeListWithoutECVolume", pb.VolumeListWithoutECVolumeRequest,
+                   pb.VolumeListResponse)
+        def volume_list_no_ec(req, context):  # fork RPC (master.proto:30)
+            return pb.VolumeListResponse(
+                topology_info=ms.topology_info(include_ec=False),
+                volume_size_limit_mb=ms.topo.volume_size_limit >> 20)
+
+        @svc.unary("GetMasterConfiguration", pb.GetMasterConfigurationRequest,
+                   pb.GetMasterConfigurationResponse)
+        def get_conf(req, context):
+            return pb.GetMasterConfigurationResponse(
+                default_replication=ms.default_replication,
+                leader=ms.address,
+                volume_size_limit_m_b=ms.topo.volume_size_limit >> 20)
+
+        @svc.unary("LeaseAdminToken", pb.LeaseAdminTokenRequest,
+                   pb.LeaseAdminTokenResponse)
+        def lease_admin(req, context):
+            now = time.time_ns()
+            cur = ms._admin_locks.get(req.lock_name)
+            if cur and cur[0] != req.previous_token and now - cur[1] < 60e9:
+                context.abort(7, f"lock {req.lock_name} held by {cur[2]}")
+            token = random.getrandbits(63)
+            ms._admin_locks[req.lock_name] = (token, now, req.client_name)
+            return pb.LeaseAdminTokenResponse(token=token, lock_ts_ns=now)
+
+        @svc.unary("ReleaseAdminToken", pb.ReleaseAdminTokenRequest,
+                   pb.ReleaseAdminTokenResponse)
+        def release_admin(req, context):
+            cur = ms._admin_locks.get(req.lock_name)
+            if cur and cur[0] == req.previous_token:
+                ms._admin_locks.pop(req.lock_name, None)
+            return pb.ReleaseAdminTokenResponse()
+
+        @svc.unary("Ping", pb.PingRequest, pb.PingResponse)
+        def ping(req, context):
+            now = time.time_ns()
+            return pb.PingResponse(start_time_ns=now, remote_time_ns=now,
+                                   stop_time_ns=time.time_ns())
+
+        return svc
+
+    # -- heartbeat handling --------------------------------------------------
+    def _handle_heartbeat(self, hb: pb.Heartbeat, node):
+        if node is None:
+            node = self.topo.get_or_create_node(
+                hb.ip, hb.port, hb.grpc_port, hb.public_url,
+                hb.data_center, hb.rack, dict(hb.max_volume_counts))
+            log.info("node %s registered (dc=%s rack=%s)", node.id,
+                     hb.data_center, hb.rack)
+        node.last_seen = time.time()
+        if hb.max_file_key:
+            self.sequencer.set_max(hb.max_file_key)
+            node.max_file_key = hb.max_file_key
+
+        if hb.volumes or hb.has_no_volumes:
+            vols = [VolumeInfo.from_pb(m) for m in hb.volumes]
+            new, deleted = self.topo.sync_volumes(node, vols)
+            for v in vols:
+                self.layouts.register_volume(v)
+            for v in deleted:
+                self.layouts.unregister_volume(v)
+            if new or deleted:
+                self._broadcast_location(
+                    node, new_vids=[v.id for v in new],
+                    deleted_vids=[v.id for v in deleted])
+        if hb.ec_shards or hb.has_no_ec_shards:
+            shards = [EcShardInfo(m.id, m.collection, m.ec_index_bits,
+                                  m.disk_type or "hdd", m.destroy_time)
+                      for m in hb.ec_shards]
+            new, deleted = self.topo.sync_ec_shards(node, shards)
+            if new or deleted:
+                self._broadcast_location(
+                    node, new_ec=[s.volume_id for s in new],
+                    deleted_ec=[s.volume_id for s in deleted])
+        return node
+
+    # -- assign --------------------------------------------------------------
+    def do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
+        if not self.is_leader:
+            return pb.AssignResponse(error="not leader")
+        replication = req.replication or self.default_replication
+        disk_type = req.disk_type or "hdd"
+        layout = self.layouts.get(req.collection, replication, req.ttl, disk_type)
+        layout.ensure_correct_writables()
+        vid = layout.pick_for_write()
+        if vid is None:
+            try:
+                self.growth.grow(GrowRequest(
+                    collection=req.collection, replication=replication,
+                    ttl=req.ttl, disk_type=disk_type,
+                    preferred_dc=req.data_center, preferred_rack=req.rack,
+                    count=max(1, req.writable_volume_count or 1)))
+            except Exception as e:  # noqa: BLE001
+                return pb.AssignResponse(error=f"grow failed: {e}")
+            vid = layout.pick_for_write()
+            if vid is None:
+                return pb.AssignResponse(error="no writable volumes after growth")
+        count = max(1, req.count)
+        key = self.sequencer.next_id(count)
+        cookie = random.getrandbits(32)
+        nodes = self.topo.lookup(vid)
+        if not nodes:
+            return pb.AssignResponse(error=f"volume {vid} has no locations")
+        primary = random.choice(nodes)
+        resp = pb.AssignResponse(
+            fid=file_id(vid, key, cookie), count=count,
+            location=pb.Location(url=primary.url, public_url=primary.public_url,
+                                 grpc_port=primary.grpc_port))
+        for n in nodes:
+            resp.replicas.add(url=n.url, public_url=n.public_url,
+                              grpc_port=n.grpc_port)
+        return resp
+
+    # -- topology dump -------------------------------------------------------
+    def topology_info(self, include_ec: bool = True) -> pb.TopologyInfo:
+        t = pb.TopologyInfo(id="topo")
+        with self.topo.lock:
+            for dc in self.topo.dcs.values():
+                dci = t.data_center_infos.add(id=dc.id)
+                for rack in dc.racks.values():
+                    ri = dci.rack_infos.add(id=rack.id)
+                    for node in rack.nodes.values():
+                        ni = ri.data_node_infos.add(id=node.id,
+                                                    grpc_port=node.grpc_port)
+                        for dtype, disk in node.disks.items():
+                            di = ni.disk_infos[dtype]
+                            di.type = dtype
+                            di.volume_count = disk.volume_count
+                            di.max_volume_count = disk.max_volume_count
+                            di.free_volume_count = disk.free_slots()
+                            for v in disk.volumes.values():
+                                di.volume_infos.add(
+                                    id=v.id, size=v.size, collection=v.collection,
+                                    file_count=v.file_count,
+                                    delete_count=v.delete_count,
+                                    deleted_byte_count=v.deleted_byte_count,
+                                    read_only=v.read_only,
+                                    replica_placement=v.replica_placement.to_byte(),
+                                    version=v.version,
+                                    compact_revision=v.compact_revision,
+                                    disk_type=v.disk_type)
+                            if include_ec:
+                                for s in disk.ec_shards.values():
+                                    di.ec_shard_infos.add(
+                                        id=s.volume_id, collection=s.collection,
+                                        ec_index_bits=s.shard_bits,
+                                        disk_type=s.disk_type,
+                                        destroy_time=s.destroy_time)
+        return t
+
+    # -- background maintenance ---------------------------------------------
+    def _janitor(self) -> None:
+        """Dead-node reaping (heartbeat-stream death already unregisters;
+        this is belt-and-braces) + layout hygiene. The reference drives
+        vacuum/EC cron via shell scripts (master_server.go:269); our shell
+        commands call the same seams."""
+        while not self._stop.wait(self.pulse_seconds):
+            for lo in self.layouts.all_layouts():
+                lo.ensure_correct_writables()

@@ -1,0 +1,140 @@
+"""VolumeGrowth: pick servers for new volume replica sets and allocate.
+
+Reference: weed/topology/volume_growth.go:94 (AutomaticGrowByType),
+:147 (findEmptySlotsForOneVolume), :245 (grow + AllocateVolume RPC). The
+replica placement xyz code decides the spread: first server in some rack,
+`same_rack` more in that rack, `other_rack` in other racks of the same DC,
+`other_dc` in other data centers.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from ..storage.types import ReplicaPlacement
+from ..utils.log import logger
+from .topology import DataNode, Topology
+
+log = logger("growth")
+
+
+@dataclass
+class GrowRequest:
+    collection: str = ""
+    replication: str = "000"
+    ttl: str = ""
+    disk_type: str = "hdd"
+    preferred_dc: str = ""
+    preferred_rack: str = ""
+    preferred_node: str = ""
+    count: int = 1
+
+
+class VolumeGrowth:
+    def __init__(self, topo: Topology, allocate_fn=None):
+        """allocate_fn(node, vid, req) performs the AllocateVolume RPC; tests
+        inject a fake."""
+        self.topo = topo
+        self.allocate_fn = allocate_fn
+
+    def find_slots(self, req: GrowRequest) -> list[DataNode]:
+        """Pick a replica set honoring the placement code, or raise."""
+        rp = ReplicaPlacement.parse(req.replication)
+        with self.topo.lock:
+            dcs = list(self.topo.dcs.values())
+            random.shuffle(dcs)
+            main_dc = None
+            for dc in dcs:
+                if req.preferred_dc and dc.id != req.preferred_dc:
+                    continue
+                # need rp.other_dc other DCs with >=1 free slot
+                others = [d for d in dcs if d.id != dc.id
+                          and self._dc_free(d, req.disk_type) >= 1]
+                if len(others) < rp.other_dc:
+                    continue
+                picked = self._pick_in_dc(dc, rp, req)
+                if picked is None:
+                    continue
+                main_dc = dc
+                servers = picked
+                for d in random.sample(others, rp.other_dc):
+                    n = self._pick_one(self._dc_nodes(d), req)
+                    if n is None:
+                        break
+                    servers.append(n)
+                else:
+                    return servers
+            if main_dc is None:
+                raise RuntimeError(
+                    f"no free volume slots for replication {req.replication} "
+                    f"disk {req.disk_type}")
+            raise RuntimeError("insufficient data centers for replication")
+
+    def _dc_nodes(self, dc) -> list[DataNode]:
+        return [n for r in dc.racks.values() for n in r.nodes.values()]
+
+    def _dc_free(self, dc, disk_type: str) -> int:
+        return sum(n.free_slots(disk_type) for n in self._dc_nodes(dc))
+
+    def _pick_one(self, nodes: list[DataNode], req: GrowRequest,
+                  exclude: set[str] = frozenset()) -> DataNode | None:
+        cands = [n for n in nodes if n.id not in exclude
+                 and n.free_slots(req.disk_type) >= 1
+                 and (not req.preferred_node or n.id == req.preferred_node)]
+        return random.choice(cands) if cands else None
+
+    def _pick_in_dc(self, dc, rp: ReplicaPlacement, req: GrowRequest
+                    ) -> list[DataNode] | None:
+        racks = list(dc.racks.values())
+        random.shuffle(racks)
+        for rack in racks:
+            if req.preferred_rack and rack.id != req.preferred_rack:
+                continue
+            other_racks = [r for r in racks if r.id != rack.id
+                           and any(n.free_slots(req.disk_type) >= 1
+                                   for n in r.nodes.values())]
+            if len(other_racks) < rp.other_rack:
+                continue
+            # same_rack + 1 servers inside this rack
+            nodes = list(rack.nodes.values())
+            picked: list[DataNode] = []
+            used: set[str] = set()
+            for _ in range(rp.same_rack + 1):
+                n = self._pick_one(nodes, req, exclude=used)
+                if n is None:
+                    picked = []
+                    break
+                picked.append(n)
+                used.add(n.id)
+            if not picked:
+                continue
+            for r in random.sample(other_racks, rp.other_rack):
+                n = self._pick_one(list(r.nodes.values()), req)
+                if n is None:
+                    return None
+                picked.append(n)
+            return picked
+        return None
+
+    def grow(self, req: GrowRequest) -> list[tuple[int, list[DataNode]]]:
+        """Allocate req.count new volumes; returns [(vid, servers)]."""
+        out = []
+        for _ in range(max(1, req.count)):
+            servers = self.find_slots(req)
+            vid = self.topo.next_volume_id()
+            ok = True
+            for node in servers:
+                if self.allocate_fn is not None:
+                    try:
+                        self.allocate_fn(node, vid, req)
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("allocate vid=%d on %s failed: %s",
+                                    vid, node.id, e)
+                        ok = False
+                        break
+            if ok:
+                out.append((vid, servers))
+        if not out:
+            raise RuntimeError("volume growth failed on all candidates")
+        return out

@@ -1,0 +1,104 @@
+"""VolumeLayout: writable-volume tracking per (collection, rp, ttl, disk).
+
+Reference: weed/topology/volume_layout.go:132 (state), :291 (PickForWrite),
+:214 (EnsureCorrectWritables). The layout answers "which volume id should
+this write go to" with round-robin over writable volumes whose replica sets
+are complete and under the size limit.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from .topology import Topology, VolumeInfo
+
+
+class VolumeLayout:
+    def __init__(self, topo: Topology, collection: str, replication: str,
+                 ttl: str, disk_type: str):
+        self.topo = topo
+        self.collection = collection
+        self.replication = replication
+        self.ttl = ttl
+        self.disk_type = disk_type
+        self.writable: set[int] = set()
+        self.readonly: set[int] = set()
+        self.oversized: set[int] = set()
+        self.crowded: set[int] = set()
+        self.lock = threading.RLock()
+        from ..storage.types import ReplicaPlacement
+        self._copy_count = ReplicaPlacement.parse(replication).copy_count
+
+    def register(self, v: VolumeInfo) -> None:
+        with self.lock:
+            if v.read_only:
+                self.readonly.add(v.id)
+                self.writable.discard(v.id)
+            elif v.size >= self.topo.volume_size_limit:
+                self.oversized.add(v.id)
+                self.writable.discard(v.id)
+            else:
+                self.readonly.discard(v.id)
+                self.writable.add(v.id)
+
+    def unregister(self, vid: int) -> None:
+        with self.lock:
+            self.writable.discard(vid)
+            self.readonly.discard(vid)
+            self.oversized.discard(vid)
+            self.crowded.discard(vid)
+
+    def ensure_correct_writables(self) -> None:
+        """Drop volumes whose replica sets are incomplete or oversized."""
+        with self.lock:
+            for vid in list(self.writable):
+                locs = self.topo.lookup(vid)
+                if len(locs) < self._copy_count:
+                    self.writable.discard(vid)
+                infos = [v for n in locs for v in n.all_volumes() if v.id == vid]
+                if any(v.size >= self.topo.volume_size_limit or v.read_only
+                       for v in infos):
+                    self.writable.discard(vid)
+
+    def pick_for_write(self) -> int | None:
+        with self.lock:
+            if not self.writable:
+                return None
+            return random.choice(tuple(self.writable))
+
+    def active_count(self) -> int:
+        with self.lock:
+            return len(self.writable)
+
+    def should_grow(self, min_active: int = 1) -> bool:
+        return self.active_count() < min_active
+
+
+class LayoutRegistry:
+    def __init__(self, topo: Topology):
+        self.topo = topo
+        self._layouts: dict[tuple, VolumeLayout] = {}
+        self.lock = threading.RLock()
+
+    def get(self, collection: str, replication: str, ttl: str,
+            disk_type: str) -> VolumeLayout:
+        key = (collection, replication, ttl, disk_type)
+        with self.lock:
+            lo = self._layouts.get(key)
+            if lo is None:
+                lo = self._layouts[key] = VolumeLayout(
+                    self.topo, collection, replication, ttl, disk_type)
+            return lo
+
+    def register_volume(self, v: VolumeInfo) -> None:
+        self.get(v.collection, str(v.replica_placement), str(v.ttl),
+                 v.disk_type).register(v)
+
+    def unregister_volume(self, v: VolumeInfo) -> None:
+        self.get(v.collection, str(v.replica_placement), str(v.ttl),
+                 v.disk_type).unregister(v.id)
+
+    def all_layouts(self) -> list[VolumeLayout]:
+        with self.lock:
+            return list(self._layouts.values())

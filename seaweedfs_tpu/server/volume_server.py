@@ -1,0 +1,742 @@
+"""Volume server daemon: HTTP data path + gRPC admin/EC + master heartbeat.
+
+Reference: weed/server/volume_server.go, volume_server_handlers_write.go:18
+(PostHandler -> ReplicatedWrite), volume_server_handlers_read.go:44,
+volume_grpc_client_to_master.go:50 (heartbeat loop),
+volume_grpc_erasure_coding.go (EC RPC set incl. fork CopyByRebuild/Move),
+topology/store_replicate.go:25 (synchronous replica fan-out).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+
+from ..ec import files as ec_files
+from ..ec.encoder import rebuild_shards
+from ..ec.locate import EcGeometry
+from ..pb import master_pb2 as mpb
+from ..pb import volume_server_pb2 as vpb
+from ..storage.needle import Needle
+from ..storage.store import Store
+from ..storage.types import TTL, parse_file_id
+from ..storage.vacuum import commit_compact, compact
+from ..utils.log import logger
+from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
+
+log = logger("volume")
+
+
+class VolumeServer:
+    def __init__(self, store: Store, master_address: str,
+                 ip: str = "127.0.0.1", port: int = 8080,
+                 grpc_port: int | None = None,
+                 data_center: str = "", rack: str = "",
+                 pulse_seconds: float = 2.0, read_mode: str = "proxy"):
+        self.store = store
+        self.master_address = master_address
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or port + 10000
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.read_mode = read_mode
+        self.current_leader = master_address
+        self._stop = threading.Event()
+        self._hb_wake = threading.Event()
+        self._grpc = None
+        self._http_thread = None
+        self._hb_thread = None
+        self._http_runner = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._grpc = serve(f"{self.ip}:{self.grpc_port}", [self._build_service()])
+        self._http_thread = threading.Thread(target=self._run_http, daemon=True,
+                                             name=f"vs-http-{self.port}")
+        self._http_thread.start()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True,
+                                           name=f"vs-hb-{self.port}")
+        self._hb_thread.start()
+        log.info("volume server %s up (grpc :%d)", self.url, self.grpc_port)
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._hb_wake.set()
+        if self._grpc:
+            self._grpc.stop(grace=0.5)
+        self.store.close()
+
+    # -- heartbeat (reference volume_grpc_client_to_master.go) ---------------
+    def _heartbeat_messages(self):
+        while not self._stop.is_set():
+            hb = self.store.collect_heartbeat()
+            msg = mpb.Heartbeat(
+                ip=self.ip, port=self.port, grpc_port=self.grpc_port,
+                public_url=self.store.public_url,
+                max_file_key=hb["max_file_key"],
+                data_center=self.data_center, rack=self.rack,
+                max_volume_counts=hb["max_volume_counts"],
+                has_no_volumes=not hb["volumes"],
+                has_no_ec_shards=not hb["ec_shards"])
+            for v in hb["volumes"]:
+                msg.volumes.add(**v)
+            for s in hb["ec_shards"]:
+                msg.ec_shards.add(**s)
+            yield msg
+            self._hb_wake.wait(timeout=self.pulse_seconds)
+            self._hb_wake.clear()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                stub = Stub(self.current_leader, MASTER_SERVICE)
+                stream = stub.stream_stream(
+                    "SendHeartbeat", self._heartbeat_messages(),
+                    mpb.Heartbeat, mpb.HeartbeatResponse)
+                for resp in stream:
+                    if resp.volume_size_limit:
+                        pass  # informational
+                    if resp.leader and resp.leader != self.current_leader:
+                        log.info("leader moved to %s", resp.leader)
+                        self.current_leader = resp.leader
+                        break
+                    if self._stop.is_set():
+                        return
+            except Exception as e:  # noqa: BLE001
+                if not self._stop.is_set():
+                    log.warning("heartbeat to %s failed: %s; retrying",
+                                self.current_leader, e)
+                    time.sleep(min(self.pulse_seconds, 2.0))
+
+    def trigger_heartbeat(self) -> None:
+        self._hb_wake.set()
+
+    # -- HTTP data path (aiohttp) -------------------------------------------
+    def _run_http(self) -> None:
+        import asyncio
+
+        from aiohttp import web
+
+        async def handle(request: web.Request):
+            try:
+                if request.method in ("POST", "PUT"):
+                    return await self._handle_write(request)
+                if request.method == "GET" or request.method == "HEAD":
+                    return await self._handle_read(request)
+                if request.method == "DELETE":
+                    return await self._handle_delete(request)
+            except KeyError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except PermissionError as e:
+                return web.json_response({"error": str(e)}, status=403)
+            except Exception as e:  # noqa: BLE001
+                log.error("http error: %s", e)
+                return web.json_response({"error": str(e)}, status=500)
+            return web.json_response({"error": "method not allowed"}, status=405)
+
+        async def status(request):
+            return web.json_response({"version": "swtpu", **self.store.status()})
+
+        async def main():
+            app = web.Application(client_max_size=256 << 20)
+            app.router.add_get("/status", status)
+            app.router.add_route("*", "/{fid:.*}", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            self._http_runner = runner
+            site = web.TCPSite(runner, self.ip, self.port)
+            await site.start()
+            while not self._stop.is_set():
+                await asyncio.sleep(0.2)
+            await runner.cleanup()
+
+        asyncio.run(main())
+
+    async def _read_body(self, request):
+        ct = request.content_type or ""
+        name = mime = b""
+        gzipped = False
+        if ct.startswith("multipart/"):
+            reader = await request.multipart()
+            async for part in reader:
+                data = await part.read(decode=False)
+                name = (part.filename or "").encode()
+                ptype = part.headers.get("Content-Type") or ""
+                if ptype and not ptype.startswith("multipart/"):
+                    mime = ptype.encode()
+                gzipped = part.headers.get("Content-Encoding") == "gzip"
+                return data, name, mime, gzipped
+            return b"", b"", b"", False
+        data = await request.read()
+        if ct and ct != "application/octet-stream":
+            mime = ct.encode()
+        gzipped = request.headers.get("Content-Encoding") == "gzip"
+        name = (request.query.get("name") or "").encode()  # replicate fan-out
+        return data, name, mime, gzipped
+
+    async def _handle_write(self, request):
+        from aiohttp import web
+
+        fid = request.match_info["fid"]
+        vid, key, cookie = parse_file_id(fid)
+        data, name, mime, gzipped = await self._read_body(request)
+        is_replicate = request.query.get("type") == "replicate"
+        n = Needle(id=key, cookie=cookie, data=data, name=name, mime=mime,
+                   is_gzipped=gzipped,
+                   ttl=TTL.parse(request.query.get("ttl")))
+        self.store.write_needle(vid, n)
+        if not is_replicate:
+            await self._replicate(fid, data, name, mime, gzipped)
+        return web.json_response({"name": name.decode(errors="replace"),
+                                  "size": len(data),
+                                  "eTag": f"{n.checksum:x}"}, status=201)
+
+    async def _replicate(self, fid: str, data: bytes, name: bytes,
+                         mime: bytes, gzipped: bool) -> None:
+        """Synchronous fan-out to replica peers (store_replicate.go:25),
+        preserving the needle attributes (name/mime/gzip flag)."""
+        vid = int(fid.split(",")[0])
+        peers = [u for u in self._lookup_replicas(vid) if u != self.url]
+        if not peers:
+            return
+        import aiohttp
+
+        headers = {"Content-Type": mime.decode() or "application/octet-stream"}
+        if gzipped:
+            headers["Content-Encoding"] = "gzip"
+        async with aiohttp.ClientSession(auto_decompress=False) as sess:
+            for peer in peers:
+                url = f"http://{peer}/{fid}?type=replicate"
+                if name:
+                    url += "&" + urllib.parse.urlencode(
+                        {"name": name.decode(errors="replace")})
+                async with sess.post(url, data=data, headers=headers) as r:
+                    if r.status >= 300:
+                        raise OSError(f"replicate to {peer}: HTTP {r.status}")
+
+    def _lookup_replicas(self, vid: int) -> list[str]:
+        try:
+            stub = Stub(self.current_leader, MASTER_SERVICE)
+            resp = stub.call("LookupVolume",
+                             mpb.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
+                             mpb.LookupVolumeResponse, timeout=5)
+            for e in resp.volume_id_locations:
+                return [loc.url for loc in e.locations]
+        except Exception as e:  # noqa: BLE001
+            log.warning("replica lookup vid=%d failed: %s", vid, e)
+        return []
+
+    async def _handle_read(self, request):
+        from aiohttp import web
+
+        fid = request.match_info["fid"]
+        vid, key, cookie = parse_file_id(fid)
+        try:
+            n = self.store.read_needle(vid, key, cookie=cookie,
+                                       shard_reader=self._make_shard_reader(vid))
+        except KeyError:
+            # not local: proxy or redirect by master lookup (ReadMode)
+            return await self._read_remote(request, fid, vid)
+        body = n.data
+        headers = {}
+        if n.name:
+            headers["Content-Disposition"] = f'inline; filename="{n.name.decode(errors="replace")}"'
+        if n.is_gzipped and "gzip" not in (request.headers.get("Accept-Encoding") or ""):
+            import gzip as _gz
+            body = _gz.decompress(body)
+        elif n.is_gzipped:
+            headers["Content-Encoding"] = "gzip"
+        return web.Response(body=body, headers=headers,
+                            content_type=(n.mime.decode() if n.mime else
+                                          "application/octet-stream"))
+
+    async def _read_remote(self, request, fid: str, vid: int):
+        from aiohttp import web
+
+        if self.read_mode == "local":
+            return web.json_response({"error": f"volume {vid} not local"},
+                                     status=404)
+        peers = [u for u in self._lookup_replicas(vid) if u != self.url]
+        if not peers:
+            return web.json_response({"error": f"volume {vid} not found"},
+                                     status=404)
+        if self.read_mode == "redirect":
+            raise web.HTTPMovedPermanently(f"http://{peers[0]}/{fid}")
+        import aiohttp
+
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(f"http://{peers[0]}/{fid}") as r:
+                body = await r.read()
+                return web.Response(
+                    body=body, status=r.status,
+                    content_type=r.content_type or "application/octet-stream")
+
+    async def _handle_delete(self, request):
+        from aiohttp import web
+
+        fid = request.match_info["fid"]
+        vid, key, _ = parse_file_id(fid)
+        is_replicate = request.query.get("type") == "replicate"
+        v = self.store.find_volume(vid)
+        if v is not None:
+            ok = self.store.delete_needle(vid, key)
+        else:
+            ev = self.store.find_ec_volume(vid)
+            if ev is None:
+                raise KeyError(f"volume {vid} not local")
+            ok = ev.delete_needle(key)
+        if not is_replicate and ok:
+            peers = [u for u in self._lookup_replicas(vid) if u != self.url]
+            if peers:
+                import aiohttp
+
+                async with aiohttp.ClientSession() as sess:
+                    for peer in peers:
+                        await sess.delete(f"http://{peer}/{fid}?type=replicate")
+        return web.json_response({"size": 1 if ok else 0}, status=202)
+
+    # -- EC shard reader: remote fetch + degraded reconstruct ---------------
+    def _make_shard_reader(self, vid: int):
+        def reader(shard_id: int, offset: int, length: int) -> bytes:
+            locs = self._lookup_ec_shards(vid)
+            holders = locs.get(shard_id, [])
+            for addr in holders:
+                try:
+                    stub = Stub(addr, VOLUME_SERVICE)
+                    parts = [r.data for r in stub.call_stream(
+                        "VolumeEcShardRead",
+                        vpb.VolumeEcShardReadRequest(
+                            volume_id=vid, shard_id=shard_id,
+                            offset=offset, size=length),
+                        vpb.VolumeEcShardReadResponse)]
+                    return b"".join(parts)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("remote shard %d.%d read from %s: %s",
+                                vid, shard_id, addr, e)
+            # degraded read: reconstruct this interval from other shards
+            # (store_ec.go:357 recoverOneRemoteEcShardInterval)
+            ev = self.store.find_ec_volume(vid)
+            if ev is None:
+                raise KeyError(f"shard {shard_id} unreachable")
+            geo = ev.geo
+            gathered: dict[int, bytes] = {}
+            for sid in range(geo.n):
+                if sid == shard_id or len(gathered) >= geo.d:
+                    continue
+                local = ev.shards.get(sid)
+                if local is not None:
+                    gathered[sid] = local.read_at(offset, length)
+                    continue
+                for addr in locs.get(sid, []):
+                    try:
+                        stub = Stub(addr, VOLUME_SERVICE)
+                        parts = [r.data for r in stub.call_stream(
+                            "VolumeEcShardRead",
+                            vpb.VolumeEcShardReadRequest(
+                                volume_id=vid, shard_id=sid,
+                                offset=offset, size=length),
+                            vpb.VolumeEcShardReadResponse)]
+                        gathered[sid] = b"".join(parts)
+                        break
+                    except Exception:  # noqa: BLE001
+                        continue
+            if len(gathered) < geo.d:
+                raise KeyError(
+                    f"cannot reconstruct shard {shard_id}: only "
+                    f"{len(gathered)} shards reachable")
+            import numpy as np
+
+            present = tuple(sorted(gathered))[:geo.d]
+            sl = np.stack([np.frombuffer(gathered[s], dtype=np.uint8)
+                           for s in present])
+            coder = self.store.coder(geo.d, geo.p)
+            out = np.asarray(coder.reconstruct(sl, present, (shard_id,)))
+            return out[0].tobytes()
+        return reader
+
+    def _lookup_ec_shards(self, vid: int) -> dict[int, list[str]]:
+        """shard id -> list of gRPC addresses of holders."""
+        try:
+            stub = Stub(self.current_leader, MASTER_SERVICE)
+            resp = stub.call("LookupEcVolume",
+                             mpb.LookupEcVolumeRequest(volume_id=vid),
+                             mpb.LookupEcVolumeResponse, timeout=5)
+            return {e.shard_id:
+                    [f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}"
+                     for l in e.locations]
+                    for e in resp.shard_id_locations}
+        except Exception as e:  # noqa: BLE001
+            log.warning("ec lookup vid=%d: %s", vid, e)
+            return {}
+
+    # -- gRPC admin service ---------------------------------------------------
+    def _build_service(self) -> RpcService:
+        svc = RpcService(VOLUME_SERVICE)
+        vs = self
+        store = self.store
+
+        @svc.unary("AllocateVolume", vpb.AllocateVolumeRequest,
+                   vpb.AllocateVolumeResponse)
+        def allocate(req, context):
+            store.add_volume(req.volume_id, req.collection, req.replication,
+                             req.ttl, req.disk_type or None)
+            vs.trigger_heartbeat()
+            return vpb.AllocateVolumeResponse()
+
+        @svc.unary("VolumeDelete", vpb.VolumeDeleteRequest, vpb.VolumeDeleteResponse)
+        def vol_delete(req, context):
+            store.delete_volume(req.volume_id, req.only_empty)
+            vs.trigger_heartbeat()
+            return vpb.VolumeDeleteResponse()
+
+        @svc.unary("VolumeMarkReadonly", vpb.VolumeMarkReadonlyRequest,
+                   vpb.VolumeMarkReadonlyResponse)
+        def mark_ro(req, context):
+            store.mark_readonly(req.volume_id, True)
+            vs.trigger_heartbeat()
+            return vpb.VolumeMarkReadonlyResponse()
+
+        @svc.unary("VolumeMarkWritable", vpb.VolumeMarkWritableRequest,
+                   vpb.VolumeMarkWritableResponse)
+        def mark_rw(req, context):
+            store.mark_readonly(req.volume_id, False)
+            vs.trigger_heartbeat()
+            return vpb.VolumeMarkWritableResponse()
+
+        @svc.unary("VolumeStatus", vpb.VolumeStatusRequest, vpb.VolumeStatusResponse)
+        def vol_status(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            return vpb.VolumeStatusResponse(
+                is_read_only=v.read_only, volume_size=v.content_size,
+                file_count=v.file_count, file_deleted_count=v.deleted_count)
+
+        # vacuum phases (reference volume_grpc_vacuum.go)
+        @svc.unary("VacuumVolumeCheck", vpb.VacuumVolumeCheckRequest,
+                   vpb.VacuumVolumeCheckResponse)
+        def vacuum_check(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            return vpb.VacuumVolumeCheckResponse(garbage_ratio=v.garbage_ratio())
+
+        @svc.unary("VacuumVolumeCompact", vpb.VacuumVolumeCompactRequest,
+                   vpb.VacuumVolumeCompactResponse)
+        def vacuum_compact(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            _, reclaimed = compact(v)
+            return vpb.VacuumVolumeCompactResponse(processed_bytes=reclaimed)
+
+        @svc.unary("VacuumVolumeCommit", vpb.VacuumVolumeCommitRequest,
+                   vpb.VacuumVolumeCommitResponse)
+        def vacuum_commit(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            newv = commit_compact(v)
+            for loc in store.locations:
+                if loc.volumes.get(req.volume_id) is v:
+                    loc.volumes[req.volume_id] = newv
+            vs.trigger_heartbeat()
+            return vpb.VacuumVolumeCommitResponse(volume_size=newv.content_size)
+
+        @svc.unary("VacuumVolumeCleanup", vpb.VacuumVolumeCleanupRequest,
+                   vpb.VacuumVolumeCleanupResponse)
+        def vacuum_cleanup(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is not None:
+                base = v.file_name()
+                for ext in (".cpd", ".cpx"):
+                    if os.path.exists(base + ext):
+                        os.remove(base + ext)
+            return vpb.VacuumVolumeCleanupResponse()
+
+        @svc.unary("BatchDelete", vpb.BatchDeleteRequest, vpb.BatchDeleteResponse)
+        def batch_delete(req, context):
+            resp = vpb.BatchDeleteResponse()
+            for fid in req.file_ids:
+                r = resp.results.add(file_id=fid)
+                try:
+                    vid, key, cookie = parse_file_id(fid)
+                    if store.delete_needle(vid, key):
+                        r.status = 202
+                    else:
+                        r.status, r.error = 404, "not found"
+                except Exception as e:  # noqa: BLE001
+                    r.status, r.error = 500, str(e)
+            return resp
+
+        # ---- EC RPC set ----
+        @svc.unary("VolumeEcShardsGenerate", vpb.VolumeEcShardsGenerateRequest,
+                   vpb.VolumeEcShardsGenerateResponse)
+        def ec_generate(req, context):
+            store.generate_ec_shards(req.volume_id, req.collection,
+                                     req.data_shards or None,
+                                     req.parity_shards or None)
+            return vpb.VolumeEcShardsGenerateResponse()
+
+        @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
+                   vpb.VolumeEcShardsRebuildResponse)
+        def ec_rebuild(req, context):
+            rebuilt = store.rebuild_ec_shards(req.volume_id, req.collection)
+            vs.trigger_heartbeat()
+            return vpb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+
+        @svc.unary("VolumeEcShardsCopy", vpb.VolumeEcShardsCopyRequest,
+                   vpb.VolumeEcShardsCopyResponse)
+        def ec_copy(req, context):
+            """Pull shard files FROM source_data_node to this server."""
+            src = Stub(req.source_data_node, VOLUME_SERVICE)
+            loc = store._location_for(None)
+            base = loc.base_name(req.collection, req.volume_id)
+            exts = [ec_files.shard_ext(s) for s in req.shard_ids]
+            if req.copy_ecx_file:
+                exts.append(".ecx")
+            if req.copy_ecj_file:
+                exts.append(".ecj")
+            if req.copy_vif_file:
+                exts.append(".vif")
+            for ext in exts:
+                parts = []
+                try:
+                    for r in src.call_stream(
+                            "CopyFile",
+                            vpb.CopyFileRequest(volume_id=req.volume_id,
+                                                collection=req.collection,
+                                                ext=ext, is_ec_volume=True),
+                            vpb.CopyFileResponse):
+                        parts.append(r.file_content)
+                except Exception:  # noqa: BLE001
+                    if ext in (".ecj", ".ecx", ".vif"):
+                        continue  # optional sidecars may not exist at source
+                    raise
+                with open(base + ext, "wb") as f:
+                    for pc in parts:
+                        f.write(pc)
+            return vpb.VolumeEcShardsCopyResponse()
+
+        # fork RPC: rebuild shards directly onto this server from peers
+        @svc.unary("VolumeEcShardsCopyByRebuild",
+                   vpb.VolumeEcShardsCopyByRebuildRequest,
+                   vpb.VolumeEcShardsCopyByRebuildResponse)
+        def ec_copy_by_rebuild(req, context):
+            loc = store._location_for(None)
+            base = loc.base_name(req.collection, req.volume_id)
+            shard_locs = vs._lookup_ec_shards(req.volume_id)
+            info = {}
+            gathered = 0
+            geo = store.ec_geometry
+            for sid, addrs in sorted(shard_locs.items()):
+                if gathered >= geo.d:
+                    break
+                if os.path.exists(base + ec_files.shard_ext(sid)):
+                    gathered += 1
+                    continue
+                for addr in addrs:  # addrs are gRPC addresses
+                    if addr == f"{vs.ip}:{vs.grpc_port}":
+                        continue
+                    try:
+                        src = Stub(addr, VOLUME_SERVICE)
+                        parts = [r.file_content for r in src.call_stream(
+                            "CopyFile",
+                            vpb.CopyFileRequest(volume_id=req.volume_id,
+                                                collection=req.collection,
+                                                ext=ec_files.shard_ext(sid),
+                                                is_ec_volume=True),
+                            vpb.CopyFileResponse)]
+                        with open(base + ec_files.shard_ext(sid), "wb") as f:
+                            for pc in parts:
+                                f.write(pc)
+                        gathered += 1
+                        break
+                    except Exception:  # noqa: BLE001
+                        continue
+            rebuilt = rebuild_shards(base, geo, store.coder(geo.d, geo.p),
+                                     wanted=list(req.shard_ids))
+            return vpb.VolumeEcShardsCopyByRebuildResponse(
+                rebuilt_shard_ids=rebuilt)
+
+        @svc.unary("VolumeEcShardsMount", vpb.VolumeEcShardsMountRequest,
+                   vpb.VolumeEcShardsMountResponse)
+        def ec_mount(req, context):
+            store.mount_ec_shards(req.volume_id, req.collection)
+            vs.trigger_heartbeat()
+            return vpb.VolumeEcShardsMountResponse()
+
+        @svc.unary("VolumeEcShardsUnmount", vpb.VolumeEcShardsUnmountRequest,
+                   vpb.VolumeEcShardsUnmountResponse)
+        def ec_unmount(req, context):
+            store.unmount_ec_shards(req.volume_id,
+                                    list(req.shard_ids) or None)
+            vs.trigger_heartbeat()
+            return vpb.VolumeEcShardsUnmountResponse()
+
+        @svc.unary("VolumeEcShardsDelete", vpb.VolumeEcShardsDeleteRequest,
+                   vpb.VolumeEcShardsDeleteResponse)
+        def ec_delete(req, context):
+            ev = store.find_ec_volume(req.volume_id)
+            base = None
+            if ev is not None:
+                base = ev.base
+                store.unmount_ec_shards(req.volume_id, list(req.shard_ids))
+            else:
+                for loc in store.locations:
+                    cand = loc.base_name(req.collection, req.volume_id)
+                    if any(os.path.exists(cand + ec_files.shard_ext(s))
+                           for s in req.shard_ids):
+                        base = cand
+                        break
+            if base:
+                for s in req.shard_ids:
+                    p = base + ec_files.shard_ext(s)
+                    if os.path.exists(p):
+                        os.remove(p)
+            vs.trigger_heartbeat()
+            return vpb.VolumeEcShardsDeleteResponse()
+
+        # fork RPC: move = copy + source delete, driven from the target
+        @svc.unary("VolumeEcShardsMove", vpb.VolumeEcShardsMoveRequest,
+                   vpb.VolumeEcShardsMoveResponse)
+        def ec_move(req, context):
+            ec_copy(vpb.VolumeEcShardsCopyRequest(
+                volume_id=req.volume_id, collection=req.collection,
+                shard_ids=req.shard_ids,
+                source_data_node=req.source_data_node), context)
+            src = Stub(req.source_data_node, VOLUME_SERVICE)
+            src.call("VolumeEcShardsDelete",
+                     vpb.VolumeEcShardsDeleteRequest(
+                         volume_id=req.volume_id, collection=req.collection,
+                         shard_ids=req.shard_ids),
+                     vpb.VolumeEcShardsDeleteResponse)
+            store.mount_ec_shards(req.volume_id, req.collection)
+            vs.trigger_heartbeat()
+            return vpb.VolumeEcShardsMoveResponse()
+
+        @svc.unary_stream("VolumeEcShardRead", vpb.VolumeEcShardReadRequest,
+                          vpb.VolumeEcShardReadResponse)
+        def ec_shard_read(req, context):
+            ev = store.find_ec_volume(req.volume_id)
+            if ev is None:
+                context.abort(5, f"ec volume {req.volume_id} not found")
+            sh = ev.shards.get(req.shard_id)
+            if sh is None:
+                context.abort(5, f"shard {req.shard_id} not on this server")
+            remaining = req.size
+            offset = req.offset
+            while remaining > 0:
+                chunk = min(remaining, 1 << 20)
+                data = sh.read_at(offset, chunk)
+                if not data:
+                    break
+                yield vpb.VolumeEcShardReadResponse(data=data)
+                offset += len(data)
+                remaining -= len(data)
+
+        @svc.unary("VolumeEcBlobDelete", vpb.VolumeEcBlobDeleteRequest,
+                   vpb.VolumeEcBlobDeleteResponse)
+        def ec_blob_delete(req, context):
+            ev = store.find_ec_volume(req.volume_id)
+            if ev is None:
+                context.abort(5, f"ec volume {req.volume_id} not found")
+            ev.delete_needle(req.file_key)
+            return vpb.VolumeEcBlobDeleteResponse()
+
+        @svc.unary("VolumeEcShardsToVolume", vpb.VolumeEcShardsToVolumeRequest,
+                   vpb.VolumeEcShardsToVolumeResponse)
+        def ec_to_volume(req, context):
+            store.ec_shards_to_volume(req.volume_id, req.collection)
+            vs.trigger_heartbeat()
+            return vpb.VolumeEcShardsToVolumeResponse()
+
+        @svc.unary("VolumeCopy", vpb.VolumeCopyRequest, vpb.VolumeCopyResponse)
+        def volume_copy(req, context):
+            """Pull a whole volume (.dat + .idx) from source_data_node
+            (reference volume_grpc_copy.go doCopyFile flow)."""
+            if store.find_volume(req.volume_id) is not None:
+                context.abort(6, f"volume {req.volume_id} already here")
+            src = Stub(req.source_data_node, VOLUME_SERVICE)
+            loc = store._location_for(req.disk_type or None)
+            base = loc.base_name(req.collection, req.volume_id)
+            for ext in (".dat", ".idx"):
+                with open(base + ext, "wb") as f:
+                    for r in src.call_stream(
+                            "CopyFile",
+                            vpb.CopyFileRequest(volume_id=req.volume_id,
+                                                collection=req.collection,
+                                                ext=ext),
+                            vpb.CopyFileResponse):
+                        f.write(r.file_content)
+            from ..storage.volume import Volume as _Volume
+            v = _Volume(loc.directory, req.collection, req.volume_id,
+                        create_if_missing=False)
+            with loc.lock:
+                loc.volumes[req.volume_id] = v
+            vs.trigger_heartbeat()
+            return vpb.VolumeCopyResponse(last_append_at_ns=v.last_append_at_ns)
+
+        @svc.unary_stream("CopyFile", vpb.CopyFileRequest, vpb.CopyFileResponse)
+        def copy_file(req, context):
+            path = None
+            for loc in store.locations:
+                cand = loc.base_name(req.collection, req.volume_id) + req.ext
+                if os.path.exists(cand):
+                    path = cand
+                    break
+            if path is None:
+                context.abort(5, f"file vol={req.volume_id}{req.ext} not found")
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    yield vpb.CopyFileResponse(file_content=chunk)
+
+        @svc.unary("ReadVolumeFileStatus", vpb.ReadVolumeFileStatusRequest,
+                   vpb.ReadVolumeFileStatusResponse)
+        def file_status(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            return vpb.ReadVolumeFileStatusResponse(
+                volume_id=req.volume_id,
+                dat_file_size=os.path.getsize(v.dat_path),
+                idx_file_size=os.path.getsize(v.idx_path),
+                file_count=v.file_count,
+                compaction_revision=v.super_block.compaction_revision,
+                collection=v.collection)
+
+        @svc.unary("VolumeNeedleStatus", vpb.VolumeNeedleStatusRequest,
+                   vpb.VolumeNeedleStatusResponse)
+        def needle_status(req, context):
+            try:
+                n = store.read_needle(req.volume_id, req.needle_id)
+            except KeyError as e:
+                context.abort(5, str(e))
+            return vpb.VolumeNeedleStatusResponse(
+                needle_id=n.id, cookie=n.cookie, size=len(n.data),
+                last_modified=n.last_modified, crc=n.checksum,
+                ttl=str(n.ttl))
+
+        @svc.unary("Ping", vpb.PingRequest, vpb.PingResponse)
+        def ping(req, context):
+            now = time.time_ns()
+            return vpb.PingResponse(start_time_ns=now, remote_time_ns=now,
+                                    stop_time_ns=time.time_ns())
+
+        return svc
+

@@ -1,0 +1,240 @@
+"""volume.* and cluster admin commands (reference weed/shell/command_volume_*).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..pb import master_pb2 as mpb
+from ..pb import volume_server_pb2 as vpb
+from ..utils.rpc import MASTER_SERVICE, Stub, VOLUME_SERVICE
+from .commands import CommandEnv, command
+
+
+def _vs_stub(env: CommandEnv, node_id: str, grpc_port: int) -> Stub:
+    return Stub(env.grpc_addr(node_id, grpc_port), VOLUME_SERVICE)
+
+
+def _volume_holders(env: CommandEnv, vid: int) -> list[dict]:
+    out = []
+    for srv in env.collect_volume_servers():
+        for disk in srv["disks"].values():
+            for v in disk.volume_infos:
+                if v.id == vid:
+                    out.append({**srv, "info": v})
+    return out
+
+
+@command("lock", "acquire the exclusive cluster admin lock")
+def cmd_lock(env: CommandEnv, args):
+    env.acquire_lock()
+    env.println("locked")
+
+
+@command("unlock", "release the cluster admin lock")
+def cmd_unlock(env: CommandEnv, args):
+    env.release_lock()
+    env.println("unlocked")
+
+
+@command("volume.list", "list topology: servers, volumes, ec shards")
+def cmd_volume_list(env: CommandEnv, args):
+    topo = env.topology()
+    for dc in topo.data_center_infos:
+        env.println(f"DataCenter {dc.id}")
+        for rack in dc.rack_infos:
+            env.println(f"  Rack {rack.id}")
+            for node in rack.data_node_infos:
+                env.println(f"    DataNode {node.id} (grpc :{node.grpc_port})")
+                for dtype, disk in sorted(node.disk_infos.items()):
+                    env.println(f"      Disk {dtype} "
+                                f"{disk.volume_count}/{disk.max_volume_count} slots")
+                    for v in disk.volume_infos:
+                        env.println(
+                            f"        volume {v.id} col={v.collection!r} "
+                            f"size={v.size} files={v.file_count} "
+                            f"del={v.delete_count} ro={v.read_only} "
+                            f"rp={v.replica_placement:03d}")
+                    for s in disk.ec_shard_infos:
+                        bits = [i for i in range(32) if s.ec_index_bits >> i & 1]
+                        env.println(f"        ec volume {s.id} "
+                                    f"col={s.collection!r} shards={bits}")
+
+
+@command("cluster.check", "ping every node and report health")
+def cmd_cluster_check(env: CommandEnv, args):
+    ok = 0
+    for srv in env.collect_volume_servers():
+        try:
+            _vs_stub(env, srv["id"], srv["grpc_port"]).call(
+                "Ping", vpb.PingRequest(), vpb.PingResponse, timeout=5)
+            env.println(f"  volume server {srv['id']}: ok")
+            ok += 1
+        except Exception as e:  # noqa: BLE001
+            env.println(f"  volume server {srv['id']}: UNREACHABLE ({e})")
+    env.println(f"{ok} volume servers healthy")
+
+
+@command("collection.list", "list collections")
+def cmd_collection_list(env: CommandEnv, args):
+    for c in env.mc.collection_list():
+        env.println(f"  collection {c!r}")
+
+
+@command("volume.vacuum", "-garbageThreshold 0.3 [-volumeId N]: compact garbage",
+         needs_lock=True)
+def cmd_volume_vacuum(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="volume.vacuum")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    p.add_argument("-volumeId", type=int, default=0)
+    opt = p.parse_args(args)
+    vacuumed = 0
+    for srv in env.collect_volume_servers():
+        stub = _vs_stub(env, srv["id"], srv["grpc_port"])
+        for disk in srv["disks"].values():
+            for v in disk.volume_infos:
+                if opt.volumeId and v.id != opt.volumeId:
+                    continue
+                chk = stub.call("VacuumVolumeCheck",
+                                vpb.VacuumVolumeCheckRequest(volume_id=v.id),
+                                vpb.VacuumVolumeCheckResponse)
+                if chk.garbage_ratio < opt.garbageThreshold:
+                    continue
+                env.println(f"  vacuuming volume {v.id} on {srv['id']} "
+                            f"(garbage {chk.garbage_ratio:.0%})")
+                stub.call("VacuumVolumeCompact",
+                          vpb.VacuumVolumeCompactRequest(volume_id=v.id),
+                          vpb.VacuumVolumeCompactResponse, timeout=600)
+                stub.call("VacuumVolumeCommit",
+                          vpb.VacuumVolumeCommitRequest(volume_id=v.id),
+                          vpb.VacuumVolumeCommitResponse, timeout=600)
+                vacuumed += 1
+    env.println(f"vacuumed {vacuumed} volumes")
+
+
+@command("volume.delete", "-volumeId N [-node ip:port]: delete a volume",
+         needs_lock=True)
+def cmd_volume_delete(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="volume.delete")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", default="")
+    opt = p.parse_args(args)
+    for h in _volume_holders(env, opt.volumeId):
+        if opt.node and h["id"] != opt.node:
+            continue
+        _vs_stub(env, h["id"], h["grpc_port"]).call(
+            "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=opt.volumeId),
+            vpb.VolumeDeleteResponse)
+        env.println(f"  deleted volume {opt.volumeId} on {h['id']}")
+
+
+@command("volume.mark", "-volumeId N -readonly|-writable", needs_lock=True)
+def cmd_volume_mark(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="volume.mark")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-readonly", action="store_true")
+    p.add_argument("-writable", action="store_true")
+    opt = p.parse_args(args)
+    for h in _volume_holders(env, opt.volumeId):
+        stub = _vs_stub(env, h["id"], h["grpc_port"])
+        if opt.readonly:
+            stub.call("VolumeMarkReadonly",
+                      vpb.VolumeMarkReadonlyRequest(volume_id=opt.volumeId),
+                      vpb.VolumeMarkReadonlyResponse)
+        elif opt.writable:
+            stub.call("VolumeMarkWritable",
+                      vpb.VolumeMarkWritableRequest(volume_id=opt.volumeId),
+                      vpb.VolumeMarkWritableResponse)
+    env.println("done")
+
+
+@command("volume.fix.replication",
+         "re-replicate volumes whose replica sets are incomplete",
+         needs_lock=True)
+def cmd_fix_replication(env: CommandEnv, args):
+    """Reference command_volume_fix_replication.go: for every volume whose
+    live replica count < replica placement target, copy it from a healthy
+    holder to a server that lacks it."""
+    servers = env.collect_volume_servers()
+    # volume -> holders, and volume -> info
+    holders: dict[int, list[dict]] = {}
+    infos: dict[int, mpb.VolumeInformationMessage] = {}
+    for srv in servers:
+        for disk in srv["disks"].values():
+            for v in disk.volume_infos:
+                holders.setdefault(v.id, []).append(srv)
+                infos[v.id] = v
+    fixed = 0
+    for vid, hs in sorted(holders.items()):
+        from ..storage.types import ReplicaPlacement
+        target = ReplicaPlacement.from_byte(infos[vid].replica_placement).copy_count
+        if len(hs) >= target:
+            continue
+        have = {h["id"] for h in hs}
+        candidates = [s for s in servers if s["id"] not in have]
+        src = hs[0]
+        for dst in candidates[: target - len(hs)]:
+            env.println(f"  replicating volume {vid} {src['id']} -> {dst['id']}")
+            _vs_stub(env, dst["id"], dst["grpc_port"]).call(
+                "VolumeCopy", vpb.VolumeCopyRequest(
+                    volume_id=vid, collection=infos[vid].collection,
+                    source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
+                vpb.VolumeCopyResponse, timeout=600)
+            fixed += 1
+    env.println(f"replicated {fixed} volume copies")
+
+
+@command("volume.move", "-volumeId N -source ip:port -target ip:port",
+         needs_lock=True)
+def cmd_volume_move(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="volume.move")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-source", required=True)
+    p.add_argument("-target", required=True)
+    opt = p.parse_args(args)
+    servers = {s["id"]: s for s in env.collect_volume_servers()}
+    src, dst = servers[opt.source], servers[opt.target]
+    info = next(v for d in src["disks"].values() for v in d.volume_infos
+                if v.id == opt.volumeId)
+    _vs_stub(env, dst["id"], dst["grpc_port"]).call(
+        "VolumeCopy", vpb.VolumeCopyRequest(
+            volume_id=opt.volumeId, collection=info.collection,
+            source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
+        vpb.VolumeCopyResponse, timeout=600)
+    _vs_stub(env, src["id"], src["grpc_port"]).call(
+        "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=opt.volumeId),
+        vpb.VolumeDeleteResponse)
+    env.println(f"moved volume {opt.volumeId} {opt.source} -> {opt.target}")
+
+
+@command("volume.balance", "even out volume counts across servers",
+         needs_lock=True)
+def cmd_volume_balance(env: CommandEnv, args):
+    """Reference command_volume_balance.go simplified: move volumes from the
+    fullest server to the emptiest until counts differ by <= 1."""
+    while True:
+        servers = env.collect_volume_servers()
+        counts = []
+        for s in servers:
+            vols = [v for d in s["disks"].values() for v in d.volume_infos]
+            counts.append((len(vols), s, vols))
+        counts.sort(key=lambda c: c[0])
+        low, high = counts[0], counts[-1]
+        if high[0] - low[0] <= 1:
+            break
+        # move one volume high -> low (skip volumes low already holds)
+        low_ids = {v.id for v in low[2]}
+        movable = [v for v in high[2] if v.id not in low_ids]
+        if not movable:
+            break
+        v = movable[0]
+        env.println(f"  balancing: volume {v.id} {high[1]['id']} -> {low[1]['id']}")
+        _vs_stub(env, low[1]["id"], low[1]["grpc_port"]).call(
+            "VolumeCopy", vpb.VolumeCopyRequest(
+                volume_id=v.id, collection=v.collection,
+                source_data_node=env.grpc_addr(high[1]["id"], high[1]["grpc_port"])),
+            vpb.VolumeCopyResponse, timeout=600)
+        _vs_stub(env, high[1]["id"], high[1]["grpc_port"]).call(
+            "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=v.id),
+            vpb.VolumeDeleteResponse)
+    env.println("balanced")

@@ -168,10 +168,14 @@ class NeedleMap:
         return self.map.get(key)
 
     def flush(self) -> None:
+        if self._idx.closed:
+            return
         self._idx.flush()
         os.fsync(self._idx.fileno())
 
     def close(self) -> None:
+        if self._idx.closed:
+            return
         try:
             self.flush()
         finally:

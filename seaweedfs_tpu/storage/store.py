@@ -1,0 +1,315 @@
+"""Store: the per-server aggregate over disk locations.
+
+Reference: weed/storage/store.go:83 (NewStore), :259 (CollectHeartbeat),
+:436/:460 (write/read dispatch), store_ec.go (EC mount/read), :389
+(deleteExpiredEcVolumes, fork). Serves both the volume server daemon and the
+single-binary dev mode.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..ec import files as ec_files
+from ..ec.encoder import decode_volume, encode_volume, rebuild_shards
+from ..ec.locate import EcGeometry
+from ..ec.volume import EcVolume
+from ..ops.coder import ErasureCoder, get_coder
+from ..utils.log import logger
+from . import types as t
+from .disk_location import DiskLocation
+from .needle import Needle
+from .volume import Volume
+
+log = logger("store")
+
+
+class Store:
+    def __init__(self, ip: str, port: int, public_url: str,
+                 locations: list[DiskLocation],
+                 ec_geometry: EcGeometry | None = None,
+                 coder_name: str = "auto"):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.locations = locations
+        self.ec_geometry = ec_geometry or EcGeometry()
+        self.coder_name = coder_name
+        for loc in locations:
+            loc.load_existing()
+
+    # -- coder selection (the pluggable north-star seam) --------------------
+    def coder(self, d: int | None = None, p: int | None = None) -> ErasureCoder:
+        d = d or self.ec_geometry.d
+        p = p or self.ec_geometry.p
+        name = self.coder_name
+        if name == "auto":
+            try:
+                import jax  # noqa: F401
+                name = "jax"
+            except Exception:  # noqa: BLE001
+                name = "numpy"
+        try:
+            return get_coder(name, d, p)
+        except Exception:  # noqa: BLE001
+            return get_coder("numpy", d, p)
+
+    # -- volume lifecycle ---------------------------------------------------
+    def find_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> EcVolume | None:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def _location_for(self, disk_type: str | None = None) -> DiskLocation:
+        cands = [l for l in self.locations
+                 if (disk_type is None or l.disk_type == disk_type)
+                 and l.free_slots() > 0 and l.has_free_space()]
+        if not cands:
+            raise OSError(f"no free slots for disk type {disk_type}")
+        return max(cands, key=lambda l: l.free_slots())
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "000", ttl: str = "",
+                   disk_type: str | None = None) -> Volume:
+        if self.find_volume(vid) is not None:
+            raise FileExistsError(f"volume {vid} exists")
+        loc = self._location_for(disk_type)
+        v = Volume(loc.directory, collection, vid,
+                   replica_placement=t.ReplicaPlacement.parse(replication),
+                   ttl=t.TTL.parse(ttl))
+        with loc.lock:
+            loc.volumes[vid] = v
+        log.info("allocated volume %d (col=%r) at %s", vid, collection, loc.directory)
+        return v
+
+    def delete_volume(self, vid: int, only_empty: bool = False) -> None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is None:
+                continue
+            if only_empty and v.file_count > 0:
+                raise OSError(f"volume {vid} not empty")
+            with loc.lock:
+                loc.volumes.pop(vid, None)
+            v.destroy()
+            return
+        raise KeyError(f"volume {vid} not found")
+
+    def mark_readonly(self, vid: int, read_only: bool = True) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        v.read_only = read_only
+
+    # -- data path ----------------------------------------------------------
+    def write_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_needle(self, vid: int, needle_id: int, cookie: int | None = None,
+                    shard_reader=None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie=cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(needle_id, cookie=cookie,
+                                  shard_reader=shard_reader)
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.delete_needle(needle_id)
+
+    # -- EC operations (reference volume_grpc_erasure_coding.go) -----------
+    def generate_ec_shards(self, vid: int, collection: str = "",
+                           d: int | None = None, p: int | None = None) -> str:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        geo = EcGeometry(d or self.ec_geometry.d, p or self.ec_geometry.p,
+                         self.ec_geometry.large_block,
+                         self.ec_geometry.small_block)
+        v.sync()
+        base = v.file_name()
+        encode_volume(base + ".dat", base, geo, self.coder(geo.d, geo.p),
+                      idx_path=base + ".idx")
+        return base
+
+    def mount_ec_shards(self, vid: int, collection: str = "") -> EcVolume:
+        for loc in self.locations:
+            old = loc.ec_volumes.get(vid)
+            if old is not None:  # remount: rescan shard files on disk
+                old.close()
+                ev = EcVolume(old.base, vid, collection, old.geo)
+                with loc.lock:
+                    loc.ec_volumes[vid] = ev
+                return ev
+        for loc in self.locations:
+            base = loc.base_name(collection, vid)
+            if os.path.exists(base + ".ecx") or any(
+                    os.path.exists(base + ec_files.shard_ext(i))
+                    for i in range(32)):
+                ev = EcVolume(base, vid, collection)
+                with loc.lock:
+                    loc.ec_volumes[vid] = ev
+                return ev
+        raise KeyError(f"no ec shards for volume {vid}")
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int] | None = None) -> None:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is None:
+                continue
+            if shard_ids is None:
+                with loc.lock:
+                    loc.ec_volumes.pop(vid, None)
+                ev.close()
+            else:
+                for sid in shard_ids:
+                    sh = ev.shards.pop(sid, None)
+                    if sh:
+                        sh.close()
+                if not ev.shards:
+                    with loc.lock:
+                        loc.ec_volumes.pop(vid, None)
+                    ev.close()
+            return
+
+    def rebuild_ec_shards(self, vid: int, collection: str = "") -> list[int]:
+        ev = self.find_ec_volume(vid)
+        base = ev.base if ev else None
+        if base is None:
+            for loc in self.locations:
+                cand = loc.base_name(collection, vid)
+                if os.path.exists(cand + ".ecx"):
+                    base = cand
+                    break
+        if base is None:
+            raise KeyError(f"no ec files for volume {vid}")
+        info = ec_files.read_vif(base + ".vif")
+        geo = EcGeometry(info.get("d", self.ec_geometry.d),
+                         info.get("p", self.ec_geometry.p),
+                         info.get("large_block", self.ec_geometry.large_block),
+                         info.get("small_block", self.ec_geometry.small_block))
+        if ev:
+            ev.close()
+        rebuilt = rebuild_shards(base, geo, self.coder(geo.d, geo.p))
+        if ev:
+            for loc in self.locations:
+                if loc.ec_volumes.get(vid) is ev:
+                    loc.ec_volumes[vid] = EcVolume(base, vid, collection, geo)
+        return rebuilt
+
+    def ec_shards_to_volume(self, vid: int, collection: str = "") -> Volume:
+        """Decode EC shards back into a normal volume (ShardsToVolume RPC)."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"no ec volume {vid}")
+        base = ev.base
+        geo = ev.geo
+        coder = self.coder(geo.d, geo.p)
+        decode_volume(base, base + ".dat", geo, coder)
+        if os.path.exists(base + ".ecx"):
+            ec_files.write_idx_from_ecx(base + ".ecx", base + ".ecj", base + ".idx")
+        else:
+            # no index sidecar survived: rebuild the .idx by scanning the .dat
+            # (reference `weed fix` behavior, command/fix.go:74), then replay
+            # the delete journal so journal-only deletes stay deleted
+            from .needle_map import _ENTRY
+            from .volume import rebuild_idx_from_dat
+            rebuild_idx_from_dat(base + ".dat", base + ".idx")
+            journaled = ec_files.read_ecj(base + ".ecj")
+            if journaled:
+                with open(base + ".idx", "ab") as f:
+                    for nid in journaled:
+                        f.write(_ENTRY.pack(nid, 0, t.TOMBSTONE_SIZE))
+        self.unmount_ec_shards(vid)
+        for loc in self.locations:
+            if os.path.dirname(base) == loc.directory:
+                v = Volume(loc.directory, collection, vid, create_if_missing=False)
+                with loc.lock:
+                    loc.volumes[vid] = v
+                return v
+        raise RuntimeError("location vanished")
+
+    def delete_expired_ec_volumes(self) -> list[int]:
+        """Fork behavior (store.go:389): reap EC volumes past DestroyTime."""
+        now = time.time()
+        reaped = []
+        for loc in self.locations:
+            for vid, ev in list(loc.ec_volumes.items()):
+                if ev.destroy_time and ev.destroy_time < now:
+                    with loc.lock:
+                        loc.ec_volumes.pop(vid, None)
+                    ev.destroy(to_trash=os.path.join(loc.directory, ".trash"))
+                    reaped.append(vid)
+        return reaped
+
+    # -- heartbeat assembly (store.go:259) ----------------------------------
+    def collect_heartbeat(self) -> dict:
+        volumes, ec_shards = [], []
+        max_file_key = 0
+        for loc in self.locations:
+            for vid, v in loc.volumes.items():
+                max_file_key = max(max_file_key, v.nm.max_key)
+                volumes.append({
+                    "id": vid, "size": v.content_size,
+                    "collection": v.collection,
+                    "file_count": v.file_count,
+                    "delete_count": v.deleted_count,
+                    "deleted_byte_count": v.nm.deleted_size,
+                    "read_only": v.read_only,
+                    "replica_placement": v.super_block.replica_placement.to_byte(),
+                    "version": v.super_block.version,
+                    "ttl": int.from_bytes(v.super_block.ttl.to_bytes(), "little"),
+                    "compact_revision": v.super_block.compaction_revision,
+                    "modified_at_second": int(v.last_append_at_ns // 1e9),
+                    "disk_type": loc.disk_type,
+                })
+            for vid, ev in loc.ec_volumes.items():
+                ec_shards.append({
+                    "id": vid, "collection": ev.collection,
+                    "ec_index_bits": ev.shard_bits().bits,
+                    "disk_type": loc.disk_type,
+                    "destroy_time": ev.destroy_time,
+                })
+        return {
+            "volumes": volumes, "ec_shards": ec_shards,
+            "max_file_key": max_file_key,
+            "max_volume_counts": self._max_volume_counts(),
+        }
+
+    def _max_volume_counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for loc in self.locations:
+            out[loc.disk_type] = out.get(loc.disk_type, 0) + loc.max_volume_count
+        return out
+
+    def status(self) -> dict:
+        return {
+            "volumes": sum(len(l.volumes) for l in self.locations),
+            "ec_volumes": sum(len(l.ec_volumes) for l in self.locations),
+            "locations": [l.directory for l in self.locations],
+        }
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ev in loc.ec_volumes.values():
+                ev.close()

@@ -17,6 +17,43 @@ from .needle_map import NeedleMap, idx_entries_numpy
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 
 
+def iter_records(f, start: int, end: int):
+    """Walk whole needle records in [start, end): yields
+    (offset, needle_id, header_size). Stops at the first torn/partial record.
+    Single source of truth for the on-disk record walk (used by load-time
+    integrity check and by idx-rebuild repair)."""
+    import struct
+
+    pos = start
+    while pos + t.NEEDLE_HEADER_SIZE <= end:
+        f.seek(pos)
+        hdr = f.read(t.NEEDLE_HEADER_SIZE)
+        if len(hdr) < t.NEEDLE_HEADER_SIZE:
+            return
+        _, nid, nsize = struct.unpack("<IQI", hdr)
+        rec = record_size_from_header(nsize)
+        if pos + rec > end:
+            return
+        yield pos, nid, nsize
+        pos += rec
+
+
+def rebuild_idx_from_dat(dat_path: str, idx_path: str) -> int:
+    """Rebuild a .idx by scanning needle headers in the .dat
+    (reference command/fix.go:74). Returns entry count."""
+    from .needle_map import write_idx_entries
+
+    size = os.path.getsize(dat_path)
+    keys, offs, sizes = [], [], []
+    with open(dat_path, "rb") as f:
+        for pos, nid, nsize in iter_records(f, SUPER_BLOCK_SIZE, size):
+            keys.append(nid)
+            offs.append(pos // t.NEEDLE_PADDING if nsize != t.TOMBSTONE_SIZE else 0)
+            sizes.append(nsize)
+    write_idx_entries(idx_path, keys, offs, sizes)
+    return len(keys)
+
+
 class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: t.ReplicaPlacement | None = None,
@@ -91,17 +128,8 @@ class Volume:
     def _scan_forward(self, start: int, dat_size: int) -> int:
         """Walk records from `start`; return the end of the last whole record."""
         pos = start
-        while pos + t.NEEDLE_HEADER_SIZE <= dat_size:
-            self._dat.seek(pos)
-            hdr = self._dat.read(t.NEEDLE_HEADER_SIZE)
-            if len(hdr) < t.NEEDLE_HEADER_SIZE:
-                break
-            import struct
-            _, _, size = struct.unpack("<IQI", hdr)
-            rec = record_size_from_header(size)
-            if pos + rec > dat_size:
-                break
-            pos += rec
+        for off, _, nsize in iter_records(self._dat, start, dat_size):
+            pos = off + record_size_from_header(nsize)
         return pos
 
     # -- write path (reference volume_write.go:119 writeNeedle2) -----------
@@ -187,6 +215,8 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            if self._dat.closed:
+                return
             try:
                 self._dat.flush()
             finally:

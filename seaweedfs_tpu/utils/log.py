@@ -1,0 +1,33 @@
+"""Leveled logging (reference: weed/glog). Thin wrapper over stdlib logging
+with glog-style V(n) verbosity gates."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_VERBOSITY = int(os.environ.get("SWTPU_V", "0"))
+
+_root = logging.getLogger("swtpu")
+if not _root.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(levelname).1s%(asctime)s.%(msecs)03d %(name)s: %(message)s",
+        datefmt="%m%d %H:%M:%S"))
+    _root.addHandler(h)
+    _root.setLevel(logging.INFO)
+
+
+def logger(name: str) -> logging.Logger:
+    return _root.getChild(name)
+
+
+def v(level: int) -> bool:
+    """glog-style verbosity check: if log.v(2): log...  (weed/glog V(n))."""
+    return _VERBOSITY >= level
+
+
+def set_verbosity(level: int) -> None:
+    global _VERBOSITY
+    _VERBOSITY = level

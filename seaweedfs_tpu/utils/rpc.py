@@ -1,0 +1,122 @@
+"""gRPC without grpcio-tools: generic method registration + client stubs.
+
+The image has grpcio + protoc but not grpcio-tools, so services are declared
+in code against protoc-generated message classes. Server side builds a
+GenericRpcHandler per service; client side wraps channel.unary_unary etc.
+Plays the role of the reference's pb/grpc dial helpers
+(weed/operation/grpc_client.go, weed/pb/grpc_client_server.go) including
+cached channels.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Callable
+
+import grpc
+
+
+class RpcService:
+    """Declarative service: register handlers, then mount on a grpc.Server."""
+
+    def __init__(self, name: str):
+        self.name = name  # e.g. "swtpu.master.Master"
+        self._handlers: dict[str, grpc.RpcMethodHandler] = {}
+
+    def unary(self, method: str, req_cls, resp_cls):
+        def deco(fn: Callable):
+            self._handlers[method] = grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+            return fn
+        return deco
+
+    def unary_stream(self, method: str, req_cls, resp_cls):
+        def deco(fn: Callable):
+            self._handlers[method] = grpc.unary_stream_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+            return fn
+        return deco
+
+    def stream_stream(self, method: str, req_cls, resp_cls):
+        def deco(fn: Callable):
+            self._handlers[method] = grpc.stream_stream_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+            return fn
+        return deco
+
+    def generic_handler(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(self.name, self._handlers)
+
+
+def serve(bind: str, services: list[RpcService], max_workers: int = 16) -> grpc.Server:
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", 256 << 20),
+                 ("grpc.max_send_message_length", 256 << 20)])
+    for s in services:
+        server.add_generic_rpc_handlers((s.generic_handler(),))
+    server.add_insecure_port(bind)
+    server.start()
+    return server
+
+
+_channel_cache: dict[str, grpc.Channel] = {}
+_channel_lock = threading.Lock()
+
+
+def channel(address: str) -> grpc.Channel:
+    with _channel_lock:
+        ch = _channel_cache.get(address)
+        if ch is None:
+            ch = grpc.insecure_channel(
+                address,
+                options=[("grpc.max_receive_message_length", 256 << 20),
+                         ("grpc.max_send_message_length", 256 << 20)])
+            _channel_cache[address] = ch
+        return ch
+
+
+def drop_channel(address: str) -> None:
+    with _channel_lock:
+        ch = _channel_cache.pop(address, None)
+    if ch is not None:
+        ch.close()
+
+
+class Stub:
+    """Thin client for one service on one address."""
+
+    def __init__(self, address: str, service: str):
+        self.address = address
+        self.service = service
+        self._ch = channel(address)
+
+    def call(self, method: str, request, resp_cls, timeout: float = 30.0):
+        fn = self._ch.unary_unary(
+            f"/{self.service}/{method}",
+            request_serializer=type(request).SerializeToString,
+            response_deserializer=resp_cls.FromString)
+        return fn(request, timeout=timeout)
+
+    def call_stream(self, method: str, request, resp_cls, timeout: float = 300.0):
+        fn = self._ch.unary_stream(
+            f"/{self.service}/{method}",
+            request_serializer=type(request).SerializeToString,
+            response_deserializer=resp_cls.FromString)
+        return fn(request, timeout=timeout)
+
+    def stream_stream(self, method: str, request_iter, req_cls, resp_cls):
+        fn = self._ch.stream_stream(
+            f"/{self.service}/{method}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString)
+        return fn(request_iter)
+
+
+MASTER_SERVICE = "swtpu.master.Master"
+VOLUME_SERVICE = "swtpu.volume.VolumeServer"
+FILER_SERVICE = "swtpu.filer.Filer"

@@ -1,0 +1,216 @@
+"""Cluster integration: master + 3 volume servers in-process on localhost.
+
+The docker-compose analogue of the reference's local-cluster-compose.yml
+(SURVEY.md §4.5) — multi-node behavior (heartbeats, growth, replication,
+EC spread, degraded reads) without containers."""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.master_client import MasterClient
+from seaweedfs_tpu.ec.locate import EcGeometry
+from seaweedfs_tpu.master.master_server import MasterServer
+from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.5)
+    master.start()
+    servers = []
+    geo = EcGeometry(d=4, p=2, large_block=1 << 20, small_block=1 << 14)
+    for i in range(3):
+        d = tmp_path_factory.mktemp(f"vs{i}")
+        store = Store("127.0.0.1", 0, "", [DiskLocation(str(d), max_volume_count=10)],
+                      ec_geometry=geo, coder_name="numpy")
+        port = free_port()
+        store.port = port
+        store.public_url = f"127.0.0.1:{port}"
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.5,
+                          rack=f"rack{i % 2}")
+        vs.start()
+        servers.append(vs)
+    # wait for registration and HTTP readiness
+    import requests as _rq
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.nodes) < 3:
+        time.sleep(0.1)
+    assert len(master.topo.nodes) == 3, "volume servers failed to register"
+    for vs in servers:
+        while time.time() < deadline:
+            try:
+                if _rq.get(f"http://127.0.0.1:{vs.port}/status", timeout=1).ok:
+                    break
+            except Exception:
+                time.sleep(0.1)
+        else:
+            pytest.fail(f"volume server {vs.port} HTTP not ready")
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+    yield master, servers, mc
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:
+            pass
+    master.stop()
+
+
+def test_write_read_delete_single(cluster):
+    master, servers, mc = cluster
+    payload = b"hello weedtpu" * 100
+    res = operation.submit(mc, payload, name="hello.txt", mime="text/plain")
+    assert res.fid and res.size > 0
+    got = operation.read(mc, res.fid)
+    assert got == payload
+    assert operation.delete(mc, res.fid)
+    time.sleep(0.1)
+    with pytest.raises((KeyError, RuntimeError)):
+        operation.read(mc, res.fid)
+
+
+def test_replicated_write(cluster):
+    master, servers, mc = cluster
+    payload = os.urandom(5000)
+    res = operation.submit(mc, payload, replication="001", collection="rep")
+    # both replicas must hold the needle
+    vid = int(res.fid.split(",")[0])
+    time.sleep(1.5)  # let heartbeats propagate volume stats
+    locs = master.topo.lookup(vid)
+    assert len(locs) == 2, f"expected 2 replicas, got {[n.id for n in locs]}"
+    from seaweedfs_tpu.storage.types import parse_file_id
+    _, key, _ = parse_file_id(res.fid)
+    held = 0
+    for vs in servers:
+        v = vs.store.find_volume(vid)
+        if v is not None:
+            assert v.read_needle(key).data == payload
+            held += 1
+    assert held == 2
+
+
+def test_many_files_roundtrip(cluster):
+    master, servers, mc = cluster
+    rng = np.random.default_rng(0)
+    blobs = {}
+    for i in range(40):
+        data = rng.integers(0, 256, int(rng.integers(10, 5000)),
+                            dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data)
+        blobs[res.fid] = data
+    for fid, data in blobs.items():
+        assert operation.read(mc, fid) == data
+
+
+def test_ec_encode_spread_and_degraded_read(cluster):
+    """The ec.encode flow: write blobs, encode the volume on its server,
+    spread shards to other servers via VolumeEcShardsCopy, delete the
+    original, read through EC incl. a degraded read after killing a shard."""
+    master, servers, mc = cluster
+    rng = np.random.default_rng(1)
+    blobs = {}
+    for i in range(30):
+        data = rng.integers(0, 256, int(rng.integers(100, 20000)),
+                            dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data, collection="ecol")
+        blobs[res.fid] = data
+    vid = int(next(iter(blobs)).split(",")[0])
+    assert all(int(f.split(",")[0]) == vid for f in blobs)
+
+    src_vs = next(vs for vs in servers if vs.store.find_volume(vid) is not None)
+    src_stub = Stub(f"127.0.0.1:{src_vs.grpc_port}", VOLUME_SERVICE)
+    src_stub.call("VolumeMarkReadonly", vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                  vpb.VolumeMarkReadonlyResponse)
+    src_stub.call("VolumeEcShardsGenerate",
+                  vpb.VolumeEcShardsGenerateRequest(volume_id=vid, collection="ecol"),
+                  vpb.VolumeEcShardsGenerateResponse, timeout=120)
+
+    # spread: shards 0-2 stay on src; 3 -> server B; 4,5 -> server C
+    others = [vs for vs in servers if vs is not src_vs]
+    spread = {src_vs: [0, 1, 2], others[0]: [3], others[1]: [4, 5]}
+    for vs, sids in spread.items():
+        if vs is not src_vs:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection="ecol", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True, copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{src_vs.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=vid, collection="ecol",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    # remove non-local shards from src (it generated all 6)
+    base = src_vs.store.find_ec_volume(vid).base
+    Stub(f"127.0.0.1:{src_vs.grpc_port}", VOLUME_SERVICE).call(
+        "VolumeEcShardsUnmount",
+        vpb.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=[3, 4, 5]),
+        vpb.VolumeEcShardsUnmountResponse)
+    from seaweedfs_tpu.ec import files as ec_files
+    for sid in (3, 4, 5):
+        os.remove(base + ec_files.shard_ext(sid))
+    Stub(f"127.0.0.1:{src_vs.grpc_port}", VOLUME_SERVICE).call(
+        "VolumeEcShardsMount",
+        vpb.VolumeEcShardsMountRequest(volume_id=vid, collection="ecol",
+                                       shard_ids=[0, 1, 2]),
+        vpb.VolumeEcShardsMountResponse)
+    # delete the original volume; reads must go through EC now
+    src_stub.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
+                  vpb.VolumeDeleteResponse)
+    time.sleep(1.5)  # heartbeats update master ec registry
+
+    assert vid in master.topo.ec_locations
+    for fid, data in list(blobs.items())[:10]:
+        assert operation.read(mc, fid) == data, f"ec read {fid}"
+
+    # degraded: kill shard 3's holder entirely
+    others[0].stop()
+    time.sleep(1.0)
+    for fid, data in list(blobs.items())[10:16]:
+        assert operation.read(mc, fid) == data, f"degraded ec read {fid}"
+
+
+def test_vacuum_via_rpc(cluster):
+    master, servers, mc = cluster
+    fids = []
+    for i in range(20):
+        res = operation.submit(mc, os.urandom(2000), collection="vac")
+        fids.append(res.fid)
+    vid = int(fids[0].split(",")[0])
+    for fid in fids[:10]:
+        operation.delete(mc, fid)
+    vs = next(v for v in servers if v.store.find_volume(vid) is not None)
+    stub = Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE)
+    chk = stub.call("VacuumVolumeCheck", vpb.VacuumVolumeCheckRequest(volume_id=vid),
+                    vpb.VacuumVolumeCheckResponse)
+    assert chk.garbage_ratio > 0.3
+    stub.call("VacuumVolumeCompact", vpb.VacuumVolumeCompactRequest(volume_id=vid),
+              vpb.VacuumVolumeCompactResponse, timeout=60)
+    stub.call("VacuumVolumeCommit", vpb.VacuumVolumeCommitRequest(volume_id=vid),
+              vpb.VacuumVolumeCommitResponse)
+    for fid in fids[10:]:
+        assert operation.read(mc, fid)
+    with pytest.raises((KeyError, RuntimeError)):
+        operation.read(mc, fids[0])
