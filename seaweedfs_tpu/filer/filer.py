@@ -1,0 +1,241 @@
+"""Filer core: namespace CRUD over a pluggable store + event notification.
+
+Reference: weed/filer/filer.go:57 (Filer), :188 CreateEntry (parent-dir
+auto-create), :301 UpdateEntry, filer_delete_entry.go (recursive delete with
+chunk GC), filer_rename.go (AtomicRenameEntry as subtree move),
+filechunks.go garbage collection of replaced chunks, TTL expiry on read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterator
+
+from ..pb import filer_pb2 as fpb
+from ..utils.log import logger
+from .chunks import resolve_manifests, separate_manifest_chunks, total_size
+from .meta_log import MetaLog
+from .store import FilerStore
+
+log = logger("filer")
+
+ROOT = "/"
+
+
+def split_path(path: str) -> tuple[str, str]:
+    path = path.rstrip("/") or "/"
+    if path == "/":
+        return "/", ""
+    d, _, n = path.rpartition("/")
+    return d or "/", n
+
+
+def join_path(directory: str, name: str) -> str:
+    return f"{directory.rstrip('/')}/{name}" if name else directory
+
+
+class Filer:
+    def __init__(self, store: FilerStore, meta_log_path: str | None = None,
+                 chunk_deleter: Callable[[list[str]], None] | None = None,
+                 signature: int = 0):
+        self.store = store
+        self.meta_log = MetaLog(meta_log_path)
+        self.signature = signature or (time.time_ns() & 0x7FFFFFFF)
+        # chunk_deleter receives file_ids of unreferenced chunks (wired to
+        # operation.delete_batch by the server; no-op in unit tests)
+        self.chunk_deleter = chunk_deleter or (lambda fids: None)
+        self._dir_lock = threading.RLock()  # _ensure_parents recurses
+
+    # -- CRUD ---------------------------------------------------------------
+    def create_entry(self, directory: str, entry: fpb.Entry,
+                     o_excl: bool = False, from_other_cluster: bool = False,
+                     signatures: list[int] | None = None) -> None:
+        if not entry.attributes.crtime:
+            entry.attributes.crtime = int(time.time())
+        if not entry.attributes.mtime:
+            entry.attributes.mtime = int(time.time())
+        self._ensure_parents(directory)
+        old = self.store.find_entry(directory, entry.name)
+        if old is not None and o_excl:
+            raise FileExistsError(join_path(directory, entry.name))
+        self.store.insert_entry(directory, entry)
+        if old is not None:
+            self._gc_replaced_chunks(old, entry)
+        self._notify(directory, old, entry, delete_chunks=old is not None,
+                     from_other_cluster=from_other_cluster,
+                     signatures=signatures)
+
+    def _ensure_parents(self, directory: str) -> None:
+        if directory == "/":
+            return
+        parent, name = split_path(directory)
+        if self.store.find_entry(parent, name) is not None:
+            return
+        with self._dir_lock:
+            if self.store.find_entry(parent, name) is not None:
+                return
+            self._ensure_parents(parent)
+            e = fpb.Entry(name=name, is_directory=True)
+            e.attributes.crtime = e.attributes.mtime = int(time.time())
+            e.attributes.file_mode = 0o40755
+            self.store.insert_entry(parent, e)
+            self._notify(parent, None, e)
+
+    def update_entry(self, directory: str, entry: fpb.Entry,
+                     from_other_cluster: bool = False,
+                     signatures: list[int] | None = None) -> None:
+        old = self.store.find_entry(directory, entry.name)
+        if old is None:
+            raise FileNotFoundError(join_path(directory, entry.name))
+        entry.attributes.mtime = int(time.time())
+        self.store.update_entry(directory, entry)
+        self._gc_replaced_chunks(old, entry)
+        self._notify(directory, old, entry, delete_chunks=True,
+                     from_other_cluster=from_other_cluster,
+                     signatures=signatures)
+
+    def append_chunks(self, directory: str, name: str,
+                      chunks: list[fpb.FileChunk]) -> fpb.Entry:
+        entry = self.store.find_entry(directory, name)
+        if entry is None:
+            entry = fpb.Entry(name=name)
+            entry.attributes.crtime = int(time.time())
+            self._ensure_parents(directory)
+        offset = total_size(entry.chunks)
+        for c in chunks:
+            c.offset = offset
+            offset += c.size
+            entry.chunks.append(c)
+        entry.attributes.mtime = int(time.time())
+        entry.attributes.file_size = offset
+        self.store.insert_entry(directory, entry)
+        self._notify(directory, None, entry)
+        return entry
+
+    def find_entry(self, directory: str, name: str) -> fpb.Entry | None:
+        if directory == "/" and not name:
+            e = fpb.Entry(name="/", is_directory=True)
+            e.attributes.file_mode = 0o40755
+            return e
+        entry = self.store.find_entry(directory, name)
+        if entry is None:
+            return None
+        if self._expired(entry):
+            log.info("ttl-expired entry %s", join_path(directory, name))
+            self.delete_entry(directory, name, is_delete_data=True)
+            return None
+        return entry
+
+    @staticmethod
+    def _expired(entry: fpb.Entry) -> bool:
+        ttl = entry.attributes.ttl_sec
+        return bool(ttl) and entry.attributes.mtime + ttl < time.time()
+
+    def list_entries(self, directory: str, start_from: str = "",
+                     inclusive: bool = False, limit: int = 2**31,
+                     prefix: str = "") -> Iterator[fpb.Entry]:
+        for e in self.store.list_entries(directory, start_from, inclusive,
+                                         limit, prefix):
+            if not self._expired(e):
+                yield e
+
+    def delete_entry(self, directory: str, name: str,
+                     is_delete_data: bool = True, is_recursive: bool = False,
+                     from_other_cluster: bool = False,
+                     signatures: list[int] | None = None) -> None:
+        entry = self.store.find_entry(directory, name)
+        if entry is None:
+            return
+        path = join_path(directory, name)
+        if entry.is_directory:
+            children = list(self.store.list_entries(path, limit=2))
+            if children and not is_recursive:
+                raise OSError(f"{path} is a non-empty folder")
+            self._delete_subtree(path, is_delete_data)
+        elif is_delete_data:
+            self._delete_entry_chunks(entry)
+        self.store.delete_entry(directory, name)
+        self._notify(directory, entry, None, delete_chunks=is_delete_data,
+                     from_other_cluster=from_other_cluster,
+                     signatures=signatures)
+
+    def _delete_subtree(self, path: str, is_delete_data: bool) -> None:
+        for child in list(self.store.list_entries(path)):
+            cpath = join_path(path, child.name)
+            if child.is_directory:
+                self._delete_subtree(cpath, is_delete_data)
+            elif is_delete_data:
+                self._delete_entry_chunks(child)
+        self.store.delete_folder_children(path)
+
+    def _delete_entry_chunks(self, entry: fpb.Entry) -> None:
+        fids = [c.file_id for c in entry.chunks if c.file_id]
+        if fids:
+            self.chunk_deleter(fids)
+
+    def _gc_replaced_chunks(self, old: fpb.Entry, new: fpb.Entry) -> None:
+        """Delete chunks referenced by old but not by new (filechunks.go
+        MinusChunks)."""
+        keep = {c.file_id for c in new.chunks}
+        dead = [c.file_id for c in old.chunks
+                if c.file_id and c.file_id not in keep]
+        if dead:
+            self.chunk_deleter(dead)
+
+    # -- rename (reference filer_rename.go / AtomicRenameEntry) -------------
+    def rename(self, old_dir: str, old_name: str, new_dir: str,
+               new_name: str) -> None:
+        entry = self.store.find_entry(old_dir, old_name)
+        if entry is None:
+            raise FileNotFoundError(join_path(old_dir, old_name))
+        if self.store.find_entry(new_dir, new_name) is not None:
+            raise FileExistsError(join_path(new_dir, new_name))
+        self._ensure_parents(new_dir)
+        self._move_entry(old_dir, entry, new_dir, new_name)
+
+    def _move_entry(self, old_dir: str, entry: fpb.Entry, new_dir: str,
+                    new_name: str) -> None:
+        old_path = join_path(old_dir, entry.name)
+        moved = fpb.Entry()
+        moved.CopyFrom(entry)
+        moved.name = new_name
+        self.store.insert_entry(new_dir, moved)
+        if entry.is_directory:
+            new_path = join_path(new_dir, new_name)
+            for child in list(self.store.list_entries(old_path)):
+                self._move_entry(old_path, child, new_path, child.name)
+        self.store.delete_entry(old_dir, entry.name)
+        ev = fpb.EventNotification(old_entry=entry, new_entry=moved,
+                                   delete_chunks=False,
+                                   new_parent_path=new_dir)
+        ev.signatures.append(self.signature)
+        self.meta_log.append(old_dir, ev)
+
+    # -- events -------------------------------------------------------------
+    def _notify(self, directory: str, old: fpb.Entry | None,
+                new: fpb.Entry | None, delete_chunks: bool = False,
+                from_other_cluster: bool = False,
+                signatures: list[int] | None = None) -> None:
+        ev = fpb.EventNotification(delete_chunks=delete_chunks,
+                                   is_from_other_cluster=from_other_cluster)
+        if old is not None:
+            ev.old_entry.CopyFrom(old)
+        if new is not None:
+            ev.new_entry.CopyFrom(new)
+        for s in signatures or []:
+            ev.signatures.append(s)
+        ev.signatures.append(self.signature)
+        self.meta_log.append(directory, ev)
+
+    # -- manifest support ---------------------------------------------------
+    def data_chunks(self, entry: fpb.Entry,
+                    fetch: Callable[[str], bytes]) -> list[fpb.FileChunk]:
+        manifests, _ = separate_manifest_chunks(entry.chunks)
+        if not manifests:
+            return list(entry.chunks)
+        return resolve_manifests(entry.chunks, fetch)
+
+    def close(self) -> None:
+        self.meta_log.close()
+        self.store.close()

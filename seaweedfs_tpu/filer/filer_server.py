@@ -1,0 +1,437 @@
+"""Filer daemon: HTTP namespace API + gRPC service + metadata subscription.
+
+Reference: weed/server/filer_server.go, filer_server_handlers_write_autochunk.go:26
+(autoChunk upload loop), filer_server_handlers_read.go (range reads),
+filer_grpc_server.go (entry RPCs), filer_grpc_server_sub_meta.go
+(SubscribeMetadata). Data chunks are stored in the blob cluster via
+assign+upload; only metadata lives here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import mimetypes
+import threading
+import time
+import urllib.parse
+
+from ..client import operation
+from ..client.master_client import MasterClient
+from ..pb import filer_pb2 as fpb
+from ..utils.log import logger
+from ..utils.rpc import FILER_SERVICE, RpcService, serve
+from .chunks import etag as chunk_etag
+from .chunks import maybe_manifestize, read_views, total_size
+from .filer import Filer, join_path, split_path
+from .store import open_store
+
+log = logger("filer-server")
+
+DEFAULT_CHUNK_MB = 4  # reference filer.maxMB default (command/filer.go)
+INLINE_LIMIT = 0  # set >0 to inline small files into metadata
+
+
+class FilerServer:
+    def __init__(self, master_address: str, store_spec: str = "memory",
+                 ip: str = "127.0.0.1", port: int = 8888,
+                 grpc_port: int | None = None,
+                 meta_log_path: str | None = None,
+                 collection: str = "", replication: str = "",
+                 chunk_size_mb: int = DEFAULT_CHUNK_MB):
+        self.ip, self.port = ip, port
+        self.grpc_port = grpc_port or port + 10000
+        self.collection, self.replication = collection, replication
+        self.chunk_size = chunk_size_mb << 20
+        self.mc = MasterClient(master_address, client_type="filer")
+        self.filer = Filer(open_store(store_spec), meta_log_path,
+                           chunk_deleter=self._delete_chunks)
+        self._stop = threading.Event()
+        self._grpc = None
+        self._http_thread = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FilerServer":
+        self.mc.start()
+        self.mc.wait_connected(10)
+        self._grpc = serve(f"{self.ip}:{self.grpc_port}", [self._build_service()])
+        self._http_thread = threading.Thread(target=self._run_http, daemon=True,
+                                             name=f"filer-http-{self.port}")
+        self._http_thread.start()
+        log.info("filer %s up (grpc :%d, store %s)", self.url, self.grpc_port,
+                 self.filer.store.name)
+        return self
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._grpc:
+            self._grpc.stop(grace=0.5)
+        self.mc.stop()
+        self.filer.close()
+
+    def _delete_chunks(self, fids: list[str]) -> None:
+        def work():
+            try:
+                operation.delete_batch(self.mc, fids)
+            except Exception as e:  # noqa: BLE001
+                log.warning("chunk gc: %s", e)
+        threading.Thread(target=work, daemon=True).start()
+
+    # -- chunk IO helpers ----------------------------------------------------
+    def _save_blob(self, data: bytes, ttl: str = "") -> fpb.FileChunk:
+        a = self.mc.assign(collection=self.collection,
+                           replication=self.replication, ttl=ttl)
+        target = a.location.public_url or a.location.url
+        res = operation.upload(f"{target}/{a.fid}", data,
+                               gzip_if_worthwhile=False, ttl=ttl)
+        return fpb.FileChunk(file_id=a.fid, size=res.get("size", len(data)),
+                             modified_ts_ns=time.time_ns(),
+                             e_tag=res.get("eTag", ""))
+
+    def _fetch_blob(self, fid: str) -> bytes:
+        return operation.read(self.mc, fid)
+
+    def read_entry_bytes(self, entry: fpb.Entry, offset: int = 0,
+                         size: int | None = None) -> bytes:
+        if entry.content:
+            data = bytes(entry.content)
+            return data[offset:offset + size if size is not None else None]
+        chunks = self.filer.data_chunks(entry, self._fetch_blob)
+        fsize = max(total_size(chunks), entry.attributes.file_size)
+        if size is None:
+            size = fsize - offset
+        size = max(0, min(size, fsize - offset))
+        buf = bytearray(size)
+        for v in read_views(chunks, offset, size):
+            blob = self._fetch_blob(v.file_id)
+            part = blob[v.chunk_offset:v.chunk_offset + v.size]
+            at = v.logical_offset - offset
+            buf[at:at + len(part)] = part
+        return bytes(buf)
+
+    def write_file(self, path: str, data: bytes, mime: str = "",
+                   ttl_sec: int = 0, mode: int = 0o644) -> fpb.Entry:
+        """Auto-chunking write (reference doPostAutoChunk)."""
+        directory, name = split_path(path)
+        chunks: list[fpb.FileChunk] = []
+        md5 = hashlib.md5(data)
+        for off in range(0, len(data), self.chunk_size):
+            piece = data[off:off + self.chunk_size]
+            c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "")
+            c.offset = off
+            chunks.append(c)
+        chunks = maybe_manifestize(chunks, self._save_blob)
+        entry = fpb.Entry(name=name)
+        entry.chunks.extend(chunks)
+        a = entry.attributes
+        a.file_size = len(data)
+        a.mime = mime or mimetypes.guess_type(name)[0] or ""
+        a.file_mode = mode
+        a.ttl_sec = ttl_sec
+        a.md5 = md5.digest()
+        a.collection, a.replication = self.collection, self.replication
+        self.filer.create_entry(directory, entry)
+        return entry
+
+    # -- HTTP ---------------------------------------------------------------
+    def _run_http(self) -> None:
+        import asyncio
+
+        from aiohttp import web
+
+        async def handle(request: web.Request):
+            try:
+                if request.method in ("POST", "PUT"):
+                    return await self._h_write(request)
+                if request.method in ("GET", "HEAD"):
+                    return await self._h_read(request)
+                if request.method == "DELETE":
+                    return await self._h_delete(request)
+            except FileNotFoundError as e:
+                return web.json_response({"error": str(e)}, status=404)
+            except FileExistsError as e:
+                return web.json_response({"error": str(e)}, status=409)
+            except OSError as e:
+                return web.json_response({"error": str(e)}, status=409)
+            except Exception as e:  # noqa: BLE001
+                log.error("filer http: %r", e)
+                return web.json_response({"error": str(e)}, status=500)
+            return web.json_response({"error": "method not allowed"}, status=405)
+
+        async def status(request):
+            return web.json_response({"version": "swtpu-filer",
+                                      "master": self.mc.leader})
+
+        async def main():
+            app = web.Application(client_max_size=1 << 30)
+            app.router.add_get("/__status__", status)
+            app.router.add_route("*", "/{path:.*}", handle)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, self.ip, self.port)
+            await site.start()
+            while not self._stop.is_set():
+                await asyncio.sleep(0.2)
+            await runner.cleanup()
+
+        asyncio.run(main())
+
+    @staticmethod
+    def _req_path(request) -> str:
+        path = urllib.parse.unquote(request.path)
+        return path.rstrip("/") or "/"
+
+    async def _h_write(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        path = self._req_path(request)
+        is_dir_target = request.path.endswith("/") and path != "/"
+        mime = ""
+        if request.content_type and request.content_type.startswith("multipart/"):
+            reader = await request.multipart()
+            data = b""
+            async for part in reader:
+                data = await part.read(decode=False)
+                mime = part.headers.get("Content-Type", "")
+                if part.filename and (is_dir_target or path == "/"):
+                    path = join_path(path, part.filename)
+                break
+        else:
+            data = await request.read()
+            ct = request.content_type or ""
+            if ct and ct not in ("application/octet-stream",):
+                mime = ct
+        ttl_sec = _parse_ttl_sec(request.query.get("ttl", ""))
+        entry = await asyncio.to_thread(self.write_file, path, data, mime,
+                                        ttl_sec)
+        return web.json_response(
+            {"name": entry.name, "size": entry.attributes.file_size},
+            status=201)
+
+    async def _h_read(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        path = self._req_path(request)
+        directory, name = split_path(path)
+        entry = self.filer.find_entry(directory, name)
+        if entry is None:
+            raise FileNotFoundError(path)
+        if entry.is_directory:
+            limit = int(request.query.get("limit", "1000"))
+            last = request.query.get("lastFileName", "")
+            entries = list(self.filer.list_entries(path, start_from=last,
+                                                   limit=limit))
+            return web.json_response({
+                "Path": path,
+                "Entries": [_entry_json(path, e) for e in entries],
+                "Limit": limit,
+                "LastFileName": entries[-1].name if entries else "",
+            })
+        fsize = entry.attributes.file_size or total_size(entry.chunks)
+        headers = {"Accept-Ranges": "bytes",
+                   "Content-Type": entry.attributes.mime or "application/octet-stream"}
+        if entry.attributes.md5:
+            headers["ETag"] = f'"{entry.attributes.md5.hex()}"'
+        elif entry.chunks:
+            headers["ETag"] = f'"{chunk_etag(list(entry.chunks))}"'
+        rng = request.http_range
+        offset = rng.start or 0
+        if offset < 0:  # suffix range "bytes=-N": last N bytes
+            offset = max(0, fsize + offset)
+            stop = fsize
+        else:
+            stop = rng.stop if rng.stop is not None else fsize
+        stop = min(stop, fsize)
+        status = 200 if (offset == 0 and stop >= fsize) else 206
+        if status == 206:
+            headers["Content-Range"] = f"bytes {offset}-{stop - 1}/{fsize}"
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(fsize)
+            return web.Response(status=200, headers=headers)
+        data = await asyncio.to_thread(self.read_entry_bytes, entry, offset,
+                                       stop - offset)
+        return web.Response(body=data, status=status, headers=headers)
+
+    async def _h_delete(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        path = self._req_path(request)
+        directory, name = split_path(path)
+        recursive = request.query.get("recursive") == "true"
+        await asyncio.to_thread(self.filer.delete_entry, directory, name,
+                                True, recursive)
+        return web.Response(status=204)
+
+    # -- gRPC ---------------------------------------------------------------
+    def _build_service(self) -> RpcService:
+        svc = RpcService(FILER_SERVICE)
+        f = self.filer
+
+        @svc.unary("LookupDirectoryEntry", fpb.LookupDirectoryEntryRequest,
+                   fpb.LookupDirectoryEntryResponse)
+        def lookup(req, ctx):
+            e = f.find_entry(req.directory, req.name)
+            resp = fpb.LookupDirectoryEntryResponse()
+            if e is None:
+                ctx.abort(5, f"{join_path(req.directory, req.name)} not found")
+            resp.entry.CopyFrom(e)
+            return resp
+
+        @svc.unary_stream("ListEntries", fpb.ListEntriesRequest,
+                          fpb.ListEntriesResponse)
+        def list_entries(req, ctx):
+            for e in f.list_entries(req.directory, req.start_from_file_name,
+                                    req.inclusive_start_from,
+                                    req.limit or 2**31, req.prefix):
+                yield fpb.ListEntriesResponse(entry=e)
+
+        @svc.unary("CreateEntry", fpb.CreateEntryRequest,
+                   fpb.CreateEntryResponse)
+        def create(req, ctx):
+            try:
+                f.create_entry(req.directory, req.entry, o_excl=req.o_excl,
+                               from_other_cluster=req.is_from_other_cluster)
+                return fpb.CreateEntryResponse()
+            except (FileExistsError, OSError) as e:
+                return fpb.CreateEntryResponse(error=str(e))
+
+        @svc.unary("UpdateEntry", fpb.UpdateEntryRequest,
+                   fpb.UpdateEntryResponse)
+        def update(req, ctx):
+            f.update_entry(req.directory, req.entry,
+                           from_other_cluster=req.is_from_other_cluster)
+            return fpb.UpdateEntryResponse()
+
+        @svc.unary("AppendToEntry", fpb.AppendToEntryRequest,
+                   fpb.AppendToEntryResponse)
+        def append(req, ctx):
+            f.append_chunks(req.directory, req.entry_name, list(req.chunks))
+            return fpb.AppendToEntryResponse()
+
+        @svc.unary("DeleteEntry", fpb.DeleteEntryRequest,
+                   fpb.DeleteEntryResponse)
+        def delete(req, ctx):
+            try:
+                f.delete_entry(req.directory, req.name,
+                               is_delete_data=req.is_delete_data,
+                               is_recursive=req.is_recursive,
+                               from_other_cluster=req.is_from_other_cluster)
+                return fpb.DeleteEntryResponse()
+            except OSError as e:
+                if req.ignore_recursive_error:
+                    return fpb.DeleteEntryResponse()
+                return fpb.DeleteEntryResponse(error=str(e))
+
+        @svc.unary("AtomicRenameEntry", fpb.AtomicRenameEntryRequest,
+                   fpb.AtomicRenameEntryResponse)
+        def rename(req, ctx):
+            f.rename(req.old_directory, req.old_name,
+                     req.new_directory, req.new_name)
+            return fpb.AtomicRenameEntryResponse()
+
+        @svc.unary("AssignVolume", fpb.AssignVolumeRequest,
+                   fpb.AssignVolumeResponse)
+        def assign(req, ctx):
+            try:
+                a = self.mc.assign(count=req.count or 1,
+                                   collection=req.collection or self.collection,
+                                   replication=req.replication or self.replication,
+                                   ttl=f"{req.ttl_sec}s" if req.ttl_sec else "",
+                                   disk_type=req.disk_type)
+                return fpb.AssignVolumeResponse(
+                    file_id=a.fid, location_url=a.location.url,
+                    public_url=a.location.public_url, count=a.count,
+                    collection=req.collection or self.collection,
+                    replication=req.replication or self.replication)
+            except Exception as e:  # noqa: BLE001
+                return fpb.AssignVolumeResponse(error=str(e))
+
+        @svc.unary("LookupVolume", fpb.LookupVolumeRequest,
+                   fpb.LookupVolumeResponse)
+        def lookup_volume(req, ctx):
+            resp = fpb.LookupVolumeResponse()
+            for vid_str in req.volume_or_file_ids:
+                vid = int(vid_str.split(",")[0])
+                locs = fpb.Locations()
+                for l in self.mc.lookup(vid):
+                    locs.locations.add(url=l["url"],
+                                       public_url=l["public_url"],
+                                       grpc_port=l["grpc_port"])
+                resp.locations_map[vid_str].CopyFrom(locs)
+            return resp
+
+        @svc.unary("KvGet", fpb.KvGetRequest, fpb.KvGetResponse)
+        def kv_get(req, ctx):
+            v = f.store.kv_get(bytes(req.key))
+            return fpb.KvGetResponse(value=v or b"",
+                                     error="" if v is not None else "not found")
+
+        @svc.unary("KvPut", fpb.KvPutRequest, fpb.KvPutResponse)
+        def kv_put(req, ctx):
+            f.store.kv_put(bytes(req.key), bytes(req.value))
+            return fpb.KvPutResponse()
+
+        @svc.unary("Statistics", fpb.StatisticsRequest, fpb.StatisticsResponse)
+        def statistics(req, ctx):
+            return fpb.StatisticsResponse()
+
+        @svc.unary_stream("SubscribeMetadata", fpb.SubscribeMetadataRequest,
+                          fpb.SubscribeMetadataResponse)
+        def subscribe(req, ctx):
+            stop = threading.Event()
+            ctx.add_callback(stop.set)
+            for resp in f.meta_log.subscribe(req.since_ns, stop):
+                if req.path_prefix and not _under_prefix(resp.directory,
+                                                         req.path_prefix):
+                    continue
+                if req.signature and req.signature in \
+                        resp.event_notification.signatures:
+                    continue  # skip events this subscriber itself caused
+                yield resp
+
+        return svc
+
+
+def _under_prefix(directory: str, prefix: str) -> bool:
+    """True iff directory lies on the subscribed subtree path, respecting
+    '/' boundaries (so /data does not match /database)."""
+    p = prefix.rstrip("/") or "/"
+    if directory == p or p == "/":
+        return True
+    return directory.startswith(p + "/") or p.startswith(directory.rstrip("/") + "/")
+
+
+def _entry_json(directory: str, e: fpb.Entry) -> dict:
+    return {
+        "FullPath": join_path(directory, e.name),
+        "IsDirectory": e.is_directory,
+        "FileSize": e.attributes.file_size,
+        "Mtime": e.attributes.mtime,
+        "Crtime": e.attributes.crtime,
+        "Mime": e.attributes.mime,
+        "Mode": e.attributes.file_mode,
+        "TtlSec": e.attributes.ttl_sec,
+        "chunkCount": len(e.chunks),
+    }
+
+
+def _parse_ttl_sec(s: str) -> int:
+    if not s:
+        return 0
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800,
+             "M": 2592000, "y": 31536000}
+    if s[-1] in units:
+        return int(s[:-1]) * units[s[-1]]
+    return int(s)

@@ -1,0 +1,98 @@
+"""Filer metadata event log: every mutation appended, replayable, tailable.
+
+Reference: weed/filer/filer_notify.go:20-116 (NotifyUpdateEvent →
+util/log_buffer → dated files under /topics/.system/log, replayed by
+SubscribeMetadata) and util/log_buffer/log_buffer.go:53. Re-designed as one
+length-prefixed pb log file + an in-memory tail window and a condition
+variable for live subscribers, instead of the reference's paged buffer.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from collections import deque
+
+from ..pb import filer_pb2 as fpb
+
+_HDR = struct.Struct("<QI")  # ts_ns, blob length
+
+
+class MetaLog:
+    def __init__(self, path: str | None, tail_window: int = 4096):
+        self._path = path
+        self._f = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._f = open(path, "ab")
+        self._tail: deque[tuple[int, bytes]] = deque(maxlen=tail_window)
+        self._cond = threading.Condition()
+        self._last_ts = 0
+
+    def append(self, directory: str, ev: fpb.EventNotification) -> int:
+        resp = fpb.SubscribeMetadataResponse(directory=directory,
+                                             event_notification=ev)
+        with self._cond:
+            ts = max(time.time_ns(), self._last_ts + 1)  # strictly monotonic
+            self._last_ts = ts
+            resp.ts_ns = ts
+            blob = resp.SerializeToString()
+            if self._f:
+                self._f.write(_HDR.pack(ts, len(blob)))
+                self._f.write(blob)
+                self._f.flush()
+            self._tail.append((ts, blob))
+            self._cond.notify_all()
+        return ts
+
+    def _read_persisted(self, since_ns: int) -> list[tuple[int, bytes]]:
+        if not self._path or not os.path.exists(self._path):
+            return []
+        out = []
+        with open(self._path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break
+                ts, ln = _HDR.unpack(hdr)
+                blob = f.read(ln)
+                if len(blob) < ln:
+                    break  # torn tail
+                if ts > since_ns:
+                    out.append((ts, blob))
+        return out
+
+    def subscribe(self, since_ns: int, stop: threading.Event,
+                  poll_s: float = 0.2):
+        """Yield SubscribeMetadataResponse from since_ns (exclusive), then
+        tail live until stop is set (reference ReadPersistedLogBuffer +
+        LoopProcessLogData)."""
+        last = since_ns
+        oldest_tail = self._tail[0][0] if self._tail else None
+        if self._path is None or (oldest_tail is not None and last + 1 >= oldest_tail):
+            backlog = [(t, b) for t, b in list(self._tail) if t > last]
+        else:  # tail window may have dropped (or never seen) older events
+            backlog = self._read_persisted(last)
+        for ts, blob in backlog:
+            resp = fpb.SubscribeMetadataResponse()
+            resp.ParseFromString(blob)
+            yield resp
+            last = ts
+        while not stop.is_set():
+            with self._cond:
+                fresh = [(t, b) for t, b in list(self._tail) if t > last]
+                if not fresh:
+                    self._cond.wait(timeout=poll_s)
+                    fresh = [(t, b) for t, b in list(self._tail) if t > last]
+            for ts, blob in fresh:
+                resp = fpb.SubscribeMetadataResponse()
+                resp.ParseFromString(blob)
+                yield resp
+                last = ts
+
+    def close(self):
+        if self._f:
+            self._f.close()
+            self._f = None

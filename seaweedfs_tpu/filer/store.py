@@ -1,0 +1,317 @@
+"""FilerStore plugin interface + built-in backends.
+
+Reference: weed/filer/filerstore.go:21-44 (the 8-method plugin interface
+implemented by 20 backends) and abstract_sql/ (shared SQL logic). Here the
+registry ships three embeddable backends — memory (tests/dev), sqlite
+(stdlib, durable single-node), and logdb (append-only pb log + in-memory
+index, recovering the reference's leveldb role without a leveldb binding).
+All store serialized filer_pb2.Entry blobs keyed by (directory, name).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import struct
+import threading
+from bisect import bisect_left, bisect_right, insort
+from typing import Iterator
+
+from ..pb import filer_pb2 as fpb
+
+
+class FilerStore:
+    """Abstract store. Paths are absolute, '/'-separated, no trailing '/'."""
+
+    name = "abstract"
+
+    def insert_entry(self, directory: str, entry: fpb.Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, directory: str, entry: fpb.Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, directory: str, name: str) -> fpb.Entry | None:
+        raise NotImplementedError
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, directory: str) -> None:
+        raise NotImplementedError
+
+    def list_entries(self, directory: str, start_from: str = "",
+                     inclusive: bool = False, limit: int = 2**31,
+                     prefix: str = "") -> Iterator[fpb.Entry]:
+        raise NotImplementedError
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(FilerStore):
+    """Sorted in-memory map — the conformance-suite reference backend."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._dirs: dict[str, list[str]] = {}   # directory -> sorted names
+        self._blobs: dict[tuple[str, str], bytes] = {}
+        self._kv: dict[bytes, bytes] = {}
+
+    def insert_entry(self, directory, entry):
+        with self._lock:
+            key = (directory, entry.name)
+            if key not in self._blobs:
+                insort(self._dirs.setdefault(directory, []), entry.name)
+            self._blobs[key] = entry.SerializeToString()
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory, name):
+        blob = self._blobs.get((directory, name))
+        if blob is None:
+            return None
+        e = fpb.Entry()
+        e.ParseFromString(blob)
+        return e
+
+    def delete_entry(self, directory, name):
+        with self._lock:
+            if self._blobs.pop((directory, name), None) is not None:
+                names = self._dirs[directory]
+                names.pop(bisect_left(names, name))
+
+    def delete_folder_children(self, directory):
+        with self._lock:
+            for name in self._dirs.pop(directory, []):
+                self._blobs.pop((directory, name), None)
+
+    def list_entries(self, directory, start_from="", inclusive=False,
+                     limit=2**31, prefix=""):
+        with self._lock:
+            names = list(self._dirs.get(directory, []))
+        lo = 0
+        if start_from:
+            lo = (bisect_left if inclusive else bisect_right)(names, start_from)
+        n = 0
+        for name in names[lo:]:
+            if prefix and not name.startswith(prefix):
+                if name[:len(prefix)] > prefix:
+                    break  # sorted: no later name can match
+                continue
+            if n >= limit:
+                break
+            e = self.find_entry(directory, name)
+            if e is not None:
+                n += 1
+                yield e
+
+    def kv_get(self, key):
+        return self._kv.get(key)
+
+    def kv_put(self, key, value):
+        self._kv[key] = value
+
+
+class SqliteStore(FilerStore):
+    """Durable stdlib-sqlite backend (reference abstract_sql + sqlite dirs)."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str):
+        self._path = path
+        self._local = threading.local()
+        self._init_schema()
+
+    def _conn(self) -> sqlite3.Connection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self._path, timeout=30)
+            c.execute("PRAGMA journal_mode=WAL")
+            c.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = c
+        return c
+
+    def _init_schema(self):
+        c = self._conn()
+        c.execute("""CREATE TABLE IF NOT EXISTS filemeta(
+            directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB,
+            PRIMARY KEY(directory, name))""")
+        c.execute("CREATE TABLE IF NOT EXISTS kv(k BLOB PRIMARY KEY, v BLOB)")
+        c.commit()
+
+    def insert_entry(self, directory, entry):
+        c = self._conn()
+        c.execute("INSERT OR REPLACE INTO filemeta VALUES(?,?,?)",
+                  (directory, entry.name, entry.SerializeToString()))
+        c.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory, name):
+        row = self._conn().execute(
+            "SELECT meta FROM filemeta WHERE directory=? AND name=?",
+            (directory, name)).fetchone()
+        if row is None:
+            return None
+        e = fpb.Entry()
+        e.ParseFromString(row[0])
+        return e
+
+    def delete_entry(self, directory, name):
+        c = self._conn()
+        c.execute("DELETE FROM filemeta WHERE directory=? AND name=?",
+                  (directory, name))
+        c.commit()
+
+    def delete_folder_children(self, directory):
+        c = self._conn()
+        c.execute("DELETE FROM filemeta WHERE directory=?", (directory,))
+        c.commit()
+
+    def list_entries(self, directory, start_from="", inclusive=False,
+                     limit=2**31, prefix=""):
+        op = ">=" if inclusive else ">"
+        q = f"SELECT meta FROM filemeta WHERE directory=? AND name {op} ?"
+        args: list = [directory, start_from]
+        if prefix:
+            q += " AND name GLOB ?"
+            args.append(prefix.replace("[", "[[]").replace("*", "[*]")
+                        .replace("?", "[?]") + "*")
+        q += " ORDER BY name LIMIT ?"
+        args.append(min(limit, 2**31 - 1))
+        for (blob,) in self._conn().execute(q, args):
+            e = fpb.Entry()
+            e.ParseFromString(blob)
+            yield e
+
+    def kv_get(self, key):
+        row = self._conn().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def kv_put(self, key, value):
+        c = self._conn()
+        c.execute("INSERT OR REPLACE INTO kv VALUES(?,?)", (key, value))
+        c.commit()
+
+    def close(self):
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+            self._local.conn = None
+
+
+class LogDbStore(MemoryStore):
+    """Append-only pb log + in-memory sorted index; replayed at open.
+
+    Fills the reference's default-leveldb slot (weed/filer/leveldb) with a
+    WAL the image can build without a leveldb binding: every mutation is a
+    length-prefixed record (op, directory, name, blob), compacted when the
+    log exceeds 4x live size."""
+
+    name = "logdb"
+    _REC = struct.Struct("<BHH I")  # op, len(dir), len(name), len(blob)
+    OP_PUT, OP_DEL, OP_DELDIR, OP_KV = 0, 1, 2, 3
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        self._wlock = threading.Lock()
+        self._written = 0
+        if os.path.exists(path):
+            self._replay()
+        self._f = open(path, "ab")
+
+    def _replay(self):
+        with open(self._path, "rb") as f:
+            while True:
+                hdr = f.read(self._REC.size)
+                if len(hdr) < self._REC.size:
+                    break
+                op, dl, nl, bl = self._REC.unpack(hdr)
+                body = f.read(dl + nl + bl)
+                if len(body) < dl + nl + bl:
+                    break  # torn tail write — ignore (volume_checking analogue)
+                blob = body[dl + nl:]
+                if op == self.OP_KV:  # first field is a raw bytes key
+                    MemoryStore.kv_put(self, body[:dl], blob)
+                    continue
+                d = body[:dl].decode()
+                n = body[dl:dl + nl].decode()
+                if op == self.OP_PUT:
+                    e = fpb.Entry()
+                    e.ParseFromString(blob)
+                    MemoryStore.insert_entry(self, d, e)
+                elif op == self.OP_DEL:
+                    MemoryStore.delete_entry(self, d, n)
+                elif op == self.OP_DELDIR:
+                    MemoryStore.delete_folder_children(self, d)
+
+    def _append(self, op: int, d: bytes, n: bytes, blob: bytes):
+        with self._wlock:
+            self._f.write(self._REC.pack(op, len(d), len(n), len(blob)))
+            self._f.write(d + n + blob)
+            self._f.flush()
+            self._written += 1
+            if self._written > 10_000 and self._written > 4 * max(len(self._blobs), 1):
+                self._compact()
+
+    def _compact(self):
+        tmp = self._path + ".compact"
+        with open(tmp, "wb") as f:
+            for (d, n), blob in list(self._blobs.items()):
+                db = d.encode()
+                f.write(self._REC.pack(self.OP_PUT, len(db), len(n.encode()),
+                                       len(blob)))
+                f.write(db + n.encode() + blob)
+            for k, v in list(self._kv.items()):
+                f.write(self._REC.pack(self.OP_KV, len(k), 0, len(v)))
+                f.write(k + v)
+        self._f.close()
+        os.replace(tmp, self._path)
+        self._f = open(self._path, "ab")
+        self._written = len(self._blobs)
+
+    def insert_entry(self, directory, entry):
+        MemoryStore.insert_entry(self, directory, entry)
+        self._append(self.OP_PUT, directory.encode(), entry.name.encode(),
+                     entry.SerializeToString())
+
+    update_entry = insert_entry
+
+    def delete_entry(self, directory, name):
+        MemoryStore.delete_entry(self, directory, name)
+        self._append(self.OP_DEL, directory.encode(), name.encode(), b"")
+
+    def delete_folder_children(self, directory):
+        MemoryStore.delete_folder_children(self, directory)
+        self._append(self.OP_DELDIR, directory.encode(), b"", b"")
+
+    def kv_put(self, key, value):
+        MemoryStore.kv_put(self, key, value)
+        self._append(self.OP_KV, key, b"", value)
+
+    def close(self):
+        with self._wlock:
+            self._f.close()
+
+
+def open_store(spec: str) -> FilerStore:
+    """spec: 'memory', 'sqlite:/path/db.sqlite', 'logdb:/path/filer.log'."""
+    kind, _, arg = spec.partition(":")
+    if kind == "memory":
+        return MemoryStore()
+    if kind == "sqlite":
+        return SqliteStore(arg or "filer.sqlite")
+    if kind == "logdb":
+        return LogDbStore(arg or "filer.logdb")
+    raise ValueError(f"unknown filer store {spec!r} "
+                     f"(supported: memory, sqlite:<path>, logdb:<path>)")

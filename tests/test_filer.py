@@ -1,0 +1,441 @@
+"""Filer tests: store conformance (reference filer/store_test pattern),
+chunk interval resolution vs a brute-force byte oracle, filer core CRUD /
+rename / TTL, meta event log, and end-to-end HTTP against a live in-process
+cluster (reference test/s3/basic + docker-compose analogue)."""
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.chunks import (MANIFEST_BATCH, maybe_manifestize,
+                                        read_views, resolve_chunks,
+                                        resolve_manifests, total_size)
+from seaweedfs_tpu.filer.filer import Filer, split_path
+from seaweedfs_tpu.filer.store import (LogDbStore, MemoryStore, SqliteStore,
+                                       open_store)
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+
+from test_cluster import cluster, free_port  # noqa: F401  (reuse fixture)
+
+
+# -- store conformance -------------------------------------------------------
+
+def _mk_entry(name, size=0, is_dir=False):
+    e = fpb.Entry(name=name, is_directory=is_dir)
+    e.attributes.file_size = size
+    return e
+
+
+def _store_suite(store):
+    store.insert_entry("/a", _mk_entry("f1", 10))
+    store.insert_entry("/a", _mk_entry("f2", 20))
+    store.insert_entry("/a", _mk_entry("g1", 30))
+    store.insert_entry("/a/b", _mk_entry("deep", 5))
+
+    assert store.find_entry("/a", "f1").attributes.file_size == 10
+    assert store.find_entry("/a", "nope") is None
+
+    # listing: ordering, start_from, inclusive, prefix, limit
+    names = [e.name for e in store.list_entries("/a")]
+    assert names == ["f1", "f2", "g1"]
+    assert [e.name for e in store.list_entries("/a", start_from="f1")] == ["f2", "g1"]
+    assert [e.name for e in store.list_entries("/a", start_from="f1",
+                                               inclusive=True)] == ["f1", "f2", "g1"]
+    assert [e.name for e in store.list_entries("/a", prefix="f")] == ["f1", "f2"]
+    assert [e.name for e in store.list_entries("/a", limit=2)] == ["f1", "f2"]
+
+    # update overwrites
+    store.update_entry("/a", _mk_entry("f1", 99))
+    assert store.find_entry("/a", "f1").attributes.file_size == 99
+
+    store.delete_entry("/a", "f2")
+    assert store.find_entry("/a", "f2") is None
+    store.delete_folder_children("/a")
+    assert list(store.list_entries("/a")) == []
+    assert store.find_entry("/a/b", "deep") is not None
+
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+    assert store.kv_get(b"missing") is None
+
+
+def test_memory_store_conformance():
+    _store_suite(MemoryStore())
+
+
+def test_sqlite_store_conformance(tmp_path):
+    _store_suite(SqliteStore(str(tmp_path / "f.sqlite")))
+
+
+def test_logdb_store_conformance(tmp_path):
+    _store_suite(LogDbStore(str(tmp_path / "f.logdb")))
+
+
+def test_logdb_replay(tmp_path):
+    path = str(tmp_path / "f.logdb")
+    s = LogDbStore(path)
+    s.insert_entry("/d", _mk_entry("a", 1))
+    s.insert_entry("/d", _mk_entry("b", 2))
+    s.delete_entry("/d", "a")
+    s.kv_put(b"x", b"y")
+    s.kv_put(b"\xff\x00raw", b"\x01\x02")  # non-UTF-8 key must replay
+    s.close()
+    s2 = LogDbStore(path)
+    assert [e.name for e in s2.list_entries("/d")] == ["b"]
+    assert s2.kv_get(b"x") == b"y"
+    assert s2.kv_get(b"\xff\x00raw") == b"\x01\x02"
+    s2.close()
+
+
+def test_meta_log_persisted_backlog(tmp_path):
+    """A fresh MetaLog instance must serve persisted history it never held
+    in its in-memory tail."""
+    from seaweedfs_tpu.filer.meta_log import MetaLog
+
+    path = str(tmp_path / "meta.log")
+    m1 = MetaLog(path)
+    ev = fpb.EventNotification()
+    ev.new_entry.name = "old-event"
+    m1.append("/d", ev)
+    m1.close()
+    m2 = MetaLog(path)
+    stop = threading.Event()
+    stop.set()  # backlog only, no live tail
+    seen = [r.event_notification.new_entry.name
+            for r in m2.subscribe(0, stop)]
+    assert seen == ["old-event"]
+    m2.close()
+
+
+def test_open_store_registry(tmp_path):
+    assert open_store("memory").name == "memory"
+    assert open_store(f"sqlite:{tmp_path}/x.db").name == "sqlite"
+    with pytest.raises(ValueError):
+        open_store("cassandra:whatever")
+
+
+# -- chunk interval resolution ----------------------------------------------
+
+def _chunk(fid, offset, size, ts):
+    return fpb.FileChunk(file_id=fid, offset=offset, size=size,
+                         modified_ts_ns=ts)
+
+
+def _oracle(chunks, length):
+    """Brute-force newest-wins byte map."""
+    owner = [None] * length
+    for c in sorted(chunks, key=lambda c: (c.modified_ts_ns, c.file_id)):
+        for i in range(c.offset, min(c.offset + c.size, length)):
+            owner[i] = c.file_id
+    return owner
+
+
+def test_resolve_chunks_against_oracle():
+    import random
+
+    rng = random.Random(42)
+    for _ in range(50):
+        n = rng.randint(1, 12)
+        chunks = [_chunk(f"c{i}", rng.randint(0, 90), rng.randint(1, 40), i + 1)
+                  for i in range(n)]
+        length = max(c.offset + c.size for c in chunks)
+        owner = _oracle(chunks, length)
+        resolved = [None] * length
+        for s, e, c in resolve_chunks(chunks):
+            for i in range(s, e):
+                assert resolved[i] is None, "overlapping resolved intervals"
+                resolved[i] = c.file_id
+        assert resolved == owner
+
+
+def test_read_views_cover_range():
+    chunks = [_chunk("a", 0, 100, 1), _chunk("b", 50, 100, 2),
+              _chunk("c", 25, 10, 3)]
+    views = read_views(chunks, 10, 120)
+    covered = []
+    for v in views:
+        covered.extend(range(v.logical_offset, v.logical_offset + v.size))
+    assert covered == list(range(10, 130))
+    assert total_size(chunks) == 150
+    # the newest chunk owns its range
+    owners = {v.logical_offset: v.file_id for v in views}
+    assert owners[25] == "c"
+    assert owners[35] == "a"
+
+
+def test_manifest_roundtrip():
+    blobs = {}
+
+    def save(blob):
+        fid = f"m{len(blobs)}"
+        blobs[fid] = blob
+        return fpb.FileChunk(file_id=fid, size=len(blob),
+                             modified_ts_ns=time.time_ns())
+
+    n = MANIFEST_BATCH * 2 + 7
+    chunks = [_chunk(f"c{i}", i * 10, 10, i + 1) for i in range(n)]
+    folded = maybe_manifestize(list(chunks), save)
+    assert sum(c.is_chunk_manifest for c in folded) == 2
+    assert len(folded) == 2 + 7
+    expanded = resolve_manifests(folded, blobs.__getitem__)
+    assert sorted(c.file_id for c in expanded) == sorted(c.file_id for c in chunks)
+    assert total_size(expanded) == n * 10
+
+
+# -- filer core --------------------------------------------------------------
+
+@pytest.fixture
+def filer(tmp_path):
+    deleted = []
+    f = Filer(MemoryStore(), meta_log_path=str(tmp_path / "meta.log"),
+              chunk_deleter=deleted.extend)
+    f._test_deleted = deleted
+    yield f
+    f.close()
+
+
+def _file_entry(name, fids=(), size_each=10):
+    e = fpb.Entry(name=name)
+    for i, fid in enumerate(fids):
+        e.chunks.add(file_id=fid, offset=i * size_each, size=size_each,
+                     modified_ts_ns=i + 1)
+    e.attributes.file_size = size_each * len(fids)
+    return e
+
+
+def test_create_auto_parents_and_find(filer):
+    filer.create_entry("/x/y/z", _file_entry("f", ["1,ab"]))
+    assert filer.find_entry("/x/y/z", "f") is not None
+    assert filer.find_entry("/x/y", "z").is_directory
+    assert filer.find_entry("/x", "y").is_directory
+    assert filer.find_entry("/", "x").is_directory
+
+
+def test_create_o_excl(filer):
+    filer.create_entry("/d", _file_entry("f"))
+    with pytest.raises(FileExistsError):
+        filer.create_entry("/d", _file_entry("f"), o_excl=True)
+
+
+def test_update_gc_replaced_chunks(filer):
+    filer.create_entry("/d", _file_entry("f", ["1,aa", "1,bb"]))
+    filer.update_entry("/d", _file_entry("f", ["1,bb", "1,cc"]))
+    assert filer._test_deleted == ["1,aa"]
+
+
+def test_delete_recursive_chunks(filer):
+    filer.create_entry("/t/sub", _file_entry("f1", ["1,aa"]))
+    filer.create_entry("/t", _file_entry("f2", ["1,bb"]))
+    with pytest.raises(OSError):
+        filer.delete_entry("/", "t", is_recursive=False)
+    filer.delete_entry("/", "t", is_recursive=True)
+    assert filer.find_entry("/t", "f2") is None
+    assert sorted(filer._test_deleted) == ["1,aa", "1,bb"]
+
+
+def test_rename_subtree(filer):
+    filer.create_entry("/old/sub", _file_entry("f", ["1,aa"]))
+    filer.rename("/", "old", "/", "new")
+    assert filer.find_entry("/", "old") is None
+    assert filer.find_entry("/new/sub", "f") is not None
+    assert filer._test_deleted == []  # rename moves, never deletes data
+
+
+def test_ttl_expiry(filer):
+    e = _file_entry("f", ["1,aa"])
+    e.attributes.ttl_sec = 1
+    filer.create_entry("/d", e)
+    assert filer.find_entry("/d", "f") is not None
+    # backdate mtime past the ttl
+    stored = filer.store.find_entry("/d", "f")
+    stored.attributes.mtime = int(time.time()) - 10
+    filer.store.update_entry("/d", stored)
+    assert filer.find_entry("/d", "f") is None
+    assert "1,aa" in filer._test_deleted
+
+
+def test_append_chunks(filer):
+    filer.append_chunks("/d", "log", [fpb.FileChunk(file_id="1,aa", size=5)])
+    filer.append_chunks("/d", "log", [fpb.FileChunk(file_id="1,bb", size=7)])
+    e = filer.find_entry("/d", "log")
+    assert e.attributes.file_size == 12
+    assert [c.offset for c in e.chunks] == [0, 5]
+
+
+def test_meta_log_subscribe(filer):
+    filer.create_entry("/d", _file_entry("f1"))
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for resp in filer.meta_log.subscribe(0, stop):
+            seen.append((resp.directory,
+                         resp.event_notification.new_entry.name))
+            if len(seen) >= 3:
+                stop.set()
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    filer.create_entry("/d", _file_entry("f2"))
+    t.join(timeout=5)
+    stop.set()
+    assert ("/d", "f1") in seen and ("/d", "f2") in seen
+    # ts strictly monotonic
+    all_ts = [ts for ts, _ in filer.meta_log._tail]
+    assert all_ts == sorted(set(all_ts))
+
+
+def test_split_path():
+    assert split_path("/a/b/c") == ("/a/b", "c")
+    assert split_path("/a") == ("/", "a")
+    assert split_path("/") == ("/", "")
+    assert split_path("/a/b/") == ("/a", "b")
+
+
+# -- end-to-end over a live cluster ------------------------------------------
+
+@pytest.fixture(scope="module")
+def filer_server(cluster, tmp_path_factory):  # noqa: F811
+    master, servers, mc = cluster
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+
+    fs = FilerServer(f"127.0.0.1:{master.port}", store_spec="memory",
+                     port=free_port(), grpc_port=free_port(),
+                     meta_log_path=str(tmp_path_factory.mktemp("fl") / "meta.log"),
+                     chunk_size_mb=1)
+    fs.start()
+    import requests
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            if requests.get(f"http://{fs.url}/__status__", timeout=1).ok:
+                break
+        except Exception:
+            time.sleep(0.1)
+    else:
+        pytest.fail("filer HTTP not ready")
+    yield fs
+    fs.stop()
+
+
+def test_http_write_read_roundtrip(filer_server):
+    import requests
+
+    data = bytes(range(256)) * 8192  # 2 MiB -> 2 chunks at 1 MiB
+    url = f"http://{filer_server.url}/docs/blob.bin"
+    r = requests.post(url, data=data, timeout=30)
+    assert r.status_code == 201, r.text
+    got = requests.get(url, timeout=30)
+    assert got.content == data
+    # range read across the chunk boundary
+    rng = requests.get(url, headers={"Range": "bytes=1048000-1049000"}, timeout=30)
+    assert rng.status_code == 206
+    assert rng.content == data[1048000:1049001]
+    head = requests.head(url, timeout=10)
+    assert int(head.headers["Content-Length"]) == len(data)
+    entry = filer_server.filer.find_entry("/docs", "blob.bin")
+    assert len(entry.chunks) == 2
+
+
+def test_http_suffix_range_and_empty_file(filer_server):
+    import requests
+
+    base = f"http://{filer_server.url}"
+    data = b"0123456789" * 100
+    requests.post(f"{base}/rng.bin", data=data, timeout=10)
+    r = requests.get(f"{base}/rng.bin", headers={"Range": "bytes=-100"},
+                     timeout=10)
+    assert r.status_code == 206
+    assert r.content == data[-100:]
+    assert r.headers["Content-Range"] == f"bytes 900-999/{len(data)}"
+    # empty file: no chunks uploaded, reads back empty
+    requests.post(f"{base}/empty.bin", data=b"", timeout=10)
+    assert requests.get(f"{base}/empty.bin", timeout=10).content == b""
+    assert not filer_server.filer.find_entry("/", "empty.bin").chunks
+
+
+def test_http_multipart_into_directory(filer_server):
+    import requests
+
+    base = f"http://{filer_server.url}"
+    r = requests.post(f"{base}/uploads/", files={"file": ("a.txt", b"hello")},
+                      timeout=10)
+    assert r.status_code == 201
+    assert requests.get(f"{base}/uploads/a.txt", timeout=10).content == b"hello"
+
+
+def test_prefix_boundary():
+    from seaweedfs_tpu.filer.filer_server import _under_prefix
+
+    assert _under_prefix("/data", "/data")
+    assert _under_prefix("/data/sub", "/data")
+    assert _under_prefix("/data", "/data/sub")  # parent dirs of the subtree
+    assert not _under_prefix("/database", "/data")
+    assert _under_prefix("/anything", "/")
+
+
+def test_http_listing_and_delete(filer_server):
+    import requests
+
+    base = f"http://{filer_server.url}"
+    for name in ("a.txt", "b.txt"):
+        assert requests.post(f"{base}/dir1/{name}", data=b"hi",
+                             timeout=10).status_code == 201
+    listing = requests.get(f"{base}/dir1", timeout=10).json()
+    assert [e["FullPath"] for e in listing["Entries"]] == \
+        ["/dir1/a.txt", "/dir1/b.txt"]
+    assert requests.delete(f"{base}/dir1/a.txt", timeout=10).status_code == 204
+    assert requests.get(f"{base}/dir1/a.txt", timeout=10).status_code == 404
+    assert requests.delete(f"{base}/dir1?recursive=true",
+                           timeout=10).status_code == 204
+    assert requests.get(f"{base}/dir1", timeout=10).status_code == 404
+
+
+def test_grpc_entry_rpcs(filer_server):
+    from seaweedfs_tpu.utils.rpc import FILER_SERVICE, Stub
+
+    stub = Stub(f"127.0.0.1:{filer_server.grpc_port}", FILER_SERVICE)
+    e = fpb.Entry(name="hello.txt", content=b"inline")
+    e.attributes.file_size = 6
+    resp = stub.call("CreateEntry", fpb.CreateEntryRequest(
+        directory="/grpc", entry=e), fpb.CreateEntryResponse)
+    assert not resp.error
+    got = stub.call("LookupDirectoryEntry", fpb.LookupDirectoryEntryRequest(
+        directory="/grpc", name="hello.txt"), fpb.LookupDirectoryEntryResponse)
+    assert got.entry.content == b"inline"
+    listed = list(stub.call_stream("ListEntries", fpb.ListEntriesRequest(
+        directory="/grpc"), fpb.ListEntriesResponse))
+    assert [r.entry.name for r in listed] == ["hello.txt"]
+    stub.call("AtomicRenameEntry", fpb.AtomicRenameEntryRequest(
+        old_directory="/grpc", old_name="hello.txt",
+        new_directory="/grpc2", new_name="hi.txt"),
+        fpb.AtomicRenameEntryResponse)
+    got2 = stub.call("LookupDirectoryEntry", fpb.LookupDirectoryEntryRequest(
+        directory="/grpc2", name="hi.txt"), fpb.LookupDirectoryEntryResponse)
+    assert got2.entry.content == b"inline"
+    # kv
+    stub.call("KvPut", fpb.KvPutRequest(key=b"k", value=b"v"), fpb.KvPutResponse)
+    assert stub.call("KvGet", fpb.KvGetRequest(key=b"k"),
+                     fpb.KvGetResponse).value == b"v"
+
+
+def test_grpc_subscribe_metadata(filer_server):
+    from seaweedfs_tpu.utils.rpc import FILER_SERVICE, Stub
+
+    stub = Stub(f"127.0.0.1:{filer_server.grpc_port}", FILER_SERVICE)
+    stream = stub.call_stream("SubscribeMetadata", fpb.SubscribeMetadataRequest(
+        client_name="test", since_ns=0), fpb.SubscribeMetadataResponse,
+        timeout=10)
+    e = fpb.Entry(name="sub.txt", content=b"x")
+    stub.call("CreateEntry", fpb.CreateEntryRequest(directory="/subtest",
+                                                    entry=e),
+              fpb.CreateEntryResponse)
+    seen = []
+    for resp in stream:
+        seen.append((resp.directory, resp.event_notification.new_entry.name))
+        if ("/subtest", "sub.txt") in seen:
+            break
+    stream.cancel()
+    assert ("/subtest", "sub.txt") in seen
