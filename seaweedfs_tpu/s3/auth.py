@@ -1,0 +1,225 @@
+"""S3 authentication: AWS Signature V4 (header + presigned query) and
+identity/action management.
+
+Reference: weed/s3api/auth_credentials.go (identities + Action model),
+auth_signature_v4.go (sigv4 verification), s3api/s3_constants. Identities
+come from a dict/JSON config shaped like the reference's s3.json:
+{"identities": [{"name": ..., "credentials": [{"accessKey","secretKey"}],
+"actions": ["Read","Write","List","Tagging","Admin", ...]}]}.
+Actions may be suffixed ":bucket" to scope them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import urllib.parse
+from dataclasses import dataclass, field
+
+ACTION_READ = "Read"
+ACTION_WRITE = "Write"
+ACTION_LIST = "List"
+ACTION_TAGGING = "Tagging"
+ACTION_ADMIN = "Admin"
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str, status: int):
+        super().__init__(message)
+        self.code, self.message, self.status = code, message, status
+
+
+ErrAccessDenied = lambda: S3Error("AccessDenied", "Access Denied.", 403)  # noqa: E731
+ErrSignatureMismatch = lambda: S3Error(  # noqa: E731
+    "SignatureDoesNotMatch",
+    "The request signature we calculated does not match the signature you provided.",
+    403)
+ErrInvalidAccessKey = lambda: S3Error(  # noqa: E731
+    "InvalidAccessKeyId",
+    "The AWS Access Key Id you provided does not exist in our records.", 403)
+ErrRequestExpired = lambda: S3Error(  # noqa: E731
+    "AccessDenied", "Request has expired", 403)
+
+MAX_CLOCK_SKEW_S = 15 * 60  # AWS allows +-15 min on x-amz-date
+
+
+def _amz_time(s: str) -> float:
+    import calendar
+    import time as _time
+
+    return calendar.timegm(_time.strptime(s, "%Y%m%dT%H%M%SZ"))
+
+
+@dataclass
+class Identity:
+    name: str
+    credentials: dict[str, str] = field(default_factory=dict)  # access -> secret
+    actions: list[str] = field(default_factory=list)
+
+    def allows(self, action: str, bucket: str) -> bool:
+        for a in self.actions:
+            if a == ACTION_ADMIN:
+                return True
+            act, _, scope = a.partition(":")
+            if act == action and (not scope or scope == bucket):
+                return True
+        return False
+
+
+class IdentityAccessManagement:
+    """Access-key → identity lookup + sigv4 verification."""
+
+    def __init__(self, config: dict | None = None):
+        self._by_access_key: dict[str, tuple[Identity, str]] = {}
+        self.enabled = False
+        if config:
+            self.load(config)
+
+    def load(self, config: dict) -> None:
+        self._by_access_key.clear()
+        for ident_cfg in config.get("identities", []):
+            ident = Identity(name=ident_cfg["name"],
+                             actions=list(ident_cfg.get("actions", [])))
+            for cred in ident_cfg.get("credentials", []):
+                ident.credentials[cred["accessKey"]] = cred["secretKey"]
+                self._by_access_key[cred["accessKey"]] = \
+                    (ident, cred["secretKey"])
+        self.enabled = bool(self._by_access_key)
+
+    def lookup(self, access_key: str) -> tuple[Identity, str]:
+        hit = self._by_access_key.get(access_key)
+        if hit is None:
+            raise ErrInvalidAccessKey()
+        return hit
+
+    # -- sigv4 --------------------------------------------------------------
+    def authenticate(self, method: str, path: str, query: dict[str, str],
+                     headers: dict[str, str], payload_hash: str) -> Identity:
+        """Verify a sigv4-signed request; returns the matching identity.
+        Raises S3Error on failure. headers keys must be lower-case."""
+        auth = headers.get("authorization", "")
+        if auth.startswith("AWS4-HMAC-SHA256 "):
+            return self._auth_header(method, path, query, headers,
+                                     payload_hash, auth)
+        if query.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
+            return self._auth_presigned(method, path, query, headers)
+        raise ErrAccessDenied()
+
+    def _auth_header(self, method, path, query, headers, payload_hash, auth):
+        fields = {}
+        for part in auth[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        cred = fields.get("Credential", "").split("/")
+        if len(cred) != 5:
+            raise ErrSignatureMismatch()
+        access_key, date, region, service, _ = cred
+        ident, secret = self.lookup(access_key)
+        self._check_freshness(headers.get("x-amz-date", ""))
+        signed_headers = fields.get("SignedHeaders", "").split(";")
+        canonical = self._canonical_request(
+            method, path, query, headers, signed_headers, payload_hash)
+        sig = self._signature(secret, date, region, service,
+                              headers.get("x-amz-date", ""), canonical)
+        if not hmac.compare_digest(sig, fields.get("Signature", "")):
+            raise ErrSignatureMismatch()
+        return ident
+
+    def _auth_presigned(self, method, path, query, headers):
+        cred = query.get("X-Amz-Credential", "").split("/")
+        if len(cred) != 5:
+            raise ErrSignatureMismatch()
+        access_key, date, region, service, _ = cred
+        ident, secret = self.lookup(access_key)
+        self._check_presigned_expiry(query.get("X-Amz-Date", ""),
+                                     query.get("X-Amz-Expires", ""))
+        signed_headers = query.get("X-Amz-SignedHeaders", "host").split(";")
+        q = {k: v for k, v in query.items() if k != "X-Amz-Signature"}
+        canonical = self._canonical_request(
+            method, path, q, headers, signed_headers, "UNSIGNED-PAYLOAD")
+        sig = self._signature(secret, date, region, service,
+                              query.get("X-Amz-Date", ""), canonical)
+        if not hmac.compare_digest(sig, query.get("X-Amz-Signature", "")):
+            raise ErrSignatureMismatch()
+        return ident
+
+    @staticmethod
+    def _check_freshness(amz_date: str) -> None:
+        import time as _time
+
+        try:
+            ts = _amz_time(amz_date)
+        except ValueError:
+            raise ErrSignatureMismatch() from None
+        if abs(_time.time() - ts) > MAX_CLOCK_SKEW_S:
+            raise S3Error("RequestTimeTooSkewed",
+                          "The difference between the request time and the "
+                          "server's time is too large.", 403)
+
+    @staticmethod
+    def _check_presigned_expiry(amz_date: str, expires: str) -> None:
+        import time as _time
+
+        try:
+            ts = _amz_time(amz_date)
+            ttl = int(expires) if expires else 604800
+        except ValueError:
+            raise ErrSignatureMismatch() from None
+        if _time.time() > ts + min(ttl, 604800):  # 7-day cap like AWS
+            raise ErrRequestExpired()
+
+    @staticmethod
+    def _canonical_request(method, path, query, headers, signed_headers,
+                           payload_hash) -> str:
+        enc_path = urllib.parse.quote(path, safe="/~")
+        q = "&".join(
+            f"{urllib.parse.quote(k, safe='~')}={urllib.parse.quote(v, safe='~')}"
+            for k, v in sorted(query.items()))
+        hdrs = "".join(f"{h}:{' '.join(headers.get(h, '').split())}\n"
+                       for h in signed_headers)
+        return "\n".join([method, enc_path, q, hdrs, ";".join(signed_headers),
+                          payload_hash])
+
+    @staticmethod
+    def _signature(secret, date, region, service, amz_date, canonical) -> str:
+        def h(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = h(f"AWS4{secret}".encode(), date)
+        k = h(k, region)
+        k = h(k, service)
+        k = h(k, "aws4_request")
+        sts = "\n".join(["AWS4-HMAC-SHA256", amz_date,
+                         f"{date}/{region}/{service}/aws4_request",
+                         hashlib.sha256(canonical.encode()).hexdigest()])
+        return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+
+
+def sign_request_v4(method: str, url: str, headers: dict[str, str],
+                    payload: bytes, access_key: str, secret_key: str,
+                    region: str = "us-east-1", service: str = "s3",
+                    amz_date: str | None = None) -> dict[str, str]:
+    """Client-side signer (used by tests and the replication s3 sink).
+    Returns headers with Authorization added."""
+    import datetime
+
+    u = urllib.parse.urlsplit(url)
+    now = amz_date or datetime.datetime.now(datetime.timezone.utc
+                                            ).strftime("%Y%m%dT%H%M%SZ")
+    date = now[:8]
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    out = dict(headers)
+    out.setdefault("host", u.netloc)
+    out["x-amz-date"] = now
+    out["x-amz-content-sha256"] = payload_hash
+    signed = sorted(h.lower() for h in out)
+    query = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+    iam = IdentityAccessManagement()
+    canonical = iam._canonical_request(method, u.path or "/", query,
+                                       {k.lower(): v for k, v in out.items()},
+                                       signed, payload_hash)
+    sig = iam._signature(secret_key, date, region, service, now, canonical)
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{date}/{region}/{service}/"
+        f"aws4_request, SignedHeaders={';'.join(signed)}, Signature={sig}")
+    return out

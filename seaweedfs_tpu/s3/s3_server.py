@@ -1,0 +1,661 @@
+"""S3 gateway: AWS-compatible REST API over the filer namespace.
+
+Reference: weed/s3api (14,018 LoC — SURVEY.md §2.6): s3api_server.go:109
+(router), s3api_object_handlers_put.go, filer_multipart.go (multipart
+completes by concatenating part chunk lists), s3api_object_tagging.go,
+s3api_bucket_handlers.go. Buckets map to filer dirs /buckets/<bucket>,
+object keys to paths beneath. Multipart completion is zero-copy: the final
+entry references the parts' chunks with rebased offsets.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+from ..filer.chunks import total_size
+from ..filer.filer import join_path, split_path
+from ..pb import filer_pb2 as fpb
+from ..utils.log import logger
+from .auth import (ACTION_LIST, ACTION_READ, ACTION_TAGGING, ACTION_WRITE,
+                   IdentityAccessManagement, S3Error)
+
+log = logger("s3")
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = ".uploads"  # hidden per-bucket multipart staging dir
+TAG_PREFIX = "x-amz-tag-"
+HIGH = "\U0010FFFF"
+
+ErrNoSuchBucket = lambda b: S3Error("NoSuchBucket",  # noqa: E731
+                                    f"The specified bucket does not exist: {b}", 404)
+ErrNoSuchKey = lambda k: S3Error("NoSuchKey",  # noqa: E731
+                                 f"The specified key does not exist: {k}", 404)
+ErrBucketNotEmpty = lambda b: S3Error(  # noqa: E731
+    "BucketNotEmpty", "The bucket you tried to delete is not empty", 409)
+ErrNoSuchUpload = lambda u: S3Error(  # noqa: E731
+    "NoSuchUpload", f"The specified upload does not exist: {u}", 404)
+
+
+class S3Gateway:
+    def __init__(self, filer_server, ip: str = "127.0.0.1", port: int = 8333,
+                 iam_config: dict | None = None):
+        self.fs = filer_server  # in-process FilerServer
+        self.ip, self.port = ip, port
+        self.iam = IdentityAccessManagement(iam_config)
+        self._stop = threading.Event()
+        self._http_thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> "S3Gateway":
+        self._http_thread = threading.Thread(target=self._run_http, daemon=True,
+                                             name=f"s3-http-{self.port}")
+        self._http_thread.start()
+        log.info("s3 gateway %s up (auth %s)", self.url,
+                 "on" if self.iam.enabled else "off")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- HTTP plumbing -------------------------------------------------------
+    def _run_http(self) -> None:
+        import asyncio
+
+        from aiohttp import web
+
+        async def dispatch(request: web.Request):
+            try:
+                return await self._route(request)
+            except S3Error as e:
+                return _error_response(e, request.path)
+            except FileNotFoundError as e:
+                return _error_response(
+                    S3Error("NoSuchKey", str(e), 404), request.path)
+            except Exception as e:  # noqa: BLE001
+                log.error("s3 http: %r", e)
+                return _error_response(
+                    S3Error("InternalError", str(e), 500), request.path)
+
+        async def main():
+            app = web.Application(client_max_size=1 << 30)
+            app.router.add_route("*", "/{tail:.*}", dispatch)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, self.ip, self.port)
+            await site.start()
+            while not self._stop.is_set():
+                await asyncio.sleep(0.2)
+            await runner.cleanup()
+
+        asyncio.run(main())
+
+    async def _route(self, request):
+        path = urllib.parse.unquote(request.path)
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        q = dict(request.query)
+        body = await request.read()
+        self._authorize(request, bucket, key, q, body)
+
+        if not bucket:
+            return self.list_buckets()
+        if not key:
+            return await self._route_bucket(request, bucket, q, body)
+        return await self._route_object(request, bucket, key, q, body)
+
+    def _authorize(self, request, bucket, key, q, body) -> None:
+        if not self.iam.enabled:
+            return
+        m = request.method
+        if not bucket or (m in ("GET", "HEAD") and not key):
+            action = ACTION_LIST
+        elif "tagging" in q:
+            action = ACTION_TAGGING
+        elif m in ("GET", "HEAD"):
+            action = ACTION_READ
+        else:
+            action = ACTION_WRITE
+        payload_hash = request.headers.get("x-amz-content-sha256",
+                                           "UNSIGNED-PAYLOAD")
+        if payload_hash not in ("UNSIGNED-PAYLOAD",
+                                "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
+            actual = hashlib.sha256(body).hexdigest()
+            if actual != payload_hash:
+                raise S3Error("XAmzContentSHA256Mismatch",
+                              "The provided 'x-amz-content-sha256' header "
+                              "does not match what was computed.", 400)
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        ident = self.iam.authenticate(request.method,
+                                      urllib.parse.unquote(request.path),
+                                      dict(request.query), headers,
+                                      payload_hash)
+        from .auth import ErrAccessDenied
+
+        if not ident.allows(action, bucket):
+            raise ErrAccessDenied()
+
+    async def _route_bucket(self, request, bucket, q, body):
+        m = request.method
+        if m == "PUT":
+            return self.put_bucket(bucket)
+        if m == "HEAD":
+            return self.head_bucket(bucket)
+        if m == "DELETE":
+            return self.delete_bucket(bucket)
+        if m == "POST" and "delete" in q:
+            return self.delete_multiple_objects(bucket, body)
+        if m == "GET":
+            if "uploads" in q:
+                return self.list_multipart_uploads(bucket, q)
+            return self.list_objects(bucket, q)
+        raise S3Error("MethodNotAllowed", "Method not allowed.", 405)
+
+    async def _route_object(self, request, bucket, key, q, body):
+        m = request.method
+        if m == "PUT":
+            if "partNumber" in q and "uploadId" in q:
+                return self.upload_part(bucket, key, q, body)
+            if "tagging" in q:
+                return self.put_object_tagging(bucket, key, body)
+            src = request.headers.get("x-amz-copy-source")
+            if src:
+                return self.copy_object(bucket, key, src)
+            return self.put_object(bucket, key, body,
+                                   request.content_type or "")
+        if m == "POST":
+            if "uploads" in q:
+                return self.initiate_multipart(bucket, key)
+            if "uploadId" in q:
+                return self.complete_multipart(bucket, key, q["uploadId"], body)
+        if m in ("GET", "HEAD"):
+            if "tagging" in q:
+                return self.get_object_tagging(bucket, key)
+            if "uploadId" in q:
+                return self.list_parts(bucket, key, q)
+            return self.get_object(bucket, key, request)
+        if m == "DELETE":
+            if "uploadId" in q:
+                return self.abort_multipart(bucket, key, q["uploadId"])
+            if "tagging" in q:
+                return self.delete_object_tagging(bucket, key)
+            return self.delete_object(bucket, key)
+        raise S3Error("MethodNotAllowed", "Method not allowed.", 405)
+
+    # -- buckets -------------------------------------------------------------
+    def _bucket_dir(self, bucket: str) -> str:
+        return f"{BUCKETS_DIR}/{bucket}"
+
+    def _require_bucket(self, bucket: str) -> None:
+        if self.fs.filer.find_entry(BUCKETS_DIR, bucket) is None:
+            raise ErrNoSuchBucket(bucket)
+
+    def list_buckets(self):
+        root = ET.Element("ListAllMyBucketsResult")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "swtpu"
+        buckets = ET.SubElement(root, "Buckets")
+        for e in self.fs.filer.list_entries(BUCKETS_DIR):
+            if not e.is_directory:
+                continue
+            b = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(b, "Name").text = e.name
+            ET.SubElement(b, "CreationDate").text = _iso(e.attributes.crtime)
+        return _xml_response(root)
+
+    def put_bucket(self, bucket):
+        from aiohttp import web
+
+        if self.fs.filer.find_entry(BUCKETS_DIR, bucket) is None:
+            e = fpb.Entry(name=bucket, is_directory=True)
+            e.attributes.file_mode = 0o40755
+            self.fs.filer.create_entry(BUCKETS_DIR, e)
+        return web.Response(status=200, headers={"Location": f"/{bucket}"})
+
+    def head_bucket(self, bucket):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        return web.Response(status=200)
+
+    def delete_bucket(self, bucket):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        for e in self.fs.filer.list_entries(self._bucket_dir(bucket), limit=2):
+            if e.name != UPLOADS_DIR:
+                raise ErrBucketNotEmpty(bucket)
+        self.fs.filer.delete_entry(BUCKETS_DIR, bucket, is_recursive=True)
+        return web.Response(status=204)
+
+    # -- objects -------------------------------------------------------------
+    def _object_path(self, bucket: str, key: str) -> str:
+        return f"{self._bucket_dir(bucket)}/{key}"
+
+    def put_object(self, bucket, key, body, mime):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        if key.endswith("/"):  # directory object
+            d, n = split_path(self._object_path(bucket, key))
+            e = fpb.Entry(name=n, is_directory=True)
+            e.attributes.file_mode = 0o40755
+            if self.fs.filer.find_entry(d, n) is None:
+                self.fs.filer.create_entry(d, e)
+            return web.Response(status=200, headers={"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
+        entry = self.fs.write_file(self._object_path(bucket, key), body,
+                                   mime=mime)
+        return web.Response(status=200,
+                            headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
+
+    def copy_object(self, bucket, key, src):
+        self._require_bucket(bucket)
+        src = urllib.parse.unquote(src)
+        src = src[src.startswith("/") and 1 or 0:]
+        sb, _, sk = src.partition("/")
+        d, n = split_path(self._object_path(sb, sk))
+        entry = self.fs.filer.find_entry(d, n)
+        if entry is None:
+            raise ErrNoSuchKey(sk)
+        data = self.fs.read_entry_bytes(entry)
+        new = self.fs.write_file(self._object_path(bucket, key), data,
+                                 mime=entry.attributes.mime)
+        root = ET.Element("CopyObjectResult")
+        ET.SubElement(root, "ETag").text = f'"{new.attributes.md5.hex()}"'
+        ET.SubElement(root, "LastModified").text = _iso(new.attributes.mtime)
+        return _xml_response(root)
+
+    def get_object(self, bucket, key, request):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        d, n = split_path(self._object_path(bucket, key))
+        entry = self.fs.filer.find_entry(d, n)
+        if entry is not None and entry.is_directory and key.endswith("/"):
+            return web.Response(  # directory object: empty body
+                status=200, headers={
+                    "ETag": '"d41d8cd98f00b204e9800998ecf8427e"',
+                    "Content-Type": "application/octet-stream"})
+        if entry is None or entry.is_directory:
+            raise ErrNoSuchKey(key)
+        fsize = entry.attributes.file_size or total_size(entry.chunks)
+        etag = _entry_etag(entry)
+        headers = {"ETag": f'"{etag}"', "Accept-Ranges": "bytes",
+                   "Last-Modified": _http_date(entry.attributes.mtime),
+                   "Content-Type": entry.attributes.mime or
+                   "application/octet-stream"}
+        for k, v in entry.extended.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v.decode()
+        rng = request.http_range
+        offset = rng.start or 0
+        if offset < 0:
+            offset, stop = max(0, fsize + offset), fsize
+        else:
+            stop = min(rng.stop if rng.stop is not None else fsize, fsize)
+        if offset > 0 and offset >= fsize:
+            raise S3Error("InvalidRange",
+                          "The requested range is not satisfiable", 416)
+        status = 200 if (offset == 0 and stop >= fsize) else 206
+        if status == 206:
+            headers["Content-Range"] = f"bytes {offset}-{stop - 1}/{fsize}"
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(fsize)
+            return web.Response(status=200, headers=headers)
+        data = self.fs.read_entry_bytes(entry, offset, stop - offset)
+        return web.Response(body=data, status=status, headers=headers)
+
+    def delete_object(self, bucket, key):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        d, n = split_path(self._object_path(bucket, key))
+        try:
+            self.fs.filer.delete_entry(d, n, is_delete_data=True,
+                                       is_recursive=True)
+        except FileNotFoundError:
+            pass
+        return web.Response(status=204)
+
+    def delete_multiple_objects(self, bucket, body):
+        self._require_bucket(bucket)
+        req = ET.fromstring(body)
+        ns = _ns(req)
+        quiet = (req.findtext(f"{ns}Quiet") or "false") == "true"
+        root = ET.Element("DeleteResult")
+        for obj in req.findall(f"{ns}Object"):
+            key = obj.findtext(f"{ns}Key") or ""
+            d, n = split_path(self._object_path(bucket, key))
+            try:
+                self.fs.filer.delete_entry(d, n, is_delete_data=True,
+                                           is_recursive=True)
+                if not quiet:
+                    deleted = ET.SubElement(root, "Deleted")
+                    ET.SubElement(deleted, "Key").text = key
+            except Exception as e:  # noqa: BLE001
+                err = ET.SubElement(root, "Error")
+                ET.SubElement(err, "Key").text = key
+                ET.SubElement(err, "Message").text = str(e)
+        return _xml_response(root)
+
+    # -- listing -------------------------------------------------------------
+    def _level_entries(self, directory: str, hide_uploads: bool):
+        """Entries of one dir sorted by S3 *key* order: a subtree's keys all
+        start with '<name>/', so ordering siblings by name+'/' for dirs and
+        name for files yields global lexicographic key order (e.g. file
+        'b.txt' sorts before dir 'b' because 'b.txt' < 'b/')."""
+        entries = [e for e in self.fs.filer.list_entries(directory)
+                   if not (hide_uploads and e.name == UPLOADS_DIR)]
+        entries.sort(key=lambda e: e.name + "/" if e.is_directory else e.name)
+        return entries
+
+    def _walk_keys(self, base: str, rel: str, marker: str, prefix: str):
+        """Yield (key, entry) recursively in lexicographic key order,
+        pruning subtrees outside prefix/marker and skipping the multipart
+        staging dir."""
+        directory = join_path(base, rel.rstrip("/")) if rel else base
+        for e in self._level_entries(directory, hide_uploads=not rel):
+            key = f"{rel}{e.name}"
+            if e.is_directory:
+                sub = key + "/"
+                if marker >= sub + HIGH:
+                    continue  # entire subtree <= marker
+                if not (prefix.startswith(sub) or sub.startswith(prefix)):
+                    continue  # subtree cannot contain prefix keys
+                yield from self._walk_keys(base, sub, marker, prefix)
+            elif key > marker and key.startswith(prefix):
+                yield key, e
+            elif key > prefix + HIGH:
+                return  # past the prefix range entirely
+
+    def list_objects(self, bucket, q):
+        self._require_bucket(bucket)
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        v2 = q.get("list-type") == "2"
+        marker = q.get("continuation-token", "") if v2 else q.get("marker", "")
+        if v2 and q.get("start-after", "") > marker:
+            marker = q["start-after"]
+        base = self._bucket_dir(bucket)
+
+        contents: list[tuple[str, fpb.Entry]] = []
+        prefixes: list[str] = []
+        truncated = False
+        if delimiter and delimiter != "/":
+            raise S3Error("NotImplemented",
+                          "Only '/' delimiter is supported.", 501)
+        if delimiter:
+            # list the dir named by the prefix; subdirs become CommonPrefixes
+            pdir, pname = prefix.rpartition("/")[0], prefix.rpartition("/")[2]
+            directory = join_path(base, pdir)
+            rel = f"{pdir}/" if pdir else ""
+            seen = 0
+            for e in self._level_entries(directory, hide_uploads=not rel):
+                if pname and not e.name.startswith(pname):
+                    continue
+                key = f"{rel}{e.name}"
+                ck = key + "/" if e.is_directory else key
+                if ck <= marker:  # a dir's ck <= any marker inside its subtree
+                    continue
+                if seen >= max_keys:
+                    truncated = True
+                    break
+                if e.is_directory:
+                    prefixes.append(ck)
+                else:
+                    contents.append((key, e))
+                seen += 1
+        else:
+            for key, e in self._walk_keys(base, "", marker, prefix):
+                if len(contents) >= max_keys:
+                    truncated = True
+                    break
+                contents.append((key, e))
+
+        root = ET.Element("ListBucketResult",
+                          xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        ET.SubElement(root, "IsTruncated").text = "true" if truncated else "false"
+        if delimiter:
+            ET.SubElement(root, "Delimiter").text = delimiter
+        last = ""
+        for key, e in contents:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "LastModified").text = _iso(e.attributes.mtime)
+            ET.SubElement(c, "ETag").text = f'"{_entry_etag(e)}"'
+            ET.SubElement(c, "Size").text = str(e.attributes.file_size)
+            ET.SubElement(c, "StorageClass").text = "STANDARD"
+            last = max(last, key)
+        for p in prefixes:
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
+            last = max(last, p)
+        if v2:
+            ET.SubElement(root, "KeyCount").text = \
+                str(len(contents) + len(prefixes))
+            if truncated:
+                ET.SubElement(root, "NextContinuationToken").text = last
+        elif truncated:
+            ET.SubElement(root, "NextMarker").text = last
+        return _xml_response(root)
+
+    # -- multipart -----------------------------------------------------------
+    def _upload_dir(self, bucket: str, upload_id: str) -> str:
+        return f"{self._bucket_dir(bucket)}/{UPLOADS_DIR}/{upload_id}"
+
+    def initiate_multipart(self, bucket, key):
+        self._require_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        d, n = split_path(self._upload_dir(bucket, upload_id))
+        e = fpb.Entry(name=n, is_directory=True)
+        e.extended["key"] = key.encode()
+        self.fs.filer.create_entry(d, e)
+        root = ET.Element("InitiateMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        return _xml_response(root)
+
+    def _find_upload(self, bucket, upload_id) -> fpb.Entry:
+        d, n = split_path(self._upload_dir(bucket, upload_id))
+        e = self.fs.filer.find_entry(d, n)
+        if e is None:
+            raise ErrNoSuchUpload(upload_id)
+        return e
+
+    def upload_part(self, bucket, key, q, body):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        upload_id = q["uploadId"]
+        self._find_upload(bucket, upload_id)
+        part = int(q["partNumber"])
+        path = f"{self._upload_dir(bucket, upload_id)}/{part:05d}.part"
+        entry = self.fs.write_file(path, body)
+        return web.Response(status=200,
+                            headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
+
+    def complete_multipart(self, bucket, key, upload_id, body):
+        self._require_bucket(bucket)
+        self._find_upload(bucket, upload_id)
+        updir = self._upload_dir(bucket, upload_id)
+        req = ET.fromstring(body) if body else None
+        wanted: list[int] | None = None
+        if req is not None:
+            ns = _ns(req)
+            wanted = [int(p.findtext(f"{ns}PartNumber") or "0")
+                      for p in req.findall(f"{ns}Part")]
+        parts = {int(e.name.split(".")[0]): e
+                 for e in self.fs.filer.list_entries(updir)
+                 if e.name.endswith(".part")}
+        order = sorted(parts) if wanted is None else wanted
+        if any(b <= a for a, b in zip(order, order[1:])):
+            raise S3Error("InvalidPartOrder",
+                          "The list of parts was not in ascending order.", 400)
+        if any(p not in parts for p in order):
+            raise S3Error("InvalidPart", "One or more of the specified parts "
+                          "could not be found.", 400)
+        # zero-copy concat: rebase each part's chunks onto the final offset
+        final = fpb.Entry()
+        offset = 0
+        md5s = hashlib.md5()
+        for p in order:
+            pe = parts[p]
+            md5s.update(pe.attributes.md5)
+            for c in pe.chunks:
+                nc = final.chunks.add()
+                nc.CopyFrom(c)
+                nc.offset = offset + c.offset
+            offset += pe.attributes.file_size
+        d, n = split_path(self._object_path(bucket, key))
+        final.name = n
+        final.attributes.file_size = offset
+        final.attributes.mime = "application/octet-stream"
+        etag = f"{md5s.hexdigest()}-{len(order)}"
+        final.extended["s3-etag"] = etag.encode()
+        self.fs.filer.create_entry(d, final)
+        # drop staging metadata but never the chunks (now owned by `final`)
+        pdir, pname = split_path(updir)
+        for pe in list(self.fs.filer.list_entries(updir)):
+            self.fs.filer.store.delete_entry(updir, pe.name)
+        self.fs.filer.store.delete_entry(pdir, pname)
+        root = ET.Element("CompleteMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = f'"{etag}"'
+        return _xml_response(root)
+
+    def abort_multipart(self, bucket, key, upload_id):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        d, n = split_path(self._upload_dir(bucket, upload_id))
+        self.fs.filer.delete_entry(d, n, is_delete_data=True,
+                                   is_recursive=True)
+        return web.Response(status=204)
+
+    def list_multipart_uploads(self, bucket, q):
+        self._require_bucket(bucket)
+        root = ET.Element("ListMultipartUploadsResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        updir = f"{self._bucket_dir(bucket)}/{UPLOADS_DIR}"
+        for e in self.fs.filer.list_entries(updir):
+            u = ET.SubElement(root, "Upload")
+            ET.SubElement(u, "Key").text = e.extended.get("key", b"").decode()
+            ET.SubElement(u, "UploadId").text = e.name
+            ET.SubElement(u, "Initiated").text = _iso(e.attributes.crtime)
+        return _xml_response(root)
+
+    def list_parts(self, bucket, key, q):
+        self._require_bucket(bucket)
+        upload_id = q["uploadId"]
+        self._find_upload(bucket, upload_id)
+        root = ET.Element("ListPartsResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        updir = self._upload_dir(bucket, upload_id)
+        for e in self.fs.filer.list_entries(updir):
+            if not e.name.endswith(".part"):
+                continue
+            p = ET.SubElement(root, "Part")
+            ET.SubElement(p, "PartNumber").text = str(int(e.name.split(".")[0]))
+            ET.SubElement(p, "ETag").text = f'"{e.attributes.md5.hex()}"'
+            ET.SubElement(p, "Size").text = str(e.attributes.file_size)
+            ET.SubElement(p, "LastModified").text = _iso(e.attributes.mtime)
+        return _xml_response(root)
+
+    # -- tagging -------------------------------------------------------------
+    def _find_object(self, bucket, key) -> tuple[str, str, fpb.Entry]:
+        d, n = split_path(self._object_path(bucket, key))
+        e = self.fs.filer.find_entry(d, n)
+        if e is None:
+            raise ErrNoSuchKey(key)
+        return d, n, e
+
+    def put_object_tagging(self, bucket, key, body):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        d, n, e = self._find_object(bucket, key)
+        req = ET.fromstring(body)
+        ns = _ns(req)
+        for k in [k for k in e.extended if k.startswith(TAG_PREFIX)]:
+            del e.extended[k]
+        for tag in req.iter(f"{ns}Tag"):
+            tk = tag.findtext(f"{ns}Key") or ""
+            tv = tag.findtext(f"{ns}Value") or ""
+            e.extended[TAG_PREFIX + tk] = tv.encode()
+        self.fs.filer.update_entry(d, e)  # publishes a meta-log event
+        return web.Response(status=200)
+
+    def get_object_tagging(self, bucket, key):
+        self._require_bucket(bucket)
+        _, _, e = self._find_object(bucket, key)
+        root = ET.Element("Tagging")
+        tags = ET.SubElement(root, "TagSet")
+        for k, v in sorted(e.extended.items()):
+            if k.startswith(TAG_PREFIX):
+                t = ET.SubElement(tags, "Tag")
+                ET.SubElement(t, "Key").text = k[len(TAG_PREFIX):]
+                ET.SubElement(t, "Value").text = v.decode()
+        return _xml_response(root)
+
+    def delete_object_tagging(self, bucket, key):
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        d, n, e = self._find_object(bucket, key)
+        for k in [k for k in e.extended if k.startswith(TAG_PREFIX)]:
+            del e.extended[k]
+        self.fs.filer.update_entry(d, e)  # publishes a meta-log event
+        return web.Response(status=204)
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _entry_etag(e: fpb.Entry) -> str:
+    s3etag = e.extended.get("s3-etag")
+    if s3etag:
+        return s3etag.decode()
+    return e.attributes.md5.hex() if e.attributes.md5 else ""
+
+
+def _iso(ts: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
+
+
+def _http_date(ts: int) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts or 0))
+
+
+def _ns(elem: ET.Element) -> str:
+    return elem.tag.split("}")[0] + "}" if "}" in elem.tag else ""
+
+
+def _xml_response(root: ET.Element, status: int = 200):
+    from aiohttp import web
+
+    body = b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+    return web.Response(body=body, status=status,
+                        content_type="application/xml")
+
+
+def _error_response(e: S3Error, resource: str):
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = e.code
+    ET.SubElement(root, "Message").text = e.message
+    ET.SubElement(root, "Resource").text = resource
+    return _xml_response(root, e.status)
