@@ -10,6 +10,7 @@ VolumeEcShardsMove, rebuilds can use CopyByRebuild.
 from __future__ import annotations
 
 import argparse
+import time
 
 from ..pb import volume_server_pb2 as vpb
 from ..utils.rpc import Stub, VOLUME_SERVICE
@@ -31,6 +32,24 @@ def _ec_holders(env: CommandEnv, vid: int) -> dict[int, list[dict]]:
                         if s.ec_index_bits >> sid & 1:
                             out.setdefault(sid, []).append(srv)
     return out
+
+
+def _settled_ec_holders(env: CommandEnv, vid: int,
+                        tries: int = 20, interval: float = 0.2
+                        ) -> dict[int, list[dict]]:
+    """Master topology is heartbeat-propagated (eventually consistent); after
+    mount/unmount RPCs the view lags by up to a pulse. Poll until two
+    consecutive reads agree before acting on it."""
+    prev = None
+    holders = _ec_holders(env, vid)
+    for _ in range(tries):
+        cur = {sid: sorted(h["id"] for h in hs) for sid, hs in holders.items()}
+        if prev is not None and cur == prev:
+            break
+        prev = cur
+        time.sleep(interval)
+        holders = _ec_holders(env, vid)
+    return holders
 
 
 def _free_slots(srv: dict) -> int:
@@ -158,7 +177,7 @@ def cmd_ec_rebuild(env: CommandEnv, args):
                 vols.setdefault(s.id, (s.collection, {}))
     rebuilt_total = 0
     for vid, (collection, _) in sorted(vols.items()):
-        holders = _ec_holders(env, vid)
+        holders = _settled_ec_holders(env, vid)
         if not holders:
             continue
         # geometry: n = max(shard ids)+1 is unreliable; read from a holder
@@ -209,20 +228,41 @@ def cmd_ec_rebuild(env: CommandEnv, args):
 def _gather_shards(env: CommandEnv, host_stub: Stub, vid: int, collection: str,
                    fetch: list[int], holders: dict[int, list[dict]]) -> None:
     """Copy each shard in `fetch` onto the host from a server that actually
-    holds it (per-shard source), including the index sidecars."""
+    holds it (per-shard source), including the index sidecars. Holders come
+    from eventually-consistent master state, so try every listed holder and
+    refresh the view on failure."""
     first = True
     for sid in fetch:
-        hs = holders.get(sid)
-        if not hs:
-            continue
-        src = hs[0]
-        host_stub.call(
-            "VolumeEcShardsCopy",
-            vpb.VolumeEcShardsCopyRequest(
-                volume_id=vid, collection=collection, shard_ids=[sid],
-                copy_ecx_file=first, copy_ecj_file=first, copy_vif_file=first,
-                source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
-            vpb.VolumeEcShardsCopyResponse, timeout=3600)
+        hs = list(holders.get(sid) or [])
+        last_err: Exception | None = None
+        copied = False
+        for attempt in range(6):
+            for src in hs:
+                try:
+                    host_stub.call(
+                        "VolumeEcShardsCopy",
+                        vpb.VolumeEcShardsCopyRequest(
+                            volume_id=vid, collection=collection,
+                            shard_ids=[sid],
+                            copy_ecx_file=first, copy_ecj_file=first,
+                            copy_vif_file=first,
+                            source_data_node=env.grpc_addr(
+                                src["id"], src["grpc_port"])),
+                        vpb.VolumeEcShardsCopyResponse, timeout=3600)
+                    copied = True
+                    break
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+            if copied or not hs and attempt > 2:
+                break
+            if not copied:
+                time.sleep(0.3)
+                hs = list(_ec_holders(env, vid).get(sid) or [])
+        if not copied:
+            if last_err is None:
+                continue  # no holder anywhere: leave it to rebuild
+            raise RuntimeError(
+                f"gather shard {vid}.{sid} failed from all holders: {last_err}")
         first = False
 
 
@@ -250,7 +290,7 @@ def cmd_ec_balance(env: CommandEnv, args):
                 vols.add((s.id, s.collection))
     for vid, collection in sorted(vols):
         while True:
-            holders = _ec_holders(env, vid)
+            holders = _settled_ec_holders(env, vid)
             servers = env.collect_volume_servers()
             count: dict[str, list[int]] = {s["id"]: [] for s in servers}
             for sid, hs in holders.items():
@@ -285,7 +325,7 @@ def cmd_ec_decode(env: CommandEnv, args):
     p.add_argument("-volumeId", type=int, required=True)
     opt = p.parse_args(args)
     vid = opt.volumeId
-    holders = _ec_holders(env, vid)
+    holders = _settled_ec_holders(env, vid)
     if not holders:
         env.println(f"no ec shards for volume {vid}")
         return
