@@ -23,6 +23,25 @@ def _add_master_flags(p):
     p.add_argument("-port", type=int, default=9333)
     p.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
     p.add_argument("-defaultReplication", default="000")
+    _add_security_flags(p)
+
+
+def _add_security_flags(p):
+    # security.toml analogue (reference weed/security, util/config.go):
+    # empty keys keep security off, matching the reference default.
+    p.add_argument("-jwtSigningKey", default="")
+    p.add_argument("-jwtReadSigningKey", default="")
+    p.add_argument("-whiteList", default="",
+                   help="comma-separated IPs/CIDRs allowed without jwt")
+
+
+def _make_guard(opt):
+    from .security import Guard
+    if not (opt.jwtSigningKey or opt.jwtReadSigningKey or opt.whiteList):
+        return None
+    return Guard(white_list=[s for s in opt.whiteList.split(",") if s],
+                 signing_key=opt.jwtSigningKey,
+                 read_signing_key=opt.jwtReadSigningKey)
 
 
 def _add_volume_flags(p):
@@ -37,6 +56,7 @@ def _add_volume_flags(p):
     p.add_argument("-disk", default="hdd")
     p.add_argument("-coder", default="auto",
                    help="erasure coder: auto|jax|native|numpy")
+    _add_security_flags(p)
 
 
 def run_master(argv):
@@ -46,7 +66,8 @@ def run_master(argv):
     opt = p.parse_args(argv)
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
-                      default_replication=opt.defaultReplication)
+                      default_replication=opt.defaultReplication,
+                      guard=_make_guard(opt))
     ms.start()
     _wait_forever()
 
@@ -63,7 +84,8 @@ def run_volume(argv):
                   coder_name=opt.coder)
     vs = VolumeServer(store, opt.mserver, ip=opt.ip, port=opt.port,
                       grpc_port=opt.grpcPort or None,
-                      data_center=opt.dataCenter, rack=opt.rack)
+                      data_center=opt.dataCenter, rack=opt.rack,
+                      guard=_make_guard(opt))
     vs.start()
     _wait_forever()
 
@@ -87,13 +109,14 @@ def run_server(argv):
     opt = p.parse_args(argv)
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
-                      default_replication=opt.defaultReplication)
+                      default_replication=opt.defaultReplication,
+                      guard=_make_guard(opt))
     ms.start()
     store = Store(opt.ip, opt.volumePort, f"{opt.ip}:{opt.volumePort}",
                   [DiskLocation(opt.dir, "hdd", opt.max)],
                   coder_name=opt.coder)
     vs = VolumeServer(store, f"{opt.ip}:{opt.port}", ip=opt.ip,
-                      port=opt.volumePort)
+                      port=opt.volumePort, guard=_make_guard(opt))
     vs.start()
     if opt.filer or opt.s3:
         from .filer.filer_server import FilerServer
@@ -113,9 +136,14 @@ def run_shell(argv):
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-jwtSigningKey", default="",
+                   help="cluster signing key for gRPC auth")
     p.add_argument("-c", dest="script", default="",
                    help="run semicolon-separated commands and exit")
     opt = p.parse_args(argv)
+    if opt.jwtSigningKey:
+        from .utils.rpc import set_cluster_key
+        set_cluster_key(opt.jwtSigningKey)
     env = CommandEnv(opt.master)
     if opt.script:
         for line in opt.script.split(";"):
@@ -133,8 +161,12 @@ def run_upload(argv):
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
+    p.add_argument("-jwtSigningKey", default="")
     p.add_argument("files", nargs="+")
     opt = p.parse_args(argv)
+    if opt.jwtSigningKey:
+        from .utils.rpc import set_cluster_key
+        set_cluster_key(opt.jwtSigningKey)
     mc = MasterClient(opt.master)
     import json
     import mimetypes
@@ -156,8 +188,12 @@ def run_download(argv):
     p = argparse.ArgumentParser(prog="download")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-o", dest="output", default="")
+    p.add_argument("-jwtSigningKey", default="")
     p.add_argument("fids", nargs="+")
     opt = p.parse_args(argv)
+    if opt.jwtSigningKey:
+        from .utils.rpc import set_cluster_key
+        set_cluster_key(opt.jwtSigningKey)
     mc = MasterClient(opt.master)
     for fid in opt.fids:
         data = operation.read(mc, fid)

@@ -145,6 +145,15 @@ class MasterClient:
         return [f"http://{l['public_url'] or l['url']}/{fid}"
                 for l in self.lookup(vid)]
 
+    def lookup_file_id_jwt(self, fid: str) -> str:
+        """Write-key token for mutating an existing fid (reference
+        master_grpc_server_volume.go:102 mints auth for file-id lookups)."""
+        resp = self._stub().call("LookupVolume", pb.LookupVolumeRequest(
+            volume_or_file_ids=[fid]), pb.LookupVolumeResponse)
+        for e in resp.volume_id_locations:
+            return e.auth
+        return ""
+
     def lookup_ec(self, vid: int) -> dict[int, list[str]]:
         resp = self._stub().call("LookupEcVolume",
                                  pb.LookupEcVolumeRequest(volume_id=vid),

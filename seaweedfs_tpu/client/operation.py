@@ -29,8 +29,10 @@ class UploadResult:
 
 
 def upload(url: str, data: bytes, name: str = "", mime: str = "",
-           gzip_if_worthwhile: bool = True, ttl: str = "") -> dict:
-    """PUT one blob to a volume server (reference upload_content.go:151)."""
+           gzip_if_worthwhile: bool = True, ttl: str = "",
+           jwt: str = "") -> dict:
+    """PUT one blob to a volume server (reference upload_content.go:151).
+    `jwt` is the single-fid write token the master minted on Assign."""
     body = data
     gzipped = False
     compressible = (mime.startswith("text/") or name.endswith((".txt", ".json",
@@ -41,6 +43,8 @@ def upload(url: str, data: bytes, name: str = "", mime: str = "",
             body = gz
             gzipped = True
     params = {"ttl": ttl} if ttl else {}
+    if jwt:
+        params["jwt"] = jwt
     if name:
         part_headers = {"Content-Encoding": "gzip"} if gzipped else {}
         files = {"file": (name, body, mime or "application/octet-stream",
@@ -65,7 +69,8 @@ def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
         try:
             a = mc.assign(collection=collection, replication=replication, ttl=ttl)
             target = a.location.public_url or a.location.url
-            res = upload(f"{target}/{a.fid}", data, name=name, mime=mime, ttl=ttl)
+            res = upload(f"{target}/{a.fid}", data, name=name, mime=mime,
+                         ttl=ttl, jwt=a.auth)
             return UploadResult(fid=a.fid, url=target,
                                 size=res.get("size", len(data)),
                                 e_tag=res.get("eTag", ""),
@@ -75,12 +80,14 @@ def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
     raise RuntimeError(f"submit failed after {retries} tries: {last_err}")
 
 
-def read(mc: MasterClient, fid: str) -> bytes:
-    """Fetch a blob by fid, trying each replica (wdclient vid_map round-robin)."""
+def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
+    """Fetch a blob by fid, trying each replica (wdclient vid_map round-robin).
+    Pass `jwt` (a read-key token) when the cluster read-gates volumes."""
     last_err: Exception | None = None
+    params = {"jwt": jwt} if jwt else None
     for url in mc.lookup_file_id(fid):
         try:
-            r = _session.get(url, timeout=60)
+            r = _session.get(url, timeout=60, params=params)
             if r.status_code == 404:
                 raise KeyError(fid)
             r.raise_for_status()
@@ -93,9 +100,11 @@ def read(mc: MasterClient, fid: str) -> bytes:
 
 
 def delete(mc: MasterClient, fid: str) -> bool:
+    jwt = mc.lookup_file_id_jwt(fid)
+    params = {"jwt": jwt} if jwt else None
     ok = False
     for url in mc.lookup_file_id(fid):
-        r = _session.delete(url, timeout=30)
+        r = _session.delete(url, timeout=30, params=params)
         ok = ok or r.status_code in (200, 202)
         break  # server fans out to replicas itself
     return ok

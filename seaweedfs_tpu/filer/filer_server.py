@@ -89,7 +89,7 @@ class FilerServer:
                            replication=self.replication, ttl=ttl)
         target = a.location.public_url or a.location.url
         res = operation.upload(f"{target}/{a.fid}", data,
-                               gzip_if_worthwhile=False, ttl=ttl)
+                               gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
         return fpb.FileChunk(file_id=a.fid, size=res.get("size", len(data)),
                              modified_ts_ns=time.time_ns(),
                              e_tag=res.get("eTag", ""))

@@ -32,7 +32,8 @@ class MasterServer:
                  default_replication: str = "000",
                  sequencer: str = "memory",
                  pulse_seconds: float = 5.0,
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 guard=None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -45,6 +46,10 @@ class MasterServer:
         self.pulse_seconds = pulse_seconds
         self.garbage_threshold = garbage_threshold
         self.is_leader = True
+        # Optional security.Guard: when its signing_key is set, Assign
+        # responses carry a single-fid JWT the volume server will demand
+        # (reference master_grpc_server_assign.go JWT minting).
+        self.guard = guard
         self._subscribers: dict[int, tuple[str, queue.Queue]] = {}
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
@@ -55,7 +60,11 @@ class MasterServer:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         svc = self._build_service()
-        self._grpc = serve(f"{self.ip}:{self.port}", [svc])
+        key = self.guard.signing_key if self.guard is not None else ""
+        if key:
+            from ..utils.rpc import set_cluster_key
+            set_cluster_key(key)
+        self._grpc = serve(f"{self.ip}:{self.port}", [svc], auth_key=key)
         threading.Thread(target=self._janitor, daemon=True,
                          name="master-janitor").start()
         log.info("master up at %s (leader)", self.address)
@@ -161,6 +170,14 @@ class MasterServer:
             resp = pb.LookupVolumeResponse()
             for vf in req.volume_or_file_ids:
                 entry = resp.volume_id_locations.add(volume_or_file_id=vf)
+                # Full file-id lookups get a write-key token so clients can
+                # delete/update (reference master_grpc_server_volume.go:102:
+                # auth only when the lookup string is a file id).
+                if ("," in vf and ms.guard is not None
+                        and ms.guard.signing_key):
+                    from ..security import gen_jwt_for_volume_server
+                    entry.auth = gen_jwt_for_volume_server(
+                        ms.guard.signing_key, ms.guard.expires_after_sec, vf)
                 try:
                     vid = int(vf.split(",")[0])
                 except ValueError:
@@ -344,6 +361,10 @@ class MasterServer:
         for n in nodes:
             resp.replicas.add(url=n.url, public_url=n.public_url,
                               grpc_port=n.grpc_port)
+        if self.guard is not None and self.guard.signing_key:
+            from ..security import gen_jwt_for_volume_server
+            resp.auth = gen_jwt_for_volume_server(
+                self.guard.signing_key, self.guard.expires_after_sec, resp.fid)
         return resp
 
     # -- topology dump -------------------------------------------------------

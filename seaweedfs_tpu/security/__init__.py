@@ -1,0 +1,26 @@
+"""Security: JWT write/read authz and access guard.
+
+TPU-native re-design of the reference's weed/security package
+(jwt.go:30 GenJwtForVolumeServer, guard.go:42 Guard). Masters mint an
+HS256 JWT scoped to a single file id on Assign; volume servers verify it
+before accepting writes (and optionally reads). The guard also supports an
+IP white list and basic auth, checked in that order (guard.go:27-28).
+"""
+
+from .jwt import (
+    gen_jwt_for_volume_server,
+    gen_jwt_for_filer_server,
+    decode_jwt,
+    jwt_from_request,
+    JwtError,
+)
+from .guard import Guard
+
+__all__ = [
+    "gen_jwt_for_volume_server",
+    "gen_jwt_for_filer_server",
+    "decode_jwt",
+    "jwt_from_request",
+    "JwtError",
+    "Guard",
+]

@@ -35,7 +35,8 @@ class VolumeServer:
                  ip: str = "127.0.0.1", port: int = 8080,
                  grpc_port: int | None = None,
                  data_center: str = "", rack: str = "",
-                 pulse_seconds: float = 2.0, read_mode: str = "proxy"):
+                 pulse_seconds: float = 2.0, read_mode: str = "proxy",
+                 guard=None):
         self.store = store
         self.master_address = master_address
         self.ip = ip
@@ -45,6 +46,10 @@ class VolumeServer:
         self.rack = rack
         self.pulse_seconds = pulse_seconds
         self.read_mode = read_mode
+        # security.Guard: JWT/white-list gate on mutating HTTP requests
+        # (reference guard wiring in weed/server/volume_server.go; the write
+        # token is the single-fid JWT the master minted on Assign).
+        self.guard = guard
         self.current_leader = master_address
         self._stop = threading.Event()
         self._hb_wake = threading.Event()
@@ -59,7 +64,12 @@ class VolumeServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
-        self._grpc = serve(f"{self.ip}:{self.grpc_port}", [self._build_service()])
+        key = self.guard.signing_key if self.guard is not None else ""
+        if key:
+            from ..utils.rpc import set_cluster_key
+            set_cluster_key(key)
+        self._grpc = serve(f"{self.ip}:{self.grpc_port}",
+                           [self._build_service()], auth_key=key)
         self._http_thread = threading.Thread(target=self._run_http, daemon=True,
                                              name=f"vs-http-{self.port}")
         self._http_thread.start()
@@ -190,6 +200,12 @@ class VolumeServer:
         from aiohttp import web
 
         fid = request.match_info["fid"]
+        if self.guard is not None:
+            ok, why = self.guard.check_write(request.remote or "",
+                                             dict(request.query),
+                                             request.headers, fid)
+            if not ok:
+                return web.json_response({"error": why}, status=401)
         vid, key, cookie = parse_file_id(fid)
         data, name, mime, gzipped = await self._read_body(request)
         is_replicate = request.query.get("type") == "replicate"
@@ -222,9 +238,21 @@ class VolumeServer:
                 if name:
                     url += "&" + urllib.parse.urlencode(
                         {"name": name.decode(errors="replace")})
+                url += self._peer_jwt_param(fid)
                 async with sess.post(url, data=data, headers=headers) as r:
                     if r.status >= 300:
                         raise OSError(f"replicate to {peer}: HTTP {r.status}")
+
+    def _peer_jwt_param(self, fid: str) -> str:
+        """Replica fan-out re-mints a write token with the shared signing key
+        (reference store_replicate.go forwards the request's jwt; peers share
+        the key, so minting locally is equivalent and survives expiry)."""
+        if self.guard is None or not self.guard.signing_key:
+            return ""
+        from ..security import gen_jwt_for_volume_server
+        tok = gen_jwt_for_volume_server(self.guard.signing_key,
+                                        self.guard.expires_after_sec, fid)
+        return "&jwt=" + urllib.parse.quote(tok)
 
     def _lookup_replicas(self, vid: int) -> list[str]:
         try:
@@ -242,6 +270,12 @@ class VolumeServer:
         from aiohttp import web
 
         fid = request.match_info["fid"]
+        if self.guard is not None:
+            ok, why = self.guard.check_read(request.remote or "",
+                                            dict(request.query),
+                                            request.headers, fid)
+            if not ok:
+                return web.json_response({"error": why}, status=401)
         vid, key, cookie = parse_file_id(fid)
         try:
             n = self.store.read_needle(vid, key, cookie=cookie,
@@ -272,12 +306,15 @@ class VolumeServer:
         if not peers:
             return web.json_response({"error": f"volume {vid} not found"},
                                      status=404)
+        # preserve the caller's query (jwt, resize params, …) on proxy/redirect
+        qs = request.query_string
+        suffix = f"?{qs}" if qs else ""
         if self.read_mode == "redirect":
-            raise web.HTTPMovedPermanently(f"http://{peers[0]}/{fid}")
+            raise web.HTTPMovedPermanently(f"http://{peers[0]}/{fid}{suffix}")
         import aiohttp
 
         async with aiohttp.ClientSession() as sess:
-            async with sess.get(f"http://{peers[0]}/{fid}") as r:
+            async with sess.get(f"http://{peers[0]}/{fid}{suffix}") as r:
                 body = await r.read()
                 return web.Response(
                     body=body, status=r.status,
@@ -287,6 +324,12 @@ class VolumeServer:
         from aiohttp import web
 
         fid = request.match_info["fid"]
+        if self.guard is not None:
+            ok, why = self.guard.check_write(request.remote or "",
+                                             dict(request.query),
+                                             request.headers, fid)
+            if not ok:
+                return web.json_response({"error": why}, status=401)
         vid, key, _ = parse_file_id(fid)
         is_replicate = request.query.get("type") == "replicate"
         v = self.store.find_volume(vid)
@@ -304,7 +347,8 @@ class VolumeServer:
 
                 async with aiohttp.ClientSession() as sess:
                     for peer in peers:
-                        await sess.delete(f"http://{peer}/{fid}?type=replicate")
+                        await sess.delete(f"http://{peer}/{fid}?type=replicate"
+                                          + self._peer_jwt_param(fid))
         return web.json_response({"size": 1 if ok else 0}, status=202)
 
     # -- EC shard reader: remote fetch + degraded reconstruct ---------------

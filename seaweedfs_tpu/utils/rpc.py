@@ -16,6 +16,76 @@ from typing import Callable
 
 import grpc
 
+# -- optional gRPC auth ------------------------------------------------------
+# The reference gates its gRPC plane with mTLS from security.toml
+# (weed/security/tls.go:26,92). Our equivalent is a shared-key bearer token:
+# when a process is configured with the cluster signing key
+# (set_cluster_key), every outgoing Stub call attaches a JWT and every
+# serve(..., auth_key=...) server verifies it before dispatch. Empty key =
+# open cluster, matching the reference default.
+
+_cluster_key: str = ""
+_cluster_key_lock = threading.Lock()
+
+
+def set_cluster_key(key: str) -> None:
+    global _cluster_key
+    with _cluster_key_lock:
+        _cluster_key = key
+
+
+def _outgoing_metadata() -> list[tuple[str, str]]:
+    if not _cluster_key:
+        return []
+    from ..security.jwt import gen_jwt_for_filer_server
+    return [("authorization", "Bearer "
+             + gen_jwt_for_filer_server(_cluster_key, 60))]
+
+
+class _AuthInterceptor(grpc.ServerInterceptor):
+    def __init__(self, key: str):
+        self._key = key
+
+    def intercept_service(self, continuation, handler_call_details):
+        from ..security.jwt import JwtError, decode_jwt
+        for k, v in handler_call_details.invocation_metadata or ():
+            if k == "authorization" and v.startswith("Bearer "):
+                try:
+                    decode_jwt(v[7:], self._key)
+                    return continuation(handler_call_details)
+                except JwtError:
+                    break
+        # Reject with a handler of the same streaming shape as the target
+        # method, else grpc mismatches the wire protocol.
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+
+        def abort(request_or_iter, context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or invalid cluster token")
+
+        def abort_stream(request_or_iter, context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or invalid cluster token")
+            yield  # pragma: no cover
+
+        if handler.unary_unary:
+            return grpc.unary_unary_rpc_method_handler(
+                abort, handler.request_deserializer,
+                handler.response_serializer)
+        if handler.unary_stream:
+            return grpc.unary_stream_rpc_method_handler(
+                abort_stream, handler.request_deserializer,
+                handler.response_serializer)
+        if handler.stream_unary:
+            return grpc.stream_unary_rpc_method_handler(
+                abort, handler.request_deserializer,
+                handler.response_serializer)
+        return grpc.stream_stream_rpc_method_handler(
+            abort_stream, handler.request_deserializer,
+            handler.response_serializer)
+
 
 class RpcService:
     """Declarative service: register handlers, then mount on a grpc.Server."""
@@ -52,9 +122,11 @@ class RpcService:
         return grpc.method_handlers_generic_handler(self.name, self._handlers)
 
 
-def serve(bind: str, services: list[RpcService], max_workers: int = 16) -> grpc.Server:
+def serve(bind: str, services: list[RpcService], max_workers: int = 16,
+          auth_key: str = "") -> grpc.Server:
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
+        interceptors=([_AuthInterceptor(auth_key)] if auth_key else []),
         options=[("grpc.max_receive_message_length", 256 << 20),
                  ("grpc.max_send_message_length", 256 << 20)])
     for s in services:
@@ -100,21 +172,21 @@ class Stub:
             f"/{self.service}/{method}",
             request_serializer=type(request).SerializeToString,
             response_deserializer=resp_cls.FromString)
-        return fn(request, timeout=timeout)
+        return fn(request, timeout=timeout, metadata=_outgoing_metadata())
 
     def call_stream(self, method: str, request, resp_cls, timeout: float = 300.0):
         fn = self._ch.unary_stream(
             f"/{self.service}/{method}",
             request_serializer=type(request).SerializeToString,
             response_deserializer=resp_cls.FromString)
-        return fn(request, timeout=timeout)
+        return fn(request, timeout=timeout, metadata=_outgoing_metadata())
 
     def stream_stream(self, method: str, request_iter, req_cls, resp_cls):
         fn = self._ch.stream_stream(
             f"/{self.service}/{method}",
             request_serializer=req_cls.SerializeToString,
             response_deserializer=resp_cls.FromString)
-        return fn(request_iter)
+        return fn(request_iter, metadata=_outgoing_metadata())
 
 
 MASTER_SERVICE = "swtpu.master.Master"
