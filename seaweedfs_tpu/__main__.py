@@ -21,6 +21,8 @@ import time
 def _add_master_flags(p):
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-httpPort", type=int, default=0,
+                   help="HTTP status/metrics API port (0 = off)")
     p.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
     p.add_argument("-defaultReplication", default="000")
     _add_security_flags(p)
@@ -67,7 +69,7 @@ def run_master(argv):
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
                       default_replication=opt.defaultReplication,
-                      guard=_make_guard(opt))
+                      guard=_make_guard(opt), http_port=opt.httpPort or None)
     ms.start()
     _wait_forever()
 
@@ -110,7 +112,7 @@ def run_server(argv):
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
                       default_replication=opt.defaultReplication,
-                      guard=_make_guard(opt))
+                      guard=_make_guard(opt), http_port=opt.httpPort or None)
     ms.start()
     store = Store(opt.ip, opt.volumePort, f"{opt.ip}:{opt.volumePort}",
                   [DiskLocation(opt.dir, "hdd", opt.max)],
@@ -119,14 +121,19 @@ def run_server(argv):
                       port=opt.volumePort, guard=_make_guard(opt))
     vs.start()
     if opt.filer or opt.s3:
+        import os as _os
+
         from .filer.filer_server import FilerServer
+        filer_dir = _os.path.join(opt.dir, "filer")
+        _os.makedirs(filer_dir, exist_ok=True)
         fs = FilerServer(master_address=f"{opt.ip}:{opt.port}",
+                         store_spec=f"sqlite:{filer_dir}/filer.db",
                          ip=opt.ip, port=opt.filerPort,
-                         store_dir=opt.dir + "/filer")
+                         meta_log_path=_os.path.join(filer_dir, "meta.log"))
         fs.start()
         if opt.s3:
-            from .s3.s3_server import S3Server
-            s3 = S3Server(filer=fs, ip=opt.ip, port=opt.s3Port)
+            from .s3.s3_server import S3Gateway
+            s3 = S3Gateway(fs, ip=opt.ip, port=opt.s3Port)
             s3.start()
     _wait_forever()
 

@@ -122,6 +122,8 @@ def encode_volume(dat_path: str, out_base: str, geo: EcGeometry,
         if not batcher.slabs:
             return
         arr, sinks = batcher.take()
+        from ..stats import EC_ENCODE_BYTES
+        EC_ENCODE_BYTES.inc(type(coder).__name__, amount=arr.nbytes)
         parity = np.asarray(coder.encode(arr))  # [B, p, chunk]
         for b, slab_sinks in enumerate(sinks):
             for j, (out, off, ln) in enumerate(slab_sinks):
@@ -196,6 +198,8 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
             lens.append((o, ln))
             for r, mm in enumerate(survivors):
                 arr[b, r, :ln] = mm[o:o + ln]
+        from ..stats import EC_REBUILD_BYTES
+        EC_REBUILD_BYTES.inc(type(coder).__name__, amount=arr.nbytes)
         rebuilt = np.asarray(coder.reconstruct(arr, present_t, wanted_t))
         for b, (o, ln) in enumerate(lens):
             for k, m in enumerate(missing):

@@ -145,32 +145,45 @@ class FilerServer:
 
         from aiohttp import web
 
+        from ..stats import (FILER_REQUEST_COUNTER,
+                             FILER_REQUEST_SECONDS)
+
         async def handle(request: web.Request):
-            try:
-                if request.method in ("POST", "PUT"):
-                    return await self._h_write(request)
-                if request.method in ("GET", "HEAD"):
-                    return await self._h_read(request)
-                if request.method == "DELETE":
-                    return await self._h_delete(request)
-            except FileNotFoundError as e:
-                return web.json_response({"error": str(e)}, status=404)
-            except FileExistsError as e:
-                return web.json_response({"error": str(e)}, status=409)
-            except OSError as e:
-                return web.json_response({"error": str(e)}, status=409)
-            except Exception as e:  # noqa: BLE001
-                log.error("filer http: %r", e)
-                return web.json_response({"error": str(e)}, status=500)
-            return web.json_response({"error": "method not allowed"}, status=405)
+            kind = request.method.lower()
+            resp = None
+            with FILER_REQUEST_SECONDS.time(kind):
+                try:
+                    if request.method in ("POST", "PUT"):
+                        resp = await self._h_write(request)
+                    elif request.method in ("GET", "HEAD"):
+                        resp = await self._h_read(request)
+                    elif request.method == "DELETE":
+                        resp = await self._h_delete(request)
+                    else:
+                        resp = web.json_response(
+                            {"error": "method not allowed"}, status=405)
+                except FileNotFoundError as e:
+                    resp = web.json_response({"error": str(e)}, status=404)
+                except FileExistsError as e:
+                    resp = web.json_response({"error": str(e)}, status=409)
+                except OSError as e:
+                    resp = web.json_response({"error": str(e)}, status=409)
+                except Exception as e:  # noqa: BLE001
+                    log.error("filer http: %r", e)
+                    resp = web.json_response({"error": str(e)}, status=500)
+            FILER_REQUEST_COUNTER.inc(kind)
+            return resp
 
         async def status(request):
             return web.json_response({"version": "swtpu-filer",
                                       "master": self.mc.leader})
 
+        from ..stats.metrics import aiohttp_metrics_handler
+
         async def main():
             app = web.Application(client_max_size=1 << 30)
             app.router.add_get("/__status__", status)
+            app.router.add_get("/__metrics__", aiohttp_metrics_handler)
             app.router.add_route("*", "/{path:.*}", handle)
             runner = web.AppRunner(app, access_log=None)
             await runner.setup()

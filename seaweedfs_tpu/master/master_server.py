@@ -33,7 +33,7 @@ class MasterServer:
                  sequencer: str = "memory",
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
-                 guard=None):
+                 guard=None, http_port: int | None = None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -54,7 +54,11 @@ class MasterServer:
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
         self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
+        # HTTP status/metrics API (reference master_server_handlers*.go);
+        # 0/None disables. gRPC stays on `port`, HTTP on its own port.
+        self.http_port = http_port
         self._grpc = None
+        self._http = None
         self._stop = threading.Event()
 
     # -- lifecycle ----------------------------------------------------------
@@ -65,6 +69,8 @@ class MasterServer:
             from ..utils.rpc import set_cluster_key
             set_cluster_key(key)
         self._grpc = serve(f"{self.ip}:{self.port}", [svc], auth_key=key)
+        if self.http_port:
+            self._start_http()
         threading.Thread(target=self._janitor, daemon=True,
                          name="master-janitor").start()
         log.info("master up at %s (leader)", self.address)
@@ -73,6 +79,113 @@ class MasterServer:
         self._stop.set()
         if self._grpc:
             self._grpc.stop(grace=0.5)
+        if self._http:
+            self._http.shutdown()
+            self._http.server_close()
+
+    def _start_http(self) -> None:
+        """Status/metrics HTTP API (reference master_server_handlers.go:
+        /dir/status topology dump, /dir/assign, /dir/lookup, /metrics)."""
+        import http.server
+        import json as _json
+        import urllib.parse as _up
+
+        from google.protobuf.json_format import MessageToDict
+
+        ms = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self, body_params: dict | None = None):
+                url = _up.urlparse(self.path)
+                q = dict(_up.parse_qsl(url.query))
+                if body_params:
+                    q.update(body_params)
+                # Same guard as the data plane (reference wraps master HTTP
+                # handlers in guard.WhiteList); /metrics stays open for
+                # scrapers.
+                if ms.guard is not None and url.path != "/metrics":
+                    ok, why = ms.guard.check_write(
+                        self.client_address[0], q, self.headers)
+                    if not ok:
+                        self._send(401, _json.dumps({"error": why}).encode())
+                        return
+                if url.path == "/metrics":
+                    from ..stats import REGISTRY
+                    self._send(200, REGISTRY.gather().encode(), "text/plain")
+                elif url.path == "/dir/status":
+                    body = {"Topology": MessageToDict(ms.topology_info()),
+                            "Leader": ms.address,
+                            "IsLeader": ms.is_leader}
+                    self._send(200, _json.dumps(body).encode())
+                elif url.path == "/dir/lookup":
+                    vid = q.get("volumeId", "").split(",")[0]
+                    try:
+                        nodes = ms.topo.lookup(int(vid))
+                    except ValueError:
+                        nodes = None
+                    if not nodes:
+                        self._send(404, _json.dumps(
+                            {"error": f"volume {vid} not found"}).encode())
+                    else:
+                        self._send(200, _json.dumps({
+                            "volumeId": vid,
+                            "locations": [{"url": n.url,
+                                           "publicUrl": n.public_url}
+                                          for n in nodes]}).encode())
+                elif url.path == "/dir/assign":
+                    resp = ms.do_assign(pb.AssignRequest(
+                        count=int(q.get("count", 1)),
+                        collection=q.get("collection", ""),
+                        replication=q.get("replication", ""),
+                        ttl=q.get("ttl", "")))
+                    if resp.error:
+                        self._send(406, _json.dumps(
+                            {"error": resp.error}).encode())
+                    else:
+                        self._send(200, _json.dumps({
+                            "fid": resp.fid, "count": resp.count,
+                            "url": resp.location.url,
+                            "publicUrl": resp.location.public_url,
+                            "auth": resp.auth}).encode())
+                elif url.path == "/cluster/status":
+                    self._send(200, _json.dumps({
+                        "IsLeader": ms.is_leader, "Leader": ms.address,
+                        "Peers": []}).encode())
+                else:
+                    self._send(404, b'{"error":"not found"}')
+
+            def do_POST(self):
+                # form-encoded bodies merge into the query params (the
+                # reference Go master reads both via r.FormValue)
+                params: dict = {}
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    ctype = self.headers.get("Content-Type", "")
+                    if n and "application/x-www-form-urlencoded" in ctype:
+                        params = dict(_up.parse_qsl(
+                            self.rfile.read(n).decode()))
+                    elif n:
+                        self.rfile.read(n)  # drain
+                except Exception:  # noqa: BLE001
+                    pass
+                self.do_GET(body_params=params)
+
+        self._http = http.server.ThreadingHTTPServer(
+            (self.ip, self.http_port), Handler)
+        threading.Thread(target=self._http.serve_forever, daemon=True,
+                         name="master-http").start()
+        log.info("master http api on %s:%d", self.ip, self.http_port)
 
     # -- volume allocation RPC out to volume servers ------------------------
     def _allocate_volume(self, node, vid: int, req: GrowRequest) -> None:
@@ -117,6 +230,8 @@ class MasterServer:
             node = None
             try:
                 for hb in request_iter:
+                    from ..stats import MASTER_RECEIVED_HEARTBEATS
+                    MASTER_RECEIVED_HEARTBEATS.inc()
                     node = ms._handle_heartbeat(hb, node)
                     yield pb.HeartbeatResponse(
                         volume_size_limit=ms.topo.volume_size_limit,
@@ -328,6 +443,12 @@ class MasterServer:
 
     # -- assign --------------------------------------------------------------
     def do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
+        resp = self._do_assign(req)
+        from ..stats import MASTER_ASSIGN_COUNTER
+        MASTER_ASSIGN_COUNTER.inc("error" if resp.error else "ok")
+        return resp
+
+    def _do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
         if not self.is_leader:
             return pb.AssignResponse(error="not leader")
         replication = req.replication or self.default_replication

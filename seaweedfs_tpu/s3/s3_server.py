@@ -71,18 +71,29 @@ class S3Gateway:
 
         from aiohttp import web
 
+        from ..stats import S3_REQUEST_COUNTER, S3_REQUEST_SECONDS
+
         async def dispatch(request: web.Request):
-            try:
-                return await self._route(request)
-            except S3Error as e:
-                return _error_response(e, request.path)
-            except FileNotFoundError as e:
-                return _error_response(
-                    S3Error("NoSuchKey", str(e), 404), request.path)
-            except Exception as e:  # noqa: BLE001
-                log.error("s3 http: %r", e)
-                return _error_response(
-                    S3Error("InternalError", str(e), 500), request.path)
+            kind = request.method.lower()
+            resp = None
+            with S3_REQUEST_SECONDS.time(kind):
+                try:
+                    resp = await self._route(request)
+                except S3Error as e:
+                    resp = _error_response(e, request.path)
+                except FileNotFoundError as e:
+                    resp = _error_response(
+                        S3Error("NoSuchKey", str(e), 404), request.path)
+                except Exception as e:  # noqa: BLE001
+                    log.error("s3 http: %r", e)
+                    resp = _error_response(
+                        S3Error("InternalError", str(e), 500), request.path)
+            # Label by bucket only for successful requests — failed probes
+            # (scanners, typos) would otherwise mint unbounded label sets.
+            bucket = (request.path.lstrip("/").split("/", 1)[0]
+                      if resp.status < 400 else "")
+            S3_REQUEST_COUNTER.inc(kind, str(resp.status), bucket)
+            return resp
 
         async def main():
             app = web.Application(client_max_size=1 << 30)

@@ -89,9 +89,40 @@ class VolumeServer:
         self.store.close()
 
     # -- heartbeat (reference volume_grpc_client_to_master.go) ---------------
+    def _update_gauges(self, hb: dict) -> None:
+        """Volume/EC/disk gauges from heartbeat state (reference sets
+        VolumeServerDiskSizeGauge from EC heartbeat, store_ec.go:41).
+        Label sets seen before but absent now are zeroed, so removed
+        volumes/collections don't linger in dashboards."""
+        from ..stats import (VOLUME_SERVER_DISK_SIZE_GAUGE,
+                             VOLUME_SERVER_EC_SHARD_GAUGE,
+                             VOLUME_SERVER_VOLUME_GAUGE)
+        per: dict[tuple[str, str], int] = {}
+        size: dict[tuple[str, str], int] = {}
+        for v in hb["volumes"]:
+            key = (v["collection"], v["disk_type"])
+            per[key] = per.get(key, 0) + 1
+            size[key] = size.get(key, 0) + v["size"]
+        ec_per: dict[tuple[str], int] = {}
+        for s in hb["ec_shards"]:
+            n = bin(s["ec_index_bits"]).count("1")
+            key = (s["collection"],)
+            ec_per[key] = ec_per.get(key, 0) + n
+        for gauge, cur, attr in (
+                (VOLUME_SERVER_VOLUME_GAUGE, per, "_g_vol"),
+                (VOLUME_SERVER_DISK_SIZE_GAUGE, size, "_g_size"),
+                (VOLUME_SERVER_EC_SHARD_GAUGE, ec_per, "_g_ec")):
+            prev: set = getattr(self, attr, set())
+            for key in prev - set(cur):
+                gauge.set(*key, value=0)
+            for key, n in cur.items():
+                gauge.set(*key, value=n)
+            setattr(self, attr, set(cur))
+
     def _heartbeat_messages(self):
         while not self._stop.is_set():
             hb = self.store.collect_heartbeat()
+            self._update_gauges(hb)
             msg = mpb.Heartbeat(
                 ip=self.ip, port=self.port, grpc_port=self.grpc_port,
                 public_url=self.store.public_url,
@@ -139,29 +170,54 @@ class VolumeServer:
 
         from aiohttp import web
 
+        from ..stats import (VOLUME_REQUEST_COUNTER,
+                             VOLUME_REQUEST_SECONDS)
+
+        _kind = {"POST": "post", "PUT": "put", "GET": "get",
+                 "HEAD": "head", "DELETE": "delete"}
+
         async def handle(request: web.Request):
+            kind = _kind.get(request.method, "other")
+            t0 = time.perf_counter()
+            resp = None
+            status = 500
             try:
-                if request.method in ("POST", "PUT"):
-                    return await self._handle_write(request)
-                if request.method == "GET" or request.method == "HEAD":
-                    return await self._handle_read(request)
-                if request.method == "DELETE":
-                    return await self._handle_delete(request)
-            except KeyError as e:
-                return web.json_response({"error": str(e)}, status=404)
-            except PermissionError as e:
-                return web.json_response({"error": str(e)}, status=403)
-            except Exception as e:  # noqa: BLE001
-                log.error("http error: %s", e)
-                return web.json_response({"error": str(e)}, status=500)
-            return web.json_response({"error": "method not allowed"}, status=405)
+                try:
+                    if request.method in ("POST", "PUT"):
+                        resp = await self._handle_write(request)
+                    elif request.method == "GET" or request.method == "HEAD":
+                        resp = await self._handle_read(request)
+                    elif request.method == "DELETE":
+                        resp = await self._handle_delete(request)
+                    else:
+                        resp = web.json_response(
+                            {"error": "method not allowed"}, status=405)
+                except KeyError as e:
+                    resp = web.json_response({"error": str(e)}, status=404)
+                except PermissionError as e:
+                    resp = web.json_response({"error": str(e)}, status=403)
+                except web.HTTPException as e:
+                    status = e.status  # redirects count too
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    log.error("http error: %s", e)
+                    resp = web.json_response({"error": str(e)}, status=500)
+                status = resp.status
+                return resp
+            finally:
+                VOLUME_REQUEST_COUNTER.inc(kind, str(status))
+                VOLUME_REQUEST_SECONDS.observe(
+                    kind, value=time.perf_counter() - t0)
 
         async def status(request):
             return web.json_response({"version": "swtpu", **self.store.status()})
 
+        from ..stats.metrics import aiohttp_metrics_handler
+
         async def main():
             app = web.Application(client_max_size=256 << 20)
             app.router.add_get("/status", status)
+            app.router.add_get("/metrics", aiohttp_metrics_handler)
             app.router.add_route("*", "/{fid:.*}", handle)
             runner = web.AppRunner(app, access_log=None)
             await runner.setup()

@@ -1,0 +1,29 @@
+"""Prometheus-style metrics (reference weed/stats).
+
+A dependency-free registry producing Prometheus text exposition format.
+Mirrors the reference's metric families (stats/metrics.go:105 master,
+:188 filer, :251 volume server, s3 counters/histograms and the volume/EC
+gauges set from heartbeat state, store_ec.go:41).
+"""
+
+from .metrics import (
+    Counter, Gauge, Histogram, Registry, REGISTRY,
+    MASTER_RECEIVED_HEARTBEATS, MASTER_ASSIGN_COUNTER,
+    MASTER_LEADER_CHANGES, VOLUME_REQUEST_COUNTER, VOLUME_REQUEST_SECONDS,
+    VOLUME_SERVER_VOLUME_GAUGE, VOLUME_SERVER_EC_SHARD_GAUGE,
+    VOLUME_SERVER_DISK_SIZE_GAUGE, FILER_REQUEST_COUNTER,
+    FILER_REQUEST_SECONDS, S3_REQUEST_COUNTER, S3_REQUEST_SECONDS,
+    EC_ENCODE_BYTES, EC_REBUILD_BYTES,
+    start_push_loop,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "MASTER_RECEIVED_HEARTBEATS", "MASTER_ASSIGN_COUNTER",
+    "MASTER_LEADER_CHANGES", "VOLUME_REQUEST_COUNTER",
+    "VOLUME_REQUEST_SECONDS", "VOLUME_SERVER_VOLUME_GAUGE",
+    "VOLUME_SERVER_EC_SHARD_GAUGE", "VOLUME_SERVER_DISK_SIZE_GAUGE",
+    "FILER_REQUEST_COUNTER", "FILER_REQUEST_SECONDS",
+    "S3_REQUEST_COUNTER", "S3_REQUEST_SECONDS",
+    "EC_ENCODE_BYTES", "EC_REBUILD_BYTES", "start_push_loop",
+]

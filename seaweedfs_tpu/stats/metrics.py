@@ -1,0 +1,265 @@
+"""Metric primitives + the framework's standard metric families.
+
+Text output follows the Prometheus exposition format so the reference's
+grafana/prometheus assets (docker/prometheus) work against our /metrics
+endpoints (reference stats/metrics.go:335 mounts the scrape handler; :306
+runs the optional push-gateway loop).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+from ..utils.log import logger
+
+log = logger("stats")
+
+_DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(label_names: tuple[str, ...], label_values: tuple[str, ...],
+                extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in zip(label_names, label_values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = labels
+        self._lock = threading.Lock()
+
+    def expose(self) -> list[str]:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_text, labels=()):
+        super().__init__(name, help_text, labels)
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def inc(self, *label_values: str, amount: float = 1.0) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[lv] = self._values.get(lv, 0.0) + amount
+
+    def value(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            return [f"{self.name} 0"]
+        return [f"{self.name}{_fmt_labels(self.label_names, lv)} {v}"
+                for lv, v in items]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_text, labels=()):
+        super().__init__(name, help_text, labels)
+        self._values: dict[tuple[str, ...], float] = {}
+
+    def set(self, *label_values: str, value: float) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[lv] = float(value)
+
+    def add(self, *label_values: str, amount: float = 1.0) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[lv] = self._values.get(lv, 0.0) + amount
+
+    def value(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        return [f"{self.name}{_fmt_labels(self.label_names, lv)} {v}"
+                for lv, v in items]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, labels=(),
+                 buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_text, labels)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
+
+    def observe(self, *label_values: str, value: float) -> None:
+        lv = tuple(str(v) for v in label_values)
+        with self._lock:
+            counts = self._counts.setdefault(lv, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[lv] = self._sums.get(lv, 0.0) + value
+            self._totals[lv] = self._totals.get(lv, 0) + 1
+
+    def time(self, *label_values: str):
+        """Context manager observing elapsed seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(*label_values,
+                             value=time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def count(self, *label_values: str) -> int:
+        with self._lock:
+            return self._totals.get(tuple(str(v) for v in label_values), 0)
+
+    def expose(self) -> list[str]:
+        out = []
+        with self._lock:
+            items = sorted(self._counts.items())
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for lv, counts in items:
+            for i, b in enumerate(self.buckets):
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, lv, f'le=\"{b}\"')}"
+                    f" {counts[i]}")
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(self.label_names, lv, 'le=\"+Inf\"')}"
+                       f" {totals[lv]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)}"
+                       f" {sums[lv]}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, lv)}"
+                       f" {totals[lv]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            self._metrics.append(metric)
+        return metric
+
+    def gather(self) -> str:
+        """Prometheus text format (reference metrics.go:31 Gather)."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            body = m.expose()
+            if not body:
+                continue
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(body)
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def _counter(name, help_text, labels=()):
+    return REGISTRY.register(Counter(name, help_text, labels))
+
+
+def _gauge(name, help_text, labels=()):
+    return REGISTRY.register(Gauge(name, help_text, labels))
+
+
+def _histogram(name, help_text, labels=(), **kw):
+    return REGISTRY.register(Histogram(name, help_text, labels, **kw))
+
+
+# Standard families (names follow reference stats/metrics.go so that
+# existing dashboards keep working).
+MASTER_RECEIVED_HEARTBEATS = _counter(
+    "SeaweedFS_master_received_heartbeats", "master heartbeats received")
+MASTER_ASSIGN_COUNTER = _counter(
+    "SeaweedFS_master_assign_requests", "assign requests", ("state",))
+MASTER_LEADER_CHANGES = _counter(
+    "SeaweedFS_master_leader_changes", "raft leader changes")
+VOLUME_REQUEST_COUNTER = _counter(
+    "SeaweedFS_volumeServer_request_total", "volume server requests",
+    ("type", "code"))
+VOLUME_REQUEST_SECONDS = _histogram(
+    "SeaweedFS_volumeServer_request_seconds", "volume request latency",
+    ("type",))
+VOLUME_SERVER_VOLUME_GAUGE = _gauge(
+    "SeaweedFS_volumeServer_volumes", "volumes on this server",
+    ("collection", "type"))
+VOLUME_SERVER_EC_SHARD_GAUGE = _gauge(
+    "SeaweedFS_volumeServer_ec_shards", "EC shards on this server",
+    ("collection",))
+VOLUME_SERVER_DISK_SIZE_GAUGE = _gauge(
+    "SeaweedFS_volumeServer_total_disk_size", "disk usage bytes",
+    ("collection", "type"))
+FILER_REQUEST_COUNTER = _counter(
+    "SeaweedFS_filer_request_total", "filer requests", ("type",))
+FILER_REQUEST_SECONDS = _histogram(
+    "SeaweedFS_filer_request_seconds", "filer request latency", ("type",))
+S3_REQUEST_COUNTER = _counter(
+    "SeaweedFS_s3_request_total", "s3 requests", ("type", "code", "bucket"))
+S3_REQUEST_SECONDS = _histogram(
+    "SeaweedFS_s3_request_seconds", "s3 request latency", ("type",))
+# Device EC pipeline throughput (TPU-native addition).
+EC_ENCODE_BYTES = _counter(
+    "SeaweedFS_ec_encode_bytes_total", "bytes EC-encoded", ("coder",))
+EC_REBUILD_BYTES = _counter(
+    "SeaweedFS_ec_rebuild_bytes_total", "bytes EC-rebuilt", ("coder",))
+
+
+async def aiohttp_metrics_handler(request):
+    """Shared /metrics handler for the aiohttp-based servers."""
+    from aiohttp import web
+    return web.Response(text=REGISTRY.gather(), content_type="text/plain")
+
+
+def start_push_loop(gateway_url: str, job: str, interval_seconds: int = 15,
+                    registry: Registry = REGISTRY,
+                    stop_event: threading.Event | None = None) -> threading.Thread:
+    """Push-gateway loop (reference metrics.go:306 LoopPushingMetric)."""
+    stop = stop_event or threading.Event()
+
+    def loop():
+        url = f"{gateway_url.rstrip('/')}/metrics/job/{job}"
+        while not stop.wait(interval_seconds):
+            try:
+                req = urllib.request.Request(
+                    url, data=registry.gather().encode(), method="PUT",
+                    headers={"Content-Type": "text/plain"})
+                urllib.request.urlopen(req, timeout=5)
+            except Exception as e:  # noqa: BLE001
+                log.warning("metrics push to %s: %s", gateway_url, e)
+
+    t = threading.Thread(target=loop, daemon=True, name="metrics-push")
+    t._stop_event = stop  # type: ignore[attr-defined]
+    t.start()
+    return t

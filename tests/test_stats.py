@@ -1,0 +1,154 @@
+"""Metrics: registry primitives + scrape endpoints on live servers.
+
+Reference: weed/stats/metrics.go (Gather :31, handler :335, push loop :306).
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.stats.metrics import Counter, Gauge, Histogram, Registry
+
+
+class TestPrimitives:
+    def test_counter(self):
+        reg = Registry()
+        c = reg.register(Counter("t_total", "help", ("op",)))
+        c.inc("get")
+        c.inc("get", amount=2)
+        c.inc("put")
+        assert c.value("get") == 3
+        text = reg.gather()
+        assert '# TYPE t_total counter' in text
+        assert 't_total{op="get"} 3.0' in text
+        assert 't_total{op="put"} 1.0' in text
+
+    def test_unlabeled_counter_exposes_zero(self):
+        reg = Registry()
+        reg.register(Counter("z_total", "h"))
+        assert "z_total 0" in reg.gather()
+
+    def test_gauge(self):
+        reg = Registry()
+        g = reg.register(Gauge("g", "h", ("col", "disk")))
+        g.set("", "hdd", value=5)
+        g.add("", "hdd", amount=2)
+        assert g.value("", "hdd") == 7
+        assert 'g{col="",disk="hdd"} 7.0' in reg.gather()
+
+    def test_histogram(self):
+        reg = Registry()
+        h = reg.register(Histogram("lat_seconds", "h", ("op",),
+                                   buckets=(0.01, 0.1, 1.0)))
+        h.observe("get", value=0.05)
+        h.observe("get", value=0.5)
+        h.observe("get", value=5.0)
+        text = reg.gather()
+        assert 'lat_seconds_bucket{op="get",le="0.01"} 0' in text
+        assert 'lat_seconds_bucket{op="get",le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{op="get",le="1.0"} 2' in text
+        assert 'lat_seconds_bucket{op="get",le="+Inf"} 3' in text
+        assert 'lat_seconds_count{op="get"} 3' in text
+        assert h.count("get") == 3
+
+    def test_histogram_timer(self):
+        h = Histogram("t", "h", ("op",))
+        with h.time("x"):
+            pass
+        assert h.count("x") == 1
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestScrapeEndpoints:
+    @pytest.fixture(scope="class")
+    def mini_cluster(self, tmp_path_factory):
+        import requests
+
+        from seaweedfs_tpu.master.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.storage.disk_location import DiskLocation
+        from seaweedfs_tpu.storage.store import Store
+
+        mport, vport, hport = _free_port(), _free_port(), _free_port()
+        ms = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.5, http_port=hport)
+        ms.start()
+        d = tmp_path_factory.mktemp("vs")
+        store = Store("127.0.0.1", vport, "",
+                      [DiskLocation(str(d), max_volume_count=8)],
+                      coder_name="numpy")
+        vs = VolumeServer(store, ms.address, port=vport,
+                          grpc_port=_free_port(), pulse_seconds=0.5)
+        vs.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(ms.topo.nodes) < 1:
+            time.sleep(0.05)
+        while time.time() < deadline:
+            try:
+                requests.get(f"http://{vs.url}/status", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.05)
+        yield ms, vs
+        vs.stop()
+        ms.stop()
+
+    def test_volume_metrics_endpoint(self, mini_cluster):
+        import requests
+
+        ms, vs = mini_cluster
+        from seaweedfs_tpu.client.master_client import MasterClient
+        from seaweedfs_tpu.client import operation
+
+        mc = MasterClient(ms.address).start()
+        mc.wait_connected()
+        try:
+            res = operation.submit(mc, b"metrics-payload", name="m.bin")
+            assert operation.read(mc, res.fid) == b"metrics-payload"
+        finally:
+            mc.stop()
+        r = requests.get(f"http://{vs.url}/metrics", timeout=5)
+        assert r.status_code == 200
+        assert "SeaweedFS_volumeServer_request_total" in r.text
+        assert 'type="post"' in r.text and 'type="get"' in r.text
+        assert "SeaweedFS_volumeServer_request_seconds_bucket" in r.text
+
+    def test_master_http_api(self, mini_cluster):
+        import requests
+
+        ms, _ = mini_cluster
+        base = f"http://{ms.ip}:{ms.http_port}"
+        r = requests.get(f"{base}/metrics", timeout=5)
+        assert r.status_code == 200
+        assert "SeaweedFS_master_received_heartbeats" in r.text
+        r = requests.get(f"{base}/dir/status", timeout=5)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["IsLeader"] is True
+        assert "Topology" in body
+        # HTTP assign (reference /dir/assign handler)
+        r = requests.get(f"{base}/dir/assign?count=1", timeout=5)
+        assert r.status_code == 200 and "," in r.json()["fid"]
+        fid = r.json()["fid"]
+        vid = fid.split(",")[0]
+        r = requests.get(f"{base}/dir/lookup?volumeId={vid}", timeout=5)
+        assert r.status_code == 200 and r.json()["locations"]
+
+    def test_heartbeat_gauges(self, mini_cluster):
+        ms, vs = mini_cluster
+        from seaweedfs_tpu.stats import (MASTER_RECEIVED_HEARTBEATS,
+                                         VOLUME_SERVER_VOLUME_GAUGE)
+
+        assert MASTER_RECEIVED_HEARTBEATS.value() >= 1
+        vs.trigger_heartbeat()
+        deadline = time.time() + 5
+        while (time.time() < deadline
+               and VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") < 1):
+            time.sleep(0.1)
+        assert VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") >= 1
