@@ -131,6 +131,36 @@ def delete_batch(mc: MasterClient, fids: list[str]) -> int:
     return deleted
 
 
+def query(mc: MasterClient, fids: list[str], *, field: str = "",
+          op: str = "", value: str = "", projections: list[str] | None = None,
+          input_format: str = "json", csv_has_header: bool = False,
+          output_format: str = "json") -> bytes:
+    """S3-Select-lite scan of blobs on their volume servers
+    (reference volume Query RPC, weed/server/volume_grpc_query.go)."""
+    from ..pb import volume_server_pb2 as vpb
+    from ..utils.rpc import Stub, VOLUME_SERVICE
+
+    out = bytearray()
+    by_server: dict[str, list[str]] = {}
+    for fid in fids:
+        vid, _, _ = parse_file_id(fid)
+        locs = mc.lookup(vid)
+        if not locs:
+            raise KeyError(f"volume {vid} not found")
+        by_server.setdefault(_grpc_addr(locs[0]), []).append(fid)
+    for addr, group in by_server.items():
+        req = vpb.QueryRequest(from_file_ids=group)
+        req.filter.field, req.filter.operand, req.filter.value = field, op, value
+        req.projections.extend(projections or [])
+        req.input_serialization.format = input_format
+        req.input_serialization.csv_has_header = csv_has_header
+        req.output_serialization.format = output_format
+        stub = Stub(addr, VOLUME_SERVICE)
+        for stripe in stub.call_stream("Query", req, vpb.QueriedStripe):
+            out.extend(stripe.records)
+    return bytes(out)
+
+
 def _grpc_addr(loc: dict) -> str:
     host = loc["url"].rsplit(":", 1)[0]
     return f"{host}:{loc['grpc_port']}"

@@ -343,11 +343,24 @@ class VolumeServer:
         headers = {}
         if n.name:
             headers["Content-Disposition"] = f'inline; filename="{n.name.decode(errors="replace")}"'
+        plain = not n.is_gzipped
         if n.is_gzipped and "gzip" not in (request.headers.get("Accept-Encoding") or ""):
             import gzip as _gz
             body = _gz.decompress(body)
+            plain = True
         elif n.is_gzipped:
             headers["Content-Encoding"] = "gzip"
+        # on-the-fly image ops over the uncompressed bytes (reference
+        # conditionallyResizeImages, volume_server_handlers_read.go:321)
+        name = n.name.decode(errors="replace") if n.name else ""
+        ext = os.path.splitext(name)[1].lower()
+        if ext and plain:
+            from ..images import fix_jpeg_orientation, resized, should_resize
+            if ext in (".jpg", ".jpeg"):
+                body = fix_jpeg_orientation(body)
+            w, h, mode, do = should_resize(ext, dict(request.query))
+            if do:
+                body = resized(ext, body, w, h, mode)
         return web.Response(body=body, headers=headers,
                             content_type=(n.mime.decode() if n.mime else
                                           "application/octet-stream"))
@@ -837,6 +850,53 @@ class VolumeServer:
             now = time.time_ns()
             return vpb.PingResponse(start_time_ns=now, remote_time_ns=now,
                                     stop_time_ns=time.time_ns())
+
+        @svc.unary_stream("Query", vpb.QueryRequest, vpb.QueriedStripe)
+        def query(req, context):
+            """S3-Select-lite scan over needles (reference
+            volume_grpc_query.go:12; JSON via weed/query/json, CSV is a
+            stub there — supported here)."""
+            import json as _json
+
+            from ..query import Query, query_csv_lines, query_json_lines
+
+            q = Query(field=req.filter.field, op=req.filter.operand,
+                      value=req.filter.value)
+            in_fmt = req.input_serialization.format or "json"
+            out_fmt = req.output_serialization.format or "json"
+            out_delim = req.output_serialization.csv_delimiter or ","
+            for fid in req.from_file_ids:
+                try:
+                    vid, key, cookie = parse_file_id(fid)
+                    n = store.read_needle(
+                        vid, key, cookie=cookie,
+                        shard_reader=self._make_shard_reader(vid))
+                except (KeyError, ValueError) as e:
+                    context.abort(5, f"query {fid}: {e}")
+                data = n.data
+                if n.is_gzipped:
+                    import gzip as _gz
+                    data = _gz.decompress(data)
+                if in_fmt == "csv":
+                    rows = query_csv_lines(
+                        data, list(req.projections), q,
+                        delimiter=req.input_serialization.csv_delimiter or ",",
+                        has_header=req.input_serialization.csv_has_header)
+                else:
+                    rows = query_json_lines(data, list(req.projections), q)
+                buf = []
+                for row in rows:
+                    if out_fmt == "csv":
+                        buf.append(out_delim.join(
+                            "" if v is None else str(v) for v in row))
+                    elif (in_fmt != "csv" and not req.projections
+                          and len(row) == 1):
+                        buf.append(_json.dumps(row[0]))  # whole document
+                    else:
+                        buf.append(_json.dumps(row))
+                if buf:
+                    yield vpb.QueriedStripe(
+                        records=("\n".join(buf) + "\n").encode())
 
         return svc
 

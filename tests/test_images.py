@@ -1,0 +1,113 @@
+"""Image resize on read (reference weed/images + read-handler wiring)."""
+
+import io
+
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from seaweedfs_tpu.images import fix_jpeg_orientation, resized, should_resize  # noqa: E402
+
+
+def _png(w, h, color=(255, 0, 0)):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class TestResize:
+    def test_should_resize_gate(self):
+        assert should_resize(".png", {"width": "10"})[3]
+        assert not should_resize(".txt", {"width": "10"})[3]
+        assert not should_resize(".png", {})[3]
+        assert not should_resize(".png", {"width": "x"})[3]
+
+    def test_plain_resize(self):
+        out = resized(".png", _png(100, 50), 50, 25)
+        img = Image.open(io.BytesIO(out))
+        assert img.size == (50, 25)
+
+    def test_keep_aspect_with_zero_dim(self):
+        out = resized(".png", _png(100, 50), 50, 0)
+        assert Image.open(io.BytesIO(out)).size == (50, 25)
+
+    def test_fit(self):
+        out = resized(".png", _png(100, 50), 40, 40, "fit")
+        assert Image.open(io.BytesIO(out)).size == (40, 20)
+
+    def test_fill(self):
+        out = resized(".png", _png(100, 50), 40, 40, "fill")
+        assert Image.open(io.BytesIO(out)).size == (40, 40)
+
+    def test_no_upscale(self):
+        data = _png(20, 20)
+        assert resized(".png", data, 100, 100) == data
+
+    def test_square_thumbnail_default_mode(self):
+        out = resized(".png", _png(100, 50), 30, 30)
+        assert Image.open(io.BytesIO(out)).size == (30, 30)
+
+    def test_non_image_data_passthrough(self):
+        assert resized(".png", b"not an image", 10, 10) == b"not an image"
+
+    def test_orientation_identity_without_exif(self):
+        data = _png(10, 20)
+        assert fix_jpeg_orientation(data) == data
+
+
+class TestReadPathResize:
+    def test_resize_on_read(self, tmp_path):
+        """End-to-end: upload a png, GET with ?width=&height= resizes."""
+        import socket
+        import time
+
+        import requests
+
+        from seaweedfs_tpu.client import operation
+        from seaweedfs_tpu.client.master_client import MasterClient
+        from seaweedfs_tpu.master.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.storage.disk_location import DiskLocation
+        from seaweedfs_tpu.storage.store import Store
+
+        def fp():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        mport, vport = fp(), fp()
+        ms = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.5)
+        ms.start()
+        store = Store("127.0.0.1", vport, "",
+                      [DiskLocation(str(tmp_path), max_volume_count=4)],
+                      coder_name="numpy")
+        vs = VolumeServer(store, ms.address, port=vport, grpc_port=fp(),
+                          pulse_seconds=0.5)
+        vs.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and len(ms.topo.nodes) < 1:
+                time.sleep(0.05)
+            while time.time() < deadline:
+                try:
+                    requests.get(f"http://{vs.url}/status", timeout=1)
+                    break
+                except Exception:
+                    time.sleep(0.05)
+            mc = MasterClient(ms.address).start()
+            mc.wait_connected()
+            res = operation.submit(mc, _png(80, 40), name="pic.png",
+                                   mime="image/png")
+            r = requests.get(f"http://{vs.url}/{res.fid}?width=20", timeout=5)
+            assert r.status_code == 200
+            img = Image.open(io.BytesIO(r.content))
+            assert img.size == (20, 10)
+            # no params -> original
+            r = requests.get(f"http://{vs.url}/{res.fid}", timeout=5)
+            assert Image.open(io.BytesIO(r.content)).size == (80, 40)
+            mc.stop()
+        finally:
+            vs.stop()
+            ms.stop()
