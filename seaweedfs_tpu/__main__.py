@@ -108,6 +108,10 @@ def run_server(argv):
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true")
     p.add_argument("-s3Port", type=int, default=8333)
+    p.add_argument("-webdav", action="store_true")
+    p.add_argument("-webdavPort", type=int, default=7333)
+    p.add_argument("-iam", action="store_true")
+    p.add_argument("-iamPort", type=int, default=8111)
     opt = p.parse_args(argv)
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
@@ -120,7 +124,7 @@ def run_server(argv):
     vs = VolumeServer(store, f"{opt.ip}:{opt.port}", ip=opt.ip,
                       port=opt.volumePort, guard=_make_guard(opt))
     vs.start()
-    if opt.filer or opt.s3:
+    if opt.filer or opt.s3 or opt.webdav or opt.iam:
         import os as _os
 
         from .filer.filer_server import FilerServer
@@ -135,6 +139,17 @@ def run_server(argv):
             from .s3.s3_server import S3Gateway
             s3 = S3Gateway(fs, ip=opt.ip, port=opt.s3Port)
             s3.start()
+        if opt.webdav:
+            from .webdav import WebDavServer
+            wd = WebDavServer(fs, ip=opt.ip, port=opt.webdavPort)
+            wd.start()
+        if opt.iam:
+            from .iam import IamApiServer
+            from .s3.auth import IdentityAccessManagement
+            s3_iam = (s3.iam if opt.s3
+                      else IdentityAccessManagement(None))
+            IamApiServer(s3_iam, filer_server=fs, ip=opt.ip,
+                         port=opt.iamPort).start()
     _wait_forever()
 
 

@@ -1,0 +1,5 @@
+"""AWS-IAM-compatible management API (reference weed/iamapi)."""
+
+from .iam_server import IamApiServer
+
+__all__ = ["IamApiServer"]

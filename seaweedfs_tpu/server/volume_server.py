@@ -343,24 +343,29 @@ class VolumeServer:
         headers = {}
         if n.name:
             headers["Content-Disposition"] = f'inline; filename="{n.name.decode(errors="replace")}"'
-        plain = not n.is_gzipped
-        if n.is_gzipped and "gzip" not in (request.headers.get("Accept-Encoding") or ""):
-            import gzip as _gz
-            body = _gz.decompress(body)
-            plain = True
-        elif n.is_gzipped:
-            headers["Content-Encoding"] = "gzip"
-        # on-the-fly image ops over the uncompressed bytes (reference
-        # conditionallyResizeImages, volume_server_handlers_read.go:321)
+        # on-the-fly image ops need uncompressed bytes (reference
+        # conditionallyResizeImages, volume_server_handlers_read.go:321);
+        # a resize request therefore forces decompression of gzip needles.
         name = n.name.decode(errors="replace") if n.name else ""
         ext = os.path.splitext(name)[1].lower()
-        if ext and plain:
-            from ..images import fix_jpeg_orientation, resized, should_resize
+        w = h = 0
+        mode, do_resize = "", False
+        if ext:
+            from ..images import should_resize
+            w, h, mode, do_resize = should_resize(ext, dict(request.query))
+        gzip_ok = "gzip" in (request.headers.get("Accept-Encoding") or "")
+        if n.is_gzipped and (do_resize or not gzip_ok):
+            import gzip as _gz
+            body = _gz.decompress(body)
+        elif n.is_gzipped:
+            headers["Content-Encoding"] = "gzip"
+        if do_resize:
+            from ..images import fix_jpeg_orientation, resized
             if ext in (".jpg", ".jpeg"):
+                # bake EXIF rotation only when we re-encode anyway — the
+                # plain read path serves stored bytes untouched
                 body = fix_jpeg_orientation(body)
-            w, h, mode, do = should_resize(ext, dict(request.query))
-            if do:
-                body = resized(ext, body, w, h, mode)
+            body = resized(ext, body, w, h, mode)
         return web.Response(body=body, headers=headers,
                             content_type=(n.mime.decode() if n.mime else
                                           "application/octet-stream"))
@@ -884,13 +889,22 @@ class VolumeServer:
                         has_header=req.input_serialization.csv_has_header)
                 else:
                     rows = query_json_lines(data, list(req.projections), q)
+                if out_fmt == "csv":
+                    import csv as _csv
+                    import io as _io
+                    sio = _io.StringIO()
+                    wr = _csv.writer(sio, delimiter=out_delim,
+                                     lineterminator="\n")
+                    for row in rows:
+                        wr.writerow(["" if v is None else v for v in row])
+                    if rows:
+                        yield vpb.QueriedStripe(
+                            records=sio.getvalue().encode())
+                    continue
                 buf = []
                 for row in rows:
-                    if out_fmt == "csv":
-                        buf.append(out_delim.join(
-                            "" if v is None else str(v) for v in row))
-                    elif (in_fmt != "csv" and not req.projections
-                          and len(row) == 1):
+                    if (in_fmt != "csv" and not req.projections
+                            and len(row) == 1):
                         buf.append(_json.dumps(row[0]))  # whole document
                     else:
                         buf.append(_json.dumps(row))

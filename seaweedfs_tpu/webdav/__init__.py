@@ -1,0 +1,6 @@
+"""WebDAV gateway over the filer (reference weed/server/webdav_server.go,
+which adapts golang.org/x/net/webdav onto the filer API)."""
+
+from .webdav_server import WebDavServer
+
+__all__ = ["WebDavServer"]

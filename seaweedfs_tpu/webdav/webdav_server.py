@@ -1,0 +1,320 @@
+"""WebDAV server over the filer namespace.
+
+Reference: weed/server/webdav_server.go (the golang.org/x/net/webdav
+FileSystem adapter; OpenFile/Stat/Rename/RemoveAll/Mkdir map to filer
+entry CRUD, file bytes ride the chunked-file model). We speak the
+protocol directly: OPTIONS, PROPFIND (Depth 0/1), GET/HEAD, PUT, MKCOL,
+DELETE, MOVE, COPY, and advisory LOCK/UNLOCK (class-2 clients like
+macOS/Windows demand lock support; locks are process-local like the
+reference's in-memory webdav.NewMemLS).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+from ..filer.filer import join_path, split_path
+from ..pb import filer_pb2 as fpb
+from ..utils.log import logger
+
+log = logger("webdav")
+
+DAV_NS = "DAV:"
+
+
+def _dav(tag: str) -> str:
+    return f"{{{DAV_NS}}}{tag}"
+
+
+def _http_date(ts: float) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))
+
+
+class WebDavServer:
+    def __init__(self, filer_server, ip: str = "127.0.0.1", port: int = 7333,
+                 root: str = "/"):
+        self.fs = filer_server  # in-process FilerServer
+        self.ip, self.port = ip, port
+        self.root = root.rstrip("/") or ""
+        self._locks: dict[str, str] = {}  # path -> lock token
+        self._lock_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._http_thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> "WebDavServer":
+        self._http_thread = threading.Thread(target=self._run_http,
+                                             daemon=True,
+                                             name=f"webdav-{self.port}")
+        self._http_thread.start()
+        log.info("webdav %s up (root %s)", self.url, self.root or "/")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- helpers -------------------------------------------------------------
+    def _abs(self, request_path: str) -> str:
+        p = urllib.parse.unquote(request_path)
+        p = "/" + p.strip("/")
+        return (self.root + p).rstrip("/") or "/"
+
+    def _find(self, path: str) -> fpb.Entry | None:
+        if path == "/":
+            e = fpb.Entry(name="/", is_directory=True)
+            return e
+        d, n = split_path(path)
+        return self.fs.filer.find_entry(d, n)
+
+    # -- HTTP ----------------------------------------------------------------
+    def _run_http(self) -> None:
+        import asyncio
+
+        from aiohttp import web
+
+        handlers = {
+            "OPTIONS": self._h_options, "PROPFIND": self._h_propfind,
+            "GET": self._h_get, "HEAD": self._h_get, "PUT": self._h_put,
+            "MKCOL": self._h_mkcol, "DELETE": self._h_delete,
+            "MOVE": self._h_move, "COPY": self._h_copy,
+            "LOCK": self._h_lock, "UNLOCK": self._h_unlock,
+            "PROPPATCH": self._h_proppatch,
+        }
+
+        async def dispatch(request: web.Request):
+            h = handlers.get(request.method)
+            if h is None:
+                return web.Response(status=405)
+            try:
+                return await h(request)
+            except FileNotFoundError as e:
+                return web.Response(status=404, text=str(e))
+            except FileExistsError as e:
+                return web.Response(status=409, text=str(e))
+            except Exception as e:  # noqa: BLE001
+                log.error("webdav %s %s: %r", request.method, request.path, e)
+                return web.Response(status=500, text=str(e))
+
+        async def main():
+            app = web.Application(client_max_size=1 << 30)
+            app.router.add_route("*", "/{tail:.*}", dispatch)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, self.ip, self.port)
+            await site.start()
+            while not self._stop.is_set():
+                await asyncio.sleep(0.2)
+            await runner.cleanup()
+
+        asyncio.run(main())
+
+    async def _h_options(self, request):
+        from aiohttp import web
+        return web.Response(status=200, headers={
+            "DAV": "1, 2", "MS-Author-Via": "DAV",
+            "Allow": ("OPTIONS, PROPFIND, PROPPATCH, GET, HEAD, PUT, MKCOL, "
+                      "DELETE, MOVE, COPY, LOCK, UNLOCK")})
+
+    # -- PROPFIND ------------------------------------------------------------
+    def _prop_response(self, href: str, entry: fpb.Entry) -> ET.Element:
+        resp = ET.Element(_dav("response"))
+        ET.SubElement(resp, _dav("href")).text = urllib.parse.quote(href)
+        propstat = ET.SubElement(resp, _dav("propstat"))
+        prop = ET.SubElement(propstat, _dav("prop"))
+        ET.SubElement(prop, _dav("displayname")).text = entry.name.split("/")[-1]
+        rtype = ET.SubElement(prop, _dav("resourcetype"))
+        mtime = entry.attributes.mtime or int(time.time())
+        if entry.is_directory:
+            ET.SubElement(rtype, _dav("collection"))
+        else:
+            size = entry.attributes.file_size
+            ET.SubElement(prop, _dav("getcontentlength")).text = str(size)
+            ET.SubElement(prop, _dav("getcontenttype")).text = (
+                entry.attributes.mime or "application/octet-stream")
+        ET.SubElement(prop, _dav("getlastmodified")).text = _http_date(mtime)
+        ET.SubElement(propstat, _dav("status")).text = "HTTP/1.1 200 OK"
+        return resp
+
+    async def _h_propfind(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        depth = request.headers.get("Depth", "1")
+        entry = self._find(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        ET.register_namespace("D", DAV_NS)
+        ms = ET.Element(_dav("multistatus"))
+        href = request.path.rstrip("/") or "/"
+        if entry.is_directory and not href.endswith("/"):
+            href += "/"
+        ms.append(self._prop_response(href, entry))
+        if entry.is_directory and depth != "0":
+            for child in self.fs.filer.list_entries(path):
+                chref = href + child.name.split("/")[-1]
+                if child.is_directory:
+                    chref += "/"
+                ms.append(self._prop_response(chref, child))
+        body = (b'<?xml version="1.0" encoding="utf-8"?>'
+                + ET.tostring(ms, encoding="utf-8"))
+        return web.Response(status=207, body=body,
+                            content_type="application/xml")
+
+    async def _h_proppatch(self, request):
+        from aiohttp import web
+        # accept-and-ignore (reference webdav lib does the same for
+        # dead properties it doesn't store)
+        await request.read()
+        path = self._abs(request.path)
+        if self._find(path) is None:
+            raise FileNotFoundError(path)
+        ET.register_namespace("D", DAV_NS)
+        ms = ET.Element(_dav("multistatus"))
+        resp = ET.SubElement(ms, _dav("response"))
+        ET.SubElement(resp, _dav("href")).text = request.path
+        ps = ET.SubElement(resp, _dav("propstat"))
+        ET.SubElement(ps, _dav("status")).text = "HTTP/1.1 200 OK"
+        return web.Response(status=207, body=ET.tostring(ms),
+                            content_type="application/xml")
+
+    # -- data ----------------------------------------------------------------
+    async def _h_get(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        entry = self._find(path)
+        if entry is None:
+            raise FileNotFoundError(path)
+        if entry.is_directory:
+            names = [e.name.split("/")[-1] + ("/" if e.is_directory else "")
+                     for e in self.fs.filer.list_entries(path)]
+            return web.json_response({"directory": path, "entries": names})
+        if request.method == "HEAD":
+            return web.Response(status=200, headers={
+                "Content-Length": str(entry.attributes.file_size),
+                "Last-Modified": _http_date(entry.attributes.mtime or 0),
+                "Content-Type": entry.attributes.mime
+                or "application/octet-stream"})
+        data = self.fs.read_entry_bytes(entry)
+        return web.Response(body=data, content_type=(
+            entry.attributes.mime or "application/octet-stream"))
+
+    async def _h_put(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        data = await request.read()
+        existed = self._find(path) is not None
+        self.fs.write_file(path, data,
+                           mime=request.content_type or "")
+        return web.Response(status=204 if existed else 201)
+
+    async def _h_mkcol(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        if self._find(path) is not None:
+            return web.Response(status=405)  # RFC4918: MKCOL on existing
+        d, n = split_path(path)
+        entry = fpb.Entry(name=n, is_directory=True)
+        entry.attributes.file_mode = 0o755 | 0x80000000
+        self.fs.filer.create_entry(d, entry)
+        return web.Response(status=201)
+
+    async def _h_delete(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        if self._find(path) is None:
+            raise FileNotFoundError(path)
+        d, n = split_path(path)
+        self.fs.filer.delete_entry(d, n, is_recursive=True,
+                                   is_delete_data=True)
+        return web.Response(status=204)
+
+    def _dest_path(self, request) -> str:
+        dest = request.headers.get("Destination", "")
+        if not dest:
+            raise FileExistsError("missing Destination header")
+        u = urllib.parse.urlparse(dest)
+        return self._abs(u.path)
+
+    async def _h_move(self, request):
+        from aiohttp import web
+        src = self._abs(request.path)
+        dst = self._dest_path(request)
+        if src == dst:
+            return web.Response(status=403)  # RFC 4918 9.9.4
+        if self._find(src) is None:
+            raise FileNotFoundError(src)
+        overwrite = request.headers.get("Overwrite", "T") != "F"
+        existed = self._find(dst) is not None
+        if existed and not overwrite:
+            return web.Response(status=412)
+        sd, sn = split_path(src)
+        dd, dn = split_path(dst)
+        if existed:
+            self.fs.filer.delete_entry(dd, dn, is_recursive=True,
+                                       is_delete_data=True)
+        self.fs.filer.rename(sd, sn, dd, dn)
+        return web.Response(status=204 if existed else 201)
+
+    async def _h_copy(self, request):
+        from aiohttp import web
+        src = self._abs(request.path)
+        dst = self._dest_path(request)
+        entry = self._find(src)
+        if entry is None:
+            raise FileNotFoundError(src)
+        overwrite = request.headers.get("Overwrite", "T") != "F"
+        existed = self._find(dst) is not None
+        if existed and not overwrite:
+            return web.Response(status=412)
+        if entry.is_directory:
+            self._copy_tree(src, dst)
+        else:
+            data = self.fs.read_entry_bytes(entry)
+            self.fs.write_file(dst, data, mime=entry.attributes.mime)
+        return web.Response(status=204 if existed else 201)
+
+    def _copy_tree(self, src: str, dst: str) -> None:
+        dd, dn = split_path(dst)
+        if self._find(dst) is None:
+            e = fpb.Entry(name=dn, is_directory=True)
+            e.attributes.file_mode = 0o755 | 0x80000000
+            self.fs.filer.create_entry(dd, e)
+        for child in self.fs.filer.list_entries(src):
+            name = child.name.split("/")[-1]
+            if child.is_directory:
+                self._copy_tree(join_path(src, name), join_path(dst, name))
+            else:
+                data = self.fs.read_entry_bytes(child)
+                self.fs.write_file(join_path(dst, name), data,
+                                   mime=child.attributes.mime)
+
+    # -- locks (advisory, in-memory like webdav.NewMemLS) --------------------
+    async def _h_lock(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        token = f"opaquelocktoken:{uuid.uuid4()}"
+        with self._lock_mu:
+            self._locks[path] = token
+        ET.register_namespace("D", DAV_NS)
+        root = ET.Element(_dav("prop"))
+        ld = ET.SubElement(root, _dav("lockdiscovery"))
+        al = ET.SubElement(ld, _dav("activelock"))
+        lt = ET.SubElement(al, _dav("locktoken"))
+        ET.SubElement(lt, _dav("href")).text = token
+        ET.SubElement(al, _dav("timeout")).text = "Second-3600"
+        return web.Response(status=200, body=ET.tostring(root),
+                            content_type="application/xml",
+                            headers={"Lock-Token": f"<{token}>"})
+
+    async def _h_unlock(self, request):
+        from aiohttp import web
+        path = self._abs(request.path)
+        with self._lock_mu:
+            self._locks.pop(path, None)
+        return web.Response(status=204)

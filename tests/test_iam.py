@@ -1,0 +1,210 @@
+"""IAM management API (reference weed/iamapi): user/key/policy lifecycle,
+persistence into the filer, and hot reload of the S3 gateway identities.
+"""
+
+import json
+import socket
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+import requests
+
+
+def _fp():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.iam import IamApiServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.s3.s3_server import S3Gateway
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    mport, vport, fport, s3port, iamport = (_fp() for _ in range(5))
+    ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5)
+    ms.start()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path_factory.mktemp("iam")),
+                                max_volume_count=8)], coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
+                      pulse_seconds=0.5)
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(ms.topo.nodes) < 1:
+        time.sleep(0.05)
+    while time.time() < deadline:
+        try:
+            requests.get(f"http://{vs.url}/status", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.05)
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=_fp(), chunk_size_mb=1)
+    fs.start()
+    # start S3 with an admin identity so auth is ON
+    admin_cfg = {"identities": [{"name": "admin",
+                                 "credentials": [{"accessKey": "ADMINKEY",
+                                                  "secretKey": "adminsecret"}],
+                                 "actions": ["Admin"]}]}
+    s3 = S3Gateway(fs, port=s3port, iam_config=admin_cfg)
+    s3.start()
+    iam = IamApiServer(s3.iam, filer_server=fs, port=iamport)
+    iam.start()
+    # seeding from the live gateway identities must keep admin working
+    assert any(i["name"] == "admin" for i in iam.config["identities"])
+    for url in (f"http://127.0.0.1:{iamport}/", f"http://127.0.0.1:{s3port}/"):
+        while time.time() < deadline:
+            try:
+                requests.get(url, timeout=1)
+                break
+            except Exception:
+                time.sleep(0.05)
+    yield {"iam_url": f"http://127.0.0.1:{iamport}",
+           "s3_url": f"http://127.0.0.1:{s3port}",
+           "iam": iam, "s3": s3, "fs": fs}
+    iam.stop()
+    s3.stop()
+    fs.stop()
+    vs.stop()
+    ms.stop()
+
+
+def _post(url, **params):
+    """Signed IAM request (the API is admin-gated when s3 auth is on)."""
+    import urllib.parse
+
+    from seaweedfs_tpu.s3.auth import sign_request_v4
+
+    body = urllib.parse.urlencode(params).encode()
+    headers = {"Content-Type": "application/x-www-form-urlencoded"}
+    headers = sign_request_v4("POST", f"{url}/", headers, body,
+                              "ADMINKEY", "adminsecret", service="iam")
+    return requests.post(url + "/", data=body, headers=headers, timeout=10)
+
+
+def _post_unsigned(url, **params):
+    return requests.post(url, data=params, timeout=10)
+
+
+def test_create_and_list_users(stack):
+    r = _post(stack["iam_url"], Action="CreateUser", UserName="alice")
+    assert r.status_code == 200
+    assert "<UserName>alice</UserName>" in r.text
+    r = _post(stack["iam_url"], Action="ListUsers")
+    names = [e.text for e in ET.fromstring(r.content).iter()
+             if e.tag.endswith("UserName")]
+    assert "alice" in names
+
+    # duplicate -> EntityAlreadyExists
+    r = _post(stack["iam_url"], Action="CreateUser", UserName="alice")
+    assert r.status_code == 409 and "EntityAlreadyExists" in r.text
+
+
+def test_unknown_action(stack):
+    r = _post(stack["iam_url"], Action="FrobnicateUser")
+    assert r.status_code == 400 and "InvalidAction" in r.text
+
+
+def test_unsigned_request_rejected(stack):
+    r = _post_unsigned(stack["iam_url"], Action="CreateUser",
+                       UserName="mallory")
+    assert r.status_code == 403 and "AccessDenied" in r.text
+    # and mallory must not exist
+    r = _post(stack["iam_url"], Action="GetUser", UserName="mallory")
+    assert r.status_code == 404
+
+
+def test_access_key_lifecycle_and_s3_hot_reload(stack):
+    iam_url = stack["iam_url"]
+    _post(iam_url, Action="CreateUser", UserName="bob")
+    policy = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:*"],
+         "Resource": ["arn:aws:s3:::*"]}]})
+    r = _post(iam_url, Action="PutUserPolicy", UserName="bob",
+              PolicyName="all", PolicyDocument=policy)
+    assert r.status_code == 200
+    r = _post(iam_url, Action="CreateAccessKey", UserName="bob")
+    assert r.status_code == 200
+    doc = ET.fromstring(r.content)
+    ak = next(e.text for e in doc.iter() if e.tag.endswith("AccessKeyId"))
+    sk = next(e.text for e in doc.iter() if e.tag.endswith("SecretAccessKey"))
+    assert ak.startswith("AKIA") and len(sk) == 40
+
+    # the S3 gateway accepts the fresh credentials immediately
+    from seaweedfs_tpu.s3.auth import sign_request_v4
+    s3_url = stack["s3_url"]
+    headers = sign_request_v4("PUT", f"{s3_url}/bob-bucket", {}, b"", ak, sk)
+    r = requests.put(f"{s3_url}/bob-bucket", headers=headers, timeout=10)
+    assert r.status_code == 200, r.text
+    # unsigned still rejected
+    r = requests.put(f"{s3_url}/anon-bucket", timeout=10)
+    assert r.status_code == 403
+
+    # list + delete the key
+    r = _post(iam_url, Action="ListAccessKeys", UserName="bob")
+    assert ak in r.text
+    r = _post(iam_url, Action="DeleteAccessKey", UserName="bob",
+              AccessKeyId=ak)
+    assert r.status_code == 200
+    headers = sign_request_v4("PUT", f"{s3_url}/bob2", {}, b"", ak, sk)
+    assert requests.put(f"{s3_url}/bob2", headers=headers,
+                        timeout=10).status_code == 403
+
+
+def test_policy_mapping(stack):
+    from seaweedfs_tpu.iam.iam_server import _policy_to_actions
+    doc = {"Statement": [
+        {"Effect": "Allow", "Action": ["s3:Get*", "s3:List*"],
+         "Resource": ["arn:aws:s3:::photos/*"]},
+        {"Effect": "Allow", "Action": ["s3:Put*"],
+         "Resource": ["arn:aws:s3:::*"]},
+        {"Effect": "Deny", "Action": ["s3:*"],
+         "Resource": ["arn:aws:s3:::*"]},
+    ]}
+    assert _policy_to_actions(doc) == ["List:photos", "Read:photos", "Write"]
+
+
+def test_get_user_policy_roundtrip(stack):
+    iam_url = stack["iam_url"]
+    _post(iam_url, Action="CreateUser", UserName="carol")
+    policy = json.dumps({"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:Get*"],
+         "Resource": ["arn:aws:s3:::data/*"]}]})
+    _post(iam_url, Action="PutUserPolicy", UserName="carol",
+          PolicyName="ro", PolicyDocument=policy)
+    r = _post(iam_url, Action="GetUserPolicy", UserName="carol",
+              PolicyName="ro")
+    assert r.status_code == 200
+    got = next(e.text for e in ET.fromstring(r.content).iter()
+               if e.tag.endswith("PolicyDocument"))
+    assert json.loads(got)["Statement"][0]["Action"] == ["s3:Get*"]
+    # delete policy drops the actions
+    _post(iam_url, Action="DeleteUserPolicy", UserName="carol")
+    ident = stack["iam"]._ident("carol")
+    assert ident["actions"] == []
+
+
+def test_persistence_into_filer(stack):
+    fs = stack["fs"]
+    from seaweedfs_tpu.filer.filer import split_path
+    d, n = split_path("/etc/iam/identity.json")
+    entry = fs.filer.find_entry(d, n)
+    assert entry is not None
+    cfg = json.loads(fs.read_entry_bytes(entry))
+    assert any(i["name"] == "alice" for i in cfg["identities"])
+
+
+def test_delete_user(stack):
+    iam_url = stack["iam_url"]
+    _post(iam_url, Action="CreateUser", UserName="temp")
+    assert _post(iam_url, Action="DeleteUser",
+                 UserName="temp").status_code == 200
+    r = _post(iam_url, Action="GetUser", UserName="temp")
+    assert r.status_code == 404 and "NoSuchEntity" in r.text
