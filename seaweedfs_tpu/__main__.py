@@ -251,6 +251,22 @@ def _wait_forever():
         print("bye")
 
 
+def run_mount(argv):
+    """Kernel FUSE mount (reference command/mount.go); needs fusepy.
+    The WeedFS logic itself is importable and testable without it."""
+    p = argparse.ArgumentParser(prog="mount")
+    p.add_argument("-filer", default="127.0.0.1:8888",
+                   help="filer ip:port (its gRPC is port+10000)")
+    p.add_argument("-dir", required=True, help="mountpoint")
+    p.add_argument("-chunkSizeLimitMB", type=int, default=4)
+    p.add_argument("-concurrentWriters", type=int, default=8)
+    opt = p.parse_args(argv)
+    raise SystemExit(
+        "kernel mount requires the 'fuse' (fusepy) package, which is not "
+        "in this image; the mount subsystem (seaweedfs_tpu.mount.WeedFS) "
+        "is fully functional in-process — see tests/test_mount.py")
+
+
 VERBS = {
     "master": run_master,
     "volume": run_volume,
@@ -260,6 +276,7 @@ VERBS = {
     "download": run_download,
     "fix": run_fix,
     "benchmark": run_benchmark,
+    "mount": run_mount,
 }
 
 

@@ -180,20 +180,13 @@ class FilerServer:
 
         from ..stats.metrics import aiohttp_metrics_handler
 
-        async def main():
-            app = web.Application(client_max_size=1 << 30)
+        def routes(app):
             app.router.add_get("/__status__", status)
             app.router.add_get("/__metrics__", aiohttp_metrics_handler)
             app.router.add_route("*", "/{path:.*}", handle)
-            runner = web.AppRunner(app, access_log=None)
-            await runner.setup()
-            site = web.TCPSite(runner, self.ip, self.port)
-            await site.start()
-            while not self._stop.is_set():
-                await asyncio.sleep(0.2)
-            await runner.cleanup()
 
-        asyncio.run(main())
+        from ..utils.webapp import serve_web_app
+        serve_web_app(routes, self.ip, self.port, self._stop)
 
     @staticmethod
     def _req_path(request) -> str:

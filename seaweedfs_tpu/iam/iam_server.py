@@ -175,18 +175,10 @@ class IamApiServer:
                 return web.Response(status=500, body=self._xml_err(err),
                                     content_type="application/xml")
 
-        async def main():
-            app = web.Application()
-            app.router.add_route("*", "/{tail:.*}", dispatch)
-            runner = web.AppRunner(app, access_log=None)
-            await runner.setup()
-            site = web.TCPSite(runner, self.ip, self.port)
-            await site.start()
-            while not self._stop.is_set():
-                await asyncio.sleep(0.2)
-            await runner.cleanup()
-
-        asyncio.run(main())
+        from ..utils.webapp import serve_web_app
+        serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
+                                                       dispatch),
+                      self.ip, self.port, self._stop)
 
     # -- XML -----------------------------------------------------------------
     def _xml_ok(self, action: str, result: ET.Element | None) -> bytes:
@@ -245,7 +237,10 @@ class IamApiServer:
     def _a_UpdateUser(self, p) -> None:
         ident = self._ident(p.get("UserName", ""))
         new = p.get("NewUserName", "")
-        if new:
+        if new and new != ident["name"]:
+            if any(i["name"] == new for i in self.config["identities"]):
+                raise IamError("EntityAlreadyExists",
+                               f"user {new} exists", 409)
             ident["name"] = new
             self._persist()
         return None
@@ -363,8 +358,10 @@ def _policy_to_actions(doc: dict) -> list[str]:
                 if res[5] == "*":
                     actions.append(mapped)
                     continue
-                bucket, _, rest = res[5].partition("/")
-                if rest == "*":
+                bucket, slash, rest = res[5].partition("/")
+                # bucket-level ARNs ("arn:aws:s3:::bucket", the normal
+                # shape for List*) scope like bucket/*
+                if not slash or rest == "*":
                     actions.append(f"{mapped}:{bucket}")
     return sorted(set(actions))
 

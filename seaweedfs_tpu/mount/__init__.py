@@ -1,0 +1,23 @@
+"""FUSE mount subsystem (reference weed/mount, 5,330 LoC).
+
+Architecture mirrors the reference: an inode<->path map
+(inode_to_path.go), a local metadata cache kept fresh by the filer
+metadata subscription (mount/meta_cache), a write-back page cache with
+chunk-granular dirty pages and a concurrent upload pipeline
+(page_writer.go, page_writer/upload_pipeline.go), and the filesystem
+facade WeedFS (weedfs.go) exposing FUSE-shaped operations.
+
+The kernel bridge is pluggable: `WeedFS` is a plain object whose methods
+map 1:1 onto FUSE callbacks; when the `fuse` (fusepy) module is present,
+`mount()` adapts it onto a real kernel mount. The image has no fusepy,
+so tests drive WeedFS directly — same split the reference uses between
+weedfs.go (logic) and go-fuse (kernel glue).
+"""
+
+from .inode_map import InodeToPath
+from .page_writer import ChunkedDirtyPages, MemChunk, SwapFileChunk, UploadPipeline
+from .meta_cache import MetaCache
+from .weedfs import WeedFS
+
+__all__ = ["InodeToPath", "ChunkedDirtyPages", "MemChunk", "SwapFileChunk",
+           "UploadPipeline", "MetaCache", "WeedFS"]

@@ -76,15 +76,17 @@ class IdentityAccessManagement:
             self.load(config)
 
     def load(self, config: dict) -> None:
-        self._by_access_key.clear()
+        # build then swap atomically — the gateway authenticates on other
+        # threads while the IAM API hot-reloads (GIL makes the rebind safe)
+        table: dict[str, tuple[Identity, str]] = {}
         for ident_cfg in config.get("identities", []):
             ident = Identity(name=ident_cfg["name"],
                              actions=list(ident_cfg.get("actions", [])))
             for cred in ident_cfg.get("credentials", []):
                 ident.credentials[cred["accessKey"]] = cred["secretKey"]
-                self._by_access_key[cred["accessKey"]] = \
-                    (ident, cred["secretKey"])
-        self.enabled = bool(self._by_access_key)
+                table[cred["accessKey"]] = (ident, cred["secretKey"])
+        self._by_access_key = table
+        self.enabled = bool(table)
 
     def lookup(self, access_key: str) -> tuple[Identity, str]:
         hit = self._by_access_key.get(access_key)

@@ -95,18 +95,10 @@ class S3Gateway:
             S3_REQUEST_COUNTER.inc(kind, str(resp.status), bucket)
             return resp
 
-        async def main():
-            app = web.Application(client_max_size=1 << 30)
-            app.router.add_route("*", "/{tail:.*}", dispatch)
-            runner = web.AppRunner(app, access_log=None)
-            await runner.setup()
-            site = web.TCPSite(runner, self.ip, self.port)
-            await site.start()
-            while not self._stop.is_set():
-                await asyncio.sleep(0.2)
-            await runner.cleanup()
-
-        asyncio.run(main())
+        from ..utils.webapp import serve_web_app
+        serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
+                                                       dispatch),
+                      self.ip, self.port, self._stop)
 
     async def _route(self, request):
         path = urllib.parse.unquote(request.path)

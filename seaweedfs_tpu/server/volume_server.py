@@ -214,21 +214,14 @@ class VolumeServer:
 
         from ..stats.metrics import aiohttp_metrics_handler
 
-        async def main():
-            app = web.Application(client_max_size=256 << 20)
+        def routes(app):
             app.router.add_get("/status", status)
             app.router.add_get("/metrics", aiohttp_metrics_handler)
             app.router.add_route("*", "/{fid:.*}", handle)
-            runner = web.AppRunner(app, access_log=None)
-            await runner.setup()
-            self._http_runner = runner
-            site = web.TCPSite(runner, self.ip, self.port)
-            await site.start()
-            while not self._stop.is_set():
-                await asyncio.sleep(0.2)
-            await runner.cleanup()
 
-        asyncio.run(main())
+        from ..utils.webapp import serve_web_app
+        serve_web_app(routes, self.ip, self.port, self._stop,
+                      client_max_size=256 << 20)
 
     async def _read_body(self, request):
         ct = request.content_type or ""

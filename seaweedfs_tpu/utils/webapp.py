@@ -1,0 +1,32 @@
+"""Shared aiohttp-in-a-thread serve loop.
+
+The filer, s3, webdav, and iam servers all run an aiohttp app on a
+daemon thread with an Event-driven shutdown; this is the single copy of
+that loop. `add_routes(app)` registers handlers; the call blocks until
+`stop` is set (callers run it on their own thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Callable
+
+
+def serve_web_app(add_routes: Callable, ip: str, port: int,
+                  stop: threading.Event,
+                  client_max_size: int = 1 << 30) -> None:
+    from aiohttp import web
+
+    async def main():
+        app = web.Application(client_max_size=client_max_size)
+        add_routes(app)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, ip, port)
+        await site.start()
+        while not stop.is_set():
+            await asyncio.sleep(0.2)
+        await runner.cleanup()
+
+    asyncio.run(main())

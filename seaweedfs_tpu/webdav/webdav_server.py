@@ -62,8 +62,9 @@ class WebDavServer:
 
     # -- helpers -------------------------------------------------------------
     def _abs(self, request_path: str) -> str:
-        p = urllib.parse.unquote(request_path)
-        p = "/" + p.strip("/")
+        # aiohttp's request.path is already percent-decoded; decoding
+        # again would collapse literal %XX sequences in filenames
+        p = "/" + request_path.strip("/")
         return (self.root + p).rstrip("/") or "/"
 
     def _find(self, path: str) -> fpb.Entry | None:
@@ -102,18 +103,10 @@ class WebDavServer:
                 log.error("webdav %s %s: %r", request.method, request.path, e)
                 return web.Response(status=500, text=str(e))
 
-        async def main():
-            app = web.Application(client_max_size=1 << 30)
-            app.router.add_route("*", "/{tail:.*}", dispatch)
-            runner = web.AppRunner(app, access_log=None)
-            await runner.setup()
-            site = web.TCPSite(runner, self.ip, self.port)
-            await site.start()
-            while not self._stop.is_set():
-                await asyncio.sleep(0.2)
-            await runner.cleanup()
-
-        asyncio.run(main())
+        from ..utils.webapp import serve_web_app
+        serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
+                                                       dispatch),
+                      self.ip, self.port, self._stop)
 
     async def _h_options(self, request):
         from aiohttp import web
@@ -239,7 +232,9 @@ class WebDavServer:
         if not dest:
             raise FileExistsError("missing Destination header")
         u = urllib.parse.urlparse(dest)
-        return self._abs(u.path)
+        # the Destination header is still percent-encoded (unlike
+        # aiohttp's request.path)
+        return self._abs(urllib.parse.unquote(u.path))
 
     async def _h_move(self, request):
         from aiohttp import web
