@@ -38,13 +38,16 @@ def join_path(directory: str, name: str) -> str:
 class Filer:
     def __init__(self, store: FilerStore, meta_log_path: str | None = None,
                  chunk_deleter: Callable[[list[str]], None] | None = None,
-                 signature: int = 0):
+                 signature: int = 0, notification_queue=None):
         self.store = store
         self.meta_log = MetaLog(meta_log_path)
         self.signature = signature or (time.time_ns() & 0x7FFFFFFF)
         # chunk_deleter receives file_ids of unreferenced chunks (wired to
         # operation.delete_batch by the server; no-op in unit tests)
         self.chunk_deleter = chunk_deleter or (lambda fids: None)
+        # optional notification.MessageQueue fed every mutation event
+        # besides the meta log (reference filer_notify.go:20-66)
+        self.notification_queue = notification_queue
         self._dir_lock = threading.RLock()  # _ensure_parents recurses
 
     # -- CRUD ---------------------------------------------------------------
@@ -206,19 +209,18 @@ class Filer:
             for child in list(self.store.list_entries(old_path)):
                 self._move_entry(old_path, child, new_path, child.name)
         self.store.delete_entry(old_dir, entry.name)
-        ev = fpb.EventNotification(old_entry=entry, new_entry=moved,
-                                   delete_chunks=False,
-                                   new_parent_path=new_dir)
-        ev.signatures.append(self.signature)
-        self.meta_log.append(old_dir, ev)
+        self._notify(old_dir, entry, moved, delete_chunks=False,
+                     new_parent_path=new_dir)
 
     # -- events -------------------------------------------------------------
     def _notify(self, directory: str, old: fpb.Entry | None,
                 new: fpb.Entry | None, delete_chunks: bool = False,
                 from_other_cluster: bool = False,
-                signatures: list[int] | None = None) -> None:
+                signatures: list[int] | None = None,
+                new_parent_path: str = "") -> None:
         ev = fpb.EventNotification(delete_chunks=delete_chunks,
-                                   is_from_other_cluster=from_other_cluster)
+                                   is_from_other_cluster=from_other_cluster,
+                                   new_parent_path=new_parent_path)
         if old is not None:
             ev.old_entry.CopyFrom(old)
         if new is not None:
@@ -227,6 +229,14 @@ class Filer:
             ev.signatures.append(s)
         ev.signatures.append(self.signature)
         self.meta_log.append(directory, ev)
+        if self.notification_queue is not None:
+            name = (new.name if new is not None
+                    else old.name if old is not None else "")
+            key = join_path(directory, name) if name else directory
+            try:
+                self.notification_queue.send(key, ev)
+            except Exception as e:  # noqa: BLE001
+                log.warning("notification send %s: %s", key, e)
 
     # -- manifest support ---------------------------------------------------
     def data_chunks(self, entry: fpb.Entry,

@@ -116,8 +116,10 @@ class FilerServer:
         return bytes(buf)
 
     def write_file(self, path: str, data: bytes, mime: str = "",
-                   ttl_sec: int = 0, mode: int = 0o644) -> fpb.Entry:
-        """Auto-chunking write (reference doPostAutoChunk)."""
+                   ttl_sec: int = 0, mode: int = 0o644,
+                   signatures: list[int] | None = None) -> fpb.Entry:
+        """Auto-chunking write (reference doPostAutoChunk). `signatures`
+        carries replication origins for sync loop prevention."""
         directory, name = split_path(path)
         chunks: list[fpb.FileChunk] = []
         md5 = hashlib.md5(data)
@@ -136,7 +138,7 @@ class FilerServer:
         a.ttl_sec = ttl_sec
         a.md5 = md5.digest()
         a.collection, a.replication = self.collection, self.replication
-        self.filer.create_entry(directory, entry)
+        self.filer.create_entry(directory, entry, signatures=signatures)
         return entry
 
     # -- HTTP ---------------------------------------------------------------

@@ -115,6 +115,8 @@ class MetaCache:
     def invalidate(self, directory: str, name: str) -> None:
         with self._lock:
             self._entries.pop(self._join(directory, name), None)
+            # the directory's cached listing no longer reflects reality
+            self._listed.discard(directory)
 
     def close(self) -> None:
         self._stop.set()

@@ -22,9 +22,9 @@ log = logger("mount.pages")
 
 class ActivityScore:
     """Sequential-writes score (reference page_writer/activity_score.go):
-    monotonically increasing offsets raise it, seeks lower it. High score
-    (sequential streams) favors swap-file chunks — they'll be sealed and
-    uploaded whole; random IO stays in memory."""
+    monotonically increasing offsets raise it, seeks lower it. Sequential
+    streams early-seal full chunks and stay in memory; a low score
+    (random IO) with many live partial chunks spills to swap files."""
 
     def __init__(self):
         self._last_offset = -1
@@ -139,13 +139,21 @@ class UploadPipeline:
         self._lock = threading.Lock()
 
     def submit(self, data: bytes, logical_offset: int) -> None:
+        import time as _time
         self._slots.acquire()
         with self._lock:
             self._inflight[logical_offset] = data
+        # submit-order timestamp: uploads finish out of order on the
+        # worker pool, but newest-chunk-wins resolution must follow
+        # write order, not completion order
+        ts_ns = _time.time_ns()
 
         def run():
             try:
-                return self._saver(data, logical_offset)
+                result = self._saver(data, logical_offset)
+                if hasattr(result, "modified_ts_ns"):
+                    result.modified_ts_ns = ts_ns
+                return result
             finally:
                 self._slots.release()
 
@@ -165,8 +173,13 @@ class UploadPipeline:
         return out
 
     def flush(self) -> list[object]:
+        """Drain pending uploads. In-flight copies stay readable until
+        the caller has merged the results into the file entry and calls
+        commit() — dropping them here would open a window where the data
+        is in neither the entry nor the overlay."""
         with self._lock:
             pending, self._pending = self._pending, []
+            self._flushed_offsets = [off for off, _ in pending]
         results = []
         errors = []
         for off, fut in sorted(pending, key=lambda t: t[0]):
@@ -174,14 +187,16 @@ class UploadPipeline:
                 results.append(fut.result())
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
-        # results are about to be merged into the file entry by the
-        # caller; only then may the in-flight copies be dropped
-        with self._lock:
-            for off, _ in pending:
-                self._inflight.pop(off, None)
         if errors:
             raise errors[0]
         return results
+
+    def commit(self) -> None:
+        """Caller merged the flushed chunks into the entry; drop copies."""
+        with self._lock:
+            for off in getattr(self, "_flushed_offsets", []):
+                self._inflight.pop(off, None)
+            self._flushed_offsets = []
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -206,8 +221,11 @@ class ChunkedDirtyPages:
         self.dirty = False
 
     def _backing(self) -> type:
-        # long sequential streams with many live chunks spill to disk
-        if (self._activity.is_sequential
+        # Random IO keeps many partially-written chunks alive (nothing
+        # gets full enough to early-seal); spill those to disk. A
+        # sequential stream seals chunks as it goes, so it never
+        # accumulates live chunks and stays in memory.
+        if (not self._activity.is_sequential
                 and len(self._chunks) >= self._swap_threshold):
             return SwapFileChunk
         return MemChunk
@@ -268,13 +286,17 @@ class ChunkedDirtyPages:
         return out
 
     def flush(self) -> list[object]:
-        """Seal everything, drain the pipeline, return saver results."""
+        """Seal everything, drain the pipeline, return saver results.
+        Call commit() once the results are merged into the file entry."""
         with self._lock:
             for ci in sorted(self._chunks):
                 self._seal(ci)
         results = self._pipeline.flush()
         self.dirty = False
         return results
+
+    def commit(self) -> None:
+        self._pipeline.commit()
 
     def destroy(self) -> None:
         with self._lock:

@@ -192,12 +192,8 @@ class WeedFS:
         if size == 0:
             return b""
         buf = bytearray(size)
-        chunks = self.fs.filer.data_chunks(h.entry, self.fs._fetch_blob)
-        for v in read_views(chunks, offset, size):
-            blob = self.fs._fetch_blob(v.file_id)
-            part = blob[v.chunk_offset:v.chunk_offset + v.size]
-            at = v.logical_offset - offset
-            buf[at:at + len(part)] = part
+        stored = self.fs.read_entry_bytes(h.entry, offset, size)
+        buf[:len(stored)] = stored
         # overlay unflushed dirty ranges (read-your-writes)
         for lo, data in h.dirty.read(offset, size):
             at = lo - offset
@@ -221,6 +217,7 @@ class WeedFS:
         updated.attributes.mtime = int(time.time())
         self.fs.filer.update_entry(d, updated)
         h.entry = updated
+        h.dirty.commit()  # entry now holds the chunks; drop overlay copies
         self.meta.invalidate(d, n)
 
     fsync = flush
@@ -238,6 +235,11 @@ class WeedFS:
 
     def truncate(self, path: str, length: int) -> None:
         """setattr(size) — weedfs_attr.go truncates the chunk list."""
+        # flush open handles first so no unflushed dirty interval beyond
+        # `length` can resurrect the truncated bytes at the next flush
+        for h in list(self._handles.values()):
+            if h.path == path and h.dirty.dirty:
+                self.flush(h.fh)
         d, n = self._split(path)
         entry = self.fs.filer.find_entry(d, n)
         if entry is None:

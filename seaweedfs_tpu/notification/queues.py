@@ -1,0 +1,112 @@
+"""MessageQueue implementations.
+
+Interface mirrors reference notification/configuration.go
+(`MessageQueue.SendMessage(key, message)`); messages are
+(key=full path, value=EventNotification) pairs.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Callable, Iterator
+
+from ..pb import filer_pb2 as fpb
+from ..utils.log import logger
+
+log = logger("notification")
+
+
+class MessageQueue:
+    name = "abstract"
+
+    def send(self, key: str, ev: fpb.EventNotification) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryQueue(MessageQueue):
+    """In-process fan-out to subscribers (test/dev; plays the role the
+    reference's gocdk mempubsub plays)."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._subs: list[Callable[[str, fpb.EventNotification], None]] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self, fn: Callable[[str, fpb.EventNotification], None]) -> None:
+        with self._lock:
+            self._subs.append(fn)
+
+    def send(self, key: str, ev: fpb.EventNotification) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for fn in subs:
+            try:
+                fn(key, ev)
+            except Exception as e:  # noqa: BLE001
+                log.warning("subscriber error for %s: %s", key, e)
+
+
+class LogFileQueue(MessageQueue):
+    """Durable length-prefixed record log; `weed filer.replicate` style
+    consumers read from an offset (the file-backed analogue of a broker
+    topic — same framing as the filer meta log)."""
+
+    name = "logfile"
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def send(self, key: str, ev: fpb.EventNotification) -> None:
+        rec = fpb.SubscribeMetadataResponse(directory=key)
+        rec.event_notification.CopyFrom(ev)
+        blob = rec.SerializeToString()
+        with self._lock:
+            self._f.write(struct.pack("<I", len(blob)))
+            self._f.write(blob)
+            self._f.flush()
+
+    def read(self, offset: int = 0
+             ) -> Iterator[tuple[int, fpb.SubscribeMetadataResponse]]:
+        """Yield (next_offset, record) from byte offset."""
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    return
+                (n,) = struct.unpack("<I", hdr)
+                blob = f.read(n)
+                if len(blob) < n:
+                    return
+                rec = fpb.SubscribeMetadataResponse()
+                rec.ParseFromString(blob)
+                yield f.tell(), rec
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def open_queue(spec: str) -> MessageQueue:
+    """spec: 'memory', 'logfile:/path', or a gated broker name.
+    Reference notification.toml picks one enabled backend the same way."""
+    kind, _, arg = spec.partition(":")
+    if kind == "memory":
+        return MemoryQueue()
+    if kind == "logfile":
+        return LogFileQueue(arg or "notification.log")
+    if kind in ("kafka", "aws_sqs", "gcp_pub_sub", "gocdk_pub_sub"):
+        raise RuntimeError(
+            f"notification backend {kind!r} requires its broker SDK, "
+            "which is not in this image (reference gates these behind "
+            "notification.toml the same way)")
+    raise ValueError(f"unknown notification queue {spec!r}")

@@ -1,0 +1,14 @@
+"""Async replication plane (reference weed/replication).
+
+Event consumers replay filer mutations into pluggable sinks
+(replicator.go:38 Replicate; sink/* implementations), and filer.sync
+streams metadata directly between two filers with signature-based loop
+prevention (command/filer_sync.go).
+"""
+
+from .replicator import Replicator
+from .sink import FilerSink, LocalSink, ReplicationSink
+from .filer_sync import FilerSync
+
+__all__ = ["Replicator", "ReplicationSink", "LocalSink", "FilerSink",
+           "FilerSync"]

@@ -1,0 +1,85 @@
+"""Continuous filer-to-filer sync (reference command/filer_sync.go).
+
+Subscribes to the source filer's metadata stream and replays every
+mutation into the target through a FilerSink. Loop prevention follows
+the reference: each filer stamps events with its signature; a sync
+worker drops events that already carry the *target's* signature (they
+originated there — command/filer_sync.go excludeSignatures). Offsets
+persist in the target's KV store so restarts resume
+(track_sync_offset-style).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+
+from ..utils.log import logger
+from .replicator import Replicator
+from .sink import FilerSink
+
+log = logger("filer.sync")
+
+
+class FilerSync:
+    def __init__(self, source_fs, target_fs, path_prefix: str = "/",
+                 from_ns: int | None = None):
+        self.source = source_fs
+        self.target = target_fs
+        self.prefix = path_prefix
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        sink = FilerSink(target_fs)
+        self.replicator = Replicator(sink, self._read_source_data,
+                                     path_prefix)
+        self._offset_key = (
+            f"sync.offset.{self.source.filer.signature}".encode())
+        self.from_ns = (self._load_offset() if from_ns is None else from_ns)
+        self.applied = 0
+        self.skipped = 0
+
+    # -- offsets (reference persists per-peer offsets in store KV) ----------
+    def _load_offset(self) -> int:
+        try:
+            raw = self.target.filer.store.kv_get(self._offset_key)
+            if raw:
+                return struct.unpack("<q", raw)[0]
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+
+    def _save_offset(self, ts_ns: int) -> None:
+        try:
+            self.target.filer.store.kv_put(self._offset_key,
+                                           struct.pack("<q", ts_ns))
+        except Exception as e:  # noqa: BLE001
+            log.warning("offset save: %s", e)
+
+    def _read_source_data(self, entry) -> bytes:
+        return self.source.read_entry_bytes(entry)
+
+    # -- run -----------------------------------------------------------------
+    def start(self) -> "FilerSync":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="filer-sync")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        target_sig = self.target.filer.signature
+        for resp in self.source.filer.meta_log.subscribe(self.from_ns,
+                                                         self._stop):
+            ev = resp.event_notification
+            if target_sig in ev.signatures:
+                self.skipped += 1  # originated at the target: loop guard
+                continue
+            try:
+                self.replicator.replicate(resp.directory, ev)
+                self.applied += 1
+            except Exception as e:  # noqa: BLE001
+                log.warning("sync apply %s: %s", resp.directory, e)
+            if resp.ts_ns:
+                self._save_offset(resp.ts_ns)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -238,6 +238,25 @@ class TestWeedFS:
         assert wfs.read(fh, 0, 100) == b"0123456789"
         wfs.release(fh)
 
+    def test_truncate_with_unflushed_writes(self, wfs):
+        """Dirty pages beyond the new length must not resurrect data."""
+        fh = wfs.create("/trunc2.bin")
+        wfs.write(fh, 0, b"Z" * 1000)  # unflushed
+        wfs.truncate("/trunc2.bin", 10)
+        wfs.release(fh)
+        assert wfs.getattr("/trunc2.bin")["st_size"] == 10
+        fh = wfs.open("/trunc2.bin")
+        assert wfs.read(fh, 0, 100) == b"Z" * 10
+        wfs.release(fh)
+
+    def test_create_then_readdir_sees_file(self, wfs):
+        """create-then-list: the cached dir listing must refresh."""
+        wfs.mkdir("/fresh")
+        wfs.readdir("/fresh")  # prime (empty) listing
+        fh = wfs.create("/fresh/new.txt")
+        wfs.release(fh)
+        assert "new.txt" in wfs.readdir("/fresh")
+
     def test_meta_cache_event_sync(self, wfs):
         """A write through the filer (not the mount) becomes visible via
         the metadata subscription."""

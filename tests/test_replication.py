@@ -1,0 +1,245 @@
+"""Replication + notification (reference weed/replication, weed/notification,
+command/filer_sync.go): queues, sinks, replicator dispatch, filer.sync
+with loop prevention.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.notification import LogFileQueue, MemoryQueue, open_queue
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+from seaweedfs_tpu.replication import (FilerSync, LocalSink, Replicator)
+
+
+def _fp():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestQueues:
+    def test_memory_queue_fanout(self):
+        q = MemoryQueue()
+        got = []
+        q.subscribe(lambda k, ev: got.append((k, ev.new_entry.name)))
+        ev = fpb.EventNotification()
+        ev.new_entry.name = "f.txt"
+        q.send("/dir/f.txt", ev)
+        assert got == [("/dir/f.txt", "f.txt")]
+
+    def test_logfile_queue_roundtrip(self, tmp_path):
+        q = LogFileQueue(str(tmp_path / "notify.log"))
+        for i in range(5):
+            ev = fpb.EventNotification()
+            ev.new_entry.name = f"f{i}"
+            q.send(f"/d/f{i}", ev)
+        q.close()
+        recs = list(LogFileQueue(str(tmp_path / "notify.log")).read(0))
+        assert len(recs) == 5
+        assert recs[0][1].directory == "/d/f0"
+        # resume from an offset
+        off2 = recs[1][0]
+        rest = list(LogFileQueue(str(tmp_path / "notify.log")).read(off2))
+        assert [r.directory for _, r in rest] == [f"/d/f{i}" for i in (2, 3, 4)]
+
+    def test_open_queue_specs(self, tmp_path):
+        assert open_queue("memory").name == "memory"
+        assert open_queue(f"logfile:{tmp_path}/q.log").name == "logfile"
+        with pytest.raises(RuntimeError):
+            open_queue("kafka:broker:9092")
+        with pytest.raises(ValueError):
+            open_queue("carrier-pigeon")
+
+    def test_filer_publishes_to_queue(self):
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.filer.store import MemoryStore
+
+        q = MemoryQueue()
+        got = []
+        q.subscribe(lambda k, ev: got.append(k))
+        f = Filer(MemoryStore(), notification_queue=q)
+        f.create_entry("/a", fpb.Entry(name="x.txt"))
+        f.delete_entry("/a", "x.txt")
+        assert "/a/x.txt" in got and len(got) >= 2
+
+
+class TestReplicatorLocalSink:
+    def _ev_create(self, name, data=b""):
+        ev = fpb.EventNotification()
+        ev.new_entry.name = name
+        return ev
+
+    def test_create_update_delete_rename(self, tmp_path):
+        sink = LocalSink(str(tmp_path / "mirror"))
+        payload = {"x": b"hello"}
+        rep = Replicator(sink, lambda e: payload["x"])
+
+        ev = fpb.EventNotification()
+        ev.new_entry.name = "f.txt"
+        rep.replicate("/docs", ev)
+        mirrored = tmp_path / "mirror" / "docs" / "f.txt"
+        assert mirrored.read_bytes() == b"hello"
+
+        # update
+        payload["x"] = b"world"
+        ev2 = fpb.EventNotification()
+        ev2.old_entry.name = "f.txt"
+        ev2.new_entry.name = "f.txt"
+        rep.replicate("/docs", ev2)
+        assert mirrored.read_bytes() == b"world"
+
+        # rename
+        ev3 = fpb.EventNotification()
+        ev3.old_entry.name = "f.txt"
+        ev3.new_entry.name = "g.txt"
+        ev3.new_parent_path = "/docs"
+        rep.replicate("/docs", ev3)
+        assert not mirrored.exists()
+        assert (tmp_path / "mirror" / "docs" / "g.txt").read_bytes() == b"world"
+
+        # delete
+        ev4 = fpb.EventNotification()
+        ev4.old_entry.name = "g.txt"
+        rep.replicate("/docs", ev4)
+        assert not (tmp_path / "mirror" / "docs" / "g.txt").exists()
+
+    def test_prefix_filter(self, tmp_path):
+        sink = LocalSink(str(tmp_path / "m2"))
+        rep = Replicator(sink, lambda e: b"data", path_prefix="/buckets")
+        ev = fpb.EventNotification()
+        ev.old_entry.name = "skip.txt"
+        rep.replicate("/other", ev)  # delete outside prefix: filtered
+        # create outside the prefix must be filtered too
+        ev2 = fpb.EventNotification()
+        ev2.new_entry.name = "secret.txt"
+        rep.replicate("/other", ev2)
+        assert not (tmp_path / "m2" / "other").exists()
+        # create inside the prefix replicates
+        ev3 = fpb.EventNotification()
+        ev3.new_entry.name = "ok.txt"
+        rep.replicate("/buckets/b1", ev3)
+        assert (tmp_path / "m2" / "buckets" / "b1" / "ok.txt").exists()
+
+    def test_rename_event_reaches_queue(self):
+        """Renames must flow to the notification queue too
+        (filer._move_entry goes through _notify)."""
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.filer.store import MemoryStore
+        from seaweedfs_tpu.notification import MemoryQueue
+
+        q = MemoryQueue()
+        events = []
+        q.subscribe(lambda k, ev: events.append((k, ev)))
+        f = Filer(MemoryStore(), notification_queue=q)
+        f.create_entry("/r", fpb.Entry(name="a.txt"))
+        f.rename("/r", "a.txt", "/r", "b.txt")
+        renames = [(k, ev) for k, ev in events
+                   if ev.old_entry.name == "a.txt"
+                   and ev.new_entry.name == "b.txt"]
+        assert renames, "rename event missing from notification queue"
+
+
+@pytest.fixture(scope="module")
+def two_filers(tmp_path_factory):
+    """One blob cluster, two filers with separate namespaces."""
+    import requests
+
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    mport, vport = _fp(), _fp()
+    ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5)
+    ms.start()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path_factory.mktemp("rep")),
+                                max_volume_count=8)], coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
+                      pulse_seconds=0.5)
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(ms.topo.nodes) < 1:
+        time.sleep(0.05)
+    while time.time() < deadline:
+        try:
+            requests.get(f"http://{vs.url}/status", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.05)
+    fa = FilerServer(ms.address, store_spec="memory", port=_fp(),
+                     grpc_port=_fp(), chunk_size_mb=1)
+    fa.start()
+    fb = FilerServer(ms.address, store_spec="memory", port=_fp(),
+                     grpc_port=_fp(), chunk_size_mb=1)
+    fb.start()
+    yield fa, fb
+    fa.stop()
+    fb.stop()
+    vs.stop()
+    ms.stop()
+
+
+class TestFilerSync:
+    def test_one_way(self, two_filers):
+        fa, fb = two_filers
+        sync = FilerSync(fa, fb, from_ns=time_ns_now()).start()
+        fa.write_file("/sync/one.txt", b"replicate me")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            e = fb.filer.find_entry("/sync", "one.txt")
+            if e is not None:
+                break
+            time.sleep(0.05)
+        assert e is not None
+        assert fb.read_entry_bytes(e) == b"replicate me"
+        sync.stop()
+
+    def test_bidirectional_no_loop(self, two_filers):
+        fa, fb = two_filers
+        s_ab = FilerSync(fa, fb, from_ns=time_ns_now()).start()
+        s_ba = FilerSync(fb, fa, from_ns=time_ns_now()).start()
+        fa.write_file("/bi/from-a.txt", b"AAA")
+        fb.write_file("/bi/from-b.txt", b"BBB")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            a_has = fa.filer.find_entry("/bi", "from-b.txt")
+            b_has = fb.filer.find_entry("/bi", "from-a.txt")
+            if a_has is not None and b_has is not None:
+                break
+            time.sleep(0.05)
+        assert a_has is not None and b_has is not None
+        assert fa.read_entry_bytes(a_has) == b"BBB"
+        assert fb.read_entry_bytes(b_has) == b"AAA"
+        # loop guard: replicated writes come back stamped and are skipped
+        time.sleep(0.5)
+        assert s_ab.skipped >= 1 or s_ba.skipped >= 1
+        applied_before = (s_ab.applied, s_ba.applied)
+        time.sleep(1.0)
+        assert (s_ab.applied, s_ba.applied) == applied_before, \
+            "sync ping-pong detected"
+        s_ab.stop()
+        s_ba.stop()
+
+    def test_delete_propagates(self, two_filers):
+        fa, fb = two_filers
+        sync = FilerSync(fa, fb, from_ns=time_ns_now()).start()
+        fa.write_file("/del/gone.txt", b"x")
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                fb.filer.find_entry("/del", "gone.txt") is None:
+            time.sleep(0.05)
+        fa.filer.delete_entry("/del", "gone.txt")
+        while time.time() < deadline and \
+                fb.filer.find_entry("/del", "gone.txt") is not None:
+            time.sleep(0.05)
+        assert fb.filer.find_entry("/del", "gone.txt") is None
+        sync.stop()
+
+
+def time_ns_now():
+    return time.time_ns()
