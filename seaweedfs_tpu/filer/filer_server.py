@@ -102,6 +102,11 @@ class FilerServer:
         if entry.content:
             data = bytes(entry.content)
             return data[offset:offset + size if size is not None else None]
+        if not entry.chunks and entry.extended.get("remote"):
+            # uncached remote-mounted entry: stream straight from the
+            # remote store (reference filer read_remote.go)
+            from ..remote import read_remote
+            return read_remote(entry, offset, size)
         chunks = self.filer.data_chunks(entry, self._fetch_blob)
         fsize = max(total_size(chunks), entry.attributes.file_size)
         if size is None:

@@ -1,0 +1,11 @@
+"""Remote storage mounts (reference weed/remote_storage + filer
+read_remote.go / remote_mapping.go): graft an external object store's
+listing into the filer namespace, read through on demand, cache/uncache
+chunks explicitly.
+"""
+
+from .remote_mount import (cache_remote, mount_remote, read_remote,
+                           uncache_remote, unmount_remote)
+
+__all__ = ["mount_remote", "unmount_remote", "cache_remote",
+           "uncache_remote", "read_remote"]

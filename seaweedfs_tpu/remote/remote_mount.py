@@ -1,0 +1,146 @@
+"""Mount an external object store path into the filer namespace.
+
+Reference behavior (weed/filer/read_remote.go, remote_mapping.go, shell
+remote.mount/remote.cache/remote.uncache):
+- remote.mount imports the remote listing as entries whose `extended`
+  metadata carries the remote ref; no data is copied.
+- reads of an uncached entry stream straight from the remote store.
+- remote.cache materializes chunks in the blob cluster (after which
+  reads are local); remote.uncache drops them again.
+- mappings persist at /etc/remote/mount.json (reference stores them in
+  the filer the same way).
+
+Remote refs live in entry.extended["remote"] as JSON
+{"spec": backend spec, "key": object key, "size": bytes}.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..filer.filer import join_path, split_path
+from ..pb import filer_pb2 as fpb
+from ..storage.backend import open_remote
+from ..utils.log import logger
+
+log = logger("remote")
+
+MOUNT_CONF = "/etc/remote/mount.json"
+REMOTE_KEY = b"remote"  # extended map key (bytes per proto)
+
+
+def _load_mappings(fs) -> dict:
+    d, n = split_path(MOUNT_CONF)
+    entry = fs.filer.find_entry(d, n)
+    if entry is None:
+        return {}
+    try:
+        return json.loads(fs.read_entry_bytes(entry))
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _save_mappings(fs, mappings: dict) -> None:
+    fs.write_file(MOUNT_CONF, json.dumps(mappings, indent=2).encode(),
+                  mime="application/json")
+
+
+def mount_remote(fs, directory: str, spec: str, prefix: str = "") -> int:
+    """Import the remote listing under `directory`; returns entry count."""
+    client = open_remote(spec)
+    count = 0
+    for key in client.list_keys(prefix):
+        rel = key[len(prefix):].lstrip("/") if prefix else key
+        if not rel:
+            continue
+        path = join_path(directory, rel)
+        d, n = split_path(path)
+        size = client.object_size(key)
+        entry = fpb.Entry(name=n)
+        entry.attributes.file_size = size
+        entry.attributes.file_mode = 0o644
+        entry.extended[REMOTE_KEY.decode()] = json.dumps(
+            {"spec": spec, "key": key, "size": size}).encode()
+        fs.filer.create_entry(d, entry)
+        count += 1
+    mappings = _load_mappings(fs)
+    mappings[directory] = {"spec": spec, "prefix": prefix}
+    _save_mappings(fs, mappings)
+    log.info("mounted %s (%s, prefix=%r): %d entries",
+             directory, spec, prefix, count)
+    return count
+
+
+def unmount_remote(fs, directory: str) -> None:
+    d, n = split_path(directory)
+    if fs.filer.find_entry(d, n) is not None:
+        fs.filer.delete_entry(d, n, is_recursive=True, is_delete_data=True)
+    mappings = _load_mappings(fs)
+    mappings.pop(directory, None)
+    _save_mappings(fs, mappings)
+
+
+def remote_ref(entry: fpb.Entry) -> dict | None:
+    raw = entry.extended.get(REMOTE_KEY.decode())
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def read_remote(entry: fpb.Entry, offset: int = 0,
+                size: int | None = None) -> bytes:
+    """Stream an uncached remote entry's bytes (read_remote.go)."""
+    ref = remote_ref(entry)
+    if ref is None:
+        raise ValueError("entry has no remote ref")
+    client = open_remote(ref["spec"])
+    total = ref.get("size") or client.object_size(ref["key"])
+    if size is None:
+        size = total - offset
+    size = max(0, min(size, total - offset))
+    if size == 0:
+        return b""
+    return client.read_object(ref["key"], offset, size)
+
+
+def cache_remote(fs, path: str) -> fpb.Entry:
+    """Materialize a remote entry's data as local chunks
+    (shell remote.cache)."""
+    d, n = split_path(path)
+    entry = fs.filer.find_entry(d, n)
+    if entry is None:
+        raise FileNotFoundError(path)
+    ref = remote_ref(entry)
+    if ref is None:
+        raise ValueError(f"{path} is not a remote entry")
+    if entry.chunks:
+        return entry  # already cached
+    data = read_remote(entry)
+    cached = fs.write_file(path, data, mime=entry.attributes.mime)
+    # keep the remote ref so uncache can revert
+    updated = fs.filer.find_entry(d, n)
+    updated.extended[REMOTE_KEY.decode()] = json.dumps(ref).encode()
+    fs.filer.update_entry(d, updated)
+    return cached
+
+
+def uncache_remote(fs, path: str) -> None:
+    """Drop local chunks, keep the remote ref (shell remote.uncache)."""
+    d, n = split_path(path)
+    entry = fs.filer.find_entry(d, n)
+    if entry is None:
+        raise FileNotFoundError(path)
+    ref = remote_ref(entry)
+    if ref is None:
+        raise ValueError(f"{path} is not a remote entry")
+    if not entry.chunks:
+        return
+    fs._delete_chunks([c.file_id for c in entry.chunks])
+    updated = fpb.Entry()
+    updated.CopyFrom(entry)
+    del updated.chunks[:]
+    updated.attributes.file_size = ref.get("size", 0)
+    fs.filer.update_entry(d, updated)

@@ -542,6 +542,10 @@ class VolumeServer:
             v = store.find_volume(req.volume_id)
             if v is None:
                 context.abort(5, f"volume {req.volume_id} not found")
+            # tiered volumes never report garbage: compacting one would
+            # silently un-tier it and orphan the remote copy
+            if v.remote_spec is not None:
+                return vpb.VacuumVolumeCheckResponse(garbage_ratio=0.0)
             return vpb.VacuumVolumeCheckResponse(garbage_ratio=v.garbage_ratio())
 
         @svc.unary("VacuumVolumeCompact", vpb.VacuumVolumeCompactRequest,
@@ -550,6 +554,9 @@ class VolumeServer:
             v = store.find_volume(req.volume_id)
             if v is None:
                 context.abort(5, f"volume {req.volume_id} not found")
+            if v.remote_spec is not None:
+                context.abort(9, f"volume {req.volume_id} is tiered; "
+                              "download it before compacting")
             _, reclaimed = compact(v)
             return vpb.VacuumVolumeCompactResponse(processed_bytes=reclaimed)
 
@@ -823,9 +830,13 @@ class VolumeServer:
             v = store.find_volume(req.volume_id)
             if v is None:
                 context.abort(5, f"volume {req.volume_id} not found")
+            dat_size = (os.path.getsize(v.dat_path)
+                        if os.path.exists(v.dat_path)
+                        else v.remote_spec.get("size", 0)
+                        if v.remote_spec else 0)
             return vpb.ReadVolumeFileStatusResponse(
                 volume_id=req.volume_id,
-                dat_file_size=os.path.getsize(v.dat_path),
+                dat_file_size=dat_size,
                 idx_file_size=os.path.getsize(v.idx_path),
                 file_count=v.file_count,
                 compaction_revision=v.super_block.compaction_revision,
@@ -848,6 +859,94 @@ class VolumeServer:
             now = time.time_ns()
             return vpb.PingResponse(start_time_ns=now, remote_time_ns=now,
                                     stop_time_ns=time.time_ns())
+
+        @svc.unary("VolumeTierMoveDatToRemote",
+                   vpb.VolumeTierMoveDatToRemoteRequest,
+                   vpb.VolumeTierMoveDatToRemoteResponse)
+        def tier_upload(req, context):
+            """Seal + upload the .dat to a remote backend; the volume
+            stays readable through ranged reads (reference
+            volume_grpc_tier_upload.go)."""
+            from ..ec import files as ec_files
+            from ..storage.backend import open_remote
+
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not local")
+            if v.remote_spec is not None:
+                context.abort(9, f"volume {req.volume_id} already tiered")
+            try:
+                client = open_remote(req.destination_backend_name)
+            except ValueError as e:
+                context.abort(3, str(e))
+            was_read_only = v.read_only
+            v.read_only = True
+            try:
+                v.sync()
+                key = os.path.basename(v.dat_path)
+                size = client.write_object(key, v.dat_path)
+            except Exception as e:  # noqa: BLE001
+                v.read_only = was_read_only  # roll back: no remote copy
+                context.abort(13, f"tier upload: {e}")
+            remote = {"spec": req.destination_backend_name,
+                      "key": key, "size": size}
+            vif = ec_files.read_vif(v.vif_path)
+            vif["remote"] = remote
+            ec_files.write_vif(v.vif_path, **vif)
+            if req.keep_local_dat_file:
+                # local .dat keeps serving reads; volume stays read-only
+                # and marked tiered so the guards above hold
+                v.remote_spec = remote
+            else:
+                v.close()
+                os.unlink(v.dat_path)
+                store.reload_volume(req.volume_id)
+            return vpb.VolumeTierMoveDatToRemoteResponse(
+                processed=size, processedPercentage=100.0)
+
+        @svc.unary("VolumeTierMoveDatFromRemote",
+                   vpb.VolumeTierMoveDatFromRemoteRequest,
+                   vpb.VolumeTierMoveDatFromRemoteResponse)
+        def tier_download(req, context):
+            """Pull a tiered .dat back to local disk (reference
+            volume_grpc_tier_download.go)."""
+            from ..ec import files as ec_files
+            from ..storage.backend import open_remote
+
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not local")
+            if v.remote_spec is None:
+                context.abort(9, f"volume {req.volume_id} not tiered")
+            remote = v.remote_spec
+            client = open_remote(remote["spec"])
+            # download to a temp file and verify the size BEFORE touching
+            # the .vif or the remote copy — a torn download must never
+            # cost the only good copy
+            tmp = v.dat_path + ".tiertmp"
+            try:
+                client.read_object_to(remote["key"], tmp)
+                got = os.path.getsize(tmp)
+                want = remote.get("size") or client.object_size(remote["key"])
+                if got != want:
+                    raise OSError(f"short download: {got} != {want}")
+            except Exception as e:  # noqa: BLE001
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                context.abort(13, f"tier download: {e}")
+            v.close()
+            os.replace(tmp, v.dat_path)
+            vif = ec_files.read_vif(v.vif_path)
+            vif.pop("remote", None)
+            ec_files.write_vif(v.vif_path, **vif)
+            nv = store.reload_volume(req.volume_id)
+            if not req.keep_remote_dat_file and nv is not None:
+                client.delete_object(remote["key"])
+            return vpb.VolumeTierMoveDatFromRemoteResponse(
+                processed=remote.get("size", 0),
+                processedPercentage=100.0)
 
         @svc.unary_stream("Query", vpb.QueryRequest, vpb.QueriedStripe)
         def query(req, context):

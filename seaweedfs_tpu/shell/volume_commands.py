@@ -238,3 +238,63 @@ def cmd_volume_balance(env: CommandEnv, args):
             "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=v.id),
             vpb.VolumeDeleteResponse)
     env.println("balanced")
+
+
+@command("volume.tier.upload",
+         "move a sealed volume's .dat to a remote backend")
+def cmd_volume_tier_upload(env: CommandEnv, args):
+    """Reference shell/command_volume_tier_upload.go ->
+    VolumeTierMoveDatToRemote."""
+    p = argparse.ArgumentParser(prog="volume.tier.upload")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-dest", required=True,
+                   help="backend spec: local:/dir or s3:http://host/bucket?ak:sk")
+    p.add_argument("-keepLocalDatFile", action="store_true")
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    holders = _volume_holders(env, opt.volumeId)
+    if not holders:
+        env.println(f"volume {opt.volumeId} not found")
+        return
+    for h in holders:
+        stub = _vs_stub(env, h["id"], h["grpc_port"])
+        resp = stub.call("VolumeTierMoveDatToRemote",
+                         vpb.VolumeTierMoveDatToRemoteRequest(
+                             volume_id=opt.volumeId,
+                             collection=opt.collection,
+                             destination_backend_name=opt.dest,
+                             keep_local_dat_file=opt.keepLocalDatFile),
+                         vpb.VolumeTierMoveDatToRemoteResponse,
+                         timeout=600)
+        env.println(f"{h['id']}: uploaded {resp.processed} bytes")
+
+
+@command("volume.tier.download",
+         "pull a tiered volume's .dat back to local disk")
+def cmd_volume_tier_download(env: CommandEnv, args):
+    """Reference shell/command_volume_tier_download.go ->
+    VolumeTierMoveDatFromRemote."""
+    p = argparse.ArgumentParser(prog="volume.tier.download")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-keepRemoteDatFile", action="store_true")
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    holders = _volume_holders(env, opt.volumeId)
+    if not holders:
+        env.println(f"volume {opt.volumeId} not found")
+        return
+    for i, h in enumerate(holders):
+        # replicas share the remote key: only the LAST holder may delete
+        # the remote copy, or the remaining downloads lose their source
+        keep = opt.keepRemoteDatFile or i < len(holders) - 1
+        stub = _vs_stub(env, h["id"], h["grpc_port"])
+        resp = stub.call("VolumeTierMoveDatFromRemote",
+                         vpb.VolumeTierMoveDatFromRemoteRequest(
+                             volume_id=opt.volumeId,
+                             collection=opt.collection,
+                             keep_remote_dat_file=keep),
+                         vpb.VolumeTierMoveDatFromRemoteResponse,
+                         timeout=600)
+        env.println(f"{h['id']}: downloaded {resp.processed} bytes")

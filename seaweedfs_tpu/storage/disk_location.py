@@ -20,6 +20,8 @@ log = logger("disk")
 
 _DAT_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
 _ECX_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ecx$")
+# tiered volumes keep only .vif+.idx locally (the .dat lives remotely)
+_VIF_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.vif$")
 
 
 class DiskLocation:
@@ -47,6 +49,24 @@ class DiskLocation:
                                 self.directory, col, vid, create_if_missing=False)
                         except Exception as e:  # noqa: BLE001
                             log.error("load volume %s: %s", name, e)
+                    continue
+                m = _VIF_RE.match(name)
+                if m:
+                    vid = int(m.group("vid"))
+                    col = m.group("col") or ""
+                    dat = os.path.join(self.directory, name[:-4] + ".dat")
+                    if vid not in self.volumes and not os.path.exists(dat):
+                        from ..ec import files as ec_files
+                        vif = ec_files.read_vif(
+                            os.path.join(self.directory, name))
+                        if "remote" in vif:
+                            try:
+                                self.volumes[vid] = Volume(
+                                    self.directory, col, vid,
+                                    create_if_missing=False)
+                            except Exception as e:  # noqa: BLE001
+                                log.error("load tiered volume %s: %s",
+                                          name, e)
                     continue
                 m = _ECX_RE.match(name)
                 if m:

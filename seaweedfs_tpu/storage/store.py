@@ -63,6 +63,22 @@ class Store:
                 return v
         return None
 
+    def reload_volume(self, vid: int) -> Volume | None:
+        """Re-open a volume whose backing changed (tier upload/download
+        swaps the .dat between local disk and a remote backend)."""
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                try:
+                    v.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                nv = Volume(loc.directory, v.collection, vid,
+                            create_if_missing=False)
+                loc.volumes[vid] = nv
+                return nv
+        return None
+
     def find_ec_volume(self, vid: int) -> EcVolume | None:
         for loc in self.locations:
             ev = loc.ec_volumes.get(vid)

@@ -12,9 +12,12 @@ import os
 import threading
 
 from . import types as t
+from ..utils.log import logger
 from .needle import Needle, record_size_from_header
 from .needle_map import NeedleMap, idx_entries_numpy
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+
+log = logger("volume")
 
 
 def iter_records(f, start: int, end: int):
@@ -69,6 +72,15 @@ class Volume:
         base = self.file_name()
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
+        self.vif_path = base + ".vif"
+        # Tiered volume: sealed .dat lives in a remote backend (reference
+        # volume_tier.go — the .vif carries the remote location).
+        self.remote_spec: dict | None = None
+        if not os.path.exists(self.dat_path):
+            vif = self._read_vif()
+            if "remote" in vif:
+                self._open_remote(vif["remote"])
+                return
         exists = os.path.exists(self.dat_path)
         if not exists and not create_if_missing:
             raise FileNotFoundError(self.dat_path)
@@ -82,6 +94,31 @@ class Volume:
         self.super_block = SuperBlock.from_bytes(self._dat.read(SUPER_BLOCK_SIZE))
         self.nm = NeedleMap(self.idx_path)
         self._check_integrity()
+        # a volume tiered with keep_local serves reads from the local
+        # .dat but must stay read-only — writes would silently diverge
+        # from the remote copy
+        vif = self._read_vif()
+        if "remote" in vif:
+            self.remote_spec = vif["remote"]
+            self.read_only = True
+
+    def _read_vif(self) -> dict:
+        from ..ec import files as ec_files
+        return ec_files.read_vif(self.vif_path)
+
+    def _open_remote(self, remote: dict) -> None:
+        """Open a tiered (remote .dat) volume read-only."""
+        from .backend import RemoteDatFile, open_remote
+        client = open_remote(remote["spec"])
+        self.remote_spec = remote
+        self._dat = RemoteDatFile(client, remote["key"],
+                                  remote.get("size"))
+        self._dat.seek(0)
+        self.super_block = SuperBlock.from_bytes(
+            self._dat.read(SUPER_BLOCK_SIZE))
+        self.nm = NeedleMap(self.idx_path)
+        self.read_only = True
+        self._append_offset = self._dat.size
 
     # -- naming ------------------------------------------------------------
     def file_name(self) -> str:
@@ -210,7 +247,8 @@ class Volume:
     def sync(self) -> None:
         with self._lock:
             self._dat.flush()
-            os.fsync(self._dat.fileno())
+            if self.remote_spec is None:
+                os.fsync(self._dat.fileno())
             self.nm.flush()
 
     def close(self) -> None:
@@ -225,7 +263,17 @@ class Volume:
 
     def destroy(self) -> None:
         self.close()
-        for ext in (".dat", ".idx"):
+        if self.remote_spec is not None:
+            # best-effort: replicas may share the remote key, so a
+            # failure here only leaks an orphan object
+            try:
+                from .backend import open_remote
+                open_remote(self.remote_spec["spec"]).delete_object(
+                    self.remote_spec["key"])
+            except Exception as e:  # noqa: BLE001
+                log.warning("delete remote copy of volume %d: %s",
+                            self.id, e)
+        for ext in (".dat", ".idx", ".vif"):
             p = self.file_name() + ext
             if os.path.exists(p):
                 os.remove(p)

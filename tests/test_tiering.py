@@ -1,0 +1,220 @@
+"""Volume tiering + remote storage mounts.
+
+Reference: weed/storage/backend (BackendStorageFile), volume_tier.go,
+volume_grpc_tier_upload.go/_download.go, weed/remote_storage, filer
+read_remote.go, shell remote.mount/cache/uncache.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.storage.backend import (LocalDirRemote, RemoteDatFile,
+                                           open_remote)
+
+
+def _fp():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestBackends:
+    def test_local_dir_remote_roundtrip(self, tmp_path):
+        src = tmp_path / "obj.bin"
+        src.write_bytes(b"tier-me" * 1000)
+        r = LocalDirRemote(str(tmp_path / "bucket"))
+        size = r.write_object("vols/1.dat", str(src))
+        assert size == 7000
+        assert r.object_size("vols/1.dat") == 7000
+        assert r.read_object("vols/1.dat", 7, 7) == b"tier-me"
+        assert r.list_keys("vols/") == ["vols/1.dat"]
+        dst = tmp_path / "back.bin"
+        r.read_object_to("vols/1.dat", str(dst))
+        assert dst.read_bytes() == src.read_bytes()
+        r.delete_object("vols/1.dat")
+        assert r.list_keys() == []
+
+    def test_open_remote_specs(self, tmp_path):
+        assert open_remote(f"local:{tmp_path}").name == "local"
+        s3 = open_remote("s3:http://h:1/bkt?AK:SK")
+        assert s3.name == "s3" and s3.bucket == "bkt" and s3.ak == "AK"
+        with pytest.raises(ValueError):
+            open_remote("ftp:whatever")
+
+    def test_remote_dat_file(self, tmp_path):
+        payload = bytes(range(256)) * 5000  # 1.28 MB, > 4 blocks
+        (tmp_path / "bkt").mkdir()
+        (tmp_path / "bkt" / "x.dat").write_bytes(payload)
+        f = RemoteDatFile(LocalDirRemote(str(tmp_path / "bkt")), "x.dat")
+        assert f.size == len(payload)
+        f.seek(0)
+        assert f.read(16) == payload[:16]
+        f.seek(300_000)
+        assert f.read(1000) == payload[300_000:301_000]
+        f.seek(-10, 2)
+        assert f.read() == payload[-10:]
+        with pytest.raises(OSError):
+            f.write(b"nope")
+
+
+@pytest.fixture(scope="module")
+def tier_cluster(tmp_path_factory):
+    import requests
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    mport, vport = _fp(), _fp()
+    ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5)
+    ms.start()
+    vol_dir = tmp_path_factory.mktemp("tiervol")
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(vol_dir), max_volume_count=8)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
+                      pulse_seconds=0.5)
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(ms.topo.nodes) < 1:
+        time.sleep(0.05)
+    while time.time() < deadline:
+        try:
+            requests.get(f"http://{vs.url}/status", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.05)
+    mc = MasterClient(ms.address).start()
+    mc.wait_connected()
+    yield {"ms": ms, "vs": vs, "mc": mc, "store": store,
+           "vol_dir": str(vol_dir),
+           "remote_dir": str(tmp_path_factory.mktemp("tierremote"))}
+    mc.stop()
+    vs.stop()
+    ms.stop()
+
+
+class TestTierRpcs:
+    def test_upload_read_download(self, tier_cluster):
+        from seaweedfs_tpu.client import operation
+        from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+        from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+
+        c = tier_cluster
+        blobs = [operation.submit(c["mc"], os.urandom(5000), name=f"t{i}")
+                 for i in range(5)]
+        vid = int(blobs[0].fid.split(",")[0])
+        stub = Stub(f"{c['vs'].ip}:{c['vs'].grpc_port}", VOLUME_SERVICE)
+        spec = f"local:{c['remote_dir']}"
+        resp = stub.call("VolumeTierMoveDatToRemote",
+                         vpb.VolumeTierMoveDatToRemoteRequest(
+                             volume_id=vid, destination_backend_name=spec),
+                         vpb.VolumeTierMoveDatToRemoteResponse, timeout=60)
+        assert resp.processed > 0
+        # local .dat gone, remote copy exists
+        v = c["store"].find_volume(vid)
+        assert v.remote_spec is not None and v.read_only
+        assert not os.path.exists(v.dat_path)
+        assert os.listdir(c["remote_dir"])
+        # reads still work (ranged reads through the backend)
+        for b in blobs:
+            if int(b.fid.split(",")[0]) == vid:
+                assert len(operation.read(c["mc"], b.fid)) == 5000
+        # writes are refused on the tiered volume
+        import requests as rq
+        a_fid = f"{vid},9999999999"
+        r = rq.post(f"http://{c['vs'].url}/{a_fid}", data=b"x", timeout=5)
+        assert r.status_code in (403, 500)
+
+        # download back
+        resp = stub.call("VolumeTierMoveDatFromRemote",
+                         vpb.VolumeTierMoveDatFromRemoteRequest(volume_id=vid),
+                         vpb.VolumeTierMoveDatFromRemoteResponse, timeout=60)
+        v = c["store"].find_volume(vid)
+        assert v.remote_spec is None
+        assert os.path.exists(v.dat_path)
+        for b in blobs:
+            if int(b.fid.split(",")[0]) == vid:
+                assert len(operation.read(c["mc"], b.fid)) == 5000
+        # remote copy removed (keep_remote_dat_file default False)
+        assert not os.listdir(c["remote_dir"])
+
+    def test_tiered_volume_survives_restart(self, tier_cluster, tmp_path):
+        """A data dir holding only .vif+.idx loads the tiered volume."""
+        from seaweedfs_tpu.storage.disk_location import DiskLocation
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.storage.volume import Volume
+
+        vol_dir = str(tmp_path / "vols")
+        os.makedirs(vol_dir)
+        v = Volume(vol_dir, "", 7)
+        n = Needle(id=1, cookie=42, data=b"persisted-needle")
+        v.write_needle(n)
+        v.sync()
+        remote = str(tmp_path / "rem")
+        from seaweedfs_tpu.ec import files as ec_files
+        from seaweedfs_tpu.storage.backend import LocalDirRemote
+        client = LocalDirRemote(remote)
+        size = client.write_object("7.dat", v.dat_path)
+        ec_files.write_vif(v.vif_path, remote={
+            "spec": f"local:{remote}", "key": "7.dat", "size": size})
+        v.close()
+        os.unlink(v.dat_path)
+
+        loc = DiskLocation(vol_dir, max_volume_count=4)
+        loc.load_existing()
+        assert 7 in loc.volumes
+        got = loc.volumes[7].read_needle(1, cookie=42)
+        assert got.data == b"persisted-needle"
+
+
+class TestRemoteMount:
+    @pytest.fixture(scope="class")
+    def filer_stack(self, tier_cluster, tmp_path_factory):
+        from seaweedfs_tpu.filer.filer_server import FilerServer
+
+        fs = FilerServer(tier_cluster["ms"].address, store_spec="memory",
+                         port=_fp(), grpc_port=_fp(), chunk_size_mb=1)
+        fs.start()
+        remote_root = tmp_path_factory.mktemp("mntremote")
+        (remote_root / "docs").mkdir()
+        (remote_root / "docs" / "a.txt").write_bytes(b"remote alpha")
+        (remote_root / "docs" / "b.txt").write_bytes(b"remote beta!")
+        yield fs, str(remote_root)
+        fs.stop()
+
+    def test_mount_read_cache_uncache(self, filer_stack):
+        from seaweedfs_tpu.remote import (cache_remote, mount_remote,
+                                          uncache_remote, unmount_remote)
+
+        fs, remote_root = filer_stack
+        n = mount_remote(fs, "/mnt/ext", f"local:{remote_root}")
+        assert n == 2
+        e = fs.filer.find_entry("/mnt/ext/docs", "a.txt")
+        assert e is not None and not e.chunks
+        assert e.attributes.file_size == 12
+        # read-through (no chunks)
+        assert fs.read_entry_bytes(e) == b"remote alpha"
+        assert fs.read_entry_bytes(e, offset=7, size=5) == b"alpha"
+        # cache -> local chunks appear, reads still correct
+        cache_remote(fs, "/mnt/ext/docs/a.txt")
+        e = fs.filer.find_entry("/mnt/ext/docs", "a.txt")
+        assert e.chunks
+        assert fs.read_entry_bytes(e) == b"remote alpha"
+        # uncache -> chunks gone, read-through again
+        uncache_remote(fs, "/mnt/ext/docs/a.txt")
+        e = fs.filer.find_entry("/mnt/ext/docs", "a.txt")
+        assert not e.chunks
+        assert fs.read_entry_bytes(e) == b"remote alpha"
+        # mapping persisted
+        from seaweedfs_tpu.remote.remote_mount import _load_mappings
+        assert "/mnt/ext" in _load_mappings(fs)
+        unmount_remote(fs, "/mnt/ext")
+        assert fs.filer.find_entry("/mnt/ext/docs", "a.txt") is None
+        assert "/mnt/ext" not in _load_mappings(fs)
