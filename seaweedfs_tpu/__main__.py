@@ -25,6 +25,11 @@ def _add_master_flags(p):
                    help="HTTP status/metrics API port (0 = off)")
     p.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
     p.add_argument("-defaultReplication", default="000")
+    p.add_argument("-peers", default="",
+                   help="comma-separated master quorum incl. self "
+                        "(enables raft leader election)")
+    p.add_argument("-raftDir", default="",
+                   help="directory for persistent raft state")
     _add_security_flags(p)
 
 
@@ -66,10 +71,17 @@ def run_master(argv):
     p = argparse.ArgumentParser(prog="master")
     _add_master_flags(p)
     opt = p.parse_args(argv)
+    import os as _os
+    raft_state = None
+    if opt.raftDir:
+        _os.makedirs(opt.raftDir, exist_ok=True)
+        raft_state = _os.path.join(opt.raftDir, f"raft-{opt.port}.json")
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
                       default_replication=opt.defaultReplication,
-                      guard=_make_guard(opt), http_port=opt.httpPort or None)
+                      guard=_make_guard(opt), http_port=opt.httpPort or None,
+                      peers=[p for p in opt.peers.split(",") if p],
+                      raft_state_path=raft_state)
     ms.start()
     _wait_forever()
 

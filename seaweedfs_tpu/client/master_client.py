@@ -49,8 +49,12 @@ class VidMap:
 class MasterClient:
     def __init__(self, master_address: str, client_type: str = "client",
                  client_address: str = ""):
-        self.master_address = master_address
-        self.leader = master_address
+        # comma-separated master quorum; leader discovered via hints
+        # (reference masterclient.go:190 tryConnectToMaster round-robin)
+        self.masters = [m for m in master_address.split(",") if m]
+        self.master_address = self.masters[0]
+        self.leader = self.masters[0]
+        self._master_rr = 0
         self.client_type = client_type
         self.client_address = client_address or f"pyclient-{random.getrandbits(24):x}"
         self.vid_map = VidMap()
@@ -110,7 +114,12 @@ class MasterClient:
                 if not self._stop.is_set():
                     log.warning("keepconnected to %s: %s; retrying", self.leader, e)
                     self._connected.clear()
-                    time.sleep(1)
+                    # rotate through the quorum until a live master
+                    # redirects us to the leader
+                    if len(self.masters) > 1:
+                        self._master_rr = (self._master_rr + 1) % len(self.masters)
+                        self.leader = self.masters[self._master_rr]
+                    time.sleep(0.5)
 
     # -- RPC helpers ---------------------------------------------------------
     def _stub(self) -> Stub:
@@ -119,12 +128,43 @@ class MasterClient:
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
                disk_type: str = "") -> pb.AssignResponse:
-        resp = self._stub().call("Assign", pb.AssignRequest(
+        req = pb.AssignRequest(
             count=count, collection=collection, replication=replication,
-            ttl=ttl, disk_type=disk_type), pb.AssignResponse)
-        if resp.error:
-            raise RuntimeError(f"assign: {resp.error}")
-        return resp
+            ttl=ttl, disk_type=disk_type)
+        # leader hints can be stale right after a failover — fall back
+        # through the whole quorum rather than pinning a dead address
+        # (reference masterclient round-robin + leader redirect)
+        candidates = [self.leader] + [m for m in self.masters
+                                      if m != self.leader]
+        last_err: Exception | None = None
+        for addr in candidates:
+            try:
+                resp = Stub(addr, MASTER_SERVICE).call(
+                    "Assign", req, pb.AssignResponse, timeout=10)
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                continue
+            if resp.error.startswith("not leader; leader is "):
+                hint = resp.error.rsplit(" ", 1)[-1]
+                try:
+                    resp = Stub(hint, MASTER_SERVICE).call(
+                        "Assign", req, pb.AssignResponse, timeout=10)
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+                    continue  # hint dead: try next candidate
+                if resp.error.startswith("not leader"):
+                    last_err = RuntimeError(resp.error)
+                    continue  # stale hint: try next candidate
+                if resp.error:
+                    # the real leader answered with a genuine failure
+                    raise RuntimeError(f"assign: {resp.error}")
+                self.leader = hint
+                return resp
+            if resp.error:
+                raise RuntimeError(f"assign: {resp.error}")
+            self.leader = addr
+            return resp
+        raise RuntimeError(f"assign: no reachable leader ({last_err})")
 
     def lookup(self, vid: int) -> list[dict]:
         cached = self.vid_map.get(vid)

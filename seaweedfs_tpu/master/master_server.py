@@ -33,7 +33,9 @@ class MasterServer:
                  sequencer: str = "memory",
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
-                 guard=None, http_port: int | None = None):
+                 guard=None, http_port: int | None = None,
+                 peers: list[str] | None = None,
+                 raft_state_path: str | None = None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -45,7 +47,12 @@ class MasterServer:
         self.default_replication = default_replication
         self.pulse_seconds = pulse_seconds
         self.garbage_threshold = garbage_threshold
-        self.is_leader = True
+        # Multi-master: a raft quorum elects the leader and replicates
+        # MaxVolumeId (reference raft_server.go FSM); single master runs
+        # leaderless-raft-free with is_leader pinned True.
+        self.peers = [p for p in (peers or []) if p] or [self.address]
+        self.raft = None
+        self._raft_state_path = raft_state_path
         # Optional security.Guard: when its signing_key is set, Assign
         # responses carry a single-fid JWT the volume server will demand
         # (reference master_grpc_server_assign.go JWT minting).
@@ -61,14 +68,42 @@ class MasterServer:
         self._http = None
         self._stop = threading.Event()
 
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader if self.raft is not None else True
+
+    @property
+    def leader_address(self) -> str:
+        if self.raft is not None and self.raft.leader_address:
+            return self.raft.leader_address
+        return self.address
+
+    def _raft_apply(self, command: dict) -> None:
+        """FSM apply (reference raft_server.go:53 StateMachine.Apply):
+        replicated MaxVolumeId keeps vid allocation monotonic across
+        leader changes."""
+        mvid = command.get("max_volume_id")
+        if mvid:
+            with self.topo.lock:
+                self.topo.max_volume_id = max(self.topo.max_volume_id, mvid)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         svc = self._build_service()
+        services = [svc]
+        if len(self.peers) > 1:
+            from .raft import RaftNode
+            self.raft = RaftNode(self.address, self.peers,
+                                 self._raft_apply,
+                                 state_path=self._raft_state_path)
+            services.append(self.raft.build_service())
         key = self.guard.signing_key if self.guard is not None else ""
         if key:
             from ..utils.rpc import set_cluster_key
             set_cluster_key(key)
-        self._grpc = serve(f"{self.ip}:{self.port}", [svc], auth_key=key)
+        self._grpc = serve(f"{self.ip}:{self.port}", services, auth_key=key)
+        if self.raft is not None:
+            self.raft.start()
         if self.http_port:
             self._start_http()
         threading.Thread(target=self._janitor, daemon=True,
@@ -77,6 +112,8 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         if self._grpc:
             self._grpc.stop(grace=0.5)
         if self._http:
@@ -235,7 +272,7 @@ class MasterServer:
                     node = ms._handle_heartbeat(hb, node)
                     yield pb.HeartbeatResponse(
                         volume_size_limit=ms.topo.volume_size_limit,
-                        leader=ms.address)
+                        leader=ms.leader_address)
             finally:
                 if node is not None:
                     vids, ec_vids = ms.topo.unregister_node(node)
@@ -266,7 +303,7 @@ class MasterServer:
                                 url=node.url, public_url=node.public_url,
                                 grpc_port=node.grpc_port,
                                 new_vids=vids, new_ec_vids=ec_vids,
-                                leader=ms.address))
+                                leader=ms.leader_address))
                 while not ms._stop.is_set() and context.is_active():
                     try:
                         yield q.get(timeout=1.0)
@@ -376,7 +413,7 @@ class MasterServer:
         def get_conf(req, context):
             return pb.GetMasterConfigurationResponse(
                 default_replication=ms.default_replication,
-                leader=ms.address,
+                leader=ms.leader_address,
                 volume_size_limit_m_b=ms.topo.volume_size_limit >> 20)
 
         @svc.unary("LeaseAdminToken", pb.LeaseAdminTokenRequest,
@@ -450,7 +487,8 @@ class MasterServer:
 
     def _do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
         if not self.is_leader:
-            return pb.AssignResponse(error="not leader")
+            return pb.AssignResponse(
+                error=f"not leader; leader is {self.leader_address}")
         replication = req.replication or self.default_replication
         disk_type = req.disk_type or "hdd"
         layout = self.layouts.get(req.collection, replication, req.ttl, disk_type)
@@ -465,6 +503,16 @@ class MasterServer:
                     count=max(1, req.writable_volume_count or 1)))
             except Exception as e:  # noqa: BLE001
                 return pb.AssignResponse(error=f"grow failed: {e}")
+            if self.raft is not None:
+                # replicate the new MaxVolumeId before handing out fids
+                # (reference raft FSM, raft_server.go:53); a failed
+                # commit means we lost the quorum — refuse the assign
+                # rather than risk split-brain fid allocation
+                if not self.raft.propose(
+                        {"max_volume_id": self.topo.max_volume_id}):
+                    return pb.AssignResponse(
+                        error="not leader; leader is "
+                              f"{self.leader_address}")
             vid = layout.pick_for_write()
             if vid is None:
                 return pb.AssignResponse(error="no writable volumes after growth")

@@ -1,0 +1,383 @@
+"""Raft consensus for the master quorum.
+
+Reference: weed/server/raft_server.go (seaweedfs/raft backend) and
+raft_hashicorp.go; the replicated state machine is deliberately tiny —
+`MaxVolumeId` (raft_server.go:53-91 StateMachine.Save/Recovery/Apply) —
+because everything else the master knows is rebuilt from volume-server
+heartbeats after a leader change.
+
+This is a compact, correct Raft core (election + log replication +
+commit), not a port: RequestVote / AppendEntries ride our gRPC layer as
+a `swtpu.raft.Raft` service with JSON-encoded commands, persistent
+term/vote/log in a single JSON file, and an apply callback into the
+master. Timing defaults suit tests (sub-second failover); production
+would raise them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils.log import logger
+from ..utils.rpc import RpcService, Stub
+
+log = logger("raft")
+
+RAFT_SERVICE = "swtpu.raft.Raft"
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: dict = field(default_factory=dict)
+
+
+class RaftNode:
+    def __init__(self, address: str, peers: list[str],
+                 apply_fn: Callable[[dict], None],
+                 state_path: str | None = None,
+                 election_timeout: tuple[float, float] = (0.4, 0.8),
+                 heartbeat_interval: float = 0.12):
+        self.address = address
+        self.peers = [p for p in peers if p != address]
+        self.apply_fn = apply_fn
+        self.state_path = state_path
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        # persistent state (term, voted_for, log)
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []
+        self._load()
+
+        # volatile
+        self.role = FOLLOWER
+        self.leader_address: str | None = None
+        self.commit_index = -1
+        self.last_applied = -1
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._election_deadline = 0.0
+        self._stop = threading.Event()
+        self._commit_cv = threading.Condition(self._lock)
+        self._thread: threading.Thread | None = None
+
+    # -- persistence ---------------------------------------------------------
+    def _load(self) -> None:
+        if not self.state_path or not os.path.exists(self.state_path):
+            return
+        try:
+            with open(self.state_path) as f:
+                st = json.load(f)
+            self.current_term = st.get("term", 0)
+            self.voted_for = st.get("voted_for")
+            self.log = [LogEntry(e["term"], e["command"])
+                        for e in st.get("log", [])]
+        except Exception as e:  # noqa: BLE001
+            log.warning("raft state load: %s", e)
+
+    def _persist(self) -> None:
+        if not self.state_path:
+            return
+        d = os.path.dirname(self.state_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term,
+                       "voted_for": self.voted_for,
+                       "log": [{"term": e.term, "command": e.command}
+                               for e in self.log]}, f)
+        os.replace(tmp, self.state_path)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "RaftNode":
+        self._reset_election_timer()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"raft-{self.address}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+    def _reset_election_timer(self) -> None:
+        lo, hi = self.election_timeout
+        self._election_deadline = time.monotonic() + random.uniform(lo, hi)
+
+    # -- main loop -----------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                role = self.role
+            if role == LEADER:
+                self._broadcast_append()
+                self._stop.wait(self.heartbeat_interval)
+            else:
+                if time.monotonic() >= self._election_deadline:
+                    self._start_election()
+                self._stop.wait(0.02)
+
+    # -- election ------------------------------------------------------------
+    def _start_election(self) -> None:
+        with self._lock:
+            self.role = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.address
+            self._persist()
+            term = self.current_term
+            last_idx = len(self.log) - 1
+            last_term = self.log[-1].term if self.log else 0
+            self._reset_election_timer()
+        log.info("%s: starting election term %d", self.address, term)
+        votes = 1
+        for peer in self.peers:
+            try:
+                resp = self._call(peer, "RequestVote", {
+                    "term": term, "candidate": self.address,
+                    "last_log_index": last_idx, "last_log_term": last_term})
+            except Exception:  # noqa: BLE001
+                continue
+            with self._lock:
+                if resp.get("term", 0) > self.current_term:
+                    self._become_follower(resp["term"], None)
+                    return
+                if resp.get("granted") and self.current_term == term:
+                    votes += 1
+        with self._lock:
+            quorum = (len(self.peers) + 1) // 2 + 1
+            if self.role == CANDIDATE and self.current_term == term \
+                    and votes >= quorum:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_address = self.address
+        n = len(self.log)
+        self.next_index = {p: n for p in self.peers}
+        self.match_index = {p: -1 for p in self.peers}
+        self._quorum_seen = time.monotonic()
+        # no-op entry: commits all prior-term entries immediately (Raft
+        # §8 / the reference raft libraries do the same on election),
+        # closing the window where a replicated max_volume_id from the
+        # old term sits unapplied on the new leader
+        self.log.append(LogEntry(self.current_term, {}))
+        self._persist()
+        log.info("%s: LEADER for term %d", self.address, self.current_term)
+
+    def _become_follower(self, term: int, leader: str | None) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist()
+        if self.role != FOLLOWER:
+            log.info("%s: -> follower term %d", self.address, term)
+        self.role = FOLLOWER
+        if leader:
+            self.leader_address = leader
+        self._reset_election_timer()
+
+    # -- replication (leader) ------------------------------------------------
+    def _broadcast_append(self) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            term = self.current_term
+            commit = self.commit_index
+        reached = 1
+        for peer in self.peers:
+            with self._lock:
+                ni = self.next_index.get(peer, len(self.log))
+                prev_idx = ni - 1
+                prev_term = (self.log[prev_idx].term
+                             if 0 <= prev_idx < len(self.log) else 0)
+                entries = [{"term": e.term, "command": e.command}
+                           for e in self.log[ni:]]
+            try:
+                resp = self._call(peer, "AppendEntries", {
+                    "term": term, "leader": self.address,
+                    "prev_log_index": prev_idx, "prev_log_term": prev_term,
+                    "entries": entries, "leader_commit": commit})
+            except Exception:  # noqa: BLE001
+                continue
+            with self._lock:
+                if resp.get("term", 0) > self.current_term:
+                    self._become_follower(resp["term"], None)
+                    return
+                reached += 1  # peer answered (success or log mismatch)
+                if resp.get("success"):
+                    self.match_index[peer] = ni + len(entries) - 1
+                    self.next_index[peer] = ni + len(entries)
+                else:
+                    self.next_index[peer] = max(0, ni - 1)
+        with self._lock:
+            if self.role != LEADER:
+                return
+            quorum_n = (len(self.peers) + 1) // 2 + 1
+            now = time.monotonic()
+            if reached >= quorum_n:
+                self._quorum_seen = now
+            elif now - getattr(self, "_quorum_seen", now) > \
+                    self.election_timeout[1] * 2:
+                # leader lease lost: a minority-partitioned leader must
+                # stop serving (split-brain guard; the majority side is
+                # free to elect)
+                log.warning("%s: lost contact with quorum; stepping down",
+                            self.address)
+                self.role = FOLLOWER
+                self.leader_address = None
+                self._reset_election_timer()
+                return
+            # advance commit: highest index replicated on a quorum with
+            # an entry from the current term (Raft §5.4.2)
+            quorum = (len(self.peers) + 1) // 2 + 1
+            for idx in range(len(self.log) - 1, self.commit_index, -1):
+                if self.log[idx].term != self.current_term:
+                    break
+                count = 1 + sum(1 for p in self.peers
+                                if self.match_index.get(p, -1) >= idx)
+                if count >= quorum:
+                    self.commit_index = idx
+                    self._commit_cv.notify_all()
+                    break
+            self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            try:
+                self.apply_fn(self.log[self.last_applied].command)
+            except Exception as e:  # noqa: BLE001
+                log.error("raft apply %d: %s", self.last_applied, e)
+
+    def propose(self, command: dict, timeout: float = 5.0) -> bool:
+        """Leader-only: append + replicate; returns True once committed."""
+        with self._lock:
+            if self.role != LEADER:
+                return False
+            self.log.append(LogEntry(self.current_term, command))
+            self._persist()
+            idx = len(self.log) - 1
+        self._broadcast_append()
+        deadline = time.monotonic() + timeout
+        with self._commit_cv:
+            while self.commit_index < idx:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self.role != LEADER:
+                    return False
+                self._commit_cv.wait(min(remaining, 0.1))
+        return True
+
+    # -- RPC plumbing --------------------------------------------------------
+    def _call(self, peer: str, method: str, payload: dict) -> dict:
+        from ..pb import master_pb2 as pb
+        stub = Stub(peer, RAFT_SERVICE)
+        if method == "RequestVote":
+            req = pb.RequestVoteRequest(
+                term=payload["term"], candidate=payload["candidate"],
+                last_log_index=payload["last_log_index"],
+                last_log_term=payload["last_log_term"])
+            r = stub.call(method, req, pb.RequestVoteResponse, timeout=1.0)
+            return {"term": r.term, "granted": r.granted}
+        req = pb.AppendEntriesRequest(
+            term=payload["term"], leader=payload["leader"],
+            prev_log_index=payload["prev_log_index"],
+            prev_log_term=payload["prev_log_term"],
+            leader_commit=payload["leader_commit"])
+        for e in payload["entries"]:
+            req.entries.add(term=e["term"],
+                            command=json.dumps(e["command"]).encode())
+        r = stub.call(method, req, pb.AppendEntriesResponse, timeout=1.0)
+        return {"term": r.term, "success": r.success}
+
+    def build_service(self) -> RpcService:
+        from ..pb import master_pb2 as pb
+        svc = RpcService(RAFT_SERVICE)
+        node = self
+
+        @svc.unary("RequestVote", pb.RequestVoteRequest,
+                   pb.RequestVoteResponse)
+        def request_vote(req, context):
+            out = node._on_request_vote({
+                "term": req.term, "candidate": req.candidate,
+                "last_log_index": req.last_log_index,
+                "last_log_term": req.last_log_term})
+            return pb.RequestVoteResponse(term=out["term"],
+                                          granted=out["granted"])
+
+        @svc.unary("AppendEntries", pb.AppendEntriesRequest,
+                   pb.AppendEntriesResponse)
+        def append_entries(req, context):
+            out = node._on_append_entries({
+                "term": req.term, "leader": req.leader,
+                "prev_log_index": req.prev_log_index,
+                "prev_log_term": req.prev_log_term,
+                "entries": [{"term": e.term,
+                             "command": json.loads(e.command or b"{}")}
+                            for e in req.entries],
+                "leader_commit": req.leader_commit})
+            return pb.AppendEntriesResponse(term=out["term"],
+                                            success=out["success"])
+
+        return svc
+
+    # -- RPC handlers (any role) ---------------------------------------------
+    def _on_request_vote(self, p: dict) -> dict:
+        with self._lock:
+            if p["term"] > self.current_term:
+                self._become_follower(p["term"], None)
+            granted = False
+            if p["term"] == self.current_term and \
+                    self.voted_for in (None, p["candidate"]):
+                last_idx = len(self.log) - 1
+                last_term = self.log[-1].term if self.log else 0
+                up_to_date = (p["last_log_term"], p["last_log_index"]) >= \
+                             (last_term, last_idx)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = p["candidate"]
+                    self._persist()
+                    self._reset_election_timer()
+            return {"term": self.current_term, "granted": granted}
+
+    def _on_append_entries(self, p: dict) -> dict:
+        with self._lock:
+            if p["term"] < self.current_term:
+                return {"term": self.current_term, "success": False}
+            self._become_follower(p["term"], p["leader"])
+            prev_idx = p["prev_log_index"]
+            if prev_idx >= 0:
+                if prev_idx >= len(self.log) or \
+                        self.log[prev_idx].term != p["prev_log_term"]:
+                    return {"term": self.current_term, "success": False}
+            # append, truncating conflicts
+            at = prev_idx + 1
+            for i, e in enumerate(p["entries"]):
+                idx = at + i
+                if idx < len(self.log):
+                    if self.log[idx].term != e["term"]:
+                        del self.log[idx:]
+                        self.log.append(LogEntry(e["term"], e["command"]))
+                else:
+                    self.log.append(LogEntry(e["term"], e["command"]))
+            if p["entries"]:
+                self._persist()
+            if p["leader_commit"] > self.commit_index:
+                self.commit_index = min(p["leader_commit"], len(self.log) - 1)
+                self._apply_committed()
+            return {"term": self.current_term, "success": True}

@@ -38,7 +38,13 @@ class VolumeServer:
                  pulse_seconds: float = 2.0, read_mode: str = "proxy",
                  guard=None):
         self.store = store
-        self.master_address = master_address
+        # comma-separated master quorum; heartbeats follow leader hints
+        # and rotate through the list on failure (reference
+        # volume_grpc_client_to_master.go:28 checkWithMaster)
+        self.masters = [m for m in master_address.split(",") if m]
+        self.master_address = self.masters[0]
+        self._master_rr = 0
+        self.current_leader = self.masters[0]
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or port + 10000
@@ -50,7 +56,6 @@ class VolumeServer:
         # (reference guard wiring in weed/server/volume_server.go; the write
         # token is the single-fid JWT the master minted on Assign).
         self.guard = guard
-        self.current_leader = master_address
         self._stop = threading.Event()
         self._hb_wake = threading.Event()
         self._grpc = None
@@ -159,6 +164,10 @@ class VolumeServer:
                 if not self._stop.is_set():
                     log.warning("heartbeat to %s failed: %s; retrying",
                                 self.current_leader, e)
+                    if len(self.masters) > 1:
+                        self._master_rr = ((self._master_rr + 1)
+                                           % len(self.masters))
+                        self.current_leader = self.masters[self._master_rr]
                     time.sleep(min(self.pulse_seconds, 2.0))
 
     def trigger_heartbeat(self) -> None:
@@ -731,9 +740,17 @@ class VolumeServer:
         @svc.unary("VolumeEcShardsMove", vpb.VolumeEcShardsMoveRequest,
                    vpb.VolumeEcShardsMoveResponse)
         def ec_move(req, context):
+            # first shards of this volume on this server need the index
+            # sidecars too (reference copies .ecx/.vif on first placement,
+            # command_ec_encode.go parallelCopyEcShardsFromSource)
+            loc = store._location_for(None)
+            base = loc.base_name(req.collection, req.volume_id)
+            need_sidecars = not os.path.exists(base + ".ecx")
             ec_copy(vpb.VolumeEcShardsCopyRequest(
                 volume_id=req.volume_id, collection=req.collection,
                 shard_ids=req.shard_ids,
+                copy_ecx_file=need_sidecars, copy_ecj_file=need_sidecars,
+                copy_vif_file=need_sidecars,
                 source_data_node=req.source_data_node), context)
             src = Stub(req.source_data_node, VOLUME_SERVICE)
             src.call("VolumeEcShardsDelete",

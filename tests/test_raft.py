@@ -1,0 +1,161 @@
+"""Raft master quorum: election, log replication, failover.
+
+Reference: weed/server/raft_server.go (FSM = MaxVolumeId), leader gating
+of Assign (master_grpc_server_assign.go:40), KeepConnected leader hints.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.master.master_server import MasterServer
+
+
+def _fp():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for_leader(masters, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader and not m._stop.is_set()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no single leader: {[(m.address, m.is_leader) for m in masters]}")
+
+
+@pytest.fixture()
+def quorum(tmp_path):
+    ports = [_fp() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for p in ports:
+        ms = MasterServer(port=p, volume_size_limit_mb=64,
+                          pulse_seconds=0.5, peers=peers,
+                          raft_state_path=str(tmp_path / f"raft-{p}.json"))
+        ms.start()
+        masters.append(ms)
+    yield masters
+    for m in masters:
+        m.stop()
+
+
+class TestElection:
+    def test_single_leader_elected(self, quorum):
+        leader = _wait_for_leader(quorum)
+        # followers know who the leader is
+        time.sleep(0.5)
+        for m in quorum:
+            assert m.leader_address == leader.address
+
+    def test_leader_failover(self, quorum):
+        leader = _wait_for_leader(quorum)
+        leader.stop()
+        rest = [m for m in quorum if m is not leader]
+        new_leader = _wait_for_leader(rest)
+        assert new_leader is not leader
+
+    def test_non_leader_rejects_assign(self, quorum):
+        from seaweedfs_tpu.pb import master_pb2 as mpb
+
+        leader = _wait_for_leader(quorum)
+        time.sleep(0.5)
+        follower = next(m for m in quorum if m is not leader)
+        resp = follower.do_assign(mpb.AssignRequest(count=1))
+        assert "not leader" in resp.error
+        assert leader.address in resp.error
+
+    def test_max_volume_id_replicated(self, quorum):
+        leader = _wait_for_leader(quorum)
+        ok = leader.raft.propose({"max_volume_id": 41})
+        assert ok
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(m.topo.max_volume_id >= 41 for m in quorum):
+                break
+            time.sleep(0.05)
+        for m in quorum:
+            assert m.topo.max_volume_id >= 41
+
+    def test_raft_state_persists(self, tmp_path):
+        from seaweedfs_tpu.master.raft import LogEntry, RaftNode
+
+        path = str(tmp_path / "raft.json")
+        n = RaftNode("a:1", ["a:1", "b:2"], lambda c: None, state_path=path)
+        n.current_term = 7
+        n.voted_for = "b:2"
+        n.log.append(LogEntry(7, {"max_volume_id": 3}))
+        n._persist()
+        n2 = RaftNode("a:1", ["a:1", "b:2"], lambda c: None, state_path=path)
+        assert n2.current_term == 7
+        assert n2.voted_for == "b:2"
+        assert n2.log[0].command == {"max_volume_id": 3}
+
+
+class TestFailoverEndToEnd:
+    def test_write_survives_leader_change(self, quorum, tmp_path):
+        """Volume servers + clients follow the new leader and writes
+        keep working after the old leader dies."""
+        import requests
+
+        from seaweedfs_tpu.client import operation
+        from seaweedfs_tpu.client.master_client import MasterClient
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.storage.disk_location import DiskLocation
+        from seaweedfs_tpu.storage.store import Store
+
+        leader = _wait_for_leader(quorum)
+        all_addrs = ",".join(m.address for m in quorum)
+        vport = _fp()
+        store = Store("127.0.0.1", vport, "",
+                      [DiskLocation(str(tmp_path / "vols"),
+                                    max_volume_count=8)],
+                      coder_name="numpy")
+        vs = VolumeServer(store, all_addrs, port=vport,
+                          grpc_port=_fp(), pulse_seconds=0.3)
+        vs.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(leader.topo.nodes) < 1:
+            time.sleep(0.05)
+        while time.time() < deadline:
+            try:
+                requests.get(f"http://{vs.url}/status", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.05)
+        mc = MasterClient(all_addrs).start()
+        mc.wait_connected()
+        try:
+            r1 = operation.submit(mc, b"before failover", name="a")
+            assert operation.read(mc, r1.fid) == b"before failover"
+
+            leader.stop()
+            survivors = [m for m in quorum if m is not leader]
+            new_leader = _wait_for_leader(survivors)
+            # volume server re-registers with the new leader via the
+            # heartbeat leader hint
+            deadline = time.time() + 15
+            while time.time() < deadline and len(new_leader.topo.nodes) < 1:
+                time.sleep(0.1)
+            assert len(new_leader.topo.nodes) == 1
+
+            deadline = time.time() + 15
+            last = None
+            while time.time() < deadline:
+                try:
+                    r2 = operation.submit(mc, b"after failover", name="b")
+                    break
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    time.sleep(0.3)
+            else:
+                raise AssertionError(f"write after failover: {last}")
+            assert operation.read(mc, r2.fid) == b"after failover"
+        finally:
+            mc.stop()
+            vs.stop()
