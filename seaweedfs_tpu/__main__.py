@@ -279,8 +279,23 @@ def run_mount(argv):
         "is fully functional in-process — see tests/test_mount.py")
 
 
+def run_mq_broker(argv):
+    """MQ broker daemon (reference weed mq.broker)."""
+    from .mq import BrokerServer
+    p = argparse.ArgumentParser(prog="mq.broker")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=17777)
+    p.add_argument("-master", default="127.0.0.1:9333")
+    opt = p.parse_args(argv)
+    # segment persistence needs an in-process filer; the standalone CLI
+    # broker runs memory-only until a remote-filer client lands
+    BrokerServer(opt.master, ip=opt.ip, port=opt.port).start()
+    _wait_forever()
+
+
 VERBS = {
     "master": run_master,
+    "mq.broker": run_mq_broker,
     "volume": run_volume,
     "server": run_server,
     "shell": run_shell,

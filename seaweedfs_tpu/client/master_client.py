@@ -97,7 +97,11 @@ class MasterClient:
                         return
                     vl = resp.volume_location
                     if vl.leader and vl.leader != self.leader:
+                        # reconnect to the leader: only it sees volume
+                        # heartbeats, a follower's stream would leave the
+                        # vid map stale (reference re-dials the same way)
                         self.leader = vl.leader
+                        break
                     if not vl.url:
                         continue
                     loc = {"url": vl.url, "public_url": vl.public_url,
@@ -125,6 +129,19 @@ class MasterClient:
     def _stub(self) -> Stub:
         return Stub(self.leader, MASTER_SERVICE)
 
+    def _call_any(self, method: str, req, resp_cls, timeout: float = 10.0):
+        """Unary call with quorum fallback: try the known leader, then
+        the rest of the master list (reads work against any master)."""
+        last_err: Exception | None = None
+        for addr in [self.leader] + [m for m in self.masters
+                                     if m != self.leader]:
+            try:
+                return Stub(addr, MASTER_SERVICE).call(
+                    method, req, resp_cls, timeout=timeout)
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+        raise RuntimeError(f"{method}: no reachable master ({last_err})")
+
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
                disk_type: str = "") -> pb.AssignResponse:
@@ -144,7 +161,10 @@ class MasterClient:
             except Exception as e:  # noqa: BLE001
                 last_err = e
                 continue
-            if resp.error.startswith("not leader; leader is "):
+            if resp.error.startswith("not leader"):
+                if "; leader is " not in resp.error:
+                    last_err = RuntimeError(resp.error)
+                    continue  # election in progress: try next candidate
                 hint = resp.error.rsplit(" ", 1)[-1]
                 try:
                     resp = Stub(hint, MASTER_SERVICE).call(
@@ -170,7 +190,7 @@ class MasterClient:
         cached = self.vid_map.get(vid)
         if cached:
             return cached
-        resp = self._stub().call("LookupVolume", pb.LookupVolumeRequest(
+        resp = self._call_any("LookupVolume", pb.LookupVolumeRequest(
             volume_or_file_ids=[str(vid)]), pb.LookupVolumeResponse)
         for e in resp.volume_id_locations:
             if e.error:
@@ -188,24 +208,24 @@ class MasterClient:
     def lookup_file_id_jwt(self, fid: str) -> str:
         """Write-key token for mutating an existing fid (reference
         master_grpc_server_volume.go:102 mints auth for file-id lookups)."""
-        resp = self._stub().call("LookupVolume", pb.LookupVolumeRequest(
+        resp = self._call_any("LookupVolume", pb.LookupVolumeRequest(
             volume_or_file_ids=[fid]), pb.LookupVolumeResponse)
         for e in resp.volume_id_locations:
             return e.auth
         return ""
 
     def lookup_ec(self, vid: int) -> dict[int, list[str]]:
-        resp = self._stub().call("LookupEcVolume",
+        resp = self._call_any("LookupEcVolume",
                                  pb.LookupEcVolumeRequest(volume_id=vid),
                                  pb.LookupEcVolumeResponse)
         return {e.shard_id: [l.url for l in e.locations]
                 for e in resp.shard_id_locations}
 
     def collection_list(self) -> list[str]:
-        resp = self._stub().call("CollectionList", pb.CollectionListRequest(),
+        resp = self._call_any("CollectionList", pb.CollectionListRequest(),
                                  pb.CollectionListResponse)
         return [c.name for c in resp.collections]
 
     def volume_list(self) -> pb.VolumeListResponse:
-        return self._stub().call("VolumeList", pb.VolumeListRequest(),
+        return self._call_any("VolumeList", pb.VolumeListRequest(),
                                  pb.VolumeListResponse)

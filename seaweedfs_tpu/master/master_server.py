@@ -74,9 +74,13 @@ class MasterServer:
 
     @property
     def leader_address(self) -> str:
-        if self.raft is not None and self.raft.leader_address:
-            return self.raft.leader_address
-        return self.address
+        """Current known leader; empty during elections (clients treat an
+        empty hint as 'retry elsewhere' rather than pinning a follower)."""
+        if self.raft is None:
+            return self.address
+        if self.raft.is_leader:
+            return self.address
+        return self.raft.leader_address or ""
 
     def _raft_apply(self, command: dict) -> None:
         """FSM apply (reference raft_server.go:53 StateMachine.Apply):
@@ -293,6 +297,12 @@ class MasterServer:
             log.info("client %s (%s) subscribed", first.client_address,
                      first.client_type)
             try:
+                # leader hint first — a client that landed on a follower
+                # must re-dial the leader for live vid-map updates
+                hint = ms.leader_address
+                if hint and hint != ms.address:
+                    yield pb.KeepConnectedResponse(
+                        volume_location=pb.VolumeLocation(leader=hint))
                 # initial full vid map
                 for node in ms.topo.all_nodes():
                     vids = sorted({v.id for v in node.all_volumes()})
@@ -487,8 +497,10 @@ class MasterServer:
 
     def _do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
         if not self.is_leader:
+            hint = self.leader_address
             return pb.AssignResponse(
-                error=f"not leader; leader is {self.leader_address}")
+                error=(f"not leader; leader is {hint}" if hint
+                       else "not leader; leader unknown"))
         replication = req.replication or self.default_replication
         disk_type = req.disk_type or "hdd"
         layout = self.layouts.get(req.collection, replication, req.ttl, disk_type)
@@ -511,8 +523,7 @@ class MasterServer:
                 if not self.raft.propose(
                         {"max_volume_id": self.topo.max_volume_id}):
                     return pb.AssignResponse(
-                        error="not leader; leader is "
-                              f"{self.leader_address}")
+                        error="not leader; commit quorum lost")
             vid = layout.pick_for_write()
             if vid is None:
                 return pb.AssignResponse(error="no writable volumes after growth")

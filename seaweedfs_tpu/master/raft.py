@@ -6,12 +6,14 @@ raft_hashicorp.go; the replicated state machine is deliberately tiny —
 because everything else the master knows is rebuilt from volume-server
 heartbeats after a leader change.
 
-This is a compact, correct Raft core (election + log replication +
-commit), not a port: RequestVote / AppendEntries ride our gRPC layer as
-a `swtpu.raft.Raft` service with JSON-encoded commands, persistent
-term/vote/log in a single JSON file, and an apply callback into the
-master. Timing defaults suit tests (sub-second failover); production
-would raise them.
+Compact, correct Raft core: election, log replication, commit, no-op
+entry on election, leader-lease step-down on quorum loss, and log
+compaction with snapshot install (the FSM snapshot is just the folded
+command state, so "InstallSnapshot" piggybacks on AppendEntries).
+Indexes are absolute; `log_start` is the absolute index of log[0].
+Peer RPCs fan out on a worker pool so one dead peer cannot stall
+heartbeats to the healthy ones. Timing defaults suit tests (sub-second
+failover); production would raise them.
 """
 
 from __future__ import annotations
@@ -21,6 +23,7 @@ import os
 import random
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -33,6 +36,8 @@ RAFT_SERVICE = "swtpu.raft.Raft"
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
+COMPACT_THRESHOLD = 512  # committed entries kept before compaction
+
 
 @dataclass
 class LogEntry:
@@ -40,38 +45,75 @@ class LogEntry:
     command: dict = field(default_factory=dict)
 
 
+def _fold(state: dict, command: dict) -> dict:
+    """Fold a command into FSM snapshot state (monotonic maxes)."""
+    mvid = command.get("max_volume_id")
+    if mvid:
+        state["max_volume_id"] = max(state.get("max_volume_id", 0), mvid)
+    return state
+
+
 class RaftNode:
     def __init__(self, address: str, peers: list[str],
                  apply_fn: Callable[[dict], None],
                  state_path: str | None = None,
                  election_timeout: tuple[float, float] = (0.4, 0.8),
-                 heartbeat_interval: float = 0.12):
+                 heartbeat_interval: float = 0.12,
+                 rpc_timeout: float = 0.3):
         self.address = address
         self.peers = [p for p in peers if p != address]
         self.apply_fn = apply_fn
         self.state_path = state_path
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
+        self.rpc_timeout = rpc_timeout
 
-        # persistent state (term, voted_for, log)
+        # persistent state
         self.current_term = 0
         self.voted_for: str | None = None
         self.log: list[LogEntry] = []
+        self.log_start = 0          # absolute index of log[0]
+        self.snapshot_state: dict = {}   # folded commands below log_start
+        self.snapshot_term = 0      # term of entry log_start-1
         self._load()
 
         # volatile
         self.role = FOLLOWER
         self.leader_address: str | None = None
-        self.commit_index = -1
-        self.last_applied = -1
+        self.commit_index = self.log_start - 1
+        self.last_applied = self.log_start - 1
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
+        self._quorum_seen = time.monotonic()
 
         self._lock = threading.RLock()
         self._election_deadline = 0.0
         self._stop = threading.Event()
         self._commit_cv = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, len(self.peers) or 1),
+            thread_name_prefix="raft-rpc")
+
+    # -- absolute index helpers ---------------------------------------------
+    @property
+    def _last_index(self) -> int:
+        return self.log_start + len(self.log) - 1
+
+    def _term_at(self, index: int) -> int:
+        if index == self.log_start - 1:
+            return self.snapshot_term
+        rel = index - self.log_start
+        if 0 <= rel < len(self.log):
+            return self.log[rel].term
+        return 0
+
+    def _entry(self, index: int) -> LogEntry:
+        return self.log[index - self.log_start]
+
+    @property
+    def _quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
 
     # -- persistence ---------------------------------------------------------
     def _load(self) -> None:
@@ -84,6 +126,11 @@ class RaftNode:
             self.voted_for = st.get("voted_for")
             self.log = [LogEntry(e["term"], e["command"])
                         for e in st.get("log", [])]
+            self.log_start = st.get("log_start", 0)
+            self.snapshot_state = st.get("snapshot_state", {})
+            self.snapshot_term = st.get("snapshot_term", 0)
+            if self.snapshot_state:
+                self.apply_fn(dict(self.snapshot_state))
         except Exception as e:  # noqa: BLE001
             log.warning("raft state load: %s", e)
 
@@ -97,9 +144,27 @@ class RaftNode:
         with open(tmp, "w") as f:
             json.dump({"term": self.current_term,
                        "voted_for": self.voted_for,
+                       "log_start": self.log_start,
+                       "snapshot_state": self.snapshot_state,
+                       "snapshot_term": self.snapshot_term,
                        "log": [{"term": e.term, "command": e.command}
                                for e in self.log]}, f)
         os.replace(tmp, self.state_path)
+
+    def _maybe_compact(self) -> None:
+        """Fold committed prefix into the snapshot (caller holds lock).
+        The reference snapshots the FSM the same way — MaxVolumeId only."""
+        committed = self.commit_index - self.log_start + 1
+        if committed <= COMPACT_THRESHOLD:
+            return
+        keep_from = self.commit_index  # keep the last committed entry
+        for i in range(self.log_start, keep_from):
+            self.snapshot_state = _fold(self.snapshot_state,
+                                        self._entry(i).command)
+        self.snapshot_term = self._term_at(keep_from - 1)
+        self.log = self.log[keep_from - self.log_start:]
+        self.log_start = keep_from
+        self._persist()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "RaftNode":
@@ -111,6 +176,7 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     @property
     def is_leader(self) -> bool:
@@ -141,41 +207,43 @@ class RaftNode:
             self.voted_for = self.address
             self._persist()
             term = self.current_term
-            last_idx = len(self.log) - 1
-            last_term = self.log[-1].term if self.log else 0
+            last_idx = self._last_index
+            last_term = self._term_at(last_idx)
             self._reset_election_timer()
         log.info("%s: starting election term %d", self.address, term)
         votes = 1
-        for peer in self.peers:
-            try:
-                resp = self._call(peer, "RequestVote", {
+        futs = {self._pool.submit(self._call, peer, "RequestVote", {
                     "term": term, "candidate": self.address,
-                    "last_log_index": last_idx, "last_log_term": last_term})
-            except Exception:  # noqa: BLE001
-                continue
-            with self._lock:
-                if resp.get("term", 0) > self.current_term:
-                    self._become_follower(resp["term"], None)
-                    return
-                if resp.get("granted") and self.current_term == term:
-                    votes += 1
-        with self._lock:
-            quorum = (len(self.peers) + 1) // 2 + 1
-            if self.role == CANDIDATE and self.current_term == term \
-                    and votes >= quorum:
-                self._become_leader()
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                }): peer for peer in self.peers}
+        try:
+            for fut in as_completed(futs, timeout=self.rpc_timeout * 3):
+                try:
+                    resp = fut.result()
+                except Exception:  # noqa: BLE001
+                    continue
+                with self._lock:
+                    if resp.get("term", 0) > self.current_term:
+                        self._become_follower(resp["term"], None)
+                        return
+                    if resp.get("granted") and self.current_term == term:
+                        votes += 1
+                        if votes >= self._quorum and self.role == CANDIDATE:
+                            self._become_leader()
+                            return
+        except TimeoutError:
+            pass
 
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader_address = self.address
-        n = len(self.log)
+        n = self._last_index + 1
         self.next_index = {p: n for p in self.peers}
         self.match_index = {p: -1 for p in self.peers}
         self._quorum_seen = time.monotonic()
         # no-op entry: commits all prior-term entries immediately (Raft
-        # §8 / the reference raft libraries do the same on election),
-        # closing the window where a replicated max_volume_id from the
-        # old term sits unapplied on the new leader
+        # §8), closing the window where a replicated max_volume_id from
+        # the old term sits unapplied on the new leader
         self.log.append(LogEntry(self.current_term, {}))
         self._persist()
         log.info("%s: LEADER for term %d", self.address, self.current_term)
@@ -193,50 +261,66 @@ class RaftNode:
         self._reset_election_timer()
 
     # -- replication (leader) ------------------------------------------------
+    def _append_args_for(self, peer: str) -> dict:
+        """Build AppendEntries for one peer (caller holds lock). Peers
+        lagging below log_start get the snapshot piggybacked."""
+        ni = self.next_index.get(peer, self._last_index + 1)
+        args = {"term": self.current_term, "leader": self.address,
+                "leader_commit": self.commit_index}
+        if ni < self.log_start:
+            # follower is behind the compaction horizon: install snapshot
+            args["snapshot"] = {"state": self.snapshot_state,
+                                "last_index": self.log_start - 1,
+                                "last_term": self.snapshot_term}
+            ni = self.log_start
+        args["prev_log_index"] = ni - 1
+        args["prev_log_term"] = self._term_at(ni - 1)
+        args["entries"] = [{"term": e.term, "command": e.command}
+                           for e in self.log[ni - self.log_start:]]
+        args["_ni"] = ni
+        return args
+
     def _broadcast_append(self) -> None:
         with self._lock:
             if self.role != LEADER:
                 return
             term = self.current_term
-            commit = self.commit_index
+            per_peer = {p: self._append_args_for(p) for p in self.peers}
+        futs = {}
+        for peer, args in per_peer.items():
+            ni = args.pop("_ni")
+            futs[self._pool.submit(self._call, peer, "AppendEntries",
+                                   args)] = (peer, ni, len(args["entries"]))
         reached = 1
-        for peer in self.peers:
-            with self._lock:
-                ni = self.next_index.get(peer, len(self.log))
-                prev_idx = ni - 1
-                prev_term = (self.log[prev_idx].term
-                             if 0 <= prev_idx < len(self.log) else 0)
-                entries = [{"term": e.term, "command": e.command}
-                           for e in self.log[ni:]]
-            try:
-                resp = self._call(peer, "AppendEntries", {
-                    "term": term, "leader": self.address,
-                    "prev_log_index": prev_idx, "prev_log_term": prev_term,
-                    "entries": entries, "leader_commit": commit})
-            except Exception:  # noqa: BLE001
-                continue
-            with self._lock:
-                if resp.get("term", 0) > self.current_term:
-                    self._become_follower(resp["term"], None)
-                    return
-                reached += 1  # peer answered (success or log mismatch)
-                if resp.get("success"):
-                    self.match_index[peer] = ni + len(entries) - 1
-                    self.next_index[peer] = ni + len(entries)
-                else:
-                    self.next_index[peer] = max(0, ni - 1)
+        try:
+            for fut in as_completed(futs, timeout=self.rpc_timeout * 3):
+                peer, ni, n_entries = futs[fut]
+                try:
+                    resp = fut.result()
+                except Exception:  # noqa: BLE001
+                    continue
+                with self._lock:
+                    if resp.get("term", 0) > self.current_term:
+                        self._become_follower(resp["term"], None)
+                        return
+                    reached += 1
+                    if resp.get("success"):
+                        self.match_index[peer] = ni + n_entries - 1
+                        self.next_index[peer] = ni + n_entries
+                    else:
+                        self.next_index[peer] = max(self.log_start - 1,
+                                                    ni - 1)
+        except TimeoutError:
+            pass
         with self._lock:
             if self.role != LEADER:
                 return
-            quorum_n = (len(self.peers) + 1) // 2 + 1
             now = time.monotonic()
-            if reached >= quorum_n:
+            if reached >= self._quorum:
                 self._quorum_seen = now
-            elif now - getattr(self, "_quorum_seen", now) > \
-                    self.election_timeout[1] * 2:
+            elif now - self._quorum_seen > self.election_timeout[1] * 2:
                 # leader lease lost: a minority-partitioned leader must
-                # stop serving (split-brain guard; the majority side is
-                # free to elect)
+                # stop serving (split-brain guard)
                 log.warning("%s: lost contact with quorum; stepping down",
                             self.address)
                 self.role = FOLLOWER
@@ -245,23 +329,25 @@ class RaftNode:
                 return
             # advance commit: highest index replicated on a quorum with
             # an entry from the current term (Raft §5.4.2)
-            quorum = (len(self.peers) + 1) // 2 + 1
-            for idx in range(len(self.log) - 1, self.commit_index, -1):
-                if self.log[idx].term != self.current_term:
+            for idx in range(self._last_index, self.commit_index, -1):
+                if self._term_at(idx) != self.current_term:
                     break
                 count = 1 + sum(1 for p in self.peers
                                 if self.match_index.get(p, -1) >= idx)
-                if count >= quorum:
+                if count >= self._quorum:
                     self.commit_index = idx
                     self._commit_cv.notify_all()
                     break
             self._apply_committed()
+            self._maybe_compact()
 
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             try:
-                self.apply_fn(self.log[self.last_applied].command)
+                cmd = self._entry(self.last_applied).command
+                if cmd:
+                    self.apply_fn(cmd)
             except Exception as e:  # noqa: BLE001
                 log.error("raft apply %d: %s", self.last_applied, e)
 
@@ -272,7 +358,7 @@ class RaftNode:
                 return False
             self.log.append(LogEntry(self.current_term, command))
             self._persist()
-            idx = len(self.log) - 1
+            idx = self._last_index
         self._broadcast_append()
         deadline = time.monotonic() + timeout
         with self._commit_cv:
@@ -292,17 +378,32 @@ class RaftNode:
                 term=payload["term"], candidate=payload["candidate"],
                 last_log_index=payload["last_log_index"],
                 last_log_term=payload["last_log_term"])
-            r = stub.call(method, req, pb.RequestVoteResponse, timeout=1.0)
+            r = stub.call(method, req, pb.RequestVoteResponse,
+                          timeout=self.rpc_timeout)
             return {"term": r.term, "granted": r.granted}
         req = pb.AppendEntriesRequest(
             term=payload["term"], leader=payload["leader"],
             prev_log_index=payload["prev_log_index"],
             prev_log_term=payload["prev_log_term"],
             leader_commit=payload["leader_commit"])
+        snap = payload.get("snapshot")
         for e in payload["entries"]:
+            cmd = dict(e["command"])
             req.entries.add(term=e["term"],
-                            command=json.dumps(e["command"]).encode())
-        r = stub.call(method, req, pb.AppendEntriesResponse, timeout=1.0)
+                            command=json.dumps(cmd).encode())
+        if snap is not None:
+            # snapshot piggybacks as the first entry with a marker key
+            # (the FSM state is one small dict, so a dedicated
+            # InstallSnapshot RPC would be overkill)
+            first = pb.RaftLogEntry(
+                term=snap["last_term"],
+                command=json.dumps({"__snapshot__": snap}).encode())
+            entries = [first] + list(req.entries)
+            del req.entries[:]
+            for e in entries:
+                req.entries.add(term=e.term, command=e.command)
+        r = stub.call(method, req, pb.AppendEntriesResponse,
+                      timeout=self.rpc_timeout)
         return {"term": r.term, "success": r.success}
 
     def build_service(self) -> RpcService:
@@ -323,13 +424,18 @@ class RaftNode:
         @svc.unary("AppendEntries", pb.AppendEntriesRequest,
                    pb.AppendEntriesResponse)
         def append_entries(req, context):
+            entries = [{"term": e.term,
+                        "command": json.loads(e.command or b"{}")}
+                       for e in req.entries]
+            snapshot = None
+            if entries and "__snapshot__" in entries[0]["command"]:
+                snapshot = entries[0]["command"]["__snapshot__"]
+                entries = entries[1:]
             out = node._on_append_entries({
                 "term": req.term, "leader": req.leader,
                 "prev_log_index": req.prev_log_index,
                 "prev_log_term": req.prev_log_term,
-                "entries": [{"term": e.term,
-                             "command": json.loads(e.command or b"{}")}
-                            for e in req.entries],
+                "entries": entries, "snapshot": snapshot,
                 "leader_commit": req.leader_commit})
             return pb.AppendEntriesResponse(term=out["term"],
                                             success=out["success"])
@@ -344,8 +450,8 @@ class RaftNode:
             granted = False
             if p["term"] == self.current_term and \
                     self.voted_for in (None, p["candidate"]):
-                last_idx = len(self.log) - 1
-                last_term = self.log[-1].term if self.log else 0
+                last_idx = self._last_index
+                last_term = self._term_at(last_idx)
                 up_to_date = (p["last_log_term"], p["last_log_index"]) >= \
                              (last_term, last_idx)
                 if up_to_date:
@@ -360,24 +466,48 @@ class RaftNode:
             if p["term"] < self.current_term:
                 return {"term": self.current_term, "success": False}
             self._become_follower(p["term"], p["leader"])
+            if p.get("snapshot"):
+                snap = p["snapshot"]
+                self.snapshot_state = dict(snap["state"])
+                self.snapshot_term = snap["last_term"]
+                self.log = []
+                self.log_start = snap["last_index"] + 1
+                self.commit_index = max(self.commit_index,
+                                        snap["last_index"])
+                self.last_applied = max(self.last_applied,
+                                        snap["last_index"])
+                if self.snapshot_state:
+                    self.apply_fn(dict(self.snapshot_state))
+                self._persist()
             prev_idx = p["prev_log_index"]
-            if prev_idx >= 0:
-                if prev_idx >= len(self.log) or \
-                        self.log[prev_idx].term != p["prev_log_term"]:
+            if prev_idx >= self.log_start - 1:
+                if prev_idx > self._last_index or \
+                        (prev_idx >= self.log_start
+                         and self._term_at(prev_idx) != p["prev_log_term"]) \
+                        or (prev_idx == self.log_start - 1
+                            and self.snapshot_term
+                            and p["prev_log_term"] != self.snapshot_term):
                     return {"term": self.current_term, "success": False}
+            else:
+                # our snapshot is ahead of the leader's prev: stale rpc
+                return {"term": self.current_term, "success": False}
             # append, truncating conflicts
             at = prev_idx + 1
+            changed = False
             for i, e in enumerate(p["entries"]):
                 idx = at + i
-                if idx < len(self.log):
-                    if self.log[idx].term != e["term"]:
-                        del self.log[idx:]
+                rel = idx - self.log_start
+                if rel < len(self.log):
+                    if self.log[rel].term != e["term"]:
+                        del self.log[rel:]
                         self.log.append(LogEntry(e["term"], e["command"]))
+                        changed = True
                 else:
                     self.log.append(LogEntry(e["term"], e["command"]))
-            if p["entries"]:
+                    changed = True
+            if changed:
                 self._persist()
             if p["leader_commit"] > self.commit_index:
-                self.commit_index = min(p["leader_commit"], len(self.log) - 1)
+                self.commit_index = min(p["leader_commit"], self._last_index)
                 self._apply_committed()
             return {"term": self.current_term, "success": True}

@@ -1,0 +1,14 @@
+"""Message queue (reference weed/mq, 6,379 LoC — SURVEY.md §2.7).
+
+Topics split into partitions over a 4096-slot ring (mq/topic/
+partition.go); brokers register in the master cluster and own partition
+ranges (pub_balancer/balancer.go); pub/sub are gRPC streams with acked
+offsets (broker/broker_grpc_pub.go, _sub.go); closed segments persist
+through the filer under /topics/<ns>/<topic>/.
+"""
+
+from .topic import Partition, TopicRef, partition_for_key, split_ring
+from .broker import BrokerServer
+
+__all__ = ["TopicRef", "Partition", "partition_for_key", "split_ring",
+           "BrokerServer"]

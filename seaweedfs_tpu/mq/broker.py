@@ -1,0 +1,304 @@
+"""MQ broker daemon (reference weed/mq/broker).
+
+gRPC service `swtpu.mq.Broker`: ConfigureTopic / LookupTopicBrokers /
+ListTopics / Publish (stream) / Subscribe (stream). Partition logs are
+in-memory lists with length-prefixed segment flushes into the filer at
+/topics/<ns>/<topic>/<range>/seg-<n> (reference persists segments via
+the filer the same way, broker_server.go) — a broker restart replays
+persisted segments. Multiple brokers register in the master cluster
+(client_type "broker", reference cluster.go:104); partition ownership is
+deterministic over the sorted live-broker list so every broker answers
+lookups identically (pub_balancer/balancer.go re-designed without the
+coordinator: ownership = hash-ordered assignment).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+
+from ..client.master_client import MasterClient
+from ..pb import mq_pb2 as mq
+from ..utils.log import logger
+from ..utils.rpc import RpcService, serve
+from .topic import Partition, TopicRef, split_ring
+
+log = logger("mq.broker")
+
+MQ_SERVICE = "swtpu.mq.Broker"
+SEGMENT_FLUSH_COUNT = 1000  # messages per persisted segment
+
+
+class PartitionLog:
+    """One partition's message log: in-memory tail + filer segments."""
+
+    def __init__(self, topic: TopicRef, partition: Partition, filer=None):
+        self.topic = topic
+        self.partition = partition
+        self.filer = filer
+        self.messages: list[tuple[bytes, bytes, int]] = []  # key, value, ts
+        self.base_offset = 0  # offset of messages[0]
+        self._flushed_segments = 0
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        if filer is not None:
+            self._replay()
+
+    # -- persistence ---------------------------------------------------------
+    @property
+    def _dir(self) -> str:
+        return (f"/topics/{self.topic.namespace}/{self.topic.name}/"
+                f"{self.partition.range_start:04d}-"
+                f"{self.partition.range_stop:04d}")
+
+    def _segment_path(self, n: int) -> str:
+        return f"{self._dir}/seg-{n:06d}"
+
+    def _replay(self) -> None:
+        """Reload persisted segments on startup (broker restart)."""
+        from ..filer.filer import split_path
+        n = 0
+        while True:
+            d, name = split_path(self._segment_path(n))
+            entry = self.filer.filer.find_entry(d, name)
+            if entry is None:
+                break
+            data = self.filer.read_entry_bytes(entry)
+            pos = 0
+            while pos + 4 <= len(data):
+                (ln,) = struct.unpack_from("<I", data, pos)
+                pos += 4
+                rec = data[pos:pos + ln]
+                pos += ln
+                klen = struct.unpack_from("<I", rec, 0)[0]
+                key = rec[4:4 + klen]
+                ts = struct.unpack_from("<q", rec, 4 + klen)[0]
+                value = rec[12 + klen:]
+                self.messages.append((key, value, ts))
+            n += 1
+        self._flushed_segments = n
+        if n:
+            log.info("%s %s: replayed %d segments, %d messages",
+                     self.topic, self.partition, n, len(self.messages))
+
+    def _maybe_flush(self) -> None:
+        """Persist a full segment (caller holds the lock)."""
+        if self.filer is None:
+            return
+        flushed_msgs = self._flushed_segments * SEGMENT_FLUSH_COUNT
+        while len(self.messages) - (flushed_msgs - self.base_offset) \
+                >= SEGMENT_FLUSH_COUNT:
+            start = flushed_msgs - self.base_offset
+            batch = self.messages[start:start + SEGMENT_FLUSH_COUNT]
+            blob = bytearray()
+            for key, value, ts in batch:
+                rec = (struct.pack("<I", len(key)) + key
+                       + struct.pack("<q", ts) + value)
+                blob += struct.pack("<I", len(rec)) + rec
+            self.filer.write_file(
+                self._segment_path(self._flushed_segments), bytes(blob),
+                mime="application/octet-stream")
+            self._flushed_segments += 1
+            flushed_msgs += SEGMENT_FLUSH_COUNT
+
+    # -- log ops -------------------------------------------------------------
+    def append(self, key: bytes, value: bytes, ts_ns: int) -> int:
+        with self._lock:
+            self.messages.append((key, value, ts_ns))
+            offset = self.base_offset + len(self.messages) - 1
+            self._maybe_flush()
+            self._cv.notify_all()
+            return offset
+
+    @property
+    def next_offset(self) -> int:
+        with self._lock:
+            return self.base_offset + len(self.messages)
+
+    def read(self, offset: int, max_count: int = 256
+             ) -> list[tuple[int, bytes, bytes, int]]:
+        with self._lock:
+            start = max(0, offset - self.base_offset)
+            out = []
+            for i, (k, v, ts) in enumerate(
+                    self.messages[start:start + max_count]):
+                out.append((self.base_offset + start + i, k, v, ts))
+            return out
+
+    def wait_for(self, offset: int, timeout: float) -> bool:
+        with self._cv:
+            if self.base_offset + len(self.messages) > offset:
+                return True
+            self._cv.wait(timeout)
+            return self.base_offset + len(self.messages) > offset
+
+
+class BrokerServer:
+    def __init__(self, master_address: str, ip: str = "127.0.0.1",
+                 port: int = 17777, filer_server=None):
+        self.ip, self.port = ip, port
+        self.filer = filer_server  # optional persistence
+        self.mc = MasterClient(master_address, client_type="broker",
+                               client_address=f"{ip}:{port}")
+        self.topics: dict[str, list[Partition]] = {}
+        self.logs: dict[tuple[str, int], PartitionLog] = {}
+        self._lock = threading.Lock()
+        self._grpc = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> "BrokerServer":
+        self.mc.start()
+        self._grpc = serve(f"{self.ip}:{self.port}", [self._build_service()])
+        log.info("mq broker %s up", self.address)
+        return self
+
+    def stop(self) -> None:
+        self.mc.stop()
+        if self._grpc:
+            self._grpc.stop(grace=0.5)
+
+    # -- topic/partition state ----------------------------------------------
+    def _log_for(self, tref: TopicRef, partition: Partition) -> PartitionLog:
+        key = (str(tref), partition.range_start)
+        with self._lock:
+            lg = self.logs.get(key)
+            if lg is None:
+                lg = PartitionLog(tref, partition, self.filer)
+                self.logs[key] = lg
+            return lg
+
+    def configure_topic(self, tref: TopicRef,
+                        partition_count: int) -> list[Partition]:
+        parts = split_ring(max(1, partition_count))
+        with self._lock:
+            self.topics[str(tref)] = parts
+        if self.filer is not None:
+            import json
+            self.filer.write_file(
+                f"/topics/{tref.namespace}/{tref.name}/topic.conf",
+                json.dumps({"partition_count": len(parts)}).encode(),
+                mime="application/json")
+        return parts
+
+    def _topic_partitions(self, tref: TopicRef) -> list[Partition] | None:
+        parts = self.topics.get(str(tref))
+        if parts is not None:
+            return parts
+        if self.filer is not None:
+            import json
+
+            from ..filer.filer import split_path
+            d, n = split_path(
+                f"/topics/{tref.namespace}/{tref.name}/topic.conf")
+            entry = self.filer.filer.find_entry(d, n)
+            if entry is not None:
+                cnt = json.loads(
+                    self.filer.read_entry_bytes(entry))["partition_count"]
+                parts = split_ring(cnt)
+                with self._lock:
+                    self.topics[str(tref)] = parts
+                return parts
+        return None
+
+    # -- gRPC ----------------------------------------------------------------
+    def _build_service(self) -> RpcService:
+        svc = RpcService(MQ_SERVICE)
+        broker = self
+
+        def tref_of(t: mq.Topic) -> TopicRef:
+            return TopicRef(t.namespace or "default", t.name)
+
+        def part_of(p: mq.Partition) -> Partition:
+            return Partition(p.range_start, p.range_stop,
+                             p.ring_size or 4096)
+
+        @svc.unary("ConfigureTopic", mq.ConfigureTopicRequest,
+                   mq.ConfigureTopicResponse)
+        def configure(req, ctx):
+            parts = broker.configure_topic(tref_of(req.topic),
+                                           req.partition_count or 1)
+            resp = mq.ConfigureTopicResponse()
+            for p in parts:
+                a = resp.assignments.add(leader_broker=broker.address)
+                a.partition.range_start = p.range_start
+                a.partition.range_stop = p.range_stop
+                a.partition.ring_size = p.ring_size
+            return resp
+
+        @svc.unary("LookupTopicBrokers", mq.LookupTopicBrokersRequest,
+                   mq.LookupTopicBrokersResponse)
+        def lookup(req, ctx):
+            tref = tref_of(req.topic)
+            parts = broker._topic_partitions(tref)
+            if parts is None:
+                ctx.abort(5, f"topic {tref} not found")
+            resp = mq.LookupTopicBrokersResponse()
+            resp.topic.CopyFrom(req.topic)
+            for p in parts:
+                a = resp.assignments.add(leader_broker=broker.address)
+                a.partition.range_start = p.range_start
+                a.partition.range_stop = p.range_stop
+                a.partition.ring_size = p.ring_size
+            return resp
+
+        @svc.unary("ListTopics", mq.ListTopicsRequest, mq.ListTopicsResponse)
+        def list_topics(req, ctx):
+            resp = mq.ListTopicsResponse()
+            with broker._lock:
+                names = sorted(broker.topics)
+            for full in names:
+                ns, _, name = full.partition(".")
+                resp.topics.add(namespace=ns, name=name)
+            return resp
+
+        @svc.stream_stream("Publish", mq.PublishRequest, mq.PublishResponse)
+        def publish(request_iter, ctx):
+            """Reference broker_grpc_pub.go: first message is init,
+            then data; each append acks with its offset."""
+            lg = None
+            for req in request_iter:
+                if req.HasField("init"):
+                    tref = tref_of(req.init.topic)
+                    if broker._topic_partitions(tref) is None:
+                        broker.configure_topic(tref, 1)
+                    lg = broker._log_for(tref, part_of(req.init.partition))
+                    continue
+                if lg is None:
+                    yield mq.PublishResponse(error="publish before init")
+                    return
+                ts = req.data.ts_ns or time.time_ns()
+                off = lg.append(bytes(req.data.key),
+                                bytes(req.data.value), ts)
+                yield mq.PublishResponse(ack_sequence=off)
+
+        @svc.unary_stream("Subscribe", mq.SubscribeRequest,
+                          mq.SubscribeResponse)
+        def subscribe(req, ctx):
+            """Reference broker_grpc_sub.go: replay from offset, then
+            follow if requested."""
+            init = req.init
+            tref = tref_of(init.topic)
+            if broker._topic_partitions(tref) is None:
+                ctx.abort(5, f"topic {tref} not found")
+            lg = broker._log_for(tref, part_of(init.partition))
+            offset = (lg.next_offset if init.start_offset < 0
+                      else init.start_offset)
+            while ctx.is_active():
+                batch = lg.read(offset)
+                for off, k, v, ts in batch:
+                    resp = mq.SubscribeResponse(offset=off)
+                    resp.data.key, resp.data.value = k, v
+                    resp.data.ts_ns = ts
+                    yield resp
+                    offset = off + 1
+                if not batch:
+                    if not init.follow:
+                        yield mq.SubscribeResponse(is_end_of_stream=True)
+                        return
+                    lg.wait_for(offset, timeout=0.5)
+
+        return svc

@@ -1,0 +1,114 @@
+"""MQ client: publisher + subscriber over the broker gRPC
+(reference weed/mq/client: pub_client / sub_client)."""
+
+from __future__ import annotations
+
+import queue
+import time
+
+from ..pb import mq_pb2 as mq
+from ..utils.rpc import Stub
+from .broker import MQ_SERVICE
+from .topic import Partition, TopicRef, partition_for_key, split_ring
+
+
+class Publisher:
+    def __init__(self, broker_address: str, namespace: str, topic: str,
+                 partition_count: int = 1):
+        self.stub = Stub(broker_address, MQ_SERVICE)
+        self.tref = TopicRef(namespace, topic)
+        resp = self.stub.call("ConfigureTopic", _configure_req(
+            self.tref, partition_count), mq.ConfigureTopicResponse)
+        self.partitions = [Partition(a.partition.range_start,
+                                     a.partition.range_stop,
+                                     a.partition.ring_size)
+                           for a in resp.assignments]
+        self._queues: dict[int, queue.Queue] = {}
+        self._streams: dict[int, object] = {}
+
+    def _stream_for(self, p: Partition):
+        if p.range_start in self._streams:
+            return (self._queues[p.range_start],
+                    self._streams[p.range_start])
+        q: queue.Queue = queue.Queue()
+
+        def reqs():
+            init = mq.PublishRequest()
+            init.init.topic.namespace = self.tref.namespace
+            init.init.topic.name = self.tref.name
+            init.init.partition.range_start = p.range_start
+            init.init.partition.range_stop = p.range_stop
+            init.init.partition.ring_size = p.ring_size
+            yield init
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+
+        stream = self.stub.stream_stream("Publish", reqs(),
+                                         mq.PublishRequest,
+                                         mq.PublishResponse)
+        self._queues[p.range_start] = q
+        self._streams[p.range_start] = iter(stream)
+        return q, self._streams[p.range_start]
+
+    def publish(self, key: bytes, value: bytes) -> int:
+        """Send one message; returns the acked partition offset."""
+        p = partition_for_key(key, self.partitions)
+        q, stream = self._stream_for(p)
+        req = mq.PublishRequest()
+        req.data.key, req.data.value = key, value
+        req.data.ts_ns = time.time_ns()
+        q.put(req)
+        ack = next(stream)
+        if ack.error:
+            raise RuntimeError(ack.error)
+        return ack.ack_sequence
+
+    def close(self) -> None:
+        for q in self._queues.values():
+            q.put(None)
+
+
+def _configure_req(tref: TopicRef, n: int) -> mq.ConfigureTopicRequest:
+    req = mq.ConfigureTopicRequest(partition_count=n)
+    req.topic.namespace = tref.namespace
+    req.topic.name = tref.name
+    return req
+
+
+def subscribe(broker_address: str, namespace: str, topic: str,
+              start_offset: int = 0, follow: bool = False,
+              partition: Partition | None = None):
+    """Yield (offset, key, value) from one partition (default: the whole
+    ring when the topic has a single partition)."""
+    stub = Stub(broker_address, MQ_SERVICE)
+    tref = TopicRef(namespace, topic)
+    if partition is None:
+        resp = stub.call("LookupTopicBrokers",
+                         _lookup_req(tref), mq.LookupTopicBrokersResponse)
+        a = resp.assignments[0]
+        partition = Partition(a.partition.range_start,
+                              a.partition.range_stop,
+                              a.partition.ring_size)
+    req = mq.SubscribeRequest()
+    req.init.topic.namespace = tref.namespace
+    req.init.topic.name = tref.name
+    req.init.partition.range_start = partition.range_start
+    req.init.partition.range_stop = partition.range_stop
+    req.init.partition.ring_size = partition.ring_size
+    req.init.start_offset = start_offset
+    req.init.follow = follow
+    for resp in stub.call_stream("Subscribe", req, mq.SubscribeResponse,
+                                 timeout=3600):
+        if resp.is_end_of_stream:
+            return
+        yield resp.offset, bytes(resp.data.key), bytes(resp.data.value)
+
+
+def _lookup_req(tref: TopicRef) -> mq.LookupTopicBrokersRequest:
+    req = mq.LookupTopicBrokersRequest()
+    req.topic.namespace = tref.namespace
+    req.topic.name = tref.name
+    return req

@@ -627,9 +627,15 @@ class VolumeServer:
         @svc.unary("VolumeEcShardsCopy", vpb.VolumeEcShardsCopyRequest,
                    vpb.VolumeEcShardsCopyResponse)
         def ec_copy(req, context):
-            """Pull shard files FROM source_data_node to this server."""
+            """Pull shard files FROM source_data_node to this server.
+            All of a volume's shard files stay in ONE location: prefer
+            the location already holding its .ecx."""
             src = Stub(req.source_data_node, VOLUME_SERVICE)
-            loc = store._location_for(None)
+            loc = next((l for l in store.locations
+                        if os.path.exists(
+                            l.base_name(req.collection,
+                                        req.volume_id) + ".ecx")),
+                       None) or store._location_for(None)
             base = loc.base_name(req.collection, req.volume_id)
             exts = [ec_files.shard_ext(s) for s in req.shard_ids]
             if req.copy_ecx_file:
@@ -742,10 +748,13 @@ class VolumeServer:
         def ec_move(req, context):
             # first shards of this volume on this server need the index
             # sidecars too (reference copies .ecx/.vif on first placement,
-            # command_ec_encode.go parallelCopyEcShardsFromSource)
-            loc = store._location_for(None)
-            base = loc.base_name(req.collection, req.volume_id)
-            need_sidecars = not os.path.exists(base + ".ecx")
+            # command_ec_encode.go parallelCopyEcShardsFromSource);
+            # look in EVERY location — existing shards may live on a
+            # different disk than the emptiest one
+            need_sidecars = not any(
+                os.path.exists(loc.base_name(req.collection,
+                                             req.volume_id) + ".ecx")
+                for loc in store.locations)
             ec_copy(vpb.VolumeEcShardsCopyRequest(
                 volume_id=req.volume_id, collection=req.collection,
                 shard_ids=req.shard_ids,
