@@ -166,10 +166,13 @@ def run_server(argv):
 
 
 def run_shell(argv):
-    from .shell import ec_commands, volume_commands  # noqa: F401 (register)
+    from .shell import (ec_commands, fs_commands,  # noqa: F401 (register)
+                        volume_commands)
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-filer", default="",
+                   help="default filer host:port for fs.* commands")
     p.add_argument("-jwtSigningKey", default="",
                    help="cluster signing key for gRPC auth")
     p.add_argument("-c", dest="script", default="",
@@ -179,6 +182,8 @@ def run_shell(argv):
         from .utils.rpc import set_cluster_key
         set_cluster_key(opt.jwtSigningKey)
     env = CommandEnv(opt.master)
+    if opt.filer:
+        env.option["filer"] = opt.filer
     if opt.script:
         for line in opt.script.split(";"):
             if not run_command(env, line):

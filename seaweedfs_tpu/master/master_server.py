@@ -399,6 +399,29 @@ class MasterServer:
                 resp.collections.add(name=c)
             return resp
 
+        @svc.unary("CollectionDelete", pb.CollectionDeleteRequest,
+                   pb.CollectionDeleteResponse)
+        def collection_delete(req, context):
+            """Delete every volume of a collection on every holder
+            (reference master_grpc_server_collection.go)."""
+            from ..pb import volume_server_pb2 as vpb
+            targets = []
+            with ms.topo.lock:
+                for node in ms.topo.all_nodes():
+                    for v in node.all_volumes():
+                        if v.collection == req.name:
+                            targets.append((node, v.id))
+            for node, vid in targets:
+                try:
+                    Stub(node.grpc_address, VOLUME_SERVICE).call(
+                        "VolumeDelete",
+                        vpb.VolumeDeleteRequest(volume_id=vid),
+                        vpb.VolumeDeleteResponse)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("collection delete vid=%d on %s: %s",
+                                vid, node.id, e)
+            return pb.CollectionDeleteResponse()
+
         @svc.unary("EcCollectList", pb.EcCollectListRequest,
                    pb.EcCollectListResponse)
         def ec_collect_list(req, context):  # fork RPC (master.proto:28)

@@ -535,6 +535,29 @@ class VolumeServer:
             vs.trigger_heartbeat()
             return vpb.VolumeMarkWritableResponse()
 
+        @svc.unary("VolumeConfigure", vpb.VolumeConfigureRequest,
+                   vpb.VolumeConfigureResponse)
+        def vol_configure(req, context):
+            """Rewrite the super block's replica placement (reference
+            volume_grpc_admin.go VolumeConfigure)."""
+            from ..storage.types import ReplicaPlacement
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                return vpb.VolumeConfigureResponse(
+                    error=f"volume {req.volume_id} not found")
+            try:
+                rp = ReplicaPlacement.parse(req.replication)
+            except Exception as e:  # noqa: BLE001
+                return vpb.VolumeConfigureResponse(error=str(e))
+            with v._lock:
+                v.super_block.replica_placement = rp
+                if v.remote_spec is None:
+                    v._dat.seek(0)
+                    v._dat.write(v.super_block.to_bytes())
+                    v._dat.flush()
+            vs.trigger_heartbeat()
+            return vpb.VolumeConfigureResponse()
+
         @svc.unary("VolumeStatus", vpb.VolumeStatusRequest, vpb.VolumeStatusResponse)
         def vol_status(req, context):
             v = store.find_volume(req.volume_id)

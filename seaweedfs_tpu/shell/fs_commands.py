@@ -1,0 +1,270 @@
+"""fs.* / s3.bucket.* / volume.fsck shell commands over the filer.
+
+Reference: weed/shell command_fs_ls.go, command_fs_cat.go,
+command_fs_du.go, command_fs_mkdir.go, command_fs_rm.go,
+command_fs_verify.go:54 (read every chunk of every entry),
+command_volume_fsck.go:81 (filer chunk refs vs volume needles),
+command_s3_bucket_*.go. Filer discovery: `-filer host:port` per command
+or the shell-wide default (reference resolves filers from the master
+cluster list).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..pb import filer_pb2 as fpb
+from ..storage.types import parse_file_id
+from ..utils.rpc import FILER_SERVICE, Stub
+from .commands import CommandEnv, command
+
+BUCKETS_DIR = "/buckets"
+
+
+def _filer_addr(env: CommandEnv, opt_filer: str) -> str:
+    addr = opt_filer or env.option.get("filer", "")
+    if not addr:
+        raise RuntimeError("no filer configured; pass -filer host:port")
+    return addr
+
+
+def _filer_grpc(addr: str) -> str:
+    host, _, port = addr.rpartition(":")
+    return f"{host}:{int(port) + 10000}"  # FilerServer grpc convention
+
+
+def _filer_stub(env: CommandEnv, opt_filer: str) -> Stub:
+    return Stub(_filer_grpc(_filer_addr(env, opt_filer)), FILER_SERVICE)
+
+
+def _list_entries(stub: Stub, directory: str):
+    for resp in stub.call_stream(
+            "ListEntries", fpb.ListEntriesRequest(directory=directory),
+            fpb.ListEntriesResponse):
+        yield resp.entry
+
+
+def _walk(stub: Stub, directory: str):
+    """Depth-first (path, entry) walk of the filer namespace."""
+    for e in _list_entries(stub, directory):
+        path = (directory.rstrip("/") + "/" + e.name) \
+            if directory != "/" else "/" + e.name
+        yield path, e
+        if e.is_directory:
+            yield from _walk(stub, path)
+
+
+def _fs_parser(prog: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog)
+    p.add_argument("-filer", default="")
+    return p
+
+
+@command("fs.ls", "list a filer directory")
+def cmd_fs_ls(env: CommandEnv, args):
+    p = _fs_parser("fs.ls")
+    p.add_argument("-l", dest="long", action="store_true")
+    p.add_argument("path", nargs="?", default="/")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    for e in _list_entries(stub, opt.path.rstrip("/") or "/"):
+        if opt.long:
+            kind = "d" if e.is_directory else "-"
+            size = e.attributes.file_size
+            env.println(f"{kind} {size:>12d} {e.name}")
+        else:
+            env.println(e.name + ("/" if e.is_directory else ""))
+
+
+@command("fs.cat", "print a filer file's content")
+def cmd_fs_cat(env: CommandEnv, args):
+    import requests
+
+    p = _fs_parser("fs.cat")
+    p.add_argument("path")
+    opt = p.parse_args(args)
+    addr = _filer_addr(env, opt.filer)
+    r = requests.get(f"http://{addr}{opt.path}", timeout=60)
+    if r.status_code != 200:
+        env.println(f"error: HTTP {r.status_code}")
+        return
+    env.out.write(r.text)
+
+
+@command("fs.du", "disk usage of a filer subtree")
+def cmd_fs_du(env: CommandEnv, args):
+    p = _fs_parser("fs.du")
+    p.add_argument("path", nargs="?", default="/")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    total_bytes = 0
+    file_count = 0
+    dir_count = 0
+    for _path, e in _walk(stub, opt.path.rstrip("/") or "/"):
+        if e.is_directory:
+            dir_count += 1
+        else:
+            file_count += 1
+            total_bytes += e.attributes.file_size
+    env.println(f"{total_bytes} bytes, {file_count} files, "
+                f"{dir_count} dirs under {opt.path}")
+
+
+@command("fs.mkdir", "create a filer directory")
+def cmd_fs_mkdir(env: CommandEnv, args):
+    p = _fs_parser("fs.mkdir")
+    p.add_argument("path")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    path = opt.path.rstrip("/")
+    d, _, n = path.rpartition("/")
+    req = fpb.CreateEntryRequest(directory=d or "/")
+    req.entry.name = n
+    req.entry.is_directory = True
+    req.entry.attributes.file_mode = 0o755
+    resp = stub.call("CreateEntry", req, fpb.CreateEntryResponse)
+    env.println(resp.error or f"created {path}")
+
+
+@command("fs.rm", "remove a filer file or directory")
+def cmd_fs_rm(env: CommandEnv, args):
+    p = _fs_parser("fs.rm")
+    p.add_argument("-r", dest="recursive", action="store_true")
+    p.add_argument("path")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    path = opt.path.rstrip("/")
+    d, _, n = path.rpartition("/")
+    resp = stub.call("DeleteEntry", fpb.DeleteEntryRequest(
+        directory=d or "/", name=n, is_delete_data=True,
+        is_recursive=opt.recursive), fpb.DeleteEntryResponse)
+    env.println(resp.error or f"removed {path}")
+
+
+@command("fs.verify", "read every chunk of every entry; report breakage")
+def cmd_fs_verify(env: CommandEnv, args):
+    """Reference command_fs_verify.go:54."""
+    import requests
+
+    p = _fs_parser("fs.verify")
+    p.add_argument("path", nargs="?", default="/")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    ok = bad = 0
+    for path, e in _walk(stub, opt.path.rstrip("/") or "/"):
+        if e.is_directory:
+            continue
+        for c in e.chunks:
+            try:
+                urls = env.mc.lookup_file_id(c.file_id)
+                good = False
+                for u in urls:
+                    r = requests.get(u, timeout=10)
+                    if r.status_code == 200:
+                        good = True
+                        break
+                if good:
+                    ok += 1
+                else:
+                    bad += 1
+                    env.println(f"BROKEN {path} chunk {c.file_id}")
+            except Exception as ex:  # noqa: BLE001
+                bad += 1
+                env.println(f"BROKEN {path} chunk {c.file_id}: {ex}")
+    env.println(f"verified {ok} chunks ok, {bad} broken")
+
+
+@command("volume.fsck", "cross-check filer chunk refs against volume needles")
+def cmd_volume_fsck(env: CommandEnv, args):
+    """Reference command_volume_fsck.go:81: finds filer references to
+    missing needles, and (with -findOrphanData) needles no filer entry
+    references."""
+    from ..pb import volume_server_pb2 as vpb
+    from ..utils.rpc import VOLUME_SERVICE
+
+    p = _fs_parser("volume.fsck")
+    p.add_argument("-findOrphanData", action="store_true")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    # collect all referenced (vid, key) pairs from the filer
+    refs: dict[int, set[int]] = {}
+    for _path, e in _walk(stub, "/"):
+        for c in e.chunks:
+            try:
+                vid, key, _ = parse_file_id(c.file_id)
+            except ValueError:
+                continue
+            refs.setdefault(vid, set()).add(key)
+    missing = 0
+    for vid, keys in sorted(refs.items()):
+        locs = env.mc.lookup(vid)
+        if not locs:
+            env.println(f"volume {vid}: no locations "
+                        f"({len(keys)} refs dangling)")
+            missing += len(keys)
+            continue
+        addr = f"{locs[0]['url'].rsplit(':', 1)[0]}:{locs[0]['grpc_port']}"
+        vstub = Stub(addr, VOLUME_SERVICE)
+        for key in sorted(keys):
+            try:
+                vstub.call("VolumeNeedleStatus",
+                           vpb.VolumeNeedleStatusRequest(
+                               volume_id=vid, needle_id=key),
+                           vpb.VolumeNeedleStatusResponse)
+            except Exception:  # noqa: BLE001
+                env.println(f"missing needle {vid},{key:x}")
+                missing += 1
+    env.println(f"fsck: {sum(len(k) for k in refs.values())} refs checked, "
+                f"{missing} missing")
+    if opt.findOrphanData:
+        orphans = 0
+        for srv in env.collect_volume_servers():
+            for disk in srv["disks"].values():
+                for v in disk.volume_infos:
+                    have = refs.get(v.id, set())
+                    if v.file_count > len(have):
+                        orphans += v.file_count - len(have)
+                        env.println(
+                            f"volume {v.id} on {srv['id']}: "
+                            f"{v.file_count - len(have)} orphan needles")
+        env.println(f"fsck: ~{orphans} orphan needles")
+
+
+@command("s3.bucket.list", "list buckets")
+def cmd_s3_bucket_list(env: CommandEnv, args):
+    p = _fs_parser("s3.bucket.list")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    try:
+        for e in _list_entries(stub, BUCKETS_DIR):
+            if e.is_directory and not e.name.startswith("."):
+                env.println(e.name)
+    except Exception:  # noqa: BLE001
+        env.println("(no buckets)")
+
+
+@command("s3.bucket.create", "create a bucket")
+def cmd_s3_bucket_create(env: CommandEnv, args):
+    p = _fs_parser("s3.bucket.create")
+    p.add_argument("-name", required=True)
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    req = fpb.CreateEntryRequest(directory=BUCKETS_DIR)
+    req.entry.name = opt.name
+    req.entry.is_directory = True
+    req.entry.attributes.file_mode = 0o755
+    resp = stub.call("CreateEntry", req, fpb.CreateEntryResponse)
+    env.println(resp.error or f"created bucket {opt.name}")
+
+
+@command("s3.bucket.delete", "delete a bucket and its objects")
+def cmd_s3_bucket_delete(env: CommandEnv, args):
+    p = _fs_parser("s3.bucket.delete")
+    p.add_argument("-name", required=True)
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    stub = _filer_stub(env, opt.filer)
+    resp = stub.call("DeleteEntry", fpb.DeleteEntryRequest(
+        directory=BUCKETS_DIR, name=opt.name, is_delete_data=True,
+        is_recursive=True), fpb.DeleteEntryResponse)
+    env.println(resp.error or f"deleted bucket {opt.name}")

@@ -298,3 +298,193 @@ def cmd_volume_tier_download(env: CommandEnv, args):
                          vpb.VolumeTierMoveDatFromRemoteResponse,
                          timeout=600)
         env.println(f"{h['id']}: downloaded {resp.processed} bytes")
+
+
+@command("volume.configure.replication",
+         "change a volume's replication setting on all holders")
+def cmd_volume_configure_replication(env: CommandEnv, args):
+    """Reference shell/command_volume_configure_replication.go ->
+    VolumeConfigure RPC."""
+    p = argparse.ArgumentParser(prog="volume.configure.replication")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-replication", required=True)
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    holders = _volume_holders(env, opt.volumeId)
+    if not holders:
+        env.println(f"volume {opt.volumeId} not found")
+        return
+    for h in holders:
+        resp = _vs_stub(env, h["id"], h["grpc_port"]).call(
+            "VolumeConfigure", vpb.VolumeConfigureRequest(
+                volume_id=opt.volumeId, replication=opt.replication),
+            vpb.VolumeConfigureResponse)
+        env.println(f"{h['id']}: {resp.error or 'ok'}")
+
+
+@command("collection.delete", "delete a collection and all its volumes",
+         needs_lock=True)
+def cmd_collection_delete(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="collection.delete")
+    p.add_argument("-collection", required=True)
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    from ..utils.rpc import MASTER_SERVICE
+    Stub(env.mc.leader, MASTER_SERVICE).call(
+        "CollectionDelete", mpb.CollectionDeleteRequest(name=opt.collection),
+        mpb.CollectionDeleteResponse)
+    env.println(f"deleted collection {opt.collection!r}")
+
+
+@command("volume.server.evacuate",
+         "move every volume and EC shard off one server", needs_lock=True)
+def cmd_volume_server_evacuate(env: CommandEnv, args):
+    """Reference shell/command_volume_server_evacuate.go: drain a server
+    before decommissioning."""
+    p = argparse.ArgumentParser(prog="volume.server.evacuate")
+    p.add_argument("-node", required=True, help="volume server id ip:port")
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    servers = env.collect_volume_servers()
+    src = next((s for s in servers if s["id"] == opt.node), None)
+    if src is None:
+        env.println(f"server {opt.node} not found")
+        return
+    others = [s for s in servers if s["id"] != opt.node]
+    if not others:
+        env.println("no other servers to evacuate to")
+        return
+    src_addr = env.grpc_addr(src["id"], src["grpc_port"])
+    moved = 0
+    rr = 0
+    for disk in src["disks"].values():
+        for v in disk.volume_infos:
+            # skip volumes whose replicas already live elsewhere
+            dst = others[rr % len(others)]
+            rr += 1
+            _vs_stub(env, dst["id"], dst["grpc_port"]).call(
+                "VolumeCopy", vpb.VolumeCopyRequest(
+                    volume_id=v.id, collection=v.collection,
+                    source_data_node=src_addr),
+                vpb.VolumeCopyResponse, timeout=600)
+            _vs_stub(env, src["id"], src["grpc_port"]).call(
+                "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=v.id),
+                vpb.VolumeDeleteResponse)
+            env.println(f"moved volume {v.id} -> {dst['id']}")
+            moved += 1
+        for s in disk.ec_shard_infos:
+            sids = [i for i in range(32) if s.ec_index_bits >> i & 1]
+            dst = others[rr % len(others)]
+            rr += 1
+            _vs_stub(env, dst["id"], dst["grpc_port"]).call(
+                "VolumeEcShardsMove", vpb.VolumeEcShardsMoveRequest(
+                    volume_id=s.id, collection=s.collection,
+                    shard_ids=sids, source_data_node=src_addr),
+                vpb.VolumeEcShardsMoveResponse, timeout=600)
+            env.println(f"moved ec shards {sids} of {s.id} -> {dst['id']}")
+            moved += 1
+    env.println(f"evacuated {moved} volumes/shard-groups off {opt.node}")
+
+
+@command("cluster.ps", "show cluster processes")
+def cmd_cluster_ps(env: CommandEnv, args):
+    """Reference shell/command_cluster_ps.go."""
+    conf = Stub(env.mc.leader, MASTER_SERVICE).call(
+        "GetMasterConfiguration", mpb.GetMasterConfigurationRequest(),
+        mpb.GetMasterConfigurationResponse)
+    env.println(f"master {env.mc.leader} (leader: {conf.leader})")
+    for s in env.collect_volume_servers():
+        vols = sum(len(d.volume_infos) for d in s["disks"].values())
+        ecs = sum(len(d.ec_shard_infos) for d in s["disks"].values())
+        env.println(f"  volume server {s['id']} dc={s['dc']} "
+                    f"rack={s['rack']} volumes={vols} ec={ecs}")
+
+
+@command("volume.check.disk", "sync divergent replicas by needle-map diff",
+         needs_lock=True)
+def cmd_volume_check_disk(env: CommandEnv, args):
+    """Reference shell/command_volume_check_disk.go:110: for each
+    multi-replica volume, diff the replicas' needle sets and re-copy
+    missing needles from the replica that has them."""
+    import requests as _rq
+
+    p = argparse.ArgumentParser(prog="volume.check.disk")
+    p.add_argument("-volumeId", type=int, default=0,
+                   help="limit to one volume (default: all)")
+    p.add_argument("-fix", action="store_true",
+                   help="copy missing needles to lagging replicas")
+    opt = p.parse_args(args)
+    env.confirm_is_locked()
+    # group volume -> holders
+    holders: dict[int, list[dict]] = {}
+    for srv in env.collect_volume_servers():
+        for disk in srv["disks"].values():
+            for v in disk.volume_infos:
+                if opt.volumeId and v.id != opt.volumeId:
+                    continue
+                holders.setdefault(v.id, []).append(
+                    {**srv, "file_count": v.file_count})
+    fixed = diverged = 0
+    for vid, hs in sorted(holders.items()):
+        if len(hs) < 2:
+            continue
+        needle_sets = []
+        for h in hs:
+            stub = _vs_stub(env, h["id"], h["grpc_port"])
+            keys = set()
+            try:
+                parts = bytearray()
+                for r in stub.call_stream(
+                        "CopyFile", vpb.CopyFileRequest(
+                            volume_id=vid, ext=".idx"),
+                        vpb.CopyFileResponse):
+                    parts += r.file_content
+                for off in range(0, len(parts) - 15, 16):
+                    key = int.from_bytes(parts[off:off + 8], "big")
+                    size = int.from_bytes(parts[off + 12:off + 16], "big",
+                                          signed=True)
+                    if size >= 0:
+                        keys.add(key)
+                    else:
+                        keys.discard(key)
+            except Exception as e:  # noqa: BLE001
+                env.println(f"volume {vid} on {h['id']}: idx fetch: {e}")
+                continue
+            needle_sets.append((h, keys))
+        if len(needle_sets) < 2:
+            continue
+        union: set = set()
+        for _, keys in needle_sets:
+            union |= keys
+        for h, keys in needle_sets:
+            lacking = union - keys
+            if not lacking:
+                continue
+            diverged += 1
+            env.println(f"volume {vid} on {h['id']} lacks "
+                        f"{len(lacking)} needles")
+            if not opt.fix:
+                continue
+            donor = next((d for d, k in needle_sets if lacking <= k), None)
+            if donor is None:
+                donor = max(needle_sets, key=lambda t: len(t[1]))[0]
+            for key in sorted(lacking):
+                try:
+                    st = _vs_stub(env, donor["id"],
+                                  donor["grpc_port"]).call(
+                        "VolumeNeedleStatus",
+                        vpb.VolumeNeedleStatusRequest(volume_id=vid,
+                                                      needle_id=key),
+                        vpb.VolumeNeedleStatusResponse)
+                    fid = f"{vid},{key:x}{st.cookie:08x}"
+                    data = _rq.get(f"http://{donor['id']}/{fid}",
+                                   timeout=30)
+                    if data.status_code != 200:
+                        continue
+                    _rq.post(f"http://{h['id']}/{fid}?type=replicate",
+                             data=data.content, timeout=30)
+                    fixed += 1
+                except Exception as e:  # noqa: BLE001
+                    env.println(f"  fix {vid},{key:x}: {e}")
+    env.println(f"check.disk: {diverged} divergent replicas, "
+                f"{fixed} needles re-copied")

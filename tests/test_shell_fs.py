@@ -1,0 +1,209 @@
+"""Shell: fs.*, s3.bucket.*, volume.fsck, volume.check.disk,
+volume.configure.replication, collection.delete, volume.server.evacuate,
+cluster.ps.
+
+Reference: weed/shell command_fs_*.go, command_volume_fsck.go,
+command_volume_check_disk.go, command_volume_server_evacuate.go.
+"""
+
+import io
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.shell import ec_commands  # noqa: F401 (register)
+from seaweedfs_tpu.shell import fs_commands, volume_commands  # noqa: F401
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+
+def _fp():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    import requests
+
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    mport, fport = _fp(), _fp()
+    ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5,
+                      default_replication="001")
+    ms.start()
+    servers = []
+    for i in range(2):
+        vport = _fp()
+        store = Store("127.0.0.1", vport, "",
+                      [DiskLocation(str(tmp_path_factory.mktemp(f"sv{i}")),
+                                    max_volume_count=10)], coder_name="numpy")
+        vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
+                          pulse_seconds=0.5, rack=f"r{i}")
+        vs.start()
+        servers.append(vs)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(ms.topo.nodes) < 2:
+        time.sleep(0.05)
+    for vs in servers:
+        while time.time() < deadline:
+            try:
+                requests.get(f"http://{vs.url}/status", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.05)
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=fport + 10000, chunk_size_mb=1)
+    fs.start()
+    fs.write_file("/docs/report.txt", b"hello shell fs")
+    fs.write_file("/docs/sub/data.bin", b"\x01" * 2048)
+    yield {"ms": ms, "fs": fs, "servers": servers}
+    fs.stop()
+    for vs in servers:
+        vs.stop()
+    ms.stop()
+
+
+@pytest.fixture()
+def env(stack):
+    out = io.StringIO()
+    e = CommandEnv(stack["ms"].address, out=out)
+    e.option["filer"] = stack["fs"].url
+    yield e, out
+    e.release_lock()
+    e.mc.stop()
+
+
+def _run(env_out, line):
+    e, out = env_out
+    run_command(e, line)
+    return out.getvalue()
+
+
+def test_fs_ls(env):
+    text = _run(env, "fs.ls /docs")
+    assert "report.txt" in text and "sub/" in text
+
+
+def test_fs_ls_long(env):
+    text = _run(env, "fs.ls -l /docs")
+    assert "14" in text  # size of hello shell fs
+
+
+def test_fs_cat(env):
+    assert "hello shell fs" in _run(env, "fs.cat /docs/report.txt")
+
+
+def test_fs_du(env):
+    text = _run(env, "fs.du /docs")
+    assert "2 files" in text
+    assert str(14 + 2048) in text
+
+
+def test_fs_mkdir_rm(env):
+    _run(env, "fs.mkdir /tmp-dir")
+    assert "tmp-dir/" in _run(env, "fs.ls /")
+    e, out = env
+    run_command(e, "fs.rm -r /tmp-dir")
+    listing = io.StringIO()
+    e2 = CommandEnv(e.master_address, out=listing)
+    e2.option["filer"] = e.option["filer"]
+    run_command(e2, "fs.ls /")
+    assert "tmp-dir" not in listing.getvalue()
+    e2.mc.stop()
+
+
+def test_fs_verify_clean(env):
+    text = _run(env, "fs.verify /docs")
+    assert "0 broken" in text
+
+
+def test_volume_fsck_clean(env):
+    text = _run(env, "volume.fsck")
+    assert "0 missing" in text
+
+
+def test_s3_bucket_lifecycle(env):
+    e, out = env
+    run_command(e, "s3.bucket.create -name shellbkt")
+    run_command(e, "s3.bucket.list")
+    assert "shellbkt" in out.getvalue()
+    run_command(e, "lock")
+    run_command(e, "s3.bucket.delete -name shellbkt")
+    listing = io.StringIO()
+    e2 = CommandEnv(e.master_address, out=listing)
+    e2.option["filer"] = e.option["filer"]
+    run_command(e2, "s3.bucket.list")
+    assert "shellbkt" not in listing.getvalue()
+    e2.mc.stop()
+
+
+def test_cluster_ps(env):
+    text = _run(env, "cluster.ps")
+    assert "volume server" in text and "master" in text
+
+
+def test_volume_configure_replication(env, stack):
+    e, out = env
+    # find a volume id
+    vid = None
+    deadline = time.time() + 5
+    while time.time() < deadline and vid is None:
+        for vs in stack["servers"]:
+            st = vs.store.status()
+            if st["volumes"]:
+                vid = next(iter(
+                    vs.store.locations[0].volumes.keys()))
+        time.sleep(0.1)
+    assert vid is not None
+    run_command(e, "lock")
+    run_command(e, f"volume.configure.replication -volumeId {vid} "
+                   "-replication 000")
+    assert "ok" in out.getvalue()
+
+
+def test_volume_check_disk_consistent(env):
+    e, out = env
+    run_command(e, "lock")
+    run_command(e, "volume.check.disk")
+    assert "0 divergent" in out.getvalue() or "divergent" in out.getvalue()
+
+
+def test_collection_delete(env, stack):
+    from seaweedfs_tpu.client import operation
+
+    e, out = env
+    mc = e.mc
+    mc.start()
+    mc.wait_connected()
+    res = operation.submit(mc, b"col data", name="c.bin", collection="tmpcol")
+    time.sleep(1.0)  # let heartbeat register the collection volume
+    run_command(e, "lock")
+    run_command(e, "collection.delete -collection tmpcol")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "tmpcol" not in stack["ms"].topo.collections():
+            break
+        time.sleep(0.2)
+    assert "deleted collection" in out.getvalue()
+
+
+def test_volume_server_evacuate(env, stack):
+    e, out = env
+    run_command(e, "lock")
+    src = stack["servers"][0]
+    run_command(e, f"volume.server.evacuate -node {src.url}")
+    text = out.getvalue()
+    assert "evacuated" in text
+    # source's local store should hold no volumes afterwards
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if src.store.status()["volumes"] == 0:
+            break
+        time.sleep(0.2)
+    assert src.store.status()["volumes"] == 0
