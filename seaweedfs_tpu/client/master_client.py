@@ -31,19 +31,27 @@ class VidMap:
             if not any(c["url"] == loc["url"] for c in cur):
                 cur.append(loc)
 
-    def remove(self, vid: int, url: str, ec: bool = False) -> None:
+    def remove(self, vid: int, url: str) -> None:
+        # purge BOTH tables: a cache-miss refresh re-adds EC holders into
+        # the non-EC table (LookupVolume can't tell them apart), and a
+        # later deleted_ec_vids event must still be able to evict them
         with self.lock:
-            table = self.ec_locations if ec else self.locations
-            cur = table.get(vid)
-            if cur:
-                table[vid] = [c for c in cur if c["url"] != url]
-                if not table[vid]:
-                    table.pop(vid, None)
+            for table in (self.locations, self.ec_locations):
+                cur = table.get(vid)
+                if cur:
+                    table[vid] = [c for c in cur if c["url"] != url]
+                    if not table[vid]:
+                        table.pop(vid, None)
 
     def get(self, vid: int) -> list[dict]:
         with self.lock:
             return list(self.locations.get(vid, [])) or list(
                 self.ec_locations.get(vid, []))
+
+    def invalidate(self, vid: int) -> None:
+        with self.lock:
+            self.locations.pop(vid, None)
+            self.ec_locations.pop(vid, None)
 
 
 class MasterClient:
@@ -113,7 +121,7 @@ class MasterClient:
                     for vid in vl.new_ec_vids:
                         self.vid_map.add(vid, loc, ec=True)
                     for vid in vl.deleted_ec_vids:
-                        self.vid_map.remove(vid, vl.url, ec=True)
+                        self.vid_map.remove(vid, vl.url)
             except Exception as e:  # noqa: BLE001
                 if not self._stop.is_set():
                     log.warning("keepconnected to %s: %s; retrying", self.leader, e)
@@ -199,6 +207,13 @@ class MasterClient:
                 self.vid_map.add(vid, {"url": l.url, "public_url": l.public_url,
                                        "grpc_port": l.grpc_port})
         return self.vid_map.get(vid)
+
+    def refresh_lookup(self, vid: int) -> list[dict]:
+        """Drop the cached locations and re-query the master — used when a
+        replica 404s after a volume move (LookupFileIdWithFallback
+        masterclient.go:59 refreshes the same way)."""
+        self.vid_map.invalidate(vid)
+        return self.lookup(vid)
 
     def lookup_file_id(self, fid: str) -> list[str]:
         vid, _, _ = parse_file_id(fid)

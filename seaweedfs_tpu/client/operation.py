@@ -82,20 +82,48 @@ def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
 
 def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
     """Fetch a blob by fid, trying each replica (wdclient vid_map round-robin).
+
+    A 404 or connection failure may just mean the cached location is stale
+    (volume moved/evacuated), so one refreshed-lookup retry pass runs before
+    giving up (LookupFileIdWithFallback masterclient.go:59).
     Pass `jwt` (a read-key token) when the cluster read-gates volumes."""
+    vid, _, _ = parse_file_id(fid)
     last_err: Exception | None = None
     params = {"jwt": jwt} if jwt else None
-    for url in mc.lookup_file_id(fid):
+    all_404 = False
+    urls: list[str] = []
+    for attempt in range(2):
+        saw_404 = saw_other_err = False
         try:
-            r = _session.get(url, timeout=60, params=params)
-            if r.status_code == 404:
-                raise KeyError(fid)
-            r.raise_for_status()
-            return r.content
-        except KeyError:
-            raise
-        except Exception as e:  # noqa: BLE001
+            urls = mc.lookup_file_id(fid)
+        except KeyError as e:
             last_err = e
+            urls = []
+        for url in urls:
+            try:
+                r = _session.get(url, timeout=60, params=params)
+                if r.status_code == 404:
+                    saw_404 = True
+                    continue
+                r.raise_for_status()
+                return r.content
+            except Exception as e:  # noqa: BLE001
+                saw_other_err = True
+                last_err = e
+        all_404 = bool(urls) and saw_404 and not saw_other_err
+        if attempt == 0:
+            try:
+                fresh = mc.refresh_lookup(vid)
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                break
+            if all_404 and {f"http://{l['public_url'] or l['url']}/{fid}"
+                            for l in fresh} == set(urls):
+                # same replica set re-answered 404 — authoritative
+                # not-found; skip the redundant second sweep
+                raise KeyError(fid)
+    if all_404 or isinstance(last_err, KeyError):
+        raise KeyError(fid) if all_404 else last_err
     raise RuntimeError(f"read {fid} failed: {last_err}")
 
 

@@ -858,6 +858,12 @@ class VolumeServer:
 
         @svc.unary_stream("CopyFile", vpb.CopyFileRequest, vpb.CopyFileResponse)
         def copy_file(req, context):
+            # flush the live volume's buffered appends first — the stream
+            # below reads through a fresh handle and would otherwise miss
+            # them (reference syncs via the readonly flip in doCopyFile)
+            v = store.find_volume(req.volume_id)
+            if v is not None and req.ext in (".dat", ".idx"):
+                v.sync()
             path = None
             for loc in store.locations:
                 cand = loc.base_name(req.collection, req.volume_id) + req.ext

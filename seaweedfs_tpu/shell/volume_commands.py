@@ -359,22 +359,64 @@ def cmd_volume_server_evacuate(env: CommandEnv, args):
     rr = 0
     for disk in src["disks"].values():
         for v in disk.volume_infos:
-            # skip volumes whose replicas already live elsewhere
-            dst = others[rr % len(others)]
+            # pick a destination that does not already hold a replica
+            # (command_volume_server_evacuate.go moveability check)
+            candidates = [
+                s for s in others
+                if not any(ov.id == v.id
+                           for od in s["disks"].values()
+                           for ov in od.volume_infos)]
+            if not candidates:
+                env.println(f"skip volume {v.id}: every other server "
+                            "already holds a replica")
+                continue
+            dst = candidates[rr % len(candidates)]
             rr += 1
-            _vs_stub(env, dst["id"], dst["grpc_port"]).call(
-                "VolumeCopy", vpb.VolumeCopyRequest(
-                    volume_id=v.id, collection=v.collection,
-                    source_data_node=src_addr),
-                vpb.VolumeCopyResponse, timeout=600)
-            _vs_stub(env, src["id"], src["grpc_port"]).call(
+            src_stub = _vs_stub(env, src["id"], src["grpc_port"])
+            # freeze writes for the copy: a .dat streamed while appends
+            # land would pair with a longer .idx and tear the clone.
+            # Remember the prior flag so a failed copy doesn't clobber a
+            # tiered/operator-frozen read-only state on rollback.
+            was_ro = src_stub.call(
+                "VolumeStatus", vpb.VolumeStatusRequest(volume_id=v.id),
+                vpb.VolumeStatusResponse).is_read_only
+            if not was_ro:
+                src_stub.call("VolumeMarkReadonly",
+                              vpb.VolumeMarkReadonlyRequest(volume_id=v.id),
+                              vpb.VolumeMarkReadonlyResponse)
+            try:
+                _vs_stub(env, dst["id"], dst["grpc_port"]).call(
+                    "VolumeCopy", vpb.VolumeCopyRequest(
+                        volume_id=v.id, collection=v.collection,
+                        source_data_node=src_addr),
+                    vpb.VolumeCopyResponse, timeout=600)
+            except Exception:
+                if not was_ro:
+                    src_stub.call(
+                        "VolumeMarkWritable",
+                        vpb.VolumeMarkWritableRequest(volume_id=v.id),
+                        vpb.VolumeMarkWritableResponse)
+                raise
+            src_stub.call(
                 "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=v.id),
                 vpb.VolumeDeleteResponse)
             env.println(f"moved volume {v.id} -> {dst['id']}")
             moved += 1
         for s in disk.ec_shard_infos:
             sids = [i for i in range(32) if s.ec_index_bits >> i & 1]
-            dst = others[rr % len(others)]
+            # avoid piling shards of one EC volume onto a server that
+            # already holds some — losing that server would then exceed
+            # the parity tolerance (reference moveability check)
+            candidates = [
+                t for t in others
+                if not any(os_.id == s.id and os_.ec_index_bits
+                           for od in t["disks"].values()
+                           for os_ in od.ec_shard_infos)]
+            if not candidates:
+                env.println(f"skip ec shards {sids} of {s.id}: every other "
+                            "server already holds shards of this volume")
+                continue
+            dst = candidates[rr % len(candidates)]
             rr += 1
             _vs_stub(env, dst["id"], dst["grpc_port"]).call(
                 "VolumeEcShardsMove", vpb.VolumeEcShardsMoveRequest(

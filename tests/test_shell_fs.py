@@ -34,6 +34,8 @@ def stack(tmp_path_factory):
     from seaweedfs_tpu.storage.store import Store
 
     mport, fport = _fp(), _fp()
+    # "001" = one extra replica in the SAME rack, so both servers share r0
+    # and fsck/check.disk/fs.verify run against a replicated cluster
     ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5,
                       default_replication="001")
     ms.start()
@@ -44,7 +46,7 @@ def stack(tmp_path_factory):
                       [DiskLocation(str(tmp_path_factory.mktemp(f"sv{i}")),
                                     max_volume_count=10)], coder_name="numpy")
         vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
-                          pulse_seconds=0.5, rack=f"r{i}")
+                          pulse_seconds=0.5, rack="r0")
         vs.start()
         servers.append(vs)
     deadline = time.time() + 10
@@ -193,17 +195,81 @@ def test_collection_delete(env, stack):
     assert "deleted collection" in out.getvalue()
 
 
-def test_volume_server_evacuate(env, stack):
+def test_volume_server_evacuate_skips_replicated(env, stack):
+    # with replication 001 over exactly 2 servers every volume already has
+    # a replica on the only other node — evacuate must skip, not clobber
     e, out = env
     run_command(e, "lock")
-    src = stack["servers"][0]
+    src = next(s for s in stack["servers"] if s.store.status()["volumes"])
+    before = src.store.status()["volumes"]
+    assert before > 0
     run_command(e, f"volume.server.evacuate -node {src.url}")
     text = out.getvalue()
     assert "evacuated" in text
-    # source's local store should hold no volumes afterwards
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        if src.store.status()["volumes"] == 0:
-            break
-        time.sleep(0.2)
-    assert src.store.status()["volumes"] == 0
+    assert "skip volume" in text
+    assert src.store.status()["volumes"] == before
+
+
+def test_volume_server_evacuate_unreplicated(tmp_path_factory):
+    """Evacuate drains an unreplicated server: volume moves, data stays
+    readable (reference command_volume_server_evacuate.go)."""
+    import requests
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    ms = MasterServer(port=_fp(), volume_size_limit_mb=64, pulse_seconds=0.5,
+                      default_replication="000")
+    ms.start()
+    servers = []
+    try:
+        for i in range(2):
+            vport = _fp()
+            store = Store("127.0.0.1", vport, "",
+                          [DiskLocation(str(tmp_path_factory.mktemp(f"ev{i}")),
+                                        max_volume_count=10)],
+                          coder_name="numpy")
+            vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
+                              pulse_seconds=0.5, rack=f"r{i}")
+            vs.start()
+            servers.append(vs)
+        deadline = time.time() + 10
+        while time.time() < deadline and len(ms.topo.nodes) < 2:
+            time.sleep(0.05)
+        for vs in servers:
+            while time.time() < deadline:
+                try:
+                    requests.get(f"http://{vs.url}/status", timeout=1)
+                    break
+                except Exception:
+                    time.sleep(0.05)
+        out = io.StringIO()
+        e = CommandEnv(ms.address, out=out)
+        e.mc.start()
+        e.mc.wait_connected()
+        res = operation.submit(e.mc, b"evac payload", name="e.bin")
+        assert operation.read(e.mc, res.fid) == b"evac payload"
+        time.sleep(1.2)  # let the holder heartbeat the volume to the master
+        run_command(e, "lock")
+        src = next(s for s in servers if s.store.status()["volumes"])
+        run_command(e, f"volume.server.evacuate -node {src.url}")
+        assert "moved volume" in out.getvalue()
+        assert src.store.status()["volumes"] == 0
+        got = None
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            try:
+                got = operation.read(e.mc, res.fid)
+                break
+            except (KeyError, RuntimeError):
+                time.sleep(0.3)
+        assert got == b"evac payload"
+        e.release_lock()
+        e.mc.stop()
+    finally:
+        for vs in servers:
+            vs.stop()
+        ms.stop()
