@@ -215,10 +215,16 @@ class MasterClient:
         self.vid_map.invalidate(vid)
         return self.lookup(vid)
 
+    @staticmethod
+    def location_urls(locs: list[dict], fid: str) -> list[str]:
+        """One place that turns location dicts into fetch URLs — read()'s
+        refreshed-replica-set comparison relies on this matching
+        lookup_file_id exactly."""
+        return [f"http://{l['public_url'] or l['url']}/{fid}" for l in locs]
+
     def lookup_file_id(self, fid: str) -> list[str]:
         vid, _, _ = parse_file_id(fid)
-        return [f"http://{l['public_url'] or l['url']}/{fid}"
-                for l in self.lookup(vid)]
+        return self.location_urls(self.lookup(vid), fid)
 
     def lookup_file_id_jwt(self, fid: str) -> str:
         """Write-key token for mutating an existing fid (reference

@@ -114,11 +114,17 @@ def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
         if attempt == 0:
             try:
                 fresh = mc.refresh_lookup(vid)
-            except Exception as e:  # noqa: BLE001
+            except KeyError as e:
                 last_err = e
+                break  # master says the volume is gone: authoritative
+            except Exception as e:  # noqa: BLE001
+                # refresh itself failed (master outage): the 404s were
+                # never re-validated, so report retryable, not not-found
+                last_err = e
+                all_404 = False
                 break
-            if all_404 and {f"http://{l['public_url'] or l['url']}/{fid}"
-                            for l in fresh} == set(urls):
+            if all_404 and set(
+                    MasterClient.location_urls(fresh, fid)) == set(urls):
                 # same replica set re-answered 404 — authoritative
                 # not-found; skip the redundant second sweep
                 raise KeyError(fid)

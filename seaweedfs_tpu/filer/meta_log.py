@@ -87,6 +87,10 @@ class MetaLog:
                     self._cond.wait(timeout=poll_s)
                     fresh = [(t, b) for t, b in list(self._tail) if t > last]
             for ts, blob in fresh:
+                # re-check per event: a stopped subscriber must not keep
+                # consuming (a "stopped" FilerSync would still replicate)
+                if stop.is_set():
+                    return
                 resp = fpb.SubscribeMetadataResponse()
                 resp.ParseFromString(blob)
                 yield resp

@@ -152,12 +152,15 @@ class MasterServer:
                 q = dict(_up.parse_qsl(url.query))
                 if body_params:
                     q.update(body_params)
-                # Same guard as the data plane (reference wraps master HTTP
-                # handlers in guard.WhiteList); /metrics stays open for
-                # scrapers.
+                # The reference wraps master HTTP handlers in
+                # guard.WhiteList only; JWT gating applies just to the
+                # mutating /dir/assign. /metrics stays open for scrapers.
                 if ms.guard is not None and url.path != "/metrics":
-                    ok, why = ms.guard.check_write(
-                        self.client_address[0], q, self.headers)
+                    if url.path == "/dir/assign":
+                        ok, why = ms.guard.check_write(
+                            self.client_address[0], q, self.headers)
+                    else:
+                        ok, why = ms.guard.check_ip(self.client_address[0])
                     if not ok:
                         self._send(401, _json.dumps({"error": why}).encode())
                         return
@@ -165,8 +168,10 @@ class MasterServer:
                     from ..stats import REGISTRY
                     self._send(200, REGISTRY.gather().encode(), "text/plain")
                 elif url.path == "/dir/status":
+                    # leader_address, not ms.address: a follower answering
+                    # here must hint at the real leader (empty mid-election)
                     body = {"Topology": MessageToDict(ms.topology_info()),
-                            "Leader": ms.address,
+                            "Leader": ms.leader_address,
                             "IsLeader": ms.is_leader}
                     self._send(200, _json.dumps(body).encode())
                 elif url.path == "/dir/lookup":
@@ -201,8 +206,10 @@ class MasterServer:
                             "auth": resp.auth}).encode())
                 elif url.path == "/cluster/status":
                     self._send(200, _json.dumps({
-                        "IsLeader": ms.is_leader, "Leader": ms.address,
-                        "Peers": []}).encode())
+                        "IsLeader": ms.is_leader,
+                        "Leader": ms.leader_address,
+                        "Peers": [p for p in ms.peers
+                                  if p != ms.address]}).encode())
                 else:
                     self._send(404, b'{"error":"not found"}')
 

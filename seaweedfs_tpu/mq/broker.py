@@ -31,17 +31,29 @@ SEGMENT_FLUSH_COUNT = 1000  # messages per persisted segment
 
 
 class PartitionLog:
-    """One partition's message log: in-memory tail + filer segments."""
+    """One partition's message log: bounded in-memory tail + filer segments.
+
+    Only the un-sealed tail (< SEGMENT_FLUSH_COUNT messages) lives in
+    memory; sealed segments are dropped after persisting and reads of old
+    offsets come back from the filer. The partial tail is re-written by
+    `flush_tail` (periodic + on broker stop) so a restart loses at most
+    the last flush interval, not 999 acked messages. Without a filer the
+    log is memory-only and unbounded (standalone dev mode)."""
 
     def __init__(self, topic: TopicRef, partition: Partition, filer=None):
         self.topic = topic
         self.partition = partition
         self.filer = filer
-        self.messages: list[tuple[bytes, bytes, int]] = []  # key, value, ts
-        self.base_offset = 0  # offset of messages[0]
-        self._flushed_segments = 0
+        self.messages: list[tuple[bytes, bytes, int]] = []  # un-sealed tail
+        self.base_offset = 0  # offset of messages[0] == sealed message count
+        self._full_segments = 0
+        self._seg_cache: tuple[int, list] | None = None  # last parsed seg
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # serializes segment WRITES so the out-of-lock periodic tail flush
+        # can never clobber a just-sealed full segment with a stale partial
+        self._io_mu = threading.Lock()
+        self._max_sealed = -1  # highest segment index written as full
         if filer is not None:
             self._replay()
 
@@ -55,61 +67,109 @@ class PartitionLog:
     def _segment_path(self, n: int) -> str:
         return f"{self._dir}/seg-{n:06d}"
 
+    @staticmethod
+    def _parse_records(data: bytes) -> list[tuple[bytes, bytes, int]]:
+        out = []
+        pos = 0
+        while pos + 4 <= len(data):
+            (ln,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            rec = data[pos:pos + ln]
+            pos += ln
+            klen = struct.unpack_from("<I", rec, 0)[0]
+            key = rec[4:4 + klen]
+            ts = struct.unpack_from("<q", rec, 4 + klen)[0]
+            value = rec[12 + klen:]
+            out.append((key, value, ts))
+        return out
+
+    def _read_segment(self, n: int) -> list[tuple[bytes, bytes, int]]:
+        from ..filer.filer import split_path
+        d, name = split_path(self._segment_path(n))
+        entry = self.filer.filer.find_entry(d, name)
+        if entry is None:
+            return []
+        return self._parse_records(self.filer.read_entry_bytes(entry))
+
     def _replay(self) -> None:
-        """Reload persisted segments on startup (broker restart)."""
+        """Restore offsets on broker restart: count sealed segments by
+        existence (no payload fetch), parse only the trailing segment and
+        keep it in memory as the tail if partial."""
         from ..filer.filer import split_path
         n = 0
         while True:
             d, name = split_path(self._segment_path(n))
-            entry = self.filer.filer.find_entry(d, name)
-            if entry is None:
+            if self.filer.filer.find_entry(d, name) is None:
                 break
-            data = self.filer.read_entry_bytes(entry)
-            pos = 0
-            while pos + 4 <= len(data):
-                (ln,) = struct.unpack_from("<I", data, pos)
-                pos += 4
-                rec = data[pos:pos + ln]
-                pos += ln
-                klen = struct.unpack_from("<I", rec, 0)[0]
-                key = rec[4:4 + klen]
-                ts = struct.unpack_from("<q", rec, 4 + klen)[0]
-                value = rec[12 + klen:]
-                self.messages.append((key, value, ts))
             n += 1
-        self._flushed_segments = n
+        tail: list[tuple[bytes, bytes, int]] = (
+            self._read_segment(n - 1) if n else [])
+        if n and len(tail) < SEGMENT_FLUSH_COUNT:
+            self._full_segments = n - 1
+            self.messages = tail
+        else:
+            self._full_segments = n
+            self.messages = []
+        self.base_offset = self._full_segments * SEGMENT_FLUSH_COUNT
+        self._max_sealed = self._full_segments - 1
         if n:
-            log.info("%s %s: replayed %d segments, %d messages",
-                     self.topic, self.partition, n, len(self.messages))
+            log.info("%s %s: replayed %d segments (next offset %d)",
+                     self.topic, self.partition, n,
+                     self.base_offset + len(self.messages))
 
-    def _maybe_flush(self) -> None:
-        """Persist a full segment (caller holds the lock)."""
+    def _write_segment(self, n: int,
+                       batch: list[tuple[bytes, bytes, int]]) -> None:
+        blob = bytearray()
+        for key, value, ts in batch:
+            rec = (struct.pack("<I", len(key)) + key
+                   + struct.pack("<q", ts) + value)
+            blob += struct.pack("<I", len(rec)) + rec
+        self.filer.write_file(self._segment_path(n), bytes(blob),
+                              mime="application/octet-stream")
+
+    def flush_tail(self) -> None:
+        """Persist the partial tail segment (re-written in place as it
+        grows; sealed for good once full). The filer write runs OUTSIDE
+        the partition lock so the periodic flusher doesn't stall appends
+        and in-memory reads for a whole upload."""
         if self.filer is None:
             return
-        flushed_msgs = self._flushed_segments * SEGMENT_FLUSH_COUNT
-        while len(self.messages) - (flushed_msgs - self.base_offset) \
-                >= SEGMENT_FLUSH_COUNT:
-            start = flushed_msgs - self.base_offset
-            batch = self.messages[start:start + SEGMENT_FLUSH_COUNT]
-            blob = bytearray()
-            for key, value, ts in batch:
-                rec = (struct.pack("<I", len(key)) + key
-                       + struct.pack("<q", ts) + value)
-                blob += struct.pack("<I", len(rec)) + rec
-            self.filer.write_file(
-                self._segment_path(self._flushed_segments), bytes(blob),
-                mime="application/octet-stream")
-            self._flushed_segments += 1
-            flushed_msgs += SEGMENT_FLUSH_COUNT
+        with self._io_mu:
+            with self._lock:
+                n, batch = self._full_segments, list(self.messages)
+            if not batch or n <= self._max_sealed:
+                return  # nothing new, or that index already sealed full
+            self._write_segment(n, batch)
+
+    def _seal_full_segments(self) -> None:
+        """Persist full segments; memory is trimmed only AFTER each file
+        write so readers never hit a window where a sealed offset is
+        neither in memory nor on the filer."""
+        with self._io_mu:
+            while True:
+                with self._lock:
+                    if len(self.messages) < SEGMENT_FLUSH_COUNT:
+                        return
+                    n = self._full_segments
+                    batch = self.messages[:SEGMENT_FLUSH_COUNT]
+                self._write_segment(n, batch)
+                with self._lock:
+                    self._full_segments = n + 1
+                    self.messages = self.messages[SEGMENT_FLUSH_COUNT:]
+                    self.base_offset += SEGMENT_FLUSH_COUNT
+                self._max_sealed = max(self._max_sealed, n)
 
     # -- log ops -------------------------------------------------------------
     def append(self, key: bytes, value: bytes, ts_ns: int) -> int:
         with self._lock:
             self.messages.append((key, value, ts_ns))
             offset = self.base_offset + len(self.messages) - 1
-            self._maybe_flush()
+            need_seal = (self.filer is not None
+                         and len(self.messages) >= SEGMENT_FLUSH_COUNT)
             self._cv.notify_all()
-            return offset
+        if need_seal:
+            self._seal_full_segments()
+        return offset
 
     @property
     def next_offset(self) -> int:
@@ -119,12 +179,28 @@ class PartitionLog:
     def read(self, offset: int, max_count: int = 256
              ) -> list[tuple[int, bytes, bytes, int]]:
         with self._lock:
-            start = max(0, offset - self.base_offset)
-            out = []
-            for i, (k, v, ts) in enumerate(
-                    self.messages[start:start + max_count]):
-                out.append((self.base_offset + start + i, k, v, ts))
-            return out
+            if offset >= self.base_offset:
+                start = offset - self.base_offset
+                return [(self.base_offset + start + i, k, v, ts)
+                        for i, (k, v, ts) in enumerate(
+                            self.messages[start:start + max_count])]
+            filer = self.filer
+        if filer is None:
+            return []
+        # old offset: serve from the sealed segment that contains it,
+        # keeping the last-parsed segment around — a replaying subscriber
+        # reads each 1000-record segment in ~4 ×256 batches
+        seg = offset // SEGMENT_FLUSH_COUNT
+        base = seg * SEGMENT_FLUSH_COUNT
+        cached = self._seg_cache
+        if cached is None or cached[0] != seg:
+            cached = (seg, self._read_segment(seg))
+            self._seg_cache = cached
+        records = cached[1]
+        lo = offset - base
+        return [(base + lo + i, k, v, ts)
+                for i, (k, v, ts) in enumerate(
+                    records[lo:lo + max_count])]
 
     def wait_for(self, offset: int, timeout: float) -> bool:
         with self._cv:
@@ -145,6 +221,8 @@ class BrokerServer:
         self.logs: dict[tuple[str, int], PartitionLog] = {}
         self._lock = threading.Lock()
         self._grpc = None
+        self._stop = threading.Event()
+        self.flush_interval = 2.0  # partial-tail persistence cadence (s)
 
     @property
     def address(self) -> str:
@@ -153,13 +231,34 @@ class BrokerServer:
     def start(self) -> "BrokerServer":
         self.mc.start()
         self._grpc = serve(f"{self.ip}:{self.port}", [self._build_service()])
+        if self.filer is not None:
+            threading.Thread(target=self._flusher, daemon=True,
+                             name=f"mq-flush-{self.port}").start()
         log.info("mq broker %s up", self.address)
         return self
 
     def stop(self) -> None:
-        self.mc.stop()
+        self._stop.set()
+        # stop accepting publishes BEFORE the final flush — an append acked
+        # after its partition's flush would be lost despite a clean stop
         if self._grpc:
-            self._grpc.stop(grace=0.5)
+            self._grpc.stop(grace=0.5).wait()
+        for lg in list(self.logs.values()):
+            try:
+                lg.flush_tail()
+            except Exception as e:  # noqa: BLE001
+                log.warning("flush tail of %s %s: %s",
+                            lg.topic, lg.partition, e)
+        self.mc.stop()
+
+    def _flusher(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            for lg in list(self.logs.values()):
+                try:
+                    lg.flush_tail()
+                except Exception as e:  # noqa: BLE001
+                    log.warning("periodic flush of %s %s: %s",
+                                lg.topic, lg.partition, e)
 
     # -- topic/partition state ----------------------------------------------
     def _log_for(self, tref: TopicRef, partition: Partition) -> PartitionLog:

@@ -23,7 +23,8 @@ log = logger("filer.sync")
 
 class FilerSync:
     def __init__(self, source_fs, target_fs, path_prefix: str = "/",
-                 from_ns: int | None = None):
+                 from_ns: int | None = None, max_retries: int = 5,
+                 retry_base_delay: float = 0.2):
         self.source = source_fs
         self.target = target_fs
         self.prefix = path_prefix
@@ -37,6 +38,9 @@ class FilerSync:
         self.from_ns = (self._load_offset() if from_ns is None else from_ns)
         self.applied = 0
         self.skipped = 0
+        self.dead_lettered = 0
+        self.max_retries = max_retries
+        self.retry_base_delay = retry_base_delay
 
     # -- offsets (reference persists per-peer offsets in store KV) ----------
     def _load_offset(self) -> int:
@@ -72,12 +76,35 @@ class FilerSync:
             ev = resp.event_notification
             if target_sig in ev.signatures:
                 self.skipped += 1  # originated at the target: loop guard
+                if resp.ts_ns:
+                    self._save_offset(resp.ts_ns)
                 continue
-            try:
-                self.replicator.replicate(resp.directory, ev)
+            # Retry with backoff and only advance the offset once the event
+            # applied (the reference filer.sync re-processes the event and
+            # persists the offset after success) — saving early would skip
+            # the mutation forever after a restart.
+            applied = False
+            for attempt in range(self.max_retries):
+                try:
+                    self.replicator.replicate(resp.directory, ev)
+                    applied = True
+                    break
+                except Exception as e:  # noqa: BLE001
+                    log.warning("sync apply %s (try %d/%d): %s",
+                                resp.directory, attempt + 1,
+                                self.max_retries, e)
+                    if attempt + 1 >= self.max_retries:
+                        break  # no point sleeping before the dead-letter
+                    if self._stop.wait(self.retry_base_delay * 2 ** attempt):
+                        return
+            if applied:
                 self.applied += 1
-            except Exception as e:  # noqa: BLE001
-                log.warning("sync apply %s: %s", resp.directory, e)
+            else:
+                # dead-letter explicitly: log loudly and move on so one
+                # poisoned event can't wedge the stream forever
+                self.dead_lettered += 1
+                log.error("sync DEAD-LETTER %s after %d tries",
+                          resp.directory, self.max_retries)
             if resp.ts_ns:
                 self._save_offset(resp.ts_ns)
 

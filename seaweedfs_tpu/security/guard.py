@@ -91,10 +91,13 @@ class Guard:
                 claims = _jwt.decode_jwt(token, self.signing_key)
             except _jwt.JwtError as e:
                 return False, str(e)
-            # The master scopes write tokens to one file id (jwt.go:18-21);
-            # an empty claimed fid (filer-style token) is a wildcard.
+            # The master scopes write tokens to one file id (jwt.go:18-21)
+            # and the volume server demands an EXACT match
+            # (volume_server_handlers.go:199) — an empty claimed fid must
+            # NOT act as a wildcard on fid-scoped checks, else any
+            # filer-style token doubles as a write-everything pass.
             claimed = claims.get("fid", "")
-            if claimed and fid and claimed != fid:
+            if fid and claimed != fid:
                 return False, "jwt fid mismatch"
             return True, ""
         return False, "not in white list"
@@ -113,6 +116,15 @@ class Guard:
         except _jwt.JwtError as e:
             return False, str(e)
         claimed = claims.get("fid", "")
-        if claimed and fid and claimed != fid:
+        if fid and claimed != fid:
             return False, "jwt fid mismatch"
         return True, ""
+
+    def check_ip(self, remote_ip: str) -> tuple[bool, str]:
+        """IP-whitelist-only gate for non-mutating endpoints (the reference
+        applies just guard.WhiteList to master HTTP handlers)."""
+        if not self.white_list:
+            return True, ""
+        if self.white_listed(remote_ip):
+            return True, ""
+        return False, "not in white list"

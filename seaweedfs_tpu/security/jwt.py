@@ -94,6 +94,17 @@ def gen_jwt_for_filer_server(signing_key: str | bytes,
     return encode(claims, signing_key)
 
 
+def derive_cluster_key(signing_key: str) -> str:
+    """Derive the gRPC-plane bearer key from the HTTP signing key, so a
+    cluster token sniffed off plaintext gRPC metadata can never validate
+    as a volume-server write/read JWT (the reference keeps the planes
+    apart with a distinct filer key + mTLS, security/tls.go:26)."""
+    if not signing_key:
+        return ""
+    return hmac.new(signing_key.encode(), b"swtpu-grpc-cluster-v1",
+                    hashlib.sha256).hexdigest()
+
+
 def jwt_from_request(query: dict, headers) -> str:
     """Extract a token the way jwt.go:76-99 does: query param, bearer
     header, cookie. `query` is a mapping; `headers` any mapping with .get."""

@@ -148,6 +148,53 @@ def cmd_volume_mark(env: CommandEnv, args):
     env.println("done")
 
 
+def _safe_copy_volume(env: CommandEnv, vid: int, collection: str,
+                      src: dict, dst: dict, *, delete_source: bool) -> None:
+    """Copy a volume src->dst with writes frozen for the duration.
+
+    VolumeCopy streams .dat then .idx through separate CopyFile calls; an
+    append landing in between would pair the clone's longer .idx with a
+    shorter .dat (torn copy) — and move flows then delete the only intact
+    source. Freezes the source (remembering a pre-existing read-only flag
+    so rollback can't clobber a tiered/operator freeze), propagates that
+    flag to the destination, deletes the source only on success, and
+    restores writability for replicate-style copies.
+    Reference: command_volume_move.go LiveMoveVolume's readonly phase."""
+    src_stub = _vs_stub(env, src["id"], src["grpc_port"])
+    dst_stub = _vs_stub(env, dst["id"], dst["grpc_port"])
+    was_ro = src_stub.call(
+        "VolumeStatus", vpb.VolumeStatusRequest(volume_id=vid),
+        vpb.VolumeStatusResponse).is_read_only
+    if not was_ro:
+        src_stub.call("VolumeMarkReadonly",
+                      vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                      vpb.VolumeMarkReadonlyResponse)
+    try:
+        dst_stub.call("VolumeCopy", vpb.VolumeCopyRequest(
+            volume_id=vid, collection=collection,
+            source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
+            vpb.VolumeCopyResponse, timeout=600)
+    except Exception:
+        if not was_ro:
+            src_stub.call("VolumeMarkWritable",
+                          vpb.VolumeMarkWritableRequest(volume_id=vid),
+                          vpb.VolumeMarkWritableResponse)
+        raise
+    if was_ro:
+        # an operator/tier freeze follows the data to its new holder
+        dst_stub.call("VolumeMarkReadonly",
+                      vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                      vpb.VolumeMarkReadonlyResponse)
+    if delete_source:
+        src_stub.call("VolumeDelete",
+                      vpb.VolumeDeleteRequest(volume_id=vid),
+                      vpb.VolumeDeleteResponse)
+    elif not was_ro:
+        src_stub.call("VolumeMarkWritable",
+                      vpb.VolumeMarkWritableRequest(volume_id=vid),
+                      vpb.VolumeMarkWritableResponse)
+
+
 @command("volume.fix.replication",
          "re-replicate volumes whose replica sets are incomplete",
          needs_lock=True)
@@ -175,11 +222,8 @@ def cmd_fix_replication(env: CommandEnv, args):
         src = hs[0]
         for dst in candidates[: target - len(hs)]:
             env.println(f"  replicating volume {vid} {src['id']} -> {dst['id']}")
-            _vs_stub(env, dst["id"], dst["grpc_port"]).call(
-                "VolumeCopy", vpb.VolumeCopyRequest(
-                    volume_id=vid, collection=infos[vid].collection,
-                    source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
-                vpb.VolumeCopyResponse, timeout=600)
+            _safe_copy_volume(env, vid, infos[vid].collection, src, dst,
+                              delete_source=False)
             fixed += 1
     env.println(f"replicated {fixed} volume copies")
 
@@ -196,14 +240,8 @@ def cmd_volume_move(env: CommandEnv, args):
     src, dst = servers[opt.source], servers[opt.target]
     info = next(v for d in src["disks"].values() for v in d.volume_infos
                 if v.id == opt.volumeId)
-    _vs_stub(env, dst["id"], dst["grpc_port"]).call(
-        "VolumeCopy", vpb.VolumeCopyRequest(
-            volume_id=opt.volumeId, collection=info.collection,
-            source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
-        vpb.VolumeCopyResponse, timeout=600)
-    _vs_stub(env, src["id"], src["grpc_port"]).call(
-        "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=opt.volumeId),
-        vpb.VolumeDeleteResponse)
+    _safe_copy_volume(env, opt.volumeId, info.collection, src, dst,
+                      delete_source=True)
     env.println(f"moved volume {opt.volumeId} {opt.source} -> {opt.target}")
 
 
@@ -211,32 +249,30 @@ def cmd_volume_move(env: CommandEnv, args):
          needs_lock=True)
 def cmd_volume_balance(env: CommandEnv, args):
     """Reference command_volume_balance.go simplified: move volumes from the
-    fullest server to the emptiest until counts differ by <= 1."""
+    fullest server to the emptiest until counts differ by <= 1.
+
+    Plans every move against ONE topology snapshot updated locally after
+    each move — re-collecting from the master mid-loop races heartbeat
+    propagation and can replay a finished move ("volume already here")."""
+    servers = env.collect_volume_servers()
+    state = {s["id"]: {v.id: v for d in s["disks"].values()
+                       for v in d.volume_infos} for s in servers}
+    info = {s["id"]: s for s in servers}
     while True:
-        servers = env.collect_volume_servers()
-        counts = []
-        for s in servers:
-            vols = [v for d in s["disks"].values() for v in d.volume_infos]
-            counts.append((len(vols), s, vols))
-        counts.sort(key=lambda c: c[0])
-        low, high = counts[0], counts[-1]
-        if high[0] - low[0] <= 1:
+        counts = sorted((len(vols), sid) for sid, vols in state.items())
+        (low_n, low_id), (high_n, high_id) = counts[0], counts[-1]
+        if high_n - low_n <= 1:
             break
-        # move one volume high -> low (skip volumes low already holds)
-        low_ids = {v.id for v in low[2]}
-        movable = [v for v in high[2] if v.id not in low_ids]
+        movable = [v for vid, v in state[high_id].items()
+                   if vid not in state[low_id]]
         if not movable:
             break
         v = movable[0]
-        env.println(f"  balancing: volume {v.id} {high[1]['id']} -> {low[1]['id']}")
-        _vs_stub(env, low[1]["id"], low[1]["grpc_port"]).call(
-            "VolumeCopy", vpb.VolumeCopyRequest(
-                volume_id=v.id, collection=v.collection,
-                source_data_node=env.grpc_addr(high[1]["id"], high[1]["grpc_port"])),
-            vpb.VolumeCopyResponse, timeout=600)
-        _vs_stub(env, high[1]["id"], high[1]["grpc_port"]).call(
-            "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=v.id),
-            vpb.VolumeDeleteResponse)
+        env.println(f"  balancing: volume {v.id} {high_id} -> {low_id}")
+        _safe_copy_volume(env, v.id, v.collection, info[high_id],
+                          info[low_id], delete_source=True)
+        state[low_id][v.id] = v
+        del state[high_id][v.id]
     env.println("balanced")
 
 
@@ -372,34 +408,8 @@ def cmd_volume_server_evacuate(env: CommandEnv, args):
                 continue
             dst = candidates[rr % len(candidates)]
             rr += 1
-            src_stub = _vs_stub(env, src["id"], src["grpc_port"])
-            # freeze writes for the copy: a .dat streamed while appends
-            # land would pair with a longer .idx and tear the clone.
-            # Remember the prior flag so a failed copy doesn't clobber a
-            # tiered/operator-frozen read-only state on rollback.
-            was_ro = src_stub.call(
-                "VolumeStatus", vpb.VolumeStatusRequest(volume_id=v.id),
-                vpb.VolumeStatusResponse).is_read_only
-            if not was_ro:
-                src_stub.call("VolumeMarkReadonly",
-                              vpb.VolumeMarkReadonlyRequest(volume_id=v.id),
-                              vpb.VolumeMarkReadonlyResponse)
-            try:
-                _vs_stub(env, dst["id"], dst["grpc_port"]).call(
-                    "VolumeCopy", vpb.VolumeCopyRequest(
-                        volume_id=v.id, collection=v.collection,
-                        source_data_node=src_addr),
-                    vpb.VolumeCopyResponse, timeout=600)
-            except Exception:
-                if not was_ro:
-                    src_stub.call(
-                        "VolumeMarkWritable",
-                        vpb.VolumeMarkWritableRequest(volume_id=v.id),
-                        vpb.VolumeMarkWritableResponse)
-                raise
-            src_stub.call(
-                "VolumeDelete", vpb.VolumeDeleteRequest(volume_id=v.id),
-                vpb.VolumeDeleteResponse)
+            _safe_copy_volume(env, v.id, v.collection, src, dst,
+                              delete_source=True)
             env.println(f"moved volume {v.id} -> {dst['id']}")
             moved += 1
         for s in disk.ec_shard_infos:

@@ -29,9 +29,12 @@ _cluster_key_lock = threading.Lock()
 
 
 def set_cluster_key(key: str) -> None:
+    """Accepts the configured signing key; stores the DERIVED gRPC-plane
+    key so control-plane bearer tokens never double as data-plane JWTs."""
+    from ..security.jwt import derive_cluster_key
     global _cluster_key
     with _cluster_key_lock:
-        _cluster_key = key
+        _cluster_key = derive_cluster_key(key)
 
 
 def _outgoing_metadata() -> list[tuple[str, str]]:
@@ -124,9 +127,11 @@ class RpcService:
 
 def serve(bind: str, services: list[RpcService], max_workers: int = 16,
           auth_key: str = "") -> grpc.Server:
+    from ..security.jwt import derive_cluster_key
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
-        interceptors=([_AuthInterceptor(auth_key)] if auth_key else []),
+        interceptors=([_AuthInterceptor(derive_cluster_key(auth_key))]
+                      if auth_key else []),
         options=[("grpc.max_receive_message_length", 256 << 20),
                  ("grpc.max_send_message_length", 256 << 20)])
     for s in services:

@@ -168,6 +168,37 @@ class TestPubSub:
         finally:
             b2.stop()
 
+    def test_acked_tail_survives_restart(self, broker_stack):
+        """A stopped broker flushes its partial tail segment, so acked
+        messages below SEGMENT_FLUSH_COUNT survive a restart, and sealed
+        segments are trimmed from broker memory."""
+        from seaweedfs_tpu.mq import BrokerServer
+        from seaweedfs_tpu.mq.client import Publisher, subscribe
+
+        fs = broker_stack["fs"]
+        ms = broker_stack["ms"]
+        b1 = BrokerServer(ms.address, port=_fp(), filer_server=fs).start()
+        pub = Publisher(b1.address, "audit", "trail")
+        for i in range(1205):  # one sealed segment + 205-message tail
+            pub.publish(b"k", f"ev-{i}".encode())
+        pub.close()
+        lg = next(lg for key, lg in b1.logs.items() if "audit" in key[0])
+        assert lg.base_offset == 1000  # sealed segment trimmed from memory
+        assert len(lg.messages) == 205
+        b1.stop()  # flushes the 205-message partial tail
+        b2 = BrokerServer(ms.address, port=_fp(), filer_server=fs).start()
+        try:
+            got = list(subscribe(b2.address, "audit", "trail",
+                                 start_offset=0))
+            assert len(got) == 1205
+            assert got[-1][2] == b"ev-1204"
+            # old offsets served from sealed filer segments, not memory
+            old = list(subscribe(b2.address, "audit", "trail",
+                                 start_offset=500))
+            assert old[0][0] == 500 and old[0][2] == b"ev-500"
+        finally:
+            b2.stop()
+
     def test_lookup_unknown_topic(self, broker_stack):
         import grpc
 

@@ -240,6 +240,37 @@ class TestFilerSync:
         assert fb.filer.find_entry("/del", "gone.txt") is None
         sync.stop()
 
+    def test_transient_failure_retried_not_skipped(self, two_filers):
+        """ADVICE r1: a transient sink failure must be retried, not
+        permanently skipped by saving the offset past it."""
+        fa, fb = two_filers
+        sync = FilerSync(fa, fb, from_ns=time_ns_now(),
+                         retry_base_delay=0.05)
+        fails = {"n": 2}
+        real = sync.replicator.replicate
+
+        def flaky(directory, ev):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise ConnectionError("transient sink outage")
+            return real(directory, ev)
+
+        sync.replicator.replicate = flaky
+        sync.start()
+        fa.write_file("/retry/flaky.txt", b"eventually lands")
+        deadline = time.time() + 10
+        e = None
+        while time.time() < deadline:
+            e = fb.filer.find_entry("/retry", "flaky.txt")
+            if e is not None:
+                break
+            time.sleep(0.05)
+        assert e is not None, "event skipped instead of retried"
+        assert fb.read_entry_bytes(e) == b"eventually lands"
+        assert fails["n"] == 0 and sync.applied >= 1
+        assert sync.dead_lettered == 0
+        sync.stop()
+
 
 def time_ns_now():
     return time.time_ns()

@@ -81,10 +81,26 @@ class TestGuard:
         ok, why = g.check_write("1.1.1.1", {"jwt": other}, {}, fid)
         assert not ok and "mismatch" in why
 
-    def test_wildcard_filer_token(self):
+    def test_filer_token_is_not_a_wildcard(self):
+        # an empty-fid (filer-style) claim must NOT pass a fid-scoped
+        # check (volume_server_handlers.go:199 requires an exact match)
         g = Guard(signing_key="sekrit")
         tok = gen_jwt_for_filer_server("sekrit", 10)
-        assert g.check_write("1.1.1.1", {"jwt": tok}, {}, "3,aa")[0]
+        ok, why = g.check_write("1.1.1.1", {"jwt": tok}, {}, "3,aa")
+        assert not ok and "mismatch" in why
+        # ...but still authenticates non-fid-scoped endpoints
+        assert g.check_write("1.1.1.1", {"jwt": tok}, {})[0]
+
+    def test_cluster_key_is_not_a_write_token(self):
+        from seaweedfs_tpu.security.jwt import derive_cluster_key
+        derived = derive_cluster_key("sekrit")
+        assert derived and derived != "sekrit"
+        # a gRPC-plane bearer token signed with the derived key must not
+        # validate against the HTTP guard's raw signing key
+        g = Guard(signing_key="sekrit")
+        tok = gen_jwt_for_filer_server(derived, 10)
+        ok, why = g.check_write("1.1.1.1", {"jwt": tok}, {}, "3,aa")
+        assert not ok
 
     def test_basic_auth(self):
         import base64
