@@ -54,6 +54,7 @@ class PartitionLog:
         # can never clobber a just-sealed full segment with a stale partial
         self._io_mu = threading.Lock()
         self._max_sealed = -1  # highest segment index written as full
+        self._last_tail_flush = (-1, -1)  # (segment idx, length) persisted
         if filer is not None:
             self._replay()
 
@@ -137,9 +138,16 @@ class PartitionLog:
         with self._io_mu:
             with self._lock:
                 n, batch = self._full_segments, list(self.messages)
+            # never write MORE than a segment's worth: a crash would make
+            # _replay mis-count the oversized file as exactly one sealed
+            # segment, orphaning the excess and reusing their offsets
+            batch = batch[:SEGMENT_FLUSH_COUNT]
             if not batch or n <= self._max_sealed:
                 return  # nothing new, or that index already sealed full
+            if self._last_tail_flush == (n, len(batch)):
+                return  # idle partition: skip the redundant re-upload
             self._write_segment(n, batch)
+            self._last_tail_flush = (n, len(batch))
 
     def _seal_full_segments(self) -> None:
         """Persist full segments; memory is trimmed only AFTER each file

@@ -839,15 +839,26 @@ class VolumeServer:
             src = Stub(req.source_data_node, VOLUME_SERVICE)
             loc = store._location_for(req.disk_type or None)
             base = loc.base_name(req.collection, req.volume_id)
-            for ext in (".dat", ".idx"):
-                with open(base + ext, "wb") as f:
-                    for r in src.call_stream(
-                            "CopyFile",
-                            vpb.CopyFileRequest(volume_id=req.volume_id,
-                                                collection=req.collection,
-                                                ext=ext),
-                            vpb.CopyFileResponse):
-                        f.write(r.file_content)
+            try:
+                for ext in (".dat", ".idx"):
+                    with open(base + ext, "wb") as f:
+                        for r in src.call_stream(
+                                "CopyFile",
+                                vpb.CopyFileRequest(volume_id=req.volume_id,
+                                                    collection=req.collection,
+                                                    ext=ext),
+                                vpb.CopyFileResponse):
+                            f.write(r.file_content)
+            except Exception:
+                # remove the partial clone: left on disk it would be
+                # mounted as a live truncated volume on restart and block
+                # every retry with "volume already here"
+                for ext in (".dat", ".idx"):
+                    try:
+                        os.remove(base + ext)
+                    except OSError:
+                        pass
+                raise
             from ..storage.volume import Volume as _Volume
             v = _Volume(loc.directory, req.collection, req.volume_id,
                         create_if_missing=False)

@@ -1,0 +1,243 @@
+"""Interop proof against the reference's checked-in binary fixtures.
+
+The reference ships a real volume (weed/storage/erasure_coding/1.dat +
+1.idx) exactly so implementations can validate EC compatibility
+(ec_test.go:21 TestEncodingDecoding encodes it and re-reads every needle
+from the shard files). This suite does the same with OUR pipeline:
+
+- parse the reference .dat/.idx with the big-endian reference-format
+  readers (storage/ref_format.py) — the migration-import path
+- CRC32C-verify every needle payload (same Castagnoli polynomial)
+- build the .ecx the way WriteSortedFileFromIdx does and check it against
+  an independently-derived expectation, byte for byte
+- EC-encode the .dat with the fork's RS(14,2) production geometry, then
+  re-read every live needle's bytes from the shard files through the
+  stripe locator and byte-compare against the .dat (validateFiles,
+  ec_test.go:43-75)
+- decode shards back to a byte-identical .dat; rebuild destroyed shards
+  byte-identically (<= p losses)
+"""
+
+import os
+import shutil
+import struct
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import files
+from seaweedfs_tpu.ec.encoder import decode_volume, encode_volume, rebuild_shards
+from seaweedfs_tpu.ec.locate import EcGeometry, locate
+from seaweedfs_tpu.ops.coder import NumpyCoder
+from seaweedfs_tpu.storage import ref_format
+
+FIXTURE_DIR = "/root/reference/weed/storage/erasure_coding"
+# the fork's production EC parameters (ec_encoder.go:17-23)
+GEO = EcGeometry(d=14, p=2)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(FIXTURE_DIR, "1.dat")),
+    reason="reference fixtures not mounted")
+
+
+@pytest.fixture(scope="module")
+def fixture(tmp_path_factory):
+    """Copy the read-only fixtures somewhere writable and parse them."""
+    work = tmp_path_factory.mktemp("interop")
+    for ext in (".dat", ".idx"):
+        shutil.copy(os.path.join(FIXTURE_DIR, "1" + ext), work / ("1" + ext))
+    base = str(work / "1")
+    sb, needles = ref_format.walk_dat(base + ".dat")
+    idx = ref_format.read_idx(base + ".idx")
+    return {"base": base, "sb": sb, "needles": needles, "idx": idx}
+
+
+class TestReferenceFormatParse:
+    def test_super_block(self, fixture):
+        sb = fixture["sb"]
+        assert sb.version in (2, 3)
+        assert sb.block_size >= 8
+
+    def test_walk_covers_whole_dat(self, fixture):
+        """The sequential scan must account for every byte (same walk as
+        `weed fix` rebuilding an idx from a dat, command/fix.go:74)."""
+        size = os.path.getsize(fixture["base"] + ".dat")
+        sb, needles = fixture["sb"], fixture["needles"]
+        end = sb.block_size
+        for n in needles:
+            raw = n.extra.get("raw_size", n.size)
+            body = 0 if raw == ref_format.TOMBSTONE else n.size
+            end = n.offset + ref_format.record_size(body, sb.version)
+        assert end == size
+
+    def test_every_needle_crc_verifies(self, fixture):
+        """Our CRC32C (ops/crc32c.py) must match the reference's
+        Castagnoli checksums stored in the fixture."""
+        live = [n for n in fixture["needles"] if not n.is_tombstone]
+        assert live, "fixture has no live needles?"
+        bad = [hex(n.id) for n in live if not n.crc_ok]
+        assert not bad, f"CRC mismatch on needles {bad[:5]}"
+
+    def test_idx_entries_match_dat_records(self, fixture):
+        """Every live .idx entry points at a record whose header id
+        matches the key (stored_offset is in 8-byte units)."""
+        by_offset = {n.offset: n for n in fixture["needles"]}
+        checked = 0
+        for key, stored, size in fixture["idx"]:
+            if size == ref_format.TOMBSTONE:
+                continue
+            n = by_offset.get(stored * 8)
+            assert n is not None, f"idx entry {key:x} points at nothing"
+            assert n.id == key
+            assert n.size == size
+            checked += 1
+        assert checked > 0
+
+
+class TestMatrixConstruction:
+    def test_matches_independent_implementation(self):
+        """Re-derive the klauspost/Backblaze systematic matrix with a
+        from-scratch pure-int GF(2^8) implementation (no shared tables)
+        and compare. Guards the interop-critical construction
+        (reedsolomon buildMatrix; gf8.py encode_matrix) against table
+        bugs for both supported geometries."""
+        def pmul(a, b):  # carry-less mul mod 0x11D, no lookup tables
+            r = 0
+            while b:
+                if b & 1:
+                    r ^= a
+                a <<= 1
+                if a & 0x100:
+                    a ^= 0x11D
+                b >>= 1
+            return r
+
+        def ppow(a, n):
+            r = 1
+            for _ in range(n):
+                r = pmul(r, a)
+            return r
+
+        def pinv(a):
+            for x in range(1, 256):
+                if pmul(a, x) == 1:
+                    return x
+            raise ZeroDivisionError
+
+        def mat_mul(A, B):
+            n, k, m = len(A), len(B), len(B[0])
+            return [[__import__("functools").reduce(
+                lambda acc, t: acc ^ pmul(A[i][t], B[t][j]),
+                range(k), 0) for j in range(m)] for i in range(n)]
+
+        def mat_inv(M):
+            n = len(M)
+            aug = [row[:] + [1 if i == j else 0 for j in range(n)]
+                   for i, row in enumerate(M)]
+            for col in range(n):
+                piv = next(r for r in range(col, n) if aug[r][col])
+                aug[col], aug[piv] = aug[piv], aug[col]
+                inv = pinv(aug[col][col])
+                aug[col] = [pmul(inv, x) for x in aug[col]]
+                for r in range(n):
+                    if r != col and aug[r][col]:
+                        f = aug[r][col]
+                        aug[r] = [a ^ pmul(f, b)
+                                  for a, b in zip(aug[r], aug[col])]
+            return [row[n:] for row in aug]
+
+        from seaweedfs_tpu.ops.gf8 import encode_matrix
+        for d, p in ((14, 2), (10, 4)):
+            n = d + p
+            vand = [[ppow(r, c) for c in range(d)] for r in range(n)]
+            expect = mat_mul(vand, mat_inv([row[:] for row in vand[:d]]))
+            got = encode_matrix(d, p)
+            assert got.shape == (n, d)
+            assert [[int(x) for x in row] for row in got] == expect
+
+
+class TestEcxConversion:
+    def test_sorted_ecx_bytes(self, fixture, tmp_path):
+        """write_sorted_ecx output == the .idx's own 16B entries sorted by
+        big-endian key — the exact WriteSortedFileFromIdx contract
+        (ec_encoder.go:27)."""
+        base = fixture["base"]
+        ecx = str(tmp_path / "1.ecx")
+        count = ref_format.write_sorted_ecx(base + ".idx", ecx)
+        raw = open(base + ".idx", "rb").read()
+        assert count == len(raw) // 16
+        # independent derivation: numpy big-endian sort of the raw entries
+        arr = np.frombuffer(raw[: len(raw) - len(raw) % 16],
+                            dtype=np.uint8).reshape(-1, 16)
+        keys = arr[:, :8].copy().view(">u8").ravel()
+        expect = arr[np.argsort(keys, kind="stable")].tobytes()
+        got = open(ecx, "rb").read()
+        assert got == expect
+        # ascending keys, 16-byte stride
+        got_keys = [struct.unpack(">Q", got[i:i + 8])[0]
+                    for i in range(0, len(got), 16)]
+        assert got_keys == sorted(got_keys)
+
+
+class TestEcEncodeFixture:
+    @pytest.fixture(scope="class")
+    def encoded(self, fixture):
+        base = fixture["base"]
+        coder = NumpyCoder(GEO.d, GEO.p)
+        encode_volume(base + ".dat", base, GEO, coder,
+                      idx_path=base + ".idx")
+        return {"base": base, "coder": coder}
+
+    def test_shard_sizes(self, fixture, encoded):
+        dat_size = os.path.getsize(fixture["base"] + ".dat")
+        want = GEO.shard_file_size(dat_size)
+        for i in range(GEO.n):
+            assert os.path.getsize(
+                encoded["base"] + files.shard_ext(i)) == want
+
+    def test_validate_files(self, fixture, encoded):
+        """ec_test.go:43 validateFiles: every live needle's bytes re-read
+        from the shard files equal the .dat bytes."""
+        base = encoded["base"]
+        dat = np.fromfile(base + ".dat", dtype=np.uint8)
+        shards = [np.fromfile(base + files.shard_ext(i), dtype=np.uint8)
+                  for i in range(GEO.d)]  # data shards suffice when intact
+        sb = fixture["sb"]
+        checked = 0
+        for key, stored, size in fixture["idx"]:
+            if size == ref_format.TOMBSTONE:
+                continue
+            offset = stored * 8
+            length = ref_format.record_size(size, sb.version)
+            want = dat[offset:offset + length]
+            got = bytearray()
+            for iv in locate(GEO, dat.size, offset, length):
+                sid, soff = iv.shard_and_offset(GEO)
+                got += shards[sid][soff:soff + iv.size].tobytes()
+            assert bytes(got) == want.tobytes(), f"needle {key:x} mismatch"
+            checked += 1
+        assert checked >= 100  # the fixture holds a few hundred needles
+
+    def test_decode_roundtrip(self, fixture, encoded, tmp_path):
+        base = encoded["base"]
+        out = str(tmp_path / "roundtrip.dat")
+        decode_volume(base, out, GEO, encoded["coder"])
+        orig = open(base + ".dat", "rb").read()
+        dec = open(out, "rb").read()
+        assert dec[:len(orig)] == orig
+        assert not any(dec[len(orig):])  # only stripe padding past the end
+
+    def test_rebuild_two_lost_shards(self, fixture, encoded):
+        """RS(14,2): destroy one data + one parity shard, rebuild both
+        bit-for-bit (RebuildEcFiles, ec_encoder.go:61)."""
+        base = encoded["base"]
+        victims = [3, GEO.d]  # .ec03 (data) + .ec14 (parity)
+        originals = {i: open(base + files.shard_ext(i), "rb").read()
+                     for i in victims}
+        for i in victims:
+            os.remove(base + files.shard_ext(i))
+        rebuilt = rebuild_shards(base, GEO, encoded["coder"])
+        assert sorted(rebuilt) == sorted(victims)
+        for i in victims:
+            assert open(base + files.shard_ext(i),
+                        "rb").read() == originals[i]
