@@ -50,42 +50,6 @@ def iter_rows(geo: EcGeometry, dat_size: int) -> Iterator[RowSpan]:
         shard_off += geo.small_block
 
 
-def _read_span(mm: np.ndarray, start: int, length: int) -> np.ndarray:
-    """Read [start, start+length) from a 1-D uint8 memmap, zero-padded at EOF."""
-    end = min(start + length, mm.shape[0])
-    if start >= mm.shape[0]:
-        return np.zeros(length, dtype=np.uint8)
-    chunk = np.asarray(mm[start:end])
-    if chunk.shape[0] < length:
-        chunk = np.concatenate([chunk, np.zeros(length - chunk.shape[0], dtype=np.uint8)])
-    return chunk
-
-
-class _SlabBatcher:
-    """Accumulates (slab, sinks) pairs and flushes [B, d|?, C] device calls."""
-
-    def __init__(self, batch: int, shape: tuple[int, int]):
-        self.batch = batch
-        self.shape = shape
-        self.slabs: list[np.ndarray] = []
-        self.sinks: list[list[tuple[np.ndarray, int, int]]] = []
-
-    def add(self, slab: np.ndarray, sinks: list[tuple[np.ndarray, int, int]]) -> bool:
-        self.slabs.append(slab)
-        self.sinks.append(sinks)
-        return len(self.slabs) >= self.batch
-
-    def take(self) -> tuple[np.ndarray, list[list[tuple[np.ndarray, int, int]]]]:
-        # always emit a full [batch, ...] array (stable jit shapes); unused
-        # trailing rows are zero and have no sinks
-        arr = np.zeros((self.batch, *self.shape), dtype=np.uint8)
-        for i, s in enumerate(self.slabs):
-            arr[i] = s
-        sinks = self.sinks
-        self.slabs, self.sinks = [], []
-        return arr, sinks
-
-
 def encode_volume(dat_path: str, out_base: str, geo: EcGeometry,
                   coder: ErasureCoder, idx_path: str | None = None,
                   chunk: int = DEFAULT_CHUNK, batch: int = DEFAULT_BATCH,
@@ -93,63 +57,13 @@ def encode_volume(dat_path: str, out_base: str, geo: EcGeometry,
     """Produce .ec00..ec{n-1} (+ .ecx if idx_path given). Returns shard paths.
 
     Reference flow: VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:39)
-    -> WriteEcFiles + WriteSortedFileFromIdx.
+    -> WriteEcFiles + WriteSortedFileFromIdx. Single-volume wrapper over the
+    streaming multi-volume pipeline (ec/stream.py).
     """
-    assert coder.d == geo.d and coder.p == geo.p
-    dat_size = os.path.getsize(dat_path)
-    shard_size = geo.shard_file_size(dat_size)
-    paths = [out_base + files.shard_ext(i) for i in range(geo.n)]
-    if dat_size == 0:
-        for p in paths:
-            open(p, "wb").close()
-        if idx_path and os.path.exists(idx_path):
-            files.write_ecx_from_idx(idx_path, out_base + ".ecx")
-        files.write_vif(out_base + ".vif", version=3, dat_size=0,
-                        d=geo.d, p=geo.p, large_block=geo.large_block,
-                        small_block=geo.small_block)
-        return paths
-    mm_in = np.memmap(dat_path, dtype=np.uint8, mode="r")
-    outs = []
-    for p in paths:
-        with open(p, "wb") as f:
-            f.truncate(shard_size)
-        outs.append(np.memmap(p, dtype=np.uint8, mode="r+", shape=(shard_size,)))
-
-    chunk = min(chunk, max(geo.small_block, 1))
-    batcher = _SlabBatcher(batch, (geo.d, chunk))
-
-    def flush():
-        if not batcher.slabs:
-            return
-        arr, sinks = batcher.take()
-        from ..stats import EC_ENCODE_BYTES
-        EC_ENCODE_BYTES.inc(type(coder).__name__, amount=arr.nbytes)
-        parity = np.asarray(coder.encode(arr))  # [B, p, chunk]
-        for b, slab_sinks in enumerate(sinks):
-            for j, (out, off, ln) in enumerate(slab_sinks):
-                out[off:off + ln] = parity[b, j, :ln]
-
-    for row in iter_rows(geo, dat_size):
-        for coff in range(0, row.block, chunk):
-            clen = min(chunk, row.block - coff)
-            slab = np.zeros((geo.d, chunk), dtype=np.uint8)
-            for i in range(geo.d):
-                src = row.logical_start + i * row.block + coff
-                slab[i, :clen] = _read_span(mm_in, src, clen)
-                # data shards: direct copy
-                outs[i][row.shard_offset + coff: row.shard_offset + coff + clen] = slab[i, :clen]
-            sinks = [(outs[geo.d + j], row.shard_offset + coff, clen) for j in range(geo.p)]
-            if batcher.add(slab, sinks):
-                flush()
-    flush()
-    for o in outs:
-        o.flush()
-    if idx_path and os.path.exists(idx_path):
-        files.write_ecx_from_idx(idx_path, out_base + ".ecx")
-    files.write_vif(out_base + ".vif", version=3, dat_size=dat_size,
-                    d=geo.d, p=geo.p, large_block=geo.large_block,
-                    small_block=geo.small_block)
-    return paths
+    from . import stream
+    res = stream.encode_volumes([(dat_path, out_base, idx_path)], geo, coder,
+                                chunk=min(chunk, geo.small_block), batch=batch)
+    return res[dat_path]
 
 
 def find_shards(base: str, n: int) -> dict[int, str]:
@@ -187,23 +101,40 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
 
     present_t = tuple(use)
     wanted_t = tuple(missing)
-    for off in range(0, shard_size, chunk * batch):
+    from ..stats import EC_REBUILD_BYTES
+    from collections import deque
+    depth = 2
+    pool = [np.zeros((batch, geo.d, chunk), dtype=np.uint8)
+            for _ in range(depth + 2)]
+    pending: deque = deque()
+
+    def drain(item):
+        fut, off, span, nb = item
+        rebuilt = np.asarray(fut)
+        for k, m in enumerate(missing):
+            outs[m][off:off + span] = rebuilt[:nb, k].reshape(-1)[:span]
+
+    for slot, off in enumerate(range(0, shard_size, chunk * batch)):
         span = min(chunk * batch, shard_size - off)
         nb = (span + chunk - 1) // chunk
-        arr = np.zeros((batch, geo.d, chunk), dtype=np.uint8)
-        lens = []
-        for b in range(nb):
-            o = off + b * chunk
-            ln = min(chunk, shard_size - o)
-            lens.append((o, ln))
-            for r, mm in enumerate(survivors):
-                arr[b, r, :ln] = mm[o:o + ln]
-        from ..stats import EC_REBUILD_BYTES
+        arr = pool[slot % len(pool)]
+        # vectorized survivor load: one strided copy per survivor shard
+        for r, mm in enumerate(survivors):
+            if span < nb * chunk:
+                padded = np.zeros(nb * chunk, dtype=np.uint8)
+                padded[:span] = mm[off:off + span]
+                arr[:nb, r] = padded.reshape(nb, chunk)
+            else:
+                arr[:nb, r] = np.asarray(mm[off:off + span]).reshape(nb, chunk)
+        if nb < batch:
+            arr[nb:] = 0
         EC_REBUILD_BYTES.inc(type(coder).__name__, amount=arr.nbytes)
-        rebuilt = np.asarray(coder.reconstruct(arr, present_t, wanted_t))
-        for b, (o, ln) in enumerate(lens):
-            for k, m in enumerate(missing):
-                outs[m][o:o + ln] = rebuilt[b, k, :ln]
+        pending.append((coder.reconstruct(arr, present_t, wanted_t),
+                        off, span, nb))
+        if len(pending) > depth:
+            drain(pending.popleft())
+    while pending:
+        drain(pending.popleft())
     for o in outs.values():
         o.flush()
     return missing
@@ -231,11 +162,28 @@ def decode_volume(base: str, dat_out: str, geo: EcGeometry,
     with open(dat_out, "wb") as f:
         f.truncate(dat_size)
     out = np.memmap(dat_out, dtype=np.uint8, mode="r+", shape=(dat_size,))
-    for row in iter_rows(geo, dat_size):
-        for i in range(geo.d):
-            dst = row.logical_start + i * row.block
-            if dst >= dat_size:
-                break
-            ln = min(row.block, dat_size - dst)
-            out[dst:dst + ln] = shards[i][row.shard_offset:row.shard_offset + ln]
+    # vectorized region copies (mirror of stream._VolumePlan region views)
+    d, lb, sb = geo.d, geo.large_block, geo.small_block
+    nl = geo.large_rows(dat_size)
+    large_bytes = nl * d * lb
+    if nl:
+        view = out[:large_bytes].reshape(nl, d, lb)
+        for i in range(d):
+            view[:, i, :] = np.asarray(shards[i][:nl * lb]).reshape(nl, lb)
+    rest = dat_size - large_bytes
+    full = rest // (d * sb)
+    if full:
+        view = out[large_bytes:large_bytes + full * d * sb].reshape(full, d, sb)
+        for i in range(d):
+            view[:, i, :] = np.asarray(
+                shards[i][nl * lb:nl * lb + full * sb]).reshape(full, sb)
+    tail_start = large_bytes + full * d * sb
+    pos = tail_start
+    base = nl * lb + full * sb
+    for i in range(d):
+        if pos >= dat_size:
+            break
+        ln = min(sb, dat_size - pos)
+        out[pos:pos + ln] = shards[i][base:base + ln]
+        pos += ln
     out.flush()

@@ -1,0 +1,236 @@
+"""Streaming multi-volume EC encode: disk -> host views -> device -> shards.
+
+Reference hot loop: weed/storage/erasure_coding/ec_encoder.go:198-233
+(`encodeDatFile`) reads 14 x 256 KB striped buffers per row and calls the CPU
+encoder once per slab (:166-196 `encodeDataOneBatch`), one volume at a time.
+
+This module replaces that with a TPU-shaped pipeline:
+
+* **Vectorized stripe views.** A .dat's large region is *already* a
+  [rows, d, large_block] tensor laid out contiguously on disk; numpy reshapes
+  of the memmap expose every slab as a strided view. Data-shard bytes are
+  extracted with one strided copy per (shard, region) — no per-chunk Python
+  loops. The small region works the same with [rows, d, small_block].
+* **Fixed-shape device batches.** Parity is computed over [B, d, C] uint8
+  slabs (C = 1 MB, B = 32 by default -> 320 MB of data per device call at
+  d=10) so XLA compiles exactly one program.
+* **Async double buffering.** `ErasureCoder.encode` on the JAX path is an
+  async dispatch; the pipeline keeps `depth` batches in flight and only
+  blocks when fetching parity bytes for slab N while N+1..N+depth transfer
+  and compute. Host staging buffers rotate through a pool sized depth+2 so a
+  buffer is never overwritten while its transfer may be in flight.
+* **Cross-volume batching.** `encode_volumes` feeds slabs from many volumes
+  through one shared batch stream; a batch may span the tail of volume k and
+  the head of volume k+1, so the device never sees a partial batch until the
+  very end of the whole job (reference encodes volumes serially,
+  command_ec_encode.go:113-126).
+
+Shard-file writes stay vectorized too: each batch's parity rows form
+contiguous runs inside each shard file (stripe rows are consecutive), so a
+run writes `parity[b0:b0+k, j].reshape(-1)` with one strided copy per parity
+shard.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ops.coder import ErasureCoder
+from . import files
+from .locate import EcGeometry
+
+DEFAULT_CHUNK = 1 << 20   # device slab length (= reference small block)
+DEFAULT_BATCH = 32        # slabs per device call
+DEFAULT_DEPTH = 2         # batches in flight beyond the one being drained
+
+
+@dataclass
+class _Run:
+    """k consecutive slabs of one volume occupying batch rows [b0, b0+k)."""
+    outs: list[np.ndarray]      # the volume's shard memmaps
+    shard_off: int              # where slab 0's parity lands in each shard file
+    b0: int
+    k: int
+
+
+@dataclass
+class _VolumePlan:
+    """Slab enumeration state for one volume's .dat."""
+    dat_path: str
+    out_base: str
+    idx_path: str | None
+    geo: EcGeometry
+    chunk: int
+    dat_size: int = 0
+    shard_size: int = 0
+    outs: list[np.ndarray] = field(default_factory=list)
+    # (view4d [rows, d, nch, C], shard_base, rows, nch) per region
+    regions: list[tuple[np.ndarray, int, int, int]] = field(default_factory=list)
+    # iteration cursor: (region_idx, row, chunk)
+    _pos: tuple[int, int, int] = (0, 0, 0)
+
+    def open(self) -> None:
+        geo, chunk = self.geo, self.chunk
+        self.dat_size = os.path.getsize(self.dat_path)
+        self.shard_size = geo.shard_file_size(self.dat_size)
+        paths = [self.out_base + files.shard_ext(i) for i in range(geo.n)]
+        for p in paths:
+            with open(p, "wb") as f:
+                if self.shard_size:
+                    f.truncate(self.shard_size)
+        if self.dat_size == 0:
+            self.outs = []
+            return
+        self.outs = [np.memmap(p, dtype=np.uint8, mode="r+",
+                               shape=(self.shard_size,)) for p in paths]
+        mm = np.memmap(self.dat_path, dtype=np.uint8, mode="r")
+
+        nl = geo.large_rows(self.dat_size)
+        lb, sb, d = geo.large_block, geo.small_block, geo.d
+        large_bytes = nl * d * lb
+        regions = []
+        if nl:
+            nch = lb // chunk
+            v = np.asarray(mm[:large_bytes]).reshape(nl, d, nch, chunk)
+            regions.append((v, 0, nl, nch))
+        rest = self.dat_size - large_bytes
+        ns = geo.small_rows(self.dat_size)
+        if ns:
+            nchs = sb // chunk
+            full = rest // (d * sb)
+            if full:
+                v = np.asarray(
+                    mm[large_bytes:large_bytes + full * d * sb]
+                ).reshape(full, d, nchs, chunk)
+                regions.append((v, nl * lb, full, nchs))
+            tail = rest - full * d * sb
+            if tail:
+                pad = np.zeros((1, d, nchs, chunk), dtype=np.uint8)
+                flat = pad.reshape(-1)
+                flat[:tail] = mm[large_bytes + full * d * sb:]
+                regions.append((pad, nl * lb + full * sb, 1, nchs))
+        self.regions = regions
+
+    def copy_data_shards(self) -> None:
+        """Data shards are pure byte moves: one strided copy per (shard, region)."""
+        d = self.geo.d
+        for view, base, rows, nch in self.regions:
+            span = rows * nch * self.chunk
+            for i in range(d):
+                self.outs[i][base:base + span] = view[:, i].reshape(-1)
+
+    def fill(self, buf: np.ndarray, b0: int) -> tuple[int, int | None]:
+        """Fill buf[b0:] with the next slabs; return (rows_filled, shard_off).
+
+        shard_off is where the first filled slab's parity goes (None if this
+        volume is exhausted). Slabs within one call are guaranteed contiguous
+        in the shard files.
+        """
+        ri, row, ch = self._pos
+        if ri >= len(self.regions):
+            return 0, None
+        view, base, rows, nch = self.regions[ri]
+        space = buf.shape[0] - b0
+        # contiguous slabs remaining in the current row
+        k = min(space, nch - ch)
+        buf[b0:b0 + k] = view[row, :, ch:ch + k].transpose(1, 0, 2)
+        shard_off = base + (row * nch + ch) * self.chunk
+        ch += k
+        if ch == nch:
+            row, ch = row + 1, 0
+            if row == rows:
+                ri, row = ri + 1, 0
+        self._pos = (ri, row, ch)
+        return k, shard_off
+
+    def exhausted(self) -> bool:
+        return self._pos[0] >= len(self.regions)
+
+    def finish(self) -> None:
+        for o in self.outs:
+            o.flush()
+        geo = self.geo
+        if self.idx_path and os.path.exists(self.idx_path):
+            files.write_ecx_from_idx(self.idx_path, self.out_base + ".ecx")
+        files.write_vif(self.out_base + ".vif", version=3,
+                        dat_size=self.dat_size, d=geo.d, p=geo.p,
+                        large_block=geo.large_block,
+                        small_block=geo.small_block)
+
+
+def _drain(item: tuple, d: int, chunk: int) -> None:
+    parity_fut, runs = item
+    parity = np.asarray(parity_fut)  # blocks until device batch is done
+    p = parity.shape[1]
+    for run in runs:
+        span = run.k * chunk
+        for j in range(p):
+            run.outs[d + j][run.shard_off:run.shard_off + span] = \
+                parity[run.b0:run.b0 + run.k, j].reshape(-1)
+
+
+def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
+                   coder: ErasureCoder, chunk: int = DEFAULT_CHUNK,
+                   batch: int = DEFAULT_BATCH, depth: int = DEFAULT_DEPTH,
+                   ) -> "dict[str, list[str]]":
+    """Encode many volumes through one shared device stream.
+
+    jobs: (dat_path, out_base, idx_path | None) per volume.
+    Returns {dat_path: [shard paths]}.
+
+    Reference equivalent: the per-volume VolumeEcShardsGenerate RPC body
+    (volume_grpc_erasure_coding.go:39 -> WriteEcFiles ec_encoder.go:57), but
+    batched across volumes so the device always sees full [B, d, C] slabs.
+    """
+    assert coder.d == geo.d and coder.p == geo.p
+    chunk = min(chunk, geo.small_block)
+    if geo.small_block % chunk or (geo.large_block % chunk):
+        raise ValueError("chunk must divide both block sizes")
+
+    plans = []
+    out: dict[str, list[str]] = {}
+    for dat_path, out_base, idx_path in jobs:
+        plan = _VolumePlan(dat_path, out_base, idx_path, geo, chunk)
+        plan.open()
+        out[dat_path] = [out_base + files.shard_ext(i) for i in range(geo.n)]
+        if plan.dat_size == 0:
+            plan.finish()
+            continue
+        plan.copy_data_shards()
+        plans.append(plan)
+
+    from ..stats import EC_ENCODE_BYTES
+    pool = [np.zeros((batch, geo.d, chunk), dtype=np.uint8)
+            for _ in range(depth + 2)]
+    pending: deque = deque()
+    active = deque(plans)
+    slot = 0
+
+    while active:
+        buf = pool[slot]
+        slot = (slot + 1) % len(pool)
+        b0, runs = 0, []
+        while b0 < batch and active:
+            plan = active[0]
+            k, shard_off = plan.fill(buf, b0)
+            if k:
+                runs.append(_Run(plan.outs, shard_off, b0, k))
+                b0 += k
+            if plan.exhausted():
+                active.popleft()
+        if b0 < batch:
+            buf[b0:] = 0  # final partial batch: stable jit shape
+        EC_ENCODE_BYTES.inc(type(coder).__name__, amount=buf.nbytes)
+        pending.append((coder.encode(buf), runs))
+        if len(pending) > depth:
+            _drain(pending.popleft(), geo.d, chunk)
+    while pending:
+        _drain(pending.popleft(), geo.d, chunk)
+
+    for plan in plans:
+        plan.finish()
+    return out

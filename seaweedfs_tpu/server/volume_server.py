@@ -640,6 +640,18 @@ class VolumeServer:
                                      req.parity_shards or None)
             return vpb.VolumeEcShardsGenerateResponse()
 
+        @svc.unary("VolumeEcShardsGenerateBatch",
+                   vpb.VolumeEcShardsGenerateBatchRequest,
+                   vpb.VolumeEcShardsGenerateBatchResponse)
+        def ec_generate_batch(req, context):
+            done = store.generate_ec_shards_batch(
+                list(req.volume_ids), req.collection,
+                req.data_shards or None, req.parity_shards or None)
+            return vpb.VolumeEcShardsGenerateBatchResponse(
+                encoded_volume_ids=done,
+                data_shards=req.data_shards or store.ec_geometry.d,
+                parity_shards=req.parity_shards or store.ec_geometry.p)
+
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
                    vpb.VolumeEcShardsRebuildResponse)
         def ec_rebuild(req, context):

@@ -99,27 +99,39 @@ def cmd_ec_encode(env: CommandEnv, args):
     if not targets:
         env.println("no volumes eligible for ec encoding")
         return
-    n_servers = len(env.collect_volume_servers())
+    # group by source server so each server encodes ALL its volumes through
+    # one shared device stream (VolumeEcShardsGenerateBatch; ec/stream.py) —
+    # the reference loops per volume instead (command_ec_encode.go:113-126)
+    by_src: dict[tuple[str, str], tuple[dict, list[tuple[int, str]]]] = {}
     for vid, collection, srv in targets:
-        _do_ec_encode(env, vid, collection, srv,
-                      opt.dataShards, opt.parityShards)
+        by_src.setdefault((srv["id"], collection),
+                          (srv, []))[1].append((vid, collection))
+    for srv, vols in by_src.values():
+        stub = _stub(env, srv)
+        collection = vols[0][1]
+        vids = [v for v, _ in vols]
+        env.println(f"  ec.encode volumes {vids} on {srv['id']} (batched)")
+        for vid, _c in vols:  # freeze writes (command_ec_encode.go:147)
+            stub.call("VolumeMarkReadonly",
+                      vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                      vpb.VolumeMarkReadonlyResponse)
+        gen = stub.call("VolumeEcShardsGenerateBatch",
+                        vpb.VolumeEcShardsGenerateBatchRequest(
+                            volume_ids=vids, collection=collection,
+                            data_shards=opt.dataShards,
+                            parity_shards=opt.parityShards),
+                        vpb.VolumeEcShardsGenerateBatchResponse, timeout=3600)
+        for vid, coll in vols:
+            _spread_and_clean(env, vid, coll, srv,
+                              gen.data_shards, gen.parity_shards)
     env.println(f"ec encoded {len(targets)} volumes")
 
 
-def _do_ec_encode(env: CommandEnv, vid: int, collection: str, srv: dict,
-                  d: int, p: int) -> None:
+def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
+                      d: int, p: int) -> None:
+    """Distribute generated shards and delete the source volume
+    (reference command_ec_encode.go:187 spreadEcShards)."""
     stub = _stub(env, srv)
-    env.println(f"  ec.encode volume {vid} on {srv['id']}")
-    # 1. freeze writes (command_ec_encode.go:147)
-    stub.call("VolumeMarkReadonly", vpb.VolumeMarkReadonlyRequest(volume_id=vid),
-              vpb.VolumeMarkReadonlyResponse)
-    # 2. generate shards locally (device-batched on the server)
-    stub.call("VolumeEcShardsGenerate",
-              vpb.VolumeEcShardsGenerateRequest(
-                  volume_id=vid, collection=collection,
-                  data_shards=d, parity_shards=p),
-              vpb.VolumeEcShardsGenerateResponse, timeout=3600)
-    # how many shards? read vif via mount on source first
     n_shards = (d or 10) + (p or 4)
     # 3. spread (command_ec_encode.go:187): copy to targets, mount, clean src
     servers = env.collect_volume_servers()

@@ -166,6 +166,33 @@ class Store:
                       idx_path=base + ".idx")
         return base
 
+    def generate_ec_shards_batch(self, vids: "list[int]", collection: str = "",
+                                 d: int | None = None, p: int | None = None,
+                                 ) -> "list[int]":
+        """Encode many local volumes through ONE shared device stream.
+
+        TPU extension over the reference's per-volume VolumeEcShardsGenerate
+        (volume_grpc_erasure_coding.go:39): slabs from all volumes are batched
+        into fixed-shape [B, d, C] device calls so the MXU never idles on a
+        volume boundary (ec/stream.py). Returns the vids encoded.
+        """
+        from ..ec import stream
+        geo = EcGeometry(d or self.ec_geometry.d, p or self.ec_geometry.p,
+                         self.ec_geometry.large_block,
+                         self.ec_geometry.small_block)
+        jobs, done = [], []
+        for vid in vids:
+            v = self.find_volume(vid)
+            if v is None:
+                raise KeyError(f"volume {vid} not found")
+            v.sync()
+            base = v.file_name()
+            jobs.append((base + ".dat", base, base + ".idx"))
+            done.append(vid)
+        if jobs:
+            stream.encode_volumes(jobs, geo, self.coder(geo.d, geo.p))
+        return done
+
     def mount_ec_shards(self, vid: int, collection: str = "") -> EcVolume:
         for loc in self.locations:
             old = loc.ec_volumes.get(vid)
