@@ -15,8 +15,7 @@ reference — decode reproduces the original file bit-for-bit.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
-from typing import Iterator, Sequence
+from typing import Sequence
 
 import numpy as np
 
@@ -26,28 +25,6 @@ from .locate import EcGeometry
 
 DEFAULT_CHUNK = 1 << 20   # device slab length per stripe row
 DEFAULT_BATCH = 32        # slabs per device call
-
-
-@dataclass(frozen=True)
-class RowSpan:
-    """One stripe row: d consecutive blocks of `block` bytes."""
-    logical_start: int   # offset in the .dat byte stream
-    block: int           # block size (large or small)
-    shard_offset: int    # where this row's block sits inside each shard file
-
-
-def iter_rows(geo: EcGeometry, dat_size: int) -> Iterator[RowSpan]:
-    pos = 0
-    shard_off = 0
-    n_large = geo.large_rows(dat_size)
-    for _ in range(n_large):
-        yield RowSpan(pos, geo.large_block, shard_off)
-        pos += geo.large_block * geo.d
-        shard_off += geo.large_block
-    while pos < dat_size:
-        yield RowSpan(pos, geo.small_block, shard_off)
-        pos += geo.small_block * geo.d
-        shard_off += geo.small_block
 
 
 def encode_volume(dat_path: str, out_base: str, geo: EcGeometry,
@@ -62,7 +39,7 @@ def encode_volume(dat_path: str, out_base: str, geo: EcGeometry,
     """
     from . import stream
     res = stream.encode_volumes([(dat_path, out_base, idx_path)], geo, coder,
-                                chunk=min(chunk, geo.small_block), batch=batch)
+                                chunk=chunk, batch=batch)
     return res[dat_path]
 
 
@@ -102,22 +79,18 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
     present_t = tuple(use)
     wanted_t = tuple(missing)
     from ..stats import EC_REBUILD_BYTES
-    from collections import deque
-    depth = 2
-    pool = [np.zeros((batch, geo.d, chunk), dtype=np.uint8)
-            for _ in range(depth + 2)]
-    pending: deque = deque()
+    from .stream import AsyncPipe
+    pipe = AsyncPipe((batch, geo.d, chunk))
 
-    def drain(item):
-        fut, off, span, nb = item
-        rebuilt = np.asarray(fut)
+    def drain(rebuilt: np.ndarray, ctx) -> None:
+        off, span, nb = ctx
         for k, m in enumerate(missing):
             outs[m][off:off + span] = rebuilt[:nb, k].reshape(-1)[:span]
 
-    for slot, off in enumerate(range(0, shard_size, chunk * batch)):
+    for off in range(0, shard_size, chunk * batch):
         span = min(chunk * batch, shard_size - off)
         nb = (span + chunk - 1) // chunk
-        arr = pool[slot % len(pool)]
+        arr = pipe.next_buffer()
         # vectorized survivor load: one strided copy per survivor shard
         for r, mm in enumerate(survivors):
             if span < nb * chunk:
@@ -129,12 +102,9 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
         if nb < batch:
             arr[nb:] = 0
         EC_REBUILD_BYTES.inc(type(coder).__name__, amount=arr.nbytes)
-        pending.append((coder.reconstruct(arr, present_t, wanted_t),
-                        off, span, nb))
-        if len(pending) > depth:
-            drain(pending.popleft())
-    while pending:
-        drain(pending.popleft())
+        pipe.submit(coder.reconstruct(arr, present_t, wanted_t),
+                    (off, span, nb), drain)
+    pipe.flush()
     for o in outs.values():
         o.flush()
     return missing
@@ -179,11 +149,11 @@ def decode_volume(base: str, dat_out: str, geo: EcGeometry,
                 shards[i][nl * lb:nl * lb + full * sb]).reshape(full, sb)
     tail_start = large_bytes + full * d * sb
     pos = tail_start
-    base = nl * lb + full * sb
+    shard_base = nl * lb + full * sb
     for i in range(d):
         if pos >= dat_size:
             break
         ln = min(sb, dat_size - pos)
-        out[pos:pos + ln] = shards[i][base:base + ln]
+        out[pos:pos + ln] = shards[i][shard_base:shard_base + ln]
         pos += ln
     out.flush()

@@ -8,27 +8,29 @@ This module replaces that with a TPU-shaped pipeline:
 
 * **Vectorized stripe views.** A .dat's large region is *already* a
   [rows, d, large_block] tensor laid out contiguously on disk; numpy reshapes
-  of the memmap expose every slab as a strided view. Data-shard bytes are
-  extracted with one strided copy per (shard, region) — no per-chunk Python
-  loops. The small region works the same with [rows, d, small_block].
+  of the memmap expose every slab as a strided view. Each input byte is read
+  from disk ONCE: the fill pass builds the [B, d, C] parity batch with one
+  strided copy per run and the data-shard bytes are written back out of that
+  same host batch.
 * **Fixed-shape device batches.** Parity is computed over [B, d, C] uint8
   slabs (C = 1 MB, B = 32 by default -> 320 MB of data per device call at
   d=10) so XLA compiles exactly one program.
 * **Async double buffering.** `ErasureCoder.encode` on the JAX path is an
   async dispatch; the pipeline keeps `depth` batches in flight and only
-  blocks when fetching parity bytes for slab N while N+1..N+depth transfer
+  blocks when fetching parity bytes for batch N while N+1..N+depth transfer
   and compute. Host staging buffers rotate through a pool sized depth+2 so a
   buffer is never overwritten while its transfer may be in flight.
 * **Cross-volume batching.** `encode_volumes` feeds slabs from many volumes
   through one shared batch stream; a batch may span the tail of volume k and
   the head of volume k+1, so the device never sees a partial batch until the
   very end of the whole job (reference encodes volumes serially,
-  command_ec_encode.go:113-126).
+  command_ec_encode.go:113-126). Volumes are opened lazily as they enter the
+  fill window and closed as their last parity batch drains, so the number of
+  simultaneously open files stays O(batch span), not O(total volumes).
 
-Shard-file writes stay vectorized too: each batch's parity rows form
-contiguous runs inside each shard file (stripe rows are consecutive), so a
-run writes `parity[b0:b0+k, j].reshape(-1)` with one strided copy per parity
-shard.
+Shard-file writes stay vectorized too: each batch's rows form contiguous
+runs inside each shard file (stripe rows are consecutive), so a run writes
+`batch[b0:b0+k, i].reshape(-1)` with one strided copy per shard.
 """
 
 from __future__ import annotations
@@ -48,10 +50,58 @@ DEFAULT_BATCH = 32        # slabs per device call
 DEFAULT_DEPTH = 2         # batches in flight beyond the one being drained
 
 
+def fit_chunk(geo: EcGeometry, chunk: int) -> int:
+    """Largest slab length <= chunk that divides both block sizes."""
+    import math
+    g = math.gcd(geo.large_block, geo.small_block)
+    chunk = min(chunk, g)
+    while g % chunk:
+        chunk -= 1
+    return chunk
+
+
+class AsyncPipe:
+    """Depth-bounded async dispatch with a rotating host-buffer pool.
+
+    Shared by encode_volumes and encoder.rebuild_shards. `depth` batches may
+    be in flight beyond the one being drained; the pool holds depth+2
+    buffers so a buffer is never refilled while its device transfer may
+    still be reading it (a batch's input is provably consumed by the time
+    its output is fetched, and batch N's buffer is only reused at
+    N + depth + 2 > N + depth, by which point N has been drained).
+    """
+
+    def __init__(self, shape: tuple, depth: int = DEFAULT_DEPTH):
+        self.depth = depth
+        self.pool = [np.zeros(shape, dtype=np.uint8)
+                     for _ in range(depth + 2)]
+        self.pending: deque = deque()
+        self._slot = 0
+
+    def next_buffer(self) -> np.ndarray:
+        buf = self.pool[self._slot]
+        self._slot = (self._slot + 1) % len(self.pool)
+        return buf
+
+    def submit(self, fut, ctx, drain_fn) -> None:
+        """Queue (future, ctx); drain the oldest once over depth."""
+        self.pending.append((fut, ctx, drain_fn))
+        if len(self.pending) > self.depth:
+            self.drain_one()
+
+    def drain_one(self) -> None:
+        fut, ctx, drain_fn = self.pending.popleft()
+        drain_fn(np.asarray(fut), ctx)  # np.asarray blocks on the device
+
+    def flush(self) -> None:
+        while self.pending:
+            self.drain_one()
+
+
 @dataclass
 class _Run:
     """k consecutive slabs of one volume occupying batch rows [b0, b0+k)."""
-    outs: list[np.ndarray]      # the volume's shard memmaps
+    plan: "_VolumePlan"
     shard_off: int              # where slab 0's parity lands in each shard file
     b0: int
     k: int
@@ -68,6 +118,7 @@ class _VolumePlan:
     dat_size: int = 0
     shard_size: int = 0
     outs: list[np.ndarray] = field(default_factory=list)
+    inflight_runs: int = 0
     # (view4d [rows, d, nch, C], shard_base, rows, nch) per region
     regions: list[tuple[np.ndarray, int, int, int]] = field(default_factory=list)
     # iteration cursor: (region_idx, row, chunk)
@@ -115,20 +166,12 @@ class _VolumePlan:
                 regions.append((pad, nl * lb + full * sb, 1, nchs))
         self.regions = regions
 
-    def copy_data_shards(self) -> None:
-        """Data shards are pure byte moves: one strided copy per (shard, region)."""
-        d = self.geo.d
-        for view, base, rows, nch in self.regions:
-            span = rows * nch * self.chunk
-            for i in range(d):
-                self.outs[i][base:base + span] = view[:, i].reshape(-1)
-
     def fill(self, buf: np.ndarray, b0: int) -> tuple[int, int | None]:
         """Fill buf[b0:] with the next slabs; return (rows_filled, shard_off).
 
-        shard_off is where the first filled slab's parity goes (None if this
-        volume is exhausted). Slabs within one call are guaranteed contiguous
-        in the shard files.
+        shard_off is where the first filled slab lands in each shard file
+        (None if this volume is exhausted). Slabs within one call are
+        guaranteed contiguous in the shard files.
         """
         ri, row, ch = self._pos
         if ri >= len(self.regions):
@@ -153,6 +196,8 @@ class _VolumePlan:
     def finish(self) -> None:
         for o in self.outs:
             o.flush()
+        self.outs = []
+        self.regions = []
         geo = self.geo
         if self.idx_path and os.path.exists(self.idx_path):
             files.write_ecx_from_idx(self.idx_path, self.out_base + ".ecx")
@@ -162,17 +207,6 @@ class _VolumePlan:
                         small_block=geo.small_block)
 
 
-def _drain(item: tuple, d: int, chunk: int) -> None:
-    parity_fut, runs = item
-    parity = np.asarray(parity_fut)  # blocks until device batch is done
-    p = parity.shape[1]
-    for run in runs:
-        span = run.k * chunk
-        for j in range(p):
-            run.outs[d + j][run.shard_off:run.shard_off + span] = \
-                parity[run.b0:run.b0 + run.k, j].reshape(-1)
-
-
 def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
                    coder: ErasureCoder, chunk: int = DEFAULT_CHUNK,
                    batch: int = DEFAULT_BATCH, depth: int = DEFAULT_DEPTH,
@@ -180,57 +214,80 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
     """Encode many volumes through one shared device stream.
 
     jobs: (dat_path, out_base, idx_path | None) per volume.
-    Returns {dat_path: [shard paths]}.
+    Returns {dat_path: [shard paths]}. `chunk` is clamped to the largest
+    value that divides both block sizes (fit_chunk).
 
     Reference equivalent: the per-volume VolumeEcShardsGenerate RPC body
     (volume_grpc_erasure_coding.go:39 -> WriteEcFiles ec_encoder.go:57), but
     batched across volumes so the device always sees full [B, d, C] slabs.
     """
     assert coder.d == geo.d and coder.p == geo.p
-    chunk = min(chunk, geo.small_block)
-    if geo.small_block % chunk or (geo.large_block % chunk):
-        raise ValueError("chunk must divide both block sizes")
-
-    plans = []
-    out: dict[str, list[str]] = {}
-    for dat_path, out_base, idx_path in jobs:
-        plan = _VolumePlan(dat_path, out_base, idx_path, geo, chunk)
-        plan.open()
-        out[dat_path] = [out_base + files.shard_ext(i) for i in range(geo.n)]
-        if plan.dat_size == 0:
-            plan.finish()
-            continue
-        plan.copy_data_shards()
-        plans.append(plan)
+    chunk = fit_chunk(geo, chunk)
 
     from ..stats import EC_ENCODE_BYTES
-    pool = [np.zeros((batch, geo.d, chunk), dtype=np.uint8)
-            for _ in range(depth + 2)]
-    pending: deque = deque()
-    active = deque(plans)
-    slot = 0
+    out: dict[str, list[str]] = {}
+    todo = deque()
+    for dat_path, out_base, idx_path in jobs:
+        todo.append(_VolumePlan(dat_path, out_base, idx_path, geo, chunk))
+        out[dat_path] = [out_base + files.shard_ext(i) for i in range(geo.n)]
 
-    while active:
-        buf = pool[slot]
-        slot = (slot + 1) % len(pool)
+    pipe = AsyncPipe((batch, geo.d, chunk), depth)
+    d = geo.d
+
+    def drain(parity: np.ndarray, runs: "list[_Run]") -> None:
+        for run in runs:
+            span = run.k * chunk
+            for j in range(parity.shape[1]):
+                run.plan.outs[d + j][run.shard_off:run.shard_off + span] = \
+                    parity[run.b0:run.b0 + run.k, j].reshape(-1)
+            run.plan.inflight_runs -= 1
+            if run.plan.exhausted() and run.plan.inflight_runs == 0:
+                run.plan.finish()
+
+    active: deque = deque()  # opened plans still producing slabs
+
+    def pump() -> bool:
+        """Open lazily until a plan with slabs is at the front; False if done.
+
+        Exhausted plans leave `active` here; their finish() runs when their
+        last in-flight parity batch drains.
+        """
+        while not active or active[0].exhausted():
+            if active and active[0].exhausted():
+                active.popleft()
+                continue
+            if not todo:
+                return False
+            plan = todo.popleft()
+            plan.open()
+            if plan.dat_size == 0:
+                plan.finish()
+                continue
+            active.append(plan)
+        return True
+
+    while pump():
+        buf = pipe.next_buffer()
         b0, runs = 0, []
-        while b0 < batch and active:
+        while b0 < batch and pump():
             plan = active[0]
             k, shard_off = plan.fill(buf, b0)
             if k:
-                runs.append(_Run(plan.outs, shard_off, b0, k))
+                run = _Run(plan, shard_off, b0, k)
+                plan.inflight_runs += 1
+                runs.append(run)
+                # data shards come straight out of the host batch (one disk
+                # read per input byte; reference re-reads per shard)
+                span = k * chunk
+                for i in range(d):
+                    plan.outs[i][shard_off:shard_off + span] = \
+                        buf[b0:b0 + k, i].reshape(-1)
                 b0 += k
-            if plan.exhausted():
-                active.popleft()
+        if b0 == 0:
+            break
         if b0 < batch:
             buf[b0:] = 0  # final partial batch: stable jit shape
         EC_ENCODE_BYTES.inc(type(coder).__name__, amount=buf.nbytes)
-        pending.append((coder.encode(buf), runs))
-        if len(pending) > depth:
-            _drain(pending.popleft(), geo.d, chunk)
-    while pending:
-        _drain(pending.popleft(), geo.d, chunk)
-
-    for plan in plans:
-        plan.finish()
+        pipe.submit(coder.encode(buf), runs, drain)
+    pipe.flush()
     return out

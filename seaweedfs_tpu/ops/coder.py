@@ -64,19 +64,64 @@ class NumpyCoder(ErasureCoder):
 
 class JaxCoder(ErasureCoder):
     """Device coder. Accepts numpy or jax arrays; returns device arrays
-    (callers `np.asarray` when they need host bytes)."""
+    (callers `np.asarray` when they need host bytes).
+
+    On a real TPU backend the Pallas kernel (ops/rs_pallas.py) carries the
+    hot path — unpack/matmul/pack pinned in VMEM; elsewhere (CPU tests,
+    GPU) it falls back to the XLA einsum formulation (ops/rs_jax.py).
+    """
+
+    def __init__(self, d: int, p: int, use_pallas: "bool | None" = None):
+        super().__init__(d, p)
+        if use_pallas is None:
+            from . import rs_pallas
+            use_pallas = rs_pallas.available()
+        self.use_pallas = use_pallas
+        self._interpret = False  # PallasCoder flips this for CPU tests
 
     def encode(self, data):
+        if self.use_pallas:
+            from . import rs_pallas
+            x, squeeze = _as_batch(data)
+            out = rs_pallas.encode_jit(x, self.d, self.p,
+                                       interpret=self._interpret)
+            return out[0] if squeeze else out
         from . import rs_jax
         return rs_jax.encode_jit(data, self.d, self.p)
 
     def reconstruct(self, survivors, present, wanted):
+        if self.use_pallas:
+            from . import rs_pallas
+            x, squeeze = _as_batch(survivors)
+            out = rs_pallas.reconstruct_jit(
+                x, tuple(sorted(present)), tuple(wanted), self.d, self.p,
+                interpret=self._interpret)
+            return out[0] if squeeze else out
         from . import rs_jax
         return rs_jax.reconstruct_jit(
             survivors, tuple(sorted(present)), tuple(wanted), self.d, self.p)
 
 
-_REGISTRY = {"numpy": NumpyCoder, "jax": JaxCoder}
+def _as_batch(arr):
+    """Pallas kernels take [B, k, C]; promote [k, C] and remember to squeeze."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(arr)
+    if arr.ndim == 2:
+        return arr[None], True
+    return arr, False
+
+
+class PallasCoder(JaxCoder):
+    """Force the Pallas path; interpreter mode off-TPU so tests cover the
+    kernel logic everywhere."""
+
+    def __init__(self, d: int, p: int):
+        from . import rs_pallas
+        super().__init__(d, p, use_pallas=True)
+        self._interpret = not rs_pallas.available()
+
+
+_REGISTRY = {"numpy": NumpyCoder, "jax": JaxCoder, "pallas": PallasCoder}
 
 
 def get_coder(name: str, d: int, p: int) -> ErasureCoder:

@@ -1,0 +1,179 @@
+"""Pallas TPU kernel for GF(2^8) Reed-Solomon encode / reconstruct.
+
+The XLA einsum path (ops/rs_jax.py) expresses the GF(2) bit-matmul as
+unpack -> einsum -> pack and trusts the compiler to fuse; measured on a v5e
+it sustains ~40 GB/s. This kernel pins the whole pipeline in VMEM per tile
+and reformulates the two elementwise stages so they vectorize:
+
+* **Plane-major bitcast unpack.** `pltpu.bitcast` reinterprets groups of 4
+  sublanes (rows) as one int32 row, so `(x32 >> s) & 0x01010101` extracts
+  bit s of FOUR bytes per lane-op. Eight shift/mask passes produce the bit
+  planes at ~1/6 the VPU cost of per-element int32 unpacking. The planes
+  concatenate plane-major (row s*dp + r = bit s of data row r), and the
+  encode matrix's columns are permuted once on the host to match.
+* **MXU bit-matmul.** int8 x int8 -> int32 dot of the permuted bit-matrix
+  [8m, 8*dp] with the bit planes [8*dp, T]; sums <= 8d < 2^31 so `& 1`
+  recovers the GF(2) product exactly.
+* **Pack via a second tiny dot.** Recombining 8 parity-bit rows into bytes
+  is itself a matmul with a constant [m, 8m] weight matrix (1 << s at
+  column 8j+s) — cheaper on the MXU than a cross-sublane shift/sum on the
+  VPU (measured: 0.5 ms vs 1.0 ms per 160 MB).
+
+HBM sees the input bytes once and the output bytes once: (d+m)/d bytes per
+data byte. Measured end to end (chained-marginal, 160 MB batches, RS 10+4):
+~118 GB/s vs ~40 GB/s for the einsum path on the same harness — ~3x.
+
+Replaces: klauspost/reedsolomon's AVX2 galMulSlicesAvx2 loops invoked from
+reference weed/storage/erasure_coding/ec_encoder.go:183 (`enc.Encode`) and
+weed/storage/store_ec.go:402 (`ReconstructData`).
+
+Availability: the compiled path needs a real TPU; `available()` gates it and
+ops/coder.JaxCoder falls back to rs_jax elsewhere. Tests run the kernel in
+interpreter mode on CPU so its logic is covered everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import gf8
+
+DEFAULT_TILE = 1 << 15  # lane-dim tile; best measured on v5e (sweep 2K-32K)
+
+
+@functools.lru_cache(maxsize=1)
+def available() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — no backend at all
+        return False
+
+
+@functools.lru_cache(maxsize=512)
+def _plane_major_bitmatrix(key: tuple) -> np.ndarray:
+    """Permute a [8m, 8d] byte-major bit-matrix to plane-major padded cols.
+
+    key = (kind, d, p, present, wanted); column s*dp + r takes byte-major
+    column r*8 + s (dp = d rounded up to 4 for the sublane bitcast).
+    """
+    kind, d, p, present, wanted = key
+    if kind == "enc":
+        bm = gf8.expand_to_bits(gf8.parity_matrix(d, p)).astype(np.int8)
+    else:
+        rec = gf8.decode_matrix(d, p, list(present))
+        bm = gf8.expand_to_bits(rec[list(wanted), :]).astype(np.int8)
+    m8 = bm.shape[0]
+    dp = (d + 3) // 4 * 4
+    out = np.zeros((m8, 8 * dp), dtype=np.int8)
+    for r in range(d):
+        for s in range(8):
+            out[:, s * dp + r] = bm[:, r * 8 + s]
+    out.setflags(write=False)
+    return out
+
+
+@functools.lru_cache(maxsize=64)
+def _pack_matrix(m: int) -> np.ndarray:
+    """[m, 8m] int8 weights recombining LSB-first bit rows into bytes.
+
+    1 << 7 wraps to -128 in int8; the final uint8 cast of the int32
+    accumulator makes the sign irrelevant (mod-256 arithmetic).
+    """
+    pm = np.zeros((m, 8 * m), dtype=np.int16)
+    for j in range(m):
+        for s in range(8):
+            pm[j, 8 * j + s] = 1 << s
+    out = pm.astype(np.int8)
+    out.setflags(write=False)
+    return out
+
+
+def _make_kernel(d: int, dp: int, tile: int):
+    def kernel(bmat_ref, packm_ref, seed_ref, data_ref, out_ref):
+        data = data_ref[0] ^ seed_ref[0].astype(jnp.uint8)
+        if dp != d:
+            data = jnp.concatenate(
+                [data, jnp.zeros((dp - d, tile), jnp.uint8)], axis=0)
+        x32 = pltpu.bitcast(data, jnp.int32)              # [dp/4, T]
+        planes = [
+            pltpu.bitcast(((x32 >> s) & 0x01010101).astype(jnp.int32),
+                          jnp.uint8)
+            for s in range(8)
+        ]
+        bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)  # [8dp, T]
+        acc = lax.dot_general(bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        pb = (acc & 1).astype(jnp.int8)                   # [8m, T] 0/1
+        packed = lax.dot_general(packm_ref[:], pb, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.int32)
+        out_ref[0] = packed.astype(jnp.uint8)
+    return kernel
+
+
+def _pick_tile(c: int, tile: int) -> int:
+    if c % tile == 0:
+        return tile
+    # largest 128-aligned divisor of c no bigger than the requested tile;
+    # Mosaic requires the lane block be 128-divisible or the full dim
+    return next((t for t in range(tile - tile % 128, 0, -128)
+                 if c % t == 0), c)
+
+
+def _apply(bmat_key: tuple, data: jax.Array, seed: jax.Array, tile: int,
+           interpret: bool) -> jax.Array:
+    b, d, c = data.shape
+    bmat = _plane_major_bitmatrix(bmat_key)
+    m = bmat.shape[0] // 8
+    packm = _pack_matrix(m)
+    dp = (d + 3) // 4 * 4
+    tile = _pick_tile(c, tile)
+    return pl.pallas_call(
+        _make_kernel(d, dp, tile),
+        grid=(b, c // tile),
+        in_specs=[
+            pl.BlockSpec(bmat.shape, lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(packm.shape, lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, d, tile), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, m, tile), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, m, c), jnp.uint8),
+        interpret=interpret,
+    )(jnp.asarray(bmat), jnp.asarray(packm), seed, data)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def encode_jit(data: jax.Array, d: int, p: int, tile: int = DEFAULT_TILE,
+               interpret: bool = False) -> jax.Array:
+    """data [B, d, C] uint8 -> parity [B, p, C] uint8 (Pallas kernel)."""
+    return _apply(("enc", d, p, (), ()), data, jnp.zeros(1, jnp.int32),
+                  tile, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def encode_seeded_jit(data: jax.Array, seed: jax.Array, d: int, p: int,
+                      tile: int = DEFAULT_TILE,
+                      interpret: bool = False) -> jax.Array:
+    """Benchmark entry: xors `seed` into the data INSIDE the kernel so
+    repeated timing loops can defeat CSE without an extra HBM pass."""
+    return _apply(("enc", d, p, (), ()), data, seed, tile, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def reconstruct_jit(survivors: jax.Array, present: tuple, wanted: tuple,
+                    d: int, p: int, tile: int = DEFAULT_TILE,
+                    interpret: bool = False) -> jax.Array:
+    """survivors [B, d, C] (rows = sorted(present)[:d]) -> [B, |wanted|, C]."""
+    key = ("rec", d, p, tuple(sorted(present)[:d]), tuple(wanted))
+    return _apply(key, survivors, jnp.zeros(1, jnp.int32), tile, interpret)

@@ -120,7 +120,8 @@ def cmd_ec_encode(env: CommandEnv, args):
                             volume_ids=vids, collection=collection,
                             data_shards=opt.dataShards,
                             parity_shards=opt.parityShards),
-                        vpb.VolumeEcShardsGenerateBatchResponse, timeout=3600)
+                        vpb.VolumeEcShardsGenerateBatchResponse,
+                        timeout=3600 * len(vids))
         for vid, coll in vols:
             _spread_and_clean(env, vid, coll, srv,
                               gen.data_shards, gen.parity_shards)

@@ -57,7 +57,7 @@ def test_shard_file_size_tiers():
     assert GEO.shard_file_size(0) == 0
 
 
-@pytest.mark.parametrize("coder_name", ["numpy", "jax"])
+@pytest.mark.parametrize("coder_name", ["numpy", "jax", "pallas"])
 def test_encode_then_read_all_needles(tmp_path, coder_name):
     v, payloads = make_volume(tmp_path)
     base = v.file_name()
@@ -192,7 +192,7 @@ def test_shard_bits():
 # Streaming multi-volume pipeline (ec/stream.py)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("coder_name", ["numpy", "jax"])
+@pytest.mark.parametrize("coder_name", ["numpy", "jax", "pallas"])
 def test_stream_encode_many_volumes_matches_oracle(tmp_path, coder_name):
     """Cross-volume batched encode must be bit-identical to per-volume
     NumpyCoder encode, across odd sizes hitting every region shape."""
@@ -260,3 +260,47 @@ def test_stream_encode_decode_roundtrip(tmp_path):
     out = tmp_path / "restored.dat"
     decode_volume(base, str(out), GEO, coder)
     assert out.read_bytes() == payload
+
+
+def test_stream_encode_non_dividing_chunk(tmp_path):
+    """A chunk that divides neither block size is clamped (fit_chunk), not
+    rejected — encode_volume keeps its old lenient contract."""
+    from seaweedfs_tpu.ec import stream
+
+    assert stream.fit_chunk(GEO, 1000) == 512  # gcd(4096,512)=512 -> 512
+    assert stream.fit_chunk(GEO, 100) == 64
+    coder = NumpyCoder(GEO.d, GEO.p)
+    rng = np.random.default_rng(17)
+    dat = tmp_path / "v.dat"
+    dat.write_bytes(rng.integers(0, 256, 5000, dtype=np.uint8).tobytes())
+    encode_volume(str(dat), str(tmp_path / "a"), GEO, coder, chunk=1000)
+    encode_volume(str(dat), str(tmp_path / "b"), GEO, coder)
+    for s in range(GEO.n):
+        assert (tmp_path / f"a{files.shard_ext(s)}").read_bytes() == \
+               (tmp_path / f"b{files.shard_ext(s)}").read_bytes()
+
+
+def test_stream_encode_many_tiny_volumes_lazy_open(tmp_path):
+    """50 tiny volumes through one stream: exercises lazy open/finish and
+    batches spanning many volume boundaries."""
+    from seaweedfs_tpu.ec import stream
+
+    coder = NumpyCoder(GEO.d, GEO.p)
+    oracle = NumpyCoder(GEO.d, GEO.p)
+    rng = np.random.default_rng(19)
+    jobs, sizes = [], []
+    for i in range(50):
+        size = int(rng.integers(1, 3 * GEO.small_block))
+        dat = tmp_path / f"t{i}.dat"
+        dat.write_bytes(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        jobs.append((str(dat), str(tmp_path / f"t{i}"), None))
+        sizes.append(size)
+    stream.encode_volumes(jobs, GEO, coder, batch=8)
+    for i in range(0, 50, 7):  # spot-check vs per-volume oracle
+        encode_volume(str(tmp_path / f"t{i}.dat"), str(tmp_path / f"o{i}"),
+                      GEO, oracle)
+        for s in range(GEO.n):
+            assert (tmp_path / f"t{i}{files.shard_ext(s)}").read_bytes() == \
+                   (tmp_path / f"o{i}{files.shard_ext(s)}").read_bytes(), (i, s)
+        # .vif written when the volume's last batch drained
+        assert (tmp_path / f"t{i}.vif").exists()
