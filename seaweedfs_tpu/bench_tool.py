@@ -28,7 +28,9 @@ class FakeReader:
 
 def _percentiles(lat: list[float]) -> dict:
     if not lat:
-        return {}
+        return {"avg_ms": float("nan"), "p50_ms": float("nan"),
+                "p95_ms": float("nan"), "p99_ms": float("nan"),
+                "max_ms": float("nan")}
     arr = np.sort(np.array(lat))
     return {
         "avg_ms": float(arr.mean() * 1e3),
