@@ -30,6 +30,13 @@ def _add_master_flags(p):
                         "(enables raft leader election)")
     p.add_argument("-raftDir", default="",
                    help="directory for persistent raft state")
+    p.add_argument("-maintenanceScripts", default="default",
+                   help="semicolon-separated shell lines the master cron runs "
+                        "(reference master.toml scripts); 'default' = "
+                        "fix.replication/ec.rebuild/ec.balance/volume.balance, "
+                        "'' disables")
+    p.add_argument("-maintenanceIntervalS", type=float, default=0,
+                   help="cron interval seconds (0 = reference default 17 min)")
     _add_security_flags(p)
 
 
@@ -76,12 +83,16 @@ def run_master(argv):
     if opt.raftDir:
         _os.makedirs(opt.raftDir, exist_ok=True)
         raft_state = _os.path.join(opt.raftDir, f"raft-{opt.port}.json")
+    scripts = (None if opt.maintenanceScripts == "default"
+               else [s for s in opt.maintenanceScripts.split(";") if s.strip()])
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
                       default_replication=opt.defaultReplication,
                       guard=_make_guard(opt), http_port=opt.httpPort or None,
                       peers=[p for p in opt.peers.split(",") if p],
-                      raft_state_path=raft_state)
+                      raft_state_path=raft_state,
+                      maintenance_scripts=scripts,
+                      maintenance_interval_s=opt.maintenanceIntervalS or None)
     ms.start()
     _wait_forever()
 

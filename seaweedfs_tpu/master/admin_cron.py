@@ -1,0 +1,121 @@
+"""Master-side maintenance cron: self-driving repair with no operator.
+
+Reference: master_server.go:269 `startAdminScripts` reads shell command lines
+from master.toml (scaffold/master.toml:11-16 ships ec.encode / ec.rebuild /
+ec.balance / volume.balance / volume.fix.replication, run every 17 minutes by
+default, master_server.go:278) and executes them through the embedded shell
+machinery, leader-only, under the exclusive cluster lock.
+
+Same shape here: the cron owns a CommandEnv dialing its own master, takes the
+admin lease per sweep (so it never races an operator's shell — if a human
+holds the lock the sweep is skipped), runs each script line, and releases.
+Script failures are logged and do not stop the remaining lines or the loop.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+
+from ..utils.log import logger
+
+log = logger("admincron")
+
+# Reference default scripts (scaffold/master.toml:11-16), minus ec.encode
+# which needs a collection policy decision; repair/balance are always safe.
+DEFAULT_SCRIPTS = [
+    "volume.fix.replication",
+    "ec.rebuild",
+    "ec.balance",
+    "volume.balance",
+]
+DEFAULT_INTERVAL_S = 17 * 60  # master_server.go:278 sleep_minutes default
+
+
+class AdminCron:
+    def __init__(self, master_address: str, scripts: "list[str] | None" = None,
+                 interval_s: float = DEFAULT_INTERVAL_S,
+                 is_leader=lambda: True):
+        self.master_address = master_address
+        self.scripts = list(DEFAULT_SCRIPTS if scripts is None else scripts)
+        self.interval_s = interval_s
+        self.is_leader = is_leader
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._env = None
+        self.sweeps = 0          # completed sweeps (observability + tests)
+        self.last_output = ""
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if not self.scripts:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="master-admin-cron")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._env is not None:
+            try:
+                self._env.mc.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def trigger(self) -> None:
+        """Run one sweep immediately (tests / admin HTTP hook)."""
+        self._sweep()
+
+    # -- internals ----------------------------------------------------------
+    def _get_env(self):
+        if self._env is None:
+            # import for side effect: registers the command tables
+            from ..shell import (commands, ec_commands,  # noqa: F401
+                                 volume_commands)
+            from ..client.master_client import MasterClient
+            mc = MasterClient(self.master_address,
+                              client_type="admin-cron").start()
+            self._env = commands.CommandEnv(self.master_address, mc=mc,
+                                            out=io.StringIO())
+        return self._env
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if not self.is_leader():
+                continue
+            try:
+                self._sweep()
+            except Exception as e:  # noqa: BLE001 — cron must survive
+                log.warning("maintenance sweep failed: %s", e)
+
+    def _sweep(self) -> None:
+        from ..shell.commands import run_command
+        env = self._get_env()
+        env.out = out = io.StringIO()
+        try:
+            env.acquire_lock()
+        except Exception as e:  # noqa: BLE001 — operator holds it, or no quorum
+            log.info("skipping maintenance sweep (lock unavailable: %s)", e)
+            return
+        try:
+            for line in self.scripts:
+                try:
+                    # renew the admin lease before each line: the master's
+                    # lease expires after 60s (master_server.py LeaseAdminToken)
+                    # and balance/rebuild lines can run far longer; renewing
+                    # with the held token keeps operators locked out mid-sweep
+                    env.acquire_lock()
+                    run_command(env, line)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("maintenance script %r failed: %s", line, e)
+                    out.write(f"error: {line}: {e}\n")
+        finally:
+            try:
+                env.release_lock()
+            except Exception:  # noqa: BLE001
+                pass
+        self.last_output = out.getvalue()
+        self.sweeps += 1
+        if self.last_output.strip():
+            log.info("maintenance sweep #%d:\n%s", self.sweeps,
+                     self.last_output.rstrip())

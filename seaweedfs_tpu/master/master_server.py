@@ -35,7 +35,9 @@ class MasterServer:
                  garbage_threshold: float = 0.3,
                  guard=None, http_port: int | None = None,
                  peers: list[str] | None = None,
-                 raft_state_path: str | None = None):
+                 raft_state_path: str | None = None,
+                 maintenance_scripts: "list[str] | None" = None,
+                 maintenance_interval_s: float | None = None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -67,6 +69,13 @@ class MasterServer:
         self._grpc = None
         self._http = None
         self._stop = threading.Event()
+        # Self-driving maintenance (reference startAdminScripts
+        # master_server.go:269): [] disables, None -> repair/balance defaults.
+        from .admin_cron import DEFAULT_INTERVAL_S, AdminCron
+        self.admin_cron = AdminCron(
+            self.address, scripts=maintenance_scripts,
+            interval_s=maintenance_interval_s or DEFAULT_INTERVAL_S,
+            is_leader=lambda: self.is_leader)
 
     @property
     def is_leader(self) -> bool:
@@ -112,10 +121,12 @@ class MasterServer:
             self._start_http()
         threading.Thread(target=self._janitor, daemon=True,
                          name="master-janitor").start()
+        self.admin_cron.start()
         log.info("master up at %s (leader)", self.address)
 
     def stop(self) -> None:
         self._stop.set()
+        self.admin_cron.stop()
         if self.raft is not None:
             self.raft.stop()
         if self._grpc:

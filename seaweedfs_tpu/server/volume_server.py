@@ -652,6 +652,28 @@ class VolumeServer:
                 data_shards=req.data_shards or store.ec_geometry.d,
                 parity_shards=req.parity_shards or store.ec_geometry.p)
 
+        @svc.unary("VolumeEcShardsInfo", vpb.VolumeEcShardsInfoRequest,
+                   vpb.VolumeEcShardsInfoResponse)
+        def ec_info(req, context):
+            """Geometry probe from the .vif (TPU extension; the reference
+            hardcodes RS(14,2) so it never needs this)."""
+            from ..ec import files as ec_files
+            ev = store.find_ec_volume(req.volume_id)
+            if ev is not None:
+                return vpb.VolumeEcShardsInfoResponse(
+                    data_shards=ev.geo.d, parity_shards=ev.geo.p,
+                    dat_size=ev.dat_size or 0,
+                    local_shard_ids=sorted(ev.shards))
+            for loc in store.locations:
+                base = loc.base_name(req.collection, req.volume_id)
+                if os.path.exists(base + ".vif"):
+                    info = ec_files.read_vif(base + ".vif")
+                    return vpb.VolumeEcShardsInfoResponse(
+                        data_shards=info.get("d", 0),
+                        parity_shards=info.get("p", 0),
+                        dat_size=info.get("dat_size", 0))
+            raise KeyError(f"ec volume {req.volume_id} not found")
+
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
                    vpb.VolumeEcShardsRebuildResponse)
         def ec_rebuild(req, context):

@@ -106,15 +106,30 @@ def cmd_ec_encode(env: CommandEnv, args):
     for vid, collection, srv in targets:
         by_src.setdefault((srv["id"], collection),
                           (srv, []))[1].append((vid, collection))
+    encoded = 0
     for srv, vols in by_src.values():
-        stub = _stub(env, srv)
-        collection = vols[0][1]
-        vids = [v for v, _ in vols]
-        env.println(f"  ec.encode volumes {vids} on {srv['id']} (batched)")
-        for vid, _c in vols:  # freeze writes (command_ec_encode.go:147)
-            stub.call("VolumeMarkReadonly",
-                      vpb.VolumeMarkReadonlyRequest(volume_id=vid),
-                      vpb.VolumeMarkReadonlyResponse)
+        encoded += _encode_on_server(env, srv, vols, opt)
+    env.println(f"ec encoded {encoded} volumes")
+
+
+def _encode_on_server(env: CommandEnv, srv: dict,
+                      vols: "list[tuple[int, str]]", opt) -> int:
+    """Freeze + batch-generate + spread one server's volumes. A failure
+    rolls the un-encoded volumes back to writable and never aborts other
+    servers' batches (caller loops on)."""
+    stub = _stub(env, srv)
+    collection = vols[0][1]
+    vids = [v for v, _ in vols]
+    env.println(f"  ec.encode volumes {vids} on {srv['id']} (batched)")
+    frozen = []
+    for vid, _c in vols:  # freeze writes (command_ec_encode.go:147)
+        stub.call("VolumeMarkReadonly",
+                  vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                  vpb.VolumeMarkReadonlyResponse)
+        frozen.append(vid)
+    done: list[int] = []
+    d = p = 0
+    try:
         gen = stub.call("VolumeEcShardsGenerateBatch",
                         vpb.VolumeEcShardsGenerateBatchRequest(
                             volume_ids=vids, collection=collection,
@@ -122,10 +137,22 @@ def cmd_ec_encode(env: CommandEnv, args):
                             parity_shards=opt.parityShards),
                         vpb.VolumeEcShardsGenerateBatchResponse,
                         timeout=3600 * len(vids))
-        for vid, coll in vols:
-            _spread_and_clean(env, vid, coll, srv,
-                              gen.data_shards, gen.parity_shards)
-    env.println(f"ec encoded {len(targets)} volumes")
+        done = list(gen.encoded_volume_ids)
+        d, p = gen.data_shards, gen.parity_shards
+    except Exception as e:  # noqa: BLE001
+        env.println(f"    batch generate failed on {srv['id']}: {e}")
+    for vid in frozen:
+        if vid not in done:  # rollback: un-encoded volumes take writes again
+            try:
+                stub.call("VolumeMarkWritable",
+                          vpb.VolumeMarkWritableRequest(volume_id=vid),
+                          vpb.VolumeMarkWritableResponse)
+            except Exception:  # noqa: BLE001
+                pass
+    coll_by_vid = dict(vols)
+    for vid in done:
+        _spread_and_clean(env, vid, coll_by_vid.get(vid, collection), srv, d, p)
+    return len(done)
 
 
 def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
@@ -280,11 +307,15 @@ def _gather_shards(env: CommandEnv, host_stub: Stub, vid: int, collection: str,
 
 
 def _probe_n_shards(env: CommandEnv, srv: dict, vid: int, collection: str) -> int:
-    """Read geometry from the holder's .vif via a tiny status call; fall back
-    to the default 14 (10+4)."""
+    """Ask a holder for the volume's real geometry (VolumeEcShardsInfo reads
+    the .vif); fall back to the reference default 14 only if the RPC fails."""
     try:
-        from ..ec import files as ec_files  # noqa: F401
-        # use EcShardRead of 0 bytes? simpler: default
+        resp = _stub(env, srv).call(
+            "VolumeEcShardsInfo",
+            vpb.VolumeEcShardsInfoRequest(volume_id=vid, collection=collection),
+            vpb.VolumeEcShardsInfoResponse)
+        if resp.data_shards:
+            return resp.data_shards + resp.parity_shards
     except Exception:  # noqa: BLE001
         pass
     return 14

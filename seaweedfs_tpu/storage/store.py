@@ -184,7 +184,10 @@ class Store:
         for vid in vids:
             v = self.find_volume(vid)
             if v is None:
-                raise KeyError(f"volume {vid} not found")
+                # volume may have been deleted/moved since the caller's
+                # topology snapshot; encode the rest (the response's
+                # encoded_volume_ids tells the caller what actually ran)
+                continue
             v.sync()
             base = v.file_name()
             jobs.append((base + ".dat", base, base + ".idx"))

@@ -1,0 +1,158 @@
+"""Automated repair loop: the master's maintenance cron restores redundancy
+with NO operator action (reference master_server.go:269 startAdminScripts +
+scaffold/master.toml:11-16).
+
+Scenario mirrored from the verdict's 'done' bar: kill a shard holder, the
+missing shards get rebuilt elsewhere by the cron alone."""
+
+import io
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.master_client import MasterClient
+from seaweedfs_tpu.ec.locate import EcGeometry
+from seaweedfs_tpu.master.admin_cron import AdminCron
+from seaweedfs_tpu.master.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import ec_commands, volume_commands  # noqa: F401
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.store import Store
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def wait_until(cond, timeout=15.0, interval=0.1, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    mport = free_port()
+    # cron present but idle (huge interval); tests call trigger() directly
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3,
+                          maintenance_scripts=["ec.rebuild", "ec.balance"],
+                          maintenance_interval_s=3600)
+    master.start()
+    geo = EcGeometry(d=4, p=2, large_block=1 << 20, small_block=1 << 14)
+    servers = []
+    for i in range(4):
+        d = tmp_path / f"svr{i}"
+        d.mkdir()
+        port = free_port()
+        store = Store("127.0.0.1", port, "",
+                      [DiskLocation(str(d), max_volume_count=10)],
+                      ec_geometry=geo, coder_name="numpy")
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.3)
+        vs.start()
+        servers.append(vs)
+    wait_until(lambda: len(master.topo.nodes) >= 4, msg="4 nodes registered")
+    import requests
+    for vs in servers:
+        wait_until(lambda v=vs: _ok(requests, v), msg="vs http up")
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+    yield master, servers, mc, geo
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:
+            pass
+    master.stop()
+
+
+def _ok(requests, vs):
+    try:
+        return requests.get(f"http://127.0.0.1:{vs.port}/status", timeout=1).ok
+    except Exception:
+        return False
+
+
+def _ec_holders(master):
+    """{shard_id: [node ids]} for the (single) ec volume in the topology."""
+    holders = {}
+    for node in master.topo.nodes.values():
+        for disk in node.disks.values():
+            for info in disk.ec_shards.values():
+                for sid in range(32):
+                    if info.shard_bits & (1 << sid):
+                        holders.setdefault(sid, []).append(node.id)
+    return holders
+
+
+def test_cron_rebuilds_lost_shards_without_operator(cluster):
+    master, servers, mc, geo = cluster
+    rng = np.random.default_rng(0)
+    payloads = {}
+    for _ in range(20):
+        data = rng.integers(0, 256, 4000, dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data, collection="cron")
+        payloads[res.fid] = data
+
+    # encode via shell (operator action: creating EC volumes is a policy
+    # decision; REPAIR after failure is what must be automatic)
+    env = CommandEnv(f"127.0.0.1:{master.port}", mc=mc, out=io.StringIO())
+    wait_until(lambda: mc.volume_list().topology_info is not None,
+               msg="topology")
+    time.sleep(1.0)  # let heartbeats settle volume sizes
+    run_command(env, "lock")
+    run_command(env, "ec.encode -collection cron -fullPercent 0")
+    run_command(env, "unlock")
+    wait_until(lambda: len(_ec_holders(master)) == geo.n,
+               msg="all shards registered")
+
+    # kill the server holding shard 0
+    victim_id = _ec_holders(master)[0][0]
+    victim = next(v for v in servers
+                  if f"127.0.0.1:{v.port}" == victim_id)
+    lost = {sid for sid, nodes in _ec_holders(master).items()
+            if victim_id in nodes}
+    assert lost, "victim held nothing?"
+    victim.stop()
+    wait_until(lambda: victim_id not in master.topo.nodes,
+               msg="victim dropped from topology")
+    missing = set(range(geo.n)) - set(_ec_holders(master))
+    assert missing == lost
+
+    # ONE cron sweep, no operator
+    master.admin_cron.trigger()
+    assert master.admin_cron.sweeps == 1
+
+    wait_until(lambda: set(range(geo.n)) <= set(_ec_holders(master)),
+               msg="shards rebuilt and re-registered")
+    survivors = {n for nodes in _ec_holders(master).values() for n in nodes}
+    assert victim_id not in survivors
+
+    # every blob still readable after repair
+    for fid, data in payloads.items():
+        assert operation.read(mc, fid) == data
+
+
+def test_cron_skips_when_operator_holds_lock(cluster):
+    master, servers, mc, geo = cluster
+    env = CommandEnv(f"127.0.0.1:{master.port}", mc=mc, out=io.StringIO())
+    run_command(env, "lock")
+    try:
+        master.admin_cron.trigger()
+        assert master.admin_cron.sweeps == 0  # skipped, not failed
+    finally:
+        run_command(env, "unlock")
+    master.admin_cron.trigger()
+    assert master.admin_cron.sweeps == 1
