@@ -8,6 +8,7 @@ Verbs (subset of reference command/command.go:12-44, growing):
   upload   - assign + upload files
   download - fetch by fid
   fix      - rebuild a .idx from a .dat (reference command/fix.go:74)
+  backup   - incrementally back up a volume to a local dir (command/backup.go)
   benchmark- built-in load test (reference command/benchmark.go)
 """
 
@@ -204,6 +205,28 @@ def run_shell(argv):
         repl(env)
 
 
+def run_backup(argv):
+    """Incrementally back up volumes to a local directory
+    (reference command/backup.go)."""
+    from .client.backup import backup_volume
+    from .client.master_client import MasterClient
+    p = argparse.ArgumentParser(prog="backup")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    opt = p.parse_args(argv)
+    mc = MasterClient(opt.master).start()
+    try:
+        mc.wait_connected()
+        res = backup_volume(mc, opt.volumeId, opt.dir, opt.collection)
+        print(f"backup volume {res['volume_id']}: {res['mode']}, "
+              f"{res['records_applied']} records applied, "
+              f"{res['size']} bytes")
+    finally:
+        mc.stop()
+
+
 def run_upload(argv):
     from .client import operation
     from .client.master_client import MasterClient
@@ -316,6 +339,7 @@ VERBS = {
     "server": run_server,
     "shell": run_shell,
     "upload": run_upload,
+    "backup": run_backup,
     "download": run_download,
     "fix": run_fix,
     "benchmark": run_benchmark,

@@ -568,6 +568,82 @@ class VolumeServer:
                 file_count=v.file_count, file_deleted_count=v.deleted_count)
 
         # vacuum phases (reference volume_grpc_vacuum.go)
+        # ---- tail / incremental sync (reference volume_grpc_tail.go,
+        # volume_grpc_copy_incremental.go) ----
+        @svc.unary("VolumeSyncStatus", vpb.VolumeSyncStatusRequest,
+                   vpb.VolumeSyncStatusResponse)
+        def volume_sync_status(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            v.sync()
+            return vpb.VolumeSyncStatusResponse(
+                volume_id=v.id, collection=v.collection,
+                tail_offset=v._append_offset,
+                compact_revision=v.super_block.compaction_revision,
+                last_append_at_ns=v.last_append_at_ns)
+
+        @svc.unary_stream("VolumeIncrementalCopy",
+                          vpb.VolumeIncrementalCopyRequest,
+                          vpb.VolumeIncrementalCopyResponse)
+        def volume_incremental_copy(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            start = v.offset_by_append_ns(req.since_ns)
+            with v._lock:
+                end = v._append_offset
+            buf = 2 << 20
+            for off in range(start, end, buf):
+                yield vpb.VolumeIncrementalCopyResponse(
+                    file_content=v.read_raw(off, min(buf, end - off)))
+
+        @svc.unary_stream("VolumeTailSender", vpb.VolumeTailSenderRequest,
+                          vpb.VolumeTailSenderResponse)
+        def volume_tail_sender(req, context):
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            last_ns = req.since_ns
+            draining = req.idle_timeout_seconds or 0
+            while context.is_active():  # dead client must free the worker
+                progressed = False
+                for rec, ts, _nsize in v.read_records_since(last_ns):
+                    yield vpb.VolumeTailSenderResponse(needle_record=rec,
+                                                      append_at_ns=ts)
+                    last_ns = max(last_ns, ts)
+                    progressed = True
+                if req.idle_timeout_seconds == 0:
+                    time.sleep(1.0)  # follow forever (while client lives)
+                    continue
+                if progressed:
+                    draining = req.idle_timeout_seconds
+                else:
+                    draining -= 1
+                    if draining <= 0:
+                        return
+                time.sleep(1.0)
+
+        @svc.unary("VolumeTailReceiver", vpb.VolumeTailReceiverRequest,
+                   vpb.VolumeTailReceiverResponse)
+        def volume_tail_receiver(req, context):
+            """Pull records from a peer's tail into the local volume
+            (reference volume_grpc_tail.go:VolumeTailReceiver)."""
+            v = store.find_volume(req.volume_id)
+            if v is None:
+                context.abort(5, f"volume {req.volume_id} not found")
+            src = Stub(req.source_volume_server, VOLUME_SERVICE)
+            received = 0
+            for resp in src.call_stream(
+                    "VolumeTailSender",
+                    vpb.VolumeTailSenderRequest(
+                        volume_id=req.volume_id, since_ns=req.since_ns,
+                        idle_timeout_seconds=req.idle_timeout_seconds or 2),
+                    vpb.VolumeTailSenderResponse):
+                v.append_records(resp.needle_record)
+                received += 1
+            return vpb.VolumeTailReceiverResponse(received=received)
+
         @svc.unary("VacuumVolumeCheck", vpb.VacuumVolumeCheckRequest,
                    vpb.VacuumVolumeCheckResponse)
         def vacuum_check(req, context):

@@ -1,11 +1,19 @@
-"""Vacuum (compaction): reclaim space from deleted needles.
+"""Vacuum (compaction): reclaim space from deleted needles, writes allowed.
 
 Reference: weed/storage/volume_vacuum.go — `Compact2` copies live needles into
 .cpd/.cpx siblings guided by the index (copyDataBasedOnIndexFile :418), then
-`CommitCompact` (:102) atomically renames them over the originals, bumping the
-super block's compaction revision. Concurrent-write replay (`makeupDiff`) is
-deferred until the volume server holds volumes open during vacuum; here the
-caller quiesces the volume first.
+`CommitCompact` (:102) replays whatever was appended to the live volume while
+the copy ran (`makeupDiff` :200-418: scan the old .dat past the offset
+recorded at compact start, append new writes to the .cpd and their entries to
+the .cpx, record deletes as tombstone index entries) and atomically renames
+the siblings over the originals, bumping the super block's compaction
+revision.
+
+Same protocol here. `compact()` snapshots the append offset + live needle set
+under the volume lock, then copies WITHOUT the lock (appends only ever extend
+the .dat, so concurrent writes/deletes are safe — they land past the snapshot
+and are replayed by `commit_compact`, which holds the lock only for the
+replay + rename window).
 """
 
 from __future__ import annotations
@@ -15,17 +23,29 @@ import os
 from . import types as t
 from .needle import record_size_from_header
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
-from .needle_map import write_idx_entries
-from .volume import Volume
+from .needle_map import write_idx_entries, _ENTRY
+from .volume import Volume, iter_records
 
 import numpy as np
 
 
 def compact(vol: Volume) -> tuple[int, int]:
-    """Copy live needles to .cpd/.cpx. Returns (live_count, reclaimed_bytes)."""
+    """Copy live needles to .cpd/.cpx. Returns (live_count, reclaimed_bytes).
+
+    Safe under concurrent writes: the live-set + append-offset snapshot is
+    taken atomically; anything appended afterwards is replayed at commit.
+    """
     base = vol.file_name()
     cpd, cpx = base + ".cpd", base + ".cpx"
-    keys, offs, sizes = vol.nm.map.items_arrays()
+    with vol._lock:
+        vol.sync()
+        vol.last_compact_offset = vol._append_offset
+        keys, offs, sizes = vol.nm.map.items_arrays()
+    # copy in OFFSET (= append-time) order, not key order: tail/incremental
+    # sync binary-searches the .dat by append_at_ns and needs monotonicity
+    # (reference copyDataBasedOnIndexFile walks the .idx in file order)
+    order = np.argsort(offs, kind="stable")
+    keys, offs, sizes = keys[order], offs[order], sizes[order]
     sb = SuperBlock(
         version=vol.super_block.version,
         replica_placement=vol.super_block.replica_placement,
@@ -48,14 +68,48 @@ def compact(vol: Volume) -> tuple[int, int]:
     return int(keys.size), int(reclaimed)
 
 
+def _makeup_diff(vol: Volume, cpd: str, cpx: str) -> int:
+    """Replay appends/deletes that raced the copy onto .cpd/.cpx.
+
+    Caller holds vol._lock. Returns the number of replayed records.
+    Reference: volume_vacuum.go:200 makeupDiff.
+    """
+    from_off = getattr(vol, "last_compact_offset", None)
+    if from_off is None:
+        return 0
+    end = vol._append_offset
+    if from_off >= end:
+        return 0
+    replayed = 0
+    with open(cpd, "ab") as out, open(cpx, "ab") as idx:
+        pos = out.tell()
+        for off, nid, nsize in iter_records(vol._dat, from_off, end):
+            rec_len = record_size_from_header(nsize)
+            rec = vol.read_raw(off, rec_len)
+            if t.is_tombstone(nsize):
+                # delete: tombstone record keeps the .dat self-describing,
+                # tombstone idx entry overrides any earlier live entry
+                out.write(rec)
+                idx.write(_ENTRY.pack(nid, 0, t.TOMBSTONE_SIZE))
+            else:
+                out.write(rec)
+                idx.write(_ENTRY.pack(nid, t.offset_to_stored(pos), nsize))
+            pos += rec_len
+            replayed += 1
+    return replayed
+
+
 def commit_compact(vol: Volume) -> Volume:
-    """Swap .cpd/.cpx into place and reopen the volume."""
+    """Replay concurrent changes, swap .cpd/.cpx into place, reopen."""
     base = vol.file_name()
     cpd, cpx = base + ".cpd", base + ".cpx"
     if not (os.path.exists(cpd) and os.path.exists(cpx)):
         raise FileNotFoundError("no compaction files; run compact() first")
     dirname, collection, vid = vol.dir, vol.collection, vol.id
-    vol.close()
-    os.replace(cpd, base + ".dat")
-    os.replace(cpx, base + ".idx")
+    with vol._lock:
+        vol.sync()
+        _makeup_diff(vol, cpd, cpx)
+        vol.close()
+        os.replace(cpd, base + ".dat")
+        os.replace(cpx, base + ".idx")
     return Volume(dirname, collection, vid, create_if_missing=False)

@@ -122,8 +122,12 @@ class Volume:
 
     # -- naming ------------------------------------------------------------
     def file_name(self) -> str:
-        name = f"{self.collection}_{self.id}" if self.collection else str(self.id)
-        return os.path.join(self.dir, name)
+        return self.path_for(self.dir, self.collection, self.id)
+
+    @staticmethod
+    def path_for(dirname: str, collection: str, vid: int) -> str:
+        name = f"{collection}_{vid}" if collection else str(vid)
+        return os.path.join(dirname, name)
 
     # -- integrity (reference volume_checking.go:17) -----------------------
     def _check_integrity(self) -> None:
@@ -168,6 +172,140 @@ class Volume:
         for off, _, nsize in iter_records(self._dat, start, dat_size):
             pos = off + record_size_from_header(nsize)
         return pos
+
+    # -- tail / incremental sync (reference volume_grpc_tail.go,
+    #    volume_grpc_copy_incremental.go) ----------------------------------
+    def record_append_ns(self, offset: int, nsize: int) -> int:
+        """append_at_ns from a record's trailer (crc u32 then ts u64,
+        needle.py layout)."""
+        import struct
+        body = 0 if t.is_tombstone(nsize) else nsize
+        raw = self.read_raw(offset + t.NEEDLE_HEADER_SIZE + body + 4, 8)
+        return struct.unpack("<Q", raw)[0]
+
+    def _probe_entries(self, end: int):
+        """.idx entries usable as timestamp probes: live, whole, within
+        `end` (a torn-tail repair truncates the .dat but leaves the original
+        live entries in the raw .idx — filter those out)."""
+        if not os.path.exists(self.idx_path):
+            return []
+        keys, offs, sizes = idx_entries_numpy(self.idx_path)
+        probes = []
+        for i in range(len(keys)):
+            if int(offs[i]) <= 0:
+                continue
+            off = t.stored_to_offset(int(offs[i]))
+            if off + record_size_from_header(int(sizes[i])) <= end:
+                probes.append((off, int(sizes[i])))
+        return probes
+
+    def offset_by_append_ns(self, since_ns: int) -> int:
+        """First .dat offset whose record has append_at_ns > since_ns.
+
+        Binary search over the append-ordered .idx probing timestamps from
+        the .dat (reference BinarySearchByAppendAtNs), then a short linear
+        walk so tombstone records (absent from probe entries) are included.
+        Requires the .dat to be append-time-ordered — vacuum preserves that
+        (compact copies in offset order) and a compaction-revision bump
+        tells cross-revision followers to resync in full.
+        Returns self._append_offset when fully caught up.
+        """
+        with self._lock:
+            self.sync()
+            end = self._append_offset
+            probes = self._probe_entries(end)
+            lo, hi = 0, len(probes)  # first probe with ts > since_ns
+            while lo < hi:
+                mid = (lo + hi) // 2
+                off, nsize = probes[mid]
+                if self.record_append_ns(off, nsize) > since_ns:
+                    hi = mid
+                else:
+                    lo = mid + 1
+            if lo == 0:
+                start = SUPER_BLOCK_SIZE
+            else:
+                off, nsize = probes[lo - 1]  # last record at-or-before
+                start = off + record_size_from_header(nsize)
+            # walk (possibly tombstone) records until ts > since_ns
+            for off, _nid, nsize in iter_records(self._dat, start, end):
+                if self.record_append_ns(off, nsize) > since_ns:
+                    return off
+            return end
+
+    def last_record_append_ns(self) -> int:
+        """append_at_ns of the newest record (0 for an empty volume).
+        O(1)-ish: jump to the newest .idx probe, walk the short tail."""
+        with self._lock:
+            self.sync()
+            end = self._append_offset
+            probes = self._probe_entries(end)
+            start = max((off for off, _ in probes), default=SUPER_BLOCK_SIZE)
+            last = 0
+            for off, _nid, nsize in iter_records(self._dat, start, end):
+                last = self.record_append_ns(off, nsize)
+            return last
+
+    def read_records_since(self, since_ns: int, max_batch: int = 2 << 20):
+        """Yield (record_bytes, append_at_ns, nsize) for records newer than
+        since_ns, in append order (tail sender body). Records are collected
+        in <= max_batch byte batches under the volume lock and yielded
+        outside it, so a slow stream consumer never blocks writers."""
+        pos = self.offset_by_append_ns(since_ns)
+        while True:
+            batch = []
+            with self._lock:
+                self.sync()
+                end = self._append_offset
+                if pos >= end:
+                    return
+                got = 0
+                for off, _nid, nsize in iter_records(self._dat, pos, end):
+                    rec_len = record_size_from_header(nsize)
+                    self._dat.seek(off)
+                    rec = self._dat.read(rec_len)
+                    batch.append((rec, self.record_append_ns(off, nsize),
+                                  nsize))
+                    pos = off + rec_len
+                    got += rec_len
+                    if got >= max_batch:
+                        break
+            yield from batch
+
+    def append_records(self, raw: bytes) -> int:
+        """Append raw record bytes (from tail/incremental copy) and replay
+        them into the needle map. Returns records applied."""
+        import struct
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read-only")
+            start = self._append_offset
+            if start + len(raw) > t.MAX_VOLUME_SIZE:
+                raise OSError(f"volume {self.id} exceeds max size")
+            self._dat.seek(start)
+            self._dat.write(raw)
+            self._append_offset = start + len(raw)
+            applied = 0
+            pos = 0
+            while pos + t.NEEDLE_HEADER_SIZE <= len(raw):
+                _, nid, nsize = struct.unpack_from("<IQI", raw, pos)
+                rec_len = record_size_from_header(nsize)
+                if pos + rec_len > len(raw):
+                    # torn tail: truncate back to the last whole record
+                    self._append_offset = start + pos
+                    self._dat.seek(self._append_offset)
+                    self._dat.truncate()
+                    break
+                if t.is_tombstone(nsize):
+                    self.nm.delete(nid)
+                else:
+                    self.nm.put(nid, start + pos, nsize)
+                    ts = struct.unpack_from(
+                        "<Q", raw, pos + t.NEEDLE_HEADER_SIZE + nsize + 4)[0]
+                    self.last_append_at_ns = ts
+                pos += rec_len
+                applied += 1
+            return applied
 
     # -- write path (reference volume_write.go:119 writeNeedle2) -----------
     def write_needle(self, n: Needle) -> int:
