@@ -62,6 +62,13 @@ class VolumeServer:
         self._http_thread = None
         self._hb_thread = None
         self._http_runner = None
+        # EC shard-location cache (tiers, store_ec.go:256-267) + the
+        # degraded-read fan-out pool (store_ec.go:367 goroutine fan-out)
+        self._ec_loc_cache: dict[int, tuple[dict, float, bool]] = {}
+        self._ec_loc_lock = threading.Lock()
+        from concurrent.futures import ThreadPoolExecutor
+        self._ec_read_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="ec-degraded-read")
 
     @property
     def url(self) -> str:
@@ -91,6 +98,7 @@ class VolumeServer:
         self._hb_wake.set()
         if self._grpc:
             self._grpc.stop(grace=0.5)
+        self._ec_read_pool.shutdown(wait=False, cancel_futures=True)
         self.store.close()
 
     # -- heartbeat (reference volume_grpc_client_to_master.go) ---------------
@@ -428,50 +436,71 @@ class VolumeServer:
         return web.json_response({"size": 1 if ok else 0}, status=202)
 
     # -- EC shard reader: remote fetch + degraded reconstruct ---------------
+    def _fetch_remote_shard(self, vid: int, sid: int, offset: int,
+                            length: int, holders: "list[str]") -> bytes | None:
+        for addr in holders:
+            try:
+                stub = Stub(addr, VOLUME_SERVICE)
+                parts = [r.data for r in stub.call_stream(
+                    "VolumeEcShardRead",
+                    vpb.VolumeEcShardReadRequest(
+                        volume_id=vid, shard_id=sid,
+                        offset=offset, size=length),
+                    vpb.VolumeEcShardReadResponse)]
+                return b"".join(parts)
+            except Exception as e:  # noqa: BLE001
+                log.warning("remote shard %d.%d read from %s: %s",
+                            vid, sid, addr, e)
+        return None
+
     def _make_shard_reader(self, vid: int):
         def reader(shard_id: int, offset: int, length: int) -> bytes:
             locs = self._lookup_ec_shards(vid)
-            holders = locs.get(shard_id, [])
-            for addr in holders:
-                try:
-                    stub = Stub(addr, VOLUME_SERVICE)
-                    parts = [r.data for r in stub.call_stream(
-                        "VolumeEcShardRead",
-                        vpb.VolumeEcShardReadRequest(
-                            volume_id=vid, shard_id=shard_id,
-                            offset=offset, size=length),
-                        vpb.VolumeEcShardReadResponse)]
-                    return b"".join(parts)
-                except Exception as e:  # noqa: BLE001
-                    log.warning("remote shard %d.%d read from %s: %s",
-                                vid, shard_id, addr, e)
-            # degraded read: reconstruct this interval from other shards
-            # (store_ec.go:357 recoverOneRemoteEcShardInterval)
+            data = self._fetch_remote_shard(vid, shard_id, offset, length,
+                                            locs.get(shard_id, []))
+            if data is None and locs.get(shard_id):
+                # holders listed but unreachable: locations may be stale
+                # (11 s tier, store_ec.go:263) — refresh once and retry
+                fresh = self._lookup_ec_shards(vid, failed=True)
+                if fresh.get(shard_id, []) != locs.get(shard_id, []):
+                    data = self._fetch_remote_shard(
+                        vid, shard_id, offset, length,
+                        fresh.get(shard_id, []))
+                locs = fresh
+            if data is not None:
+                return data
+            # degraded read: reconstruct this interval from >= d other
+            # shards fetched CONCURRENTLY (store_ec.go:357-400 fans out
+            # one goroutine per shard; sequential fetches would stack one
+            # RTT per shard onto the degraded p99)
             ev = self.store.find_ec_volume(vid)
             if ev is None:
                 raise KeyError(f"shard {shard_id} unreachable")
             geo = ev.geo
             gathered: dict[int, bytes] = {}
+            remote_sids = []
             for sid in range(geo.n):
-                if sid == shard_id or len(gathered) >= geo.d:
+                if sid == shard_id:
                     continue
                 local = ev.shards.get(sid)
-                if local is not None:
+                if local is not None and len(gathered) < geo.d:
                     gathered[sid] = local.read_at(offset, length)
-                    continue
-                for addr in locs.get(sid, []):
-                    try:
-                        stub = Stub(addr, VOLUME_SERVICE)
-                        parts = [r.data for r in stub.call_stream(
-                            "VolumeEcShardRead",
-                            vpb.VolumeEcShardReadRequest(
-                                volume_id=vid, shard_id=sid,
-                                offset=offset, size=length),
-                            vpb.VolumeEcShardReadResponse)]
-                        gathered[sid] = b"".join(parts)
+                elif local is None:
+                    remote_sids.append(sid)
+            if len(gathered) < geo.d and remote_sids:
+                import concurrent.futures as cf
+                futs = {self._ec_read_pool.submit(
+                            self._fetch_remote_shard, vid, sid, offset,
+                            length, locs.get(sid, [])): sid
+                        for sid in remote_sids}
+                for fut in cf.as_completed(futs):
+                    data = fut.result()
+                    if data is not None:
+                        gathered[futs[fut]] = data
+                    if len(gathered) >= geo.d:
+                        for f in futs:  # stop burning pool workers on
+                            f.cancel()  # fetches nobody will use
                         break
-                    except Exception:  # noqa: BLE001
-                        continue
             if len(gathered) < geo.d:
                 raise KeyError(
                     f"cannot reconstruct shard {shard_id}: only "
@@ -486,8 +515,46 @@ class VolumeServer:
             return out[0].tobytes()
         return reader
 
-    def _lookup_ec_shards(self, vid: int) -> dict[int, list[str]]:
-        """shard id -> list of gRPC addresses of holders."""
+    # shard-location cache staleness tiers (store_ec.go:256-267): complete
+    # location sets refresh every 37 min, incomplete every 7 min, and a
+    # failed read may force a refresh after 11 s — the master is OFF the
+    # EC read hot path.
+    _EC_LOC_TTL_COMPLETE = 37 * 60
+    _EC_LOC_TTL_INCOMPLETE = 7 * 60
+    _EC_LOC_TTL_FAILED = 11
+
+    def _lookup_ec_shards(self, vid: int, failed: bool = False,
+                          ) -> dict[int, list[str]]:
+        """shard id -> gRPC addresses of holders, via the tiered cache."""
+        now = time.time()
+        with self._ec_loc_lock:
+            ent = self._ec_loc_cache.get(vid)
+            if ent is not None:
+                locs, fetched, complete = ent
+                ttl = (self._EC_LOC_TTL_FAILED if failed else
+                       self._EC_LOC_TTL_COMPLETE if complete else
+                       self._EC_LOC_TTL_INCOMPLETE)
+                if now - fetched < ttl:
+                    return locs
+        locs = self._lookup_ec_shards_master(vid)
+        if locs is not None:
+            ev = self.store.find_ec_volume(vid)
+            n = ev.geo.n if ev is not None else 0
+            complete = n > 0 and all(locs.get(s) for s in range(n))
+            with self._ec_loc_lock:
+                self._ec_loc_cache[vid] = (locs, now, complete)
+            return locs
+        # master unreachable: serve stale rather than fail the read, and
+        # re-stamp the entry (complete=False) so the next probe waits a full
+        # incomplete tier (11 s via failed=True) instead of paying the 5 s
+        # lookup timeout on EVERY read for the whole outage
+        with self._ec_loc_lock:
+            ent = self._ec_loc_cache.get(vid)
+            if ent is not None:
+                self._ec_loc_cache[vid] = (ent[0], now, False)
+        return ent[0] if ent is not None else {}
+
+    def _lookup_ec_shards_master(self, vid: int) -> "dict | None":
         try:
             stub = Stub(self.current_leader, MASTER_SERVICE)
             resp = stub.call("LookupEcVolume",
@@ -499,7 +566,7 @@ class VolumeServer:
                     for e in resp.shard_id_locations}
         except Exception as e:  # noqa: BLE001
             log.warning("ec lookup vid=%d: %s", vid, e)
-            return {}
+            return None
 
     # -- gRPC admin service ---------------------------------------------------
     def _build_service(self) -> RpcService:
@@ -803,7 +870,8 @@ class VolumeServer:
         def ec_copy_by_rebuild(req, context):
             loc = store._location_for(None)
             base = loc.base_name(req.collection, req.volume_id)
-            shard_locs = vs._lookup_ec_shards(req.volume_id)
+            # admin rebuild wants FRESH holders, not read-path cache tiers
+            shard_locs = vs._lookup_ec_shards(req.volume_id, failed=True)
             info = {}
             gathered = 0
             geo = store.ec_geometry

@@ -214,3 +214,48 @@ def test_vacuum_via_rpc(cluster):
         assert operation.read(mc, fid)
     with pytest.raises((KeyError, RuntimeError)):
         operation.read(mc, fids[0])
+
+
+def test_ec_shard_location_cache_tiers(tmp_path):
+    """Shard-location lookups ride a tiered cache (store_ec.go:256-267):
+    steady-state reads never touch the master; a failed read forces a
+    refresh only after the 11s tier."""
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store("127.0.0.1", 0, "",
+                  [DiskLocation(str(tmp_path), max_volume_count=4)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, "127.0.0.1:1")  # never started; no master
+    calls = []
+
+    def fake_master(vid):
+        calls.append(vid)
+        return {0: ["a:1"], 1: ["b:1"]}
+
+    vs._lookup_ec_shards_master = fake_master
+    assert vs._lookup_ec_shards(5) == {0: ["a:1"], 1: ["b:1"]}
+    for _ in range(10):  # cache hit: no master traffic on the hot path
+        vs._lookup_ec_shards(5)
+    assert len(calls) == 1
+
+    # failed read inside the 11s tier: still served from cache
+    vs._lookup_ec_shards(5, failed=True)
+    assert len(calls) == 1
+    # age the entry past 11s: failed lookup refreshes, normal one doesn't
+    locs, fetched, complete = vs._ec_loc_cache[5]
+    vs._ec_loc_cache[5] = (locs, fetched - 12, complete)
+    vs._lookup_ec_shards(5)
+    assert len(calls) == 1
+    vs._lookup_ec_shards(5, failed=True)
+    assert len(calls) == 2
+
+    # master down: stale cache still serves the read path
+    def broken(vid):
+        calls.append(vid)
+        return None
+    vs._lookup_ec_shards_master = broken
+    locs, fetched, complete = vs._ec_loc_cache[5]
+    vs._ec_loc_cache[5] = (locs, fetched - 3000, complete)
+    assert vs._lookup_ec_shards(5) == {0: ["a:1"], 1: ["b:1"]}
