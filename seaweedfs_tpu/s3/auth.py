@@ -108,6 +108,14 @@ class IdentityAccessManagement:
         raise ErrAccessDenied()
 
     def _auth_header(self, method, path, query, headers, payload_hash, auth):
+        return self._auth_header_ctx(method, path, query, headers,
+                                     payload_hash, auth, want_ctx=False)[0]
+
+    def _auth_header_ctx(self, method, path, query, headers, payload_hash,
+                         auth, want_ctx=True):
+        """Verify; with want_ctx also return the signing context the
+        streaming-chunked verifier chains off (reference
+        calculateSeedSignature, chunked_reader_v4.go)."""
         fields = {}
         for part in auth[len("AWS4-HMAC-SHA256 "):].split(","):
             k, _, v = part.strip().partition("=")
@@ -121,11 +129,30 @@ class IdentityAccessManagement:
         signed_headers = fields.get("SignedHeaders", "").split(";")
         canonical = self._canonical_request(
             method, path, query, headers, signed_headers, payload_hash)
-        sig = self._signature(secret, date, region, service,
-                              headers.get("x-amz-date", ""), canonical)
+        amz_date = headers.get("x-amz-date", "")
+        key = self._signing_key(secret, date, region, service)
+        sig = self._signature_with_key(key, date, region, service, amz_date,
+                                       canonical)
         if not hmac.compare_digest(sig, fields.get("Signature", "")):
             raise ErrSignatureMismatch()
-        return ident
+        if not want_ctx:
+            return ident, None
+        from .chunked import SeedContext
+        ctx = SeedContext(
+            signing_key=key, amz_date=amz_date,
+            scope=f"{date}/{region}/{service}/aws4_request",
+            seed_signature=sig)
+        return ident, ctx
+
+    def authenticate_streaming(self, method, path, query, headers):
+        """Header-auth a STREAMING-AWS4-HMAC-SHA256-PAYLOAD request and hand
+        back the seed context for per-chunk verification."""
+        auth = headers.get("authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            raise ErrAccessDenied()
+        return self._auth_header_ctx(
+            method, path, query, headers,
+            "STREAMING-AWS4-HMAC-SHA256-PAYLOAD", auth)
 
     def _auth_presigned(self, method, path, query, headers):
         cred = query.get("X-Amz-Credential", "").split("/")
@@ -183,33 +210,43 @@ class IdentityAccessManagement:
                           payload_hash])
 
     @staticmethod
-    def _signature(secret, date, region, service, amz_date, canonical) -> str:
+    def _signing_key(secret, date, region, service) -> bytes:
         def h(key, msg):
             return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
         k = h(f"AWS4{secret}".encode(), date)
         k = h(k, region)
         k = h(k, service)
-        k = h(k, "aws4_request")
+        return h(k, "aws4_request")
+
+    @staticmethod
+    def _signature_with_key(key, date, region, service, amz_date,
+                            canonical) -> str:
         sts = "\n".join(["AWS4-HMAC-SHA256", amz_date,
                          f"{date}/{region}/{service}/aws4_request",
                          hashlib.sha256(canonical.encode()).hexdigest()])
-        return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        return hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+
+    @classmethod
+    def _signature(cls, secret, date, region, service, amz_date,
+                   canonical) -> str:
+        return cls._signature_with_key(
+            cls._signing_key(secret, date, region, service),
+            date, region, service, amz_date, canonical)
 
 
-def sign_request_v4(method: str, url: str, headers: dict[str, str],
-                    payload: bytes, access_key: str, secret_key: str,
-                    region: str = "us-east-1", service: str = "s3",
-                    amz_date: str | None = None) -> dict[str, str]:
-    """Client-side signer (used by tests and the replication s3 sink).
-    Returns headers with Authorization added."""
+def _client_sign(method: str, url: str, headers: dict[str, str],
+                 payload_hash: str, access_key: str, secret_key: str,
+                 region: str, service: str, amz_date: "str | None",
+                 ) -> tuple[dict[str, str], str, str, str]:
+    """Shared client-side signing core. headers must already include any
+    x-amz-* extras to sign. Returns (headers+Authorization, sig, now, date)."""
     import datetime
 
     u = urllib.parse.urlsplit(url)
     now = amz_date or datetime.datetime.now(datetime.timezone.utc
                                             ).strftime("%Y%m%dT%H%M%SZ")
     date = now[:8]
-    payload_hash = hashlib.sha256(payload).hexdigest()
     out = dict(headers)
     out.setdefault("host", u.netloc)
     out["x-amz-date"] = now
@@ -224,4 +261,42 @@ def sign_request_v4(method: str, url: str, headers: dict[str, str],
     out["Authorization"] = (
         f"AWS4-HMAC-SHA256 Credential={access_key}/{date}/{region}/{service}/"
         f"aws4_request, SignedHeaders={';'.join(signed)}, Signature={sig}")
-    return out
+    return out, sig, now, date
+
+
+def sign_request_v4(method: str, url: str, headers: dict[str, str],
+                    payload: bytes, access_key: str, secret_key: str,
+                    region: str = "us-east-1", service: str = "s3",
+                    amz_date: str | None = None) -> dict[str, str]:
+    """Client-side signer (used by tests and the replication s3 sink).
+    Returns headers with Authorization added."""
+    return _client_sign(method, url, headers,
+                        hashlib.sha256(payload).hexdigest(), access_key,
+                        secret_key, region, service, amz_date)[0]
+
+
+def sign_streaming_request_v4(method: str, url: str, headers: dict[str, str],
+                              decoded_length: int, access_key: str,
+                              secret_key: str, region: str = "us-east-1",
+                              service: str = "s3",
+                              amz_date: str | None = None):
+    """Client-side signer for STREAMING-AWS4-HMAC-SHA256-PAYLOAD uploads.
+
+    Returns (headers_with_authorization, SeedContext); frame the body with
+    chunked.encode_chunked_payload(data, ctx) afterwards. Mirrors what the
+    AWS SDKs do for large PUTs (reference chunked_reader_v4.go's client side).
+    """
+    from .chunked import STREAMING_PAYLOAD, SeedContext
+
+    pre = dict(headers)
+    pre["x-amz-decoded-content-length"] = str(decoded_length)
+    pre["content-encoding"] = "aws-chunked"
+    out, sig, now, date = _client_sign(method, url, pre, STREAMING_PAYLOAD,
+                                       access_key, secret_key, region,
+                                       service, amz_date)
+    ctx = SeedContext(
+        signing_key=IdentityAccessManagement._signing_key(
+            secret_key, date, region, service),
+        amz_date=now, scope=f"{date}/{region}/{service}/aws4_request",
+        seed_signature=sig)
+    return out, ctx

@@ -1,0 +1,67 @@
+"""Per-action concurrent-request circuit breaker for the S3 gateway.
+
+Reference: weed/s3api/s3api_circuit_breaker.go — global and per-bucket
+limits on in-flight requests per action; exceeding a limit returns 503
+SlowDown so SDK clients back off and retry, protecting the filer behind the
+gateway. (The reference also supports byte-size limits; count limits cover
+the protective behavior.)
+
+Config shape (mirrors the spirit of s3_constants circuit-breaker config):
+
+    {"global": {"Read": 64, "Write": 32, "List": 16, "Admin": 8},
+     "buckets": {"mybucket": {"Write": 4}}}
+
+Absent actions are unlimited; an empty/None config disables the breaker.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from .auth import S3Error
+
+
+class ErrTooManyRequests(S3Error):
+    def __init__(self):
+        super().__init__("SlowDown",
+                         "Please reduce your request rate.", 503)
+
+
+class CircuitBreaker:
+    def __init__(self, config: "dict | None" = None):
+        config = config or {}
+        self.global_limits: dict[str, int] = dict(config.get("global", {}))
+        self.bucket_limits: dict[str, dict[str, int]] = {
+            b: dict(v) for b, v in (config.get("buckets") or {}).items()}
+        self.enabled = bool(self.global_limits or self.bucket_limits)
+        self._lock = threading.Lock()
+        self._inflight: dict[tuple[str, str], int] = {}  # (scope, action)
+
+    @contextmanager
+    def acquire(self, action: str, bucket: str):
+        if not self.enabled:
+            yield
+            return
+        keys = []
+        g_limit = self.global_limits.get(action)
+        if g_limit is not None:
+            keys.append((("", action), g_limit))
+        b_limit = self.bucket_limits.get(bucket, {}).get(action)
+        if b_limit is not None:
+            keys.append(((bucket, action), b_limit))
+        taken = []
+        with self._lock:
+            for key, limit in keys:
+                if self._inflight.get(key, 0) >= limit:
+                    for k in taken:  # roll back partial acquisition
+                        self._inflight[k] -= 1
+                    raise ErrTooManyRequests()
+                self._inflight[key] = self._inflight.get(key, 0) + 1
+                taken.append(key)
+        try:
+            yield
+        finally:
+            with self._lock:
+                for key in taken:
+                    self._inflight[key] -= 1

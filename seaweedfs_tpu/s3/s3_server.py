@@ -43,10 +43,15 @@ ErrNoSuchUpload = lambda u: S3Error(  # noqa: E731
 
 class S3Gateway:
     def __init__(self, filer_server, ip: str = "127.0.0.1", port: int = 8333,
-                 iam_config: dict | None = None):
+                 iam_config: dict | None = None,
+                 circuit_breaker: dict | None = None,
+                 allowed_origins: str = "*"):
+        from .circuit_breaker import CircuitBreaker
         self.fs = filer_server  # in-process FilerServer
         self.ip, self.port = ip, port
         self.iam = IdentityAccessManagement(iam_config)
+        self.breaker = CircuitBreaker(circuit_breaker)
+        self.allowed_origins = allowed_origins
         self._stop = threading.Event()
         self._http_thread: threading.Thread | None = None
 
@@ -78,7 +83,10 @@ class S3Gateway:
             resp = None
             with S3_REQUEST_SECONDS.time(kind):
                 try:
-                    resp = await self._route(request)
+                    if request.method == "OPTIONS":
+                        resp = self._cors_preflight(request)
+                    else:
+                        resp = await self._route(request)
                 except S3Error as e:
                     resp = _error_response(e, request.path)
                 except FileNotFoundError as e:
@@ -93,6 +101,7 @@ class S3Gateway:
             bucket = (request.path.lstrip("/").split("/", 1)[0]
                       if resp.status < 400 else "")
             S3_REQUEST_COUNTER.inc(kind, str(resp.status), bucket)
+            self._apply_cors(request, resp)
             return resp
 
         from ..utils.webapp import serve_web_app
@@ -100,51 +109,106 @@ class S3Gateway:
                                                        dispatch),
                       self.ip, self.port, self._stop)
 
+    # CORS (reference s3api_server.go cors.AllowAll-style middleware)
+    def _cors_preflight(self, request):
+        from aiohttp import web
+        return web.Response(status=200, headers={
+            "Access-Control-Allow-Origin": self.allowed_origins,
+            "Access-Control-Allow-Methods":
+                "GET, PUT, POST, DELETE, HEAD, OPTIONS",
+            "Access-Control-Allow-Headers":
+                request.headers.get("Access-Control-Request-Headers")
+                or "Authorization, Content-Type, x-amz-date, "
+                   "x-amz-content-sha256, *",
+            "Access-Control-Expose-Headers": "*",
+            "Access-Control-Max-Age": "86400",
+        })
+
+    def _apply_cors(self, request, resp) -> None:
+        if request.headers.get("Origin") and self.allowed_origins:
+            resp.headers.setdefault("Access-Control-Allow-Origin",
+                                    self.allowed_origins)
+            resp.headers.setdefault("Access-Control-Expose-Headers", "*")
+
+    @staticmethod
+    def _classify_action(method: str, q: dict, bucket: str, key: str) -> str:
+        if not bucket or (method in ("GET", "HEAD") and not key):
+            return ACTION_LIST
+        if "tagging" in q:
+            return ACTION_TAGGING
+        if method in ("GET", "HEAD"):
+            return ACTION_READ
+        return ACTION_WRITE
+
     async def _route(self, request):
         path = urllib.parse.unquote(request.path)
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         q = dict(request.query)
-        body = await request.read()
-        self._authorize(request, bucket, key, q, body)
+        action = self._classify_action(request.method, q, bucket, key)
+        with self.breaker.acquire(action, bucket):
+            body = await request.read()
+            seed_ctx = self._authorize(request, bucket, key, q, body, action)
+            body = self._maybe_decode_chunked(request, body, seed_ctx)
 
-        if not bucket:
-            return self.list_buckets()
-        if not key:
-            return await self._route_bucket(request, bucket, q, body)
-        return await self._route_object(request, bucket, key, q, body)
+            if not bucket:
+                return self.list_buckets()
+            if not key:
+                return await self._route_bucket(request, bucket, q, body)
+            return await self._route_object(request, bucket, key, q, body)
 
-    def _authorize(self, request, bucket, key, q, body) -> None:
-        if not self.iam.enabled:
-            return
-        m = request.method
-        if not bucket or (m in ("GET", "HEAD") and not key):
-            action = ACTION_LIST
-        elif "tagging" in q:
-            action = ACTION_TAGGING
-        elif m in ("GET", "HEAD"):
-            action = ACTION_READ
+    def _maybe_decode_chunked(self, request, body, seed_ctx):
+        """Strip + verify aws-chunked framing on streaming-signed uploads
+        (reference chunked_reader_v4.go)."""
+        from .chunked import (STREAMING_PAYLOAD, STREAMING_UNSIGNED,
+                              decode_chunked_payload)
+        sha = request.headers.get("x-amz-content-sha256", "")
+        enc = request.headers.get("content-encoding", "")
+        if sha == STREAMING_PAYLOAD:
+            decoded = decode_chunked_payload(body, seed_ctx)
+        elif sha == STREAMING_UNSIGNED or "aws-chunked" in enc:
+            decoded = decode_chunked_payload(body, None)
         else:
-            action = ACTION_WRITE
+            return body
+        declared = request.headers.get("x-amz-decoded-content-length")
+        if declared is not None and declared.isdigit() and \
+                int(declared) != len(decoded):
+            raise S3Error("IncompleteBody",
+                          "You did not provide the number of bytes specified "
+                          "by the Content-Length HTTP header.", 400)
+        return decoded
+
+    def _authorize(self, request, bucket, key, q, body, action):
+        """Returns the streaming SeedContext for chunk verification when the
+        request is streaming-signed, else None."""
+        if not self.iam.enabled:
+            return None
+        from .chunked import STREAMING_PAYLOAD, STREAMING_UNSIGNED
         payload_hash = request.headers.get("x-amz-content-sha256",
                                            "UNSIGNED-PAYLOAD")
-        if payload_hash not in ("UNSIGNED-PAYLOAD",
-                                "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
-            actual = hashlib.sha256(body).hexdigest()
-            if actual != payload_hash:
-                raise S3Error("XAmzContentSHA256Mismatch",
-                              "The provided 'x-amz-content-sha256' header "
-                              "does not match what was computed.", 400)
         headers = {k.lower(): v for k, v in request.headers.items()}
-        ident = self.iam.authenticate(request.method,
-                                      urllib.parse.unquote(request.path),
-                                      dict(request.query), headers,
-                                      payload_hash)
+        seed_ctx = None
+        if payload_hash == STREAMING_PAYLOAD:
+            ident, seed_ctx = self.iam.authenticate_streaming(
+                request.method, urllib.parse.unquote(request.path),
+                dict(request.query), headers)
+        else:
+            if payload_hash not in ("UNSIGNED-PAYLOAD", STREAMING_UNSIGNED):
+                actual = hashlib.sha256(body).hexdigest()
+                if actual != payload_hash:
+                    raise S3Error("XAmzContentSHA256Mismatch",
+                                  "The provided 'x-amz-content-sha256' header "
+                                  "does not match what was computed.", 400)
+            ident = self.iam.authenticate(request.method,
+                                          urllib.parse.unquote(request.path),
+                                          dict(request.query), headers,
+                                          payload_hash)
         from .auth import ErrAccessDenied
 
         if not ident.allows(action, bucket):
             raise ErrAccessDenied()
+        return seed_ctx
 
     async def _route_bucket(self, request, bucket, q, body):
         m = request.method
