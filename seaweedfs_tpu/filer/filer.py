@@ -48,6 +48,9 @@ class Filer:
         # optional notification.MessageQueue fed every mutation event
         # besides the meta log (reference filer_notify.go:20-66)
         self.notification_queue = notification_queue
+        # in-process mutation hooks: fn(directory, old, new); used by the
+        # filer server to hot-reload /etc/seaweedfs/filer.conf
+        self.mutation_hooks: list = []
         self._dir_lock = threading.RLock()  # _ensure_parents recurses
 
     # -- CRUD ---------------------------------------------------------------
@@ -228,6 +231,11 @@ class Filer:
         for s in signatures or []:
             ev.signatures.append(s)
         ev.signatures.append(self.signature)
+        for hook in self.mutation_hooks:
+            try:
+                hook(directory, old, new, new_parent_path)
+            except Exception as e:  # noqa: BLE001 — hooks must not break writes
+                log.warning("mutation hook %s: %s", hook, e)
         self.meta_log.append(directory, ev)
         if self.notification_queue is not None:
             name = (new.name if new is not None

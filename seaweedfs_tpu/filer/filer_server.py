@@ -46,6 +46,13 @@ class FilerServer:
         self.mc = MasterClient(master_address, client_type="filer")
         self.filer = Filer(open_store(store_spec), meta_log_path,
                            chunk_deleter=self._delete_chunks)
+        # path-prefix storage rules, hot-reloaded on conf-entry mutation
+        # (reference filer_conf.go; stored IN the filer at
+        # /etc/seaweedfs/filer.conf); loaded in start() once the master
+        # client can resolve chunked conf entries
+        from . import filer_conf
+        self.conf = filer_conf.FilerConf()
+        self.filer.mutation_hooks.append(self._maybe_reload_conf)
         self._stop = threading.Event()
         self._grpc = None
         self._http_thread = None
@@ -58,6 +65,11 @@ class FilerServer:
     def start(self) -> "FilerServer":
         self.mc.start()
         self.mc.wait_connected(10)
+        from . import filer_conf
+        entry = self.filer.find_entry(filer_conf.CONF_DIR,
+                                      filer_conf.CONF_NAME)
+        if entry is not None:
+            self._maybe_reload_conf(filer_conf.CONF_DIR, None, entry)
         self._grpc = serve(f"{self.ip}:{self.grpc_port}", [self._build_service()])
         self._http_thread = threading.Thread(target=self._run_http, daemon=True,
                                              name=f"filer-http-{self.port}")
@@ -83,10 +95,48 @@ class FilerServer:
                 log.warning("chunk gc: %s", e)
         threading.Thread(target=work, daemon=True).start()
 
+    def _maybe_reload_conf(self, directory, old, new,
+                           new_parent_path: str = "") -> None:
+        from . import filer_conf
+        # renames carry old in (directory, old.name) and new in
+        # (new_parent_path or directory, new.name): react when EITHER side
+        # touches the conf path
+        old_hit = (old is not None and directory == filer_conf.CONF_DIR
+                   and old.name == filer_conf.CONF_NAME)
+        new_dir = new_parent_path or directory
+        new_hit = (new is not None and new_dir == filer_conf.CONF_DIR
+                   and new.name == filer_conf.CONF_NAME)
+        if not (old_hit or new_hit):
+            return
+        try:
+            raw = b""
+            if new_hit:
+                # the conf may be stored inline or chunked (HTTP writes
+                # auto-chunk); read through either
+                raw = (bytes(new.content) if new.content
+                       else self.read_entry_bytes(new))
+            self.conf = filer_conf.FilerConf.from_bytes(raw)
+            log.info("filer.conf reloaded: %d rules", len(self.conf.rules))
+        except Exception as e:  # noqa: BLE001 — bad conf keeps old rules
+            log.warning("filer.conf reload failed: %s", e)
+
+    def _storage_rule(self, path: str):
+        """(collection, replication, ttl, disk_type) for a path, falling
+        back to the server-wide defaults (filer_conf.go MatchStorageRule)."""
+        rule = self.conf.match(path) if path else None
+        if rule is None:
+            return self.collection, self.replication, "", ""
+        return (rule.collection or self.collection,
+                rule.replication or self.replication,
+                rule.ttl, rule.disk_type)
+
     # -- chunk IO helpers ----------------------------------------------------
-    def _save_blob(self, data: bytes, ttl: str = "") -> fpb.FileChunk:
-        a = self.mc.assign(collection=self.collection,
-                           replication=self.replication, ttl=ttl)
+    def _save_blob(self, data: bytes, ttl: str = "",
+                   path: str = "") -> fpb.FileChunk:
+        collection, replication, rule_ttl, disk = self._storage_rule(path)
+        a = self.mc.assign(collection=collection,
+                           replication=replication, ttl=ttl or rule_ttl,
+                           disk_type=disk)
         target = a.location.public_url or a.location.url
         res = operation.upload(f"{target}/{a.fid}", data,
                                gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
@@ -126,14 +176,21 @@ class FilerServer:
         """Auto-chunking write (reference doPostAutoChunk). `signatures`
         carries replication origins for sync loop prevention."""
         directory, name = split_path(path)
+        collection, replication, rule_ttl, _disk = self._storage_rule(path)
+        if not ttl_sec and rule_ttl:
+            # a path rule's ttl applies to entry expiry AND needle ttl
+            from ..storage.types import TTL
+            ttl_sec = TTL.parse(rule_ttl).seconds
         chunks: list[fpb.FileChunk] = []
         md5 = hashlib.md5(data)
         for off in range(0, len(data), self.chunk_size):
             piece = data[off:off + self.chunk_size]
-            c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "")
+            c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "",
+                                path=path)
             c.offset = off
             chunks.append(c)
-        chunks = maybe_manifestize(chunks, self._save_blob)
+        chunks = maybe_manifestize(
+            chunks, lambda d: self._save_blob(d, path=path))
         entry = fpb.Entry(name=name)
         entry.chunks.extend(chunks)
         a = entry.attributes
@@ -142,7 +199,7 @@ class FilerServer:
         a.file_mode = mode
         a.ttl_sec = ttl_sec
         a.md5 = md5.digest()
-        a.collection, a.replication = self.collection, self.replication
+        a.collection, a.replication = collection, replication
         self.filer.create_entry(directory, entry, signatures=signatures)
         return entry
 
@@ -358,16 +415,20 @@ class FilerServer:
                    fpb.AssignVolumeResponse)
         def assign(req, ctx):
             try:
+                collection, replication, rule_ttl, disk = \
+                    self._storage_rule(req.path)
+                collection = req.collection or collection
+                replication = req.replication or replication
                 a = self.mc.assign(count=req.count or 1,
-                                   collection=req.collection or self.collection,
-                                   replication=req.replication or self.replication,
-                                   ttl=f"{req.ttl_sec}s" if req.ttl_sec else "",
-                                   disk_type=req.disk_type)
+                                   collection=collection,
+                                   replication=replication,
+                                   ttl=(f"{req.ttl_sec}s" if req.ttl_sec
+                                        else rule_ttl),
+                                   disk_type=req.disk_type or disk)
                 return fpb.AssignVolumeResponse(
                     file_id=a.fid, location_url=a.location.url,
                     public_url=a.location.public_url, count=a.count,
-                    collection=req.collection or self.collection,
-                    replication=req.replication or self.replication)
+                    collection=collection, replication=replication)
             except Exception as e:  # noqa: BLE001
                 return fpb.AssignVolumeResponse(error=str(e))
 

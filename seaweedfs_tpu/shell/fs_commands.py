@@ -268,3 +268,47 @@ def cmd_s3_bucket_delete(env: CommandEnv, args):
         directory=BUCKETS_DIR, name=opt.name, is_delete_data=True,
         is_recursive=True), fpb.DeleteEntryResponse)
     env.println(resp.error or f"deleted bucket {opt.name}")
+
+
+@command("fs.configure",
+         "[-locationPrefix /p] [-collection C] [-replication R] [-ttl T] "
+         "[-disk ssd] [-fsync] [-delete] [-apply]: path-prefix storage rules "
+         "(filer.conf)")
+def cmd_fs_configure(env: CommandEnv, args):
+    """Reference command_fs_configure.go: edit /etc/seaweedfs/filer.conf
+    inside the filer; without -apply just prints the resulting rules."""
+    from ..filer.filer_conf import (CONF_DIR, CONF_NAME, FilerConf, PathRule)
+
+    p = _fs_parser("fs.configure")
+    p.add_argument("-locationPrefix", default="")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("-disk", default="")
+    p.add_argument("-fsync", action="store_true")
+    p.add_argument("-volumeGrowthCount", type=int, default=0)
+    p.add_argument("-delete", action="store_true")
+    p.add_argument("-apply", action="store_true")
+    opt = p.parse_args(args)
+    import requests
+
+    # the filer HTTP path reads/writes through chunked entries; a raw
+    # gRPC LookupDirectoryEntry would miss chunked conf content
+    base = f"http://{_filer_addr(env, opt.filer)}"
+    r = requests.get(f"{base}{CONF_DIR}/{CONF_NAME}", timeout=10)
+    conf = FilerConf.from_bytes(r.content if r.status_code == 200 else b"")
+    if opt.locationPrefix:
+        if opt.delete:
+            conf.delete(opt.locationPrefix)
+        else:
+            conf.upsert(PathRule(
+                location_prefix=opt.locationPrefix,
+                collection=opt.collection, replication=opt.replication,
+                ttl=opt.ttl, disk_type=opt.disk, fsync=opt.fsync,
+                volume_growth_count=opt.volumeGrowthCount))
+    env.println(conf.to_bytes().decode())
+    if opt.locationPrefix and opt.apply:
+        r = requests.post(f"{base}{CONF_DIR}/{CONF_NAME}",
+                          data=conf.to_bytes(), timeout=10)
+        r.raise_for_status()
+        env.println("applied.")

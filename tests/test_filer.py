@@ -439,3 +439,104 @@ def test_grpc_subscribe_metadata(filer_server):
             break
     stream.cancel()
     assert ("/subtest", "sub.txt") in seen
+
+
+# -- filer.conf path rules (reference filer_conf.go) --------------------------
+
+def test_filer_conf_matching_unit():
+    from seaweedfs_tpu.filer.filer_conf import FilerConf, PathRule
+
+    conf = FilerConf([
+        PathRule(location_prefix="/buckets/", collection="bkts"),
+        PathRule(location_prefix="/buckets/logs/", collection="logs",
+                 ttl="7d"),
+        PathRule(location_prefix="/hot/", disk_type="ssd", fsync=True),
+    ])
+    assert conf.match("/buckets/logs/app.log").collection == "logs"  # longest
+    assert conf.match("/buckets/other/x").collection == "bkts"
+    assert conf.match("/hot/a").disk_type == "ssd"
+    assert conf.match("/cold/a") is None
+    # JSON round-trip preserves rules
+    again = FilerConf.from_bytes(conf.to_bytes())
+    assert again.match("/buckets/logs/x").ttl == "7d"
+    # upsert replaces, delete removes
+    conf.upsert(PathRule(location_prefix="/hot/", disk_type="hdd"))
+    assert conf.match("/hot/a").disk_type == "hdd"
+    conf.delete("/hot/")
+    assert conf.match("/hot/a") is None
+
+
+def test_filer_conf_hot_reload_and_assign(filer_server, cluster):
+    """Writing /etc/seaweedfs/filer.conf through the filer hot-reloads the
+    rules; writes under the prefix land in the rule's collection."""
+    import requests
+
+    from seaweedfs_tpu.filer.filer_conf import CONF_PATH, FilerConf, PathRule
+
+    master, servers, mc = cluster
+    conf = FilerConf([PathRule(location_prefix="/ruled/",
+                               collection="rulecoll")])
+    r = requests.post(f"http://{filer_server.url}{CONF_PATH}",
+                      data=conf.to_bytes(), timeout=10)
+    assert r.status_code == 201, r.text
+    assert len(filer_server.conf.rules) == 1  # hook fired synchronously
+
+    r = requests.post(f"http://{filer_server.url}/ruled/f.bin",
+                      data=b"z" * 5000, timeout=30)
+    assert r.status_code == 201, r.text
+    entry = filer_server.filer.find_entry("/ruled", "f.bin")
+    assert entry.attributes.collection == "rulecoll"
+    vid = int(entry.chunks[0].file_id.split(",")[0])
+    # the chunk's volume really is in the rule collection (master topology)
+    found = None
+    for node in master.topo.nodes.values():
+        for disk in node.disks.values():
+            if vid in disk.volumes:
+                found = disk.volumes[vid].collection
+    assert found == "rulecoll"
+
+    # outside the prefix: default (empty) collection
+    r = requests.post(f"http://{filer_server.url}/plain/g.bin",
+                      data=b"y" * 100, timeout=30)
+    assert r.status_code == 201
+    entry = filer_server.filer.find_entry("/plain", "g.bin")
+    assert entry.attributes.collection == ""
+
+
+def test_fs_configure_shell_command(cluster, tmp_path):
+    import io as iomod
+
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.shell import fs_commands  # noqa: F401
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    master, servers, mc = cluster
+    # fs.* shell commands use the grpc = http+10000 convention
+    port = free_port()
+    fs = FilerServer(f"127.0.0.1:{master.port}", store_spec="memory",
+                     port=port, grpc_port=port + 10000,
+                     meta_log_path=str(tmp_path / "meta.log"))
+    fs.start()
+    import requests
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            if requests.get(f"http://{fs.url}/__status__", timeout=1).ok:
+                break
+        except Exception:
+            time.sleep(0.1)
+    try:
+        out = iomod.StringIO()
+        env = CommandEnv(f"127.0.0.1:{master.port}", mc=mc, out=out)
+        run_command(env, f"fs.configure -filer {fs.url} "
+                         "-locationPrefix /cfg/ -collection cfgc -ttl 3d "
+                         "-apply")
+        assert "applied." in out.getvalue()
+        assert any(r.location_prefix == "/cfg/"
+                   for r in fs.conf.rules)  # hot-reloaded via gRPC too
+        out.truncate(0), out.seek(0)
+        run_command(env, f"fs.configure -filer {fs.url} "
+                         "-locationPrefix /cfg/ -delete -apply")
+        assert not any(r.location_prefix == "/cfg/" for r in fs.conf.rules)
+    finally:
+        fs.stop()
