@@ -9,6 +9,7 @@ Verbs (subset of reference command/command.go:12-44, growing):
   download - fetch by fid
   fix      - rebuild a .idx from a .dat (reference command/fix.go:74)
   backup   - incrementally back up a volume to a local dir (command/backup.go)
+  scaffold - print default TOML config templates (command/scaffold.go)
   benchmark- built-in load test (reference command/benchmark.go)
 """
 
@@ -51,12 +52,22 @@ def _add_security_flags(p):
 
 
 def _make_guard(opt):
+    """Flags win; absent flags fall back to security.toml on the config
+    tier chain (reference util/config.go:37-48 + security/jwt wiring)."""
     from .security import Guard
-    if not (opt.jwtSigningKey or opt.jwtReadSigningKey or opt.whiteList):
+    from .utils import config as cfg
+    sec = cfg.load_config("security")
+    sign = opt.jwtSigningKey or cfg.get_dotted(
+        sec, "jwt.signing.key", "") or ""
+    read = opt.jwtReadSigningKey or cfg.get_dotted(
+        sec, "jwt.signing.read.key", "") or ""
+    wl = opt.whiteList or cfg.get_dotted(sec, "guard.white_list", "") or ""
+    if isinstance(wl, list):
+        wl = ",".join(wl)
+    if not (sign or read or wl):
         return None
-    return Guard(white_list=[s for s in opt.whiteList.split(",") if s],
-                 signing_key=opt.jwtSigningKey,
-                 read_signing_key=opt.jwtReadSigningKey)
+    return Guard(white_list=[s for s in wl.split(",") if s],
+                 signing_key=sign, read_signing_key=read)
 
 
 def _add_volume_flags(p):
@@ -84,8 +95,17 @@ def run_master(argv):
     if opt.raftDir:
         _os.makedirs(opt.raftDir, exist_ok=True)
         raft_state = _os.path.join(opt.raftDir, f"raft-{opt.port}.json")
-    scripts = (None if opt.maintenanceScripts == "default"
-               else [s for s in opt.maintenanceScripts.split(";") if s.strip()])
+    from .utils import config as cfg
+    mconf = cfg.load_config("master")
+    if opt.maintenanceScripts == "default":
+        toml_scripts = cfg.get_dotted(mconf, "master.maintenance.scripts", "")
+        scripts = ([ln.strip() for ln in toml_scripts.splitlines()
+                    if ln.strip()] if toml_scripts else None)
+    else:
+        scripts = [s for s in opt.maintenanceScripts.split(";") if s.strip()]
+    if not opt.maintenanceIntervalS:
+        mins = cfg.get_dotted(mconf, "master.maintenance.sleep_minutes", 0)
+        opt.maintenanceIntervalS = float(mins) * 60 if mins else 0
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
                       default_replication=opt.defaultReplication,
@@ -227,6 +247,30 @@ def run_backup(argv):
         mc.stop()
 
 
+def run_scaffold(argv):
+    """Print default TOML config templates (reference command/scaffold.go +
+    command/scaffold/*.toml); write with -output."""
+    p = argparse.ArgumentParser(prog="scaffold")
+    p.add_argument("-config", default="security",
+                   help="master|filer|security|replication|notification|shell")
+    p.add_argument("-output", default="",
+                   help="directory to write <config>.toml into ('' = stdout)")
+    opt = p.parse_args(argv)
+    from .utils.scaffold import TEMPLATES
+    body = TEMPLATES.get(opt.config)
+    if body is None:
+        print(f"unknown config {opt.config!r}; have {sorted(TEMPLATES)}")
+        sys.exit(1)
+    if opt.output:
+        import os as _os
+        path = _os.path.join(opt.output, f"{opt.config}.toml")
+        with open(path, "w") as f:
+            f.write(body)
+        print(f"wrote {path}")
+    else:
+        print(body)
+
+
 def run_upload(argv):
     from .client import operation
     from .client.master_client import MasterClient
@@ -340,6 +384,7 @@ VERBS = {
     "shell": run_shell,
     "upload": run_upload,
     "backup": run_backup,
+    "scaffold": run_scaffold,
     "download": run_download,
     "fix": run_fix,
     "benchmark": run_benchmark,

@@ -221,6 +221,13 @@ class MasterServer:
                         "Leader": ms.leader_address,
                         "Peers": [p for p in ms.peers
                                   if p != ms.address]}).encode())
+                elif url.path == "/debug/profile":
+                    # pprof-style CPU profile trigger (reference exposes
+                    # net/http/pprof on -debug.port, command/imports.go:4)
+                    from ..utils import profiling
+                    text = profiling.cpu_profile(
+                        float(q.get("seconds", "5")))
+                    self._send(200, text.encode(), "text/plain")
                 else:
                     self._send(404, b'{"error":"not found"}')
 

@@ -231,9 +231,26 @@ class VolumeServer:
 
         from ..stats.metrics import aiohttp_metrics_handler
 
+        async def debug_profile(request):
+            from ..utils import profiling
+            secs = float(request.query.get("seconds", "5"))
+            loop = asyncio.get_running_loop()
+            text = await loop.run_in_executor(
+                None, profiling.cpu_profile, secs)
+            return web.Response(text=text, content_type="text/plain")
+
+        async def debug_jax_profiler(request):
+            from ..utils import profiling
+            port = int(request.query.get("port", "9999"))
+            return web.Response(text=profiling.start_jax_profiler(port),
+                                content_type="text/plain")
+
         def routes(app):
             app.router.add_get("/status", status)
             app.router.add_get("/metrics", aiohttp_metrics_handler)
+            # pprof-style triggers (reference -debug.port net/http/pprof)
+            app.router.add_get("/debug/profile", debug_profile)
+            app.router.add_get("/debug/jax-profiler", debug_jax_profiler)
             app.router.add_route("*", "/{fid:.*}", handle)
 
         from ..utils.webapp import serve_web_app

@@ -1,0 +1,54 @@
+"""Profiling triggers (reference: pprof on -debug.port via net/http/pprof,
+command/imports.go:4 + grace.SetupProfiling; SURVEY §5 maps this to a
+jax.profiler server for the device plane).
+
+Two HTTP-triggered modes, wired into each daemon's status server:
+
+* `/debug/profile?seconds=N` — run cProfile over the whole process for N
+  seconds, return pstats text (pprof's /debug/pprof/profile analogue).
+* `/debug/jax-profiler?port=P` — start jax.profiler.start_server(P) so
+  TensorBoard/xprof can connect and capture device traces.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import threading
+import time
+
+_lock = threading.Lock()
+_jax_server = None
+
+
+def cpu_profile(seconds: float = 5.0, top: int = 60) -> str:
+    """Profile the whole process for `seconds`; returns pstats text.
+    One profile at a time (cProfile is a global tracer)."""
+    seconds = min(max(seconds, 0.1), 120.0)
+    if not _lock.acquire(blocking=False):
+        return "another profile is already running\n"
+    try:
+        prof = cProfile.Profile()
+        prof.enable()
+        time.sleep(seconds)
+        prof.disable()
+        out = io.StringIO()
+        stats = pstats.Stats(prof, stream=out)
+        stats.sort_stats("cumulative").print_stats(top)
+        return out.getvalue()
+    finally:
+        _lock.release()
+
+
+def start_jax_profiler(port: int = 9999) -> str:
+    """Start (once) the jax.profiler gRPC server for device traces."""
+    global _jax_server
+    with _lock:
+        if _jax_server is not None:
+            return f"jax profiler already running on :{_jax_server}\n"
+        import jax
+
+        jax.profiler.start_server(port)
+        _jax_server = port
+        return f"jax profiler listening on :{port} (connect xprof/tensorboard)\n"
