@@ -199,7 +199,7 @@ def run_server(argv):
 
 def run_shell(argv):
     from .shell import (ec_commands, fs_commands,  # noqa: F401 (register)
-                        volume_commands)
+                        mq_commands, volume_commands)
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")
@@ -263,6 +263,7 @@ def run_scaffold(argv):
         sys.exit(1)
     if opt.output:
         import os as _os
+        _os.makedirs(opt.output, exist_ok=True)
         path = _os.path.join(opt.output, f"{opt.config}.toml")
         with open(path, "w") as f:
             f.write(body)
@@ -369,10 +370,13 @@ def run_mq_broker(argv):
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=17777)
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-dir", default="./mq-data",
+                   help="local segment directory ('' = memory-only)")
     opt = p.parse_args(argv)
-    # segment persistence needs an in-process filer; the standalone CLI
-    # broker runs memory-only until a remote-filer client lands
-    BrokerServer(opt.master, ip=opt.ip, port=opt.port).start()
+    # standalone broker persists segments to a local directory; pass an
+    # in-process filer instead when embedded in `server`
+    BrokerServer(opt.master, ip=opt.ip, port=opt.port,
+                 data_dir=opt.dir or None).start()
     _wait_forever()
 
 

@@ -57,6 +57,7 @@ class VolumeServer:
         # token is the single-fid JWT the master minted on Assign).
         self.guard = guard
         self._stop = threading.Event()
+        self._leave = threading.Event()  # volume.server.leave: stop heartbeats
         self._hb_wake = threading.Event()
         self._grpc = None
         self._http_thread = None
@@ -133,7 +134,7 @@ class VolumeServer:
             setattr(self, attr, set(cur))
 
     def _heartbeat_messages(self):
-        while not self._stop.is_set():
+        while not (self._stop.is_set() or self._leave.is_set()):
             hb = self.store.collect_heartbeat()
             self._update_gauges(hb)
             msg = mpb.Heartbeat(
@@ -153,7 +154,7 @@ class VolumeServer:
             self._hb_wake.clear()
 
     def _heartbeat_loop(self) -> None:
-        while not self._stop.is_set():
+        while not (self._stop.is_set() or self._leave.is_set()):
             try:
                 stub = Stub(self.current_leader, MASTER_SERVICE)
                 stream = stub.stream_stream(
@@ -652,6 +653,31 @@ class VolumeServer:
                 file_count=v.file_count, file_deleted_count=v.deleted_count)
 
         # vacuum phases (reference volume_grpc_vacuum.go)
+        @svc.unary("VolumeMount", vpb.VolumeMountRequest,
+                   vpb.VolumeMountResponse)
+        def volume_mount(req, context):
+            store.mount_volume(req.volume_id, req.collection)
+            vs.trigger_heartbeat()
+            return vpb.VolumeMountResponse()
+
+        @svc.unary("VolumeUnmount", vpb.VolumeUnmountRequest,
+                   vpb.VolumeUnmountResponse)
+        def volume_unmount(req, context):
+            if not store.unmount_volume(req.volume_id):
+                context.abort(5, f"volume {req.volume_id} not found")
+            vs.trigger_heartbeat()
+            return vpb.VolumeUnmountResponse()
+
+        @svc.unary("VolumeServerLeave", vpb.VolumeServerLeaveRequest,
+                   vpb.VolumeServerLeaveResponse)
+        def volume_server_leave(req, context):
+            """Stop heartbeating so the master forgets this node; data
+            service keeps running for direct reads (reference
+            volume_grpc_admin.go VolumeServerLeave)."""
+            vs._leave.set()
+            vs._hb_wake.set()
+            return vpb.VolumeServerLeaveResponse()
+
         # ---- tail / incremental sync (reference volume_grpc_tail.go,
         # volume_grpc_copy_incremental.go) ----
         @svc.unary("VolumeSyncStatus", vpb.VolumeSyncStatusRequest,

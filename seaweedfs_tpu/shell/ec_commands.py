@@ -413,3 +413,37 @@ def cmd_ec_decode(env: CommandEnv, args):
                                                 shard_ids=[sid]),
                 vpb.VolumeEcShardsDeleteResponse)
     env.println(f"decoded ec volume {vid} back to a normal volume on {host['id']}")
+
+
+@command("ec.volume.delete", "-volumeId N [-collection C]: delete an ec "
+         "volume's shards everywhere", needs_lock=True)
+def cmd_ec_volume_delete(env: CommandEnv, args):
+    """Reference command_ecVolume_delete.go (fork)."""
+    p = argparse.ArgumentParser(prog="ec.volume.delete")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    opt = p.parse_args(args)
+    removed = 0
+    for srv in env.collect_volume_servers():
+        sids: list[int] = []
+        for disk in srv["disks"].values():
+            for s in disk.ec_shard_infos:
+                if s.id != opt.volumeId:
+                    continue
+                sids.extend(i for i in range(32)
+                            if s.ec_index_bits & (1 << i) and i not in sids)
+        if not sids:
+            continue
+        stub = _stub(env, srv)
+        stub.call("VolumeEcShardsUnmount",
+                  vpb.VolumeEcShardsUnmountRequest(volume_id=opt.volumeId,
+                                                   shard_ids=sids),
+                  vpb.VolumeEcShardsUnmountResponse)
+        stub.call("VolumeEcShardsDelete",
+                  vpb.VolumeEcShardsDeleteRequest(volume_id=opt.volumeId,
+                                                  collection=opt.collection,
+                                                  shard_ids=sids),
+                  vpb.VolumeEcShardsDeleteResponse)
+        removed += len(sids)
+        env.println(f"  removed shards {sids} from {srv['id']}")
+    env.println(f"deleted ec volume {opt.volumeId} ({removed} shards)")

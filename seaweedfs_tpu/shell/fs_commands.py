@@ -60,6 +60,14 @@ def _fs_parser(prog: str) -> argparse.ArgumentParser:
     return p
 
 
+def _abs(env: CommandEnv, path: str) -> str:
+    """Resolve a possibly-relative path against the shell cwd (fs.cd)."""
+    if not path.startswith("/"):
+        cwd = env.option.get("cwd", "/")
+        path = cwd.rstrip("/") + "/" + path
+    return path
+
+
 @command("fs.ls", "list a filer directory")
 def cmd_fs_ls(env: CommandEnv, args):
     p = _fs_parser("fs.ls")
@@ -67,7 +75,7 @@ def cmd_fs_ls(env: CommandEnv, args):
     p.add_argument("path", nargs="?", default="/")
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
-    for e in _list_entries(stub, opt.path.rstrip("/") or "/"):
+    for e in _list_entries(stub, _abs(env, opt.path).rstrip("/") or "/"):
         if opt.long:
             kind = "d" if e.is_directory else "-"
             size = e.attributes.file_size
@@ -84,7 +92,7 @@ def cmd_fs_cat(env: CommandEnv, args):
     p.add_argument("path")
     opt = p.parse_args(args)
     addr = _filer_addr(env, opt.filer)
-    r = requests.get(f"http://{addr}{opt.path}", timeout=60)
+    r = requests.get(f"http://{addr}{_abs(env, opt.path)}", timeout=60)
     if r.status_code != 200:
         env.println(f"error: HTTP {r.status_code}")
         return
@@ -100,7 +108,7 @@ def cmd_fs_du(env: CommandEnv, args):
     total_bytes = 0
     file_count = 0
     dir_count = 0
-    for _path, e in _walk(stub, opt.path.rstrip("/") or "/"):
+    for _path, e in _walk(stub, _abs(env, opt.path).rstrip("/") or "/"):
         if e.is_directory:
             dir_count += 1
         else:
@@ -116,7 +124,7 @@ def cmd_fs_mkdir(env: CommandEnv, args):
     p.add_argument("path")
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
-    path = opt.path.rstrip("/")
+    path = _abs(env, opt.path).rstrip("/")
     d, _, n = path.rpartition("/")
     req = fpb.CreateEntryRequest(directory=d or "/")
     req.entry.name = n
@@ -133,7 +141,7 @@ def cmd_fs_rm(env: CommandEnv, args):
     p.add_argument("path")
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
-    path = opt.path.rstrip("/")
+    path = _abs(env, opt.path).rstrip("/")
     d, _, n = path.rpartition("/")
     resp = stub.call("DeleteEntry", fpb.DeleteEntryRequest(
         directory=d or "/", name=n, is_delete_data=True,
@@ -151,7 +159,7 @@ def cmd_fs_verify(env: CommandEnv, args):
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
     ok = bad = 0
-    for path, e in _walk(stub, opt.path.rstrip("/") or "/"):
+    for path, e in _walk(stub, _abs(env, opt.path).rstrip("/") or "/"):
         if e.is_directory:
             continue
         for c in e.chunks:
@@ -312,3 +320,139 @@ def cmd_fs_configure(env: CommandEnv, args):
                           data=conf.to_bytes(), timeout=10)
         r.raise_for_status()
         env.println("applied.")
+
+
+@command("fs.mv", "move/rename a filer file or directory")
+def cmd_fs_mv(env: CommandEnv, args):
+    """Reference command_fs_mv.go (AtomicRenameEntry)."""
+    p = _fs_parser("fs.mv")
+    p.add_argument("src")
+    p.add_argument("dst")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    src_path = _abs(env, opt.src)
+    dst_path = _abs(env, opt.dst)
+    sd, _, sn = src_path.rstrip("/").rpartition("/")
+    dd, _, dn = dst_path.rstrip("/").rpartition("/")
+    # mv into an existing directory keeps the source name (unix mv)
+    try:
+        t = stub.call("LookupDirectoryEntry",
+                      fpb.LookupDirectoryEntryRequest(directory=dd or "/",
+                                                      name=dn),
+                      fpb.LookupDirectoryEntryResponse)
+        if t.entry.is_directory:
+            dd, dn = dst_path.rstrip("/"), sn
+    except Exception:  # noqa: BLE001 — destination doesn't exist: plain rename
+        pass
+    stub.call("AtomicRenameEntry", fpb.AtomicRenameEntryRequest(
+        old_directory=sd or "/", old_name=sn,
+        new_directory=dd or "/", new_name=dn),
+        fpb.AtomicRenameEntryResponse)
+    env.println(f"moved {src_path} -> {(dd or '/').rstrip('/')}/{dn}")
+
+
+@command("fs.tree", "recursively print a filer subtree")
+def cmd_fs_tree(env: CommandEnv, args):
+    """Reference command_fs_tree.go."""
+    p = _fs_parser("fs.tree")
+    p.add_argument("path", nargs="?", default="/")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    root = _abs(env, opt.path).rstrip("/") or "/"
+    env.println(root)
+    files = dirs = 0
+    for path, e in _walk(stub, root):
+        depth = path[len(root):].count("/") if root != "/" else path.count("/")
+        env.println("  " * depth + e.name + ("/" if e.is_directory else ""))
+        if e.is_directory:
+            dirs += 1
+        else:
+            files += 1
+    env.println(f"{dirs} directories, {files} files")
+
+
+@command("fs.meta.save", "[-o file] [path]: snapshot filer metadata to a "
+         "local file")
+def cmd_fs_meta_save(env: CommandEnv, args):
+    """Reference command_fs_meta_save.go: length-prefixed FullEntry protos."""
+    import struct as _struct
+
+    p = _fs_parser("fs.meta.save")
+    p.add_argument("-o", dest="output", default="filer-meta.bin")
+    p.add_argument("path", nargs="?", default="/")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    n = 0
+    with open(opt.output, "wb") as f:
+        for path, e in _walk(stub, _abs(env, opt.path).rstrip("/") or "/"):
+            d, _, _name = path.rpartition("/")
+            fe = fpb.FullEntry(dir=d or "/", entry=e)
+            blob = fe.SerializeToString()
+            f.write(_struct.pack("<I", len(blob)) + blob)
+            n += 1
+    env.println(f"saved {n} entries to {opt.output}")
+
+
+@command("fs.meta.load", "[-i file]: restore filer metadata from a snapshot")
+def cmd_fs_meta_load(env: CommandEnv, args):
+    """Reference command_fs_meta_load.go."""
+    import struct as _struct
+
+    p = _fs_parser("fs.meta.load")
+    p.add_argument("-i", dest="input", default="filer-meta.bin")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    n = 0
+    with open(opt.input, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (ln,) = _struct.unpack("<I", hdr)
+            fe = fpb.FullEntry()
+            fe.ParseFromString(f.read(ln))
+            stub.call("CreateEntry",
+                      fpb.CreateEntryRequest(directory=fe.dir, entry=fe.entry),
+                      fpb.CreateEntryResponse)
+            n += 1
+    env.println(f"loaded {n} entries from {opt.input}")
+
+
+@command("fs.meta.cat", "print one entry's metadata as text")
+def cmd_fs_meta_cat(env: CommandEnv, args):
+    """Reference command_fs_meta_cat.go."""
+    p = _fs_parser("fs.meta.cat")
+    p.add_argument("path")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    d, _, n = _abs(env, opt.path).rstrip("/").rpartition("/")
+    resp = stub.call("LookupDirectoryEntry",
+                     fpb.LookupDirectoryEntryRequest(directory=d or "/",
+                                                     name=n),
+                     fpb.LookupDirectoryEntryResponse)
+    env.println(str(resp.entry))
+
+
+@command("fs.cd", "change the shell's working filer directory")
+def cmd_fs_cd(env: CommandEnv, args):
+    p = _fs_parser("fs.cd")
+    p.add_argument("path", nargs="?", default="/")
+    opt = p.parse_args(args)
+    path = _abs(env, opt.path).rstrip("/") or "/"
+    if path != "/":
+        stub = _filer_stub(env, opt.filer)
+        d, _, n = path.rpartition("/")
+        resp = stub.call("LookupDirectoryEntry",
+                         fpb.LookupDirectoryEntryRequest(directory=d or "/",
+                                                         name=n),
+                         fpb.LookupDirectoryEntryResponse)
+        if not resp.entry.is_directory:
+            env.println(f"not a directory: {path}")
+            return
+    env.option["cwd"] = path
+    env.println(env.option["cwd"])
+
+
+@command("fs.pwd", "print the shell's working filer directory")
+def cmd_fs_pwd(env: CommandEnv, args):
+    env.println(env.option.get("cwd", "/"))

@@ -1,0 +1,71 @@
+"""mq.topic.* shell commands (reference command_mq_topic_list.go,
+command_mq_topic_desc.go, command_mq_topic_configure.go). Brokers register
+in the master cluster list; commands dial the first live broker."""
+
+from __future__ import annotations
+
+import argparse
+
+from ..pb import mq_pb2 as mq
+from ..utils.rpc import Stub
+from .commands import CommandEnv, command
+
+MQ_SERVICE = "swtpu.mq.Broker"
+
+
+def _broker_stub(env: CommandEnv, opt_broker: str) -> Stub:
+    addr = opt_broker or env.option.get("broker", "")
+    if not addr:
+        raise RuntimeError("no broker configured; pass -broker host:port")
+    return Stub(addr, MQ_SERVICE)
+
+
+def _mq_parser(prog: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog)
+    p.add_argument("-broker", default="")
+    return p
+
+
+@command("mq.topic.list", "list message-queue topics")
+def cmd_mq_topic_list(env: CommandEnv, args):
+    opt = _mq_parser("mq.topic.list").parse_args(args)
+    stub = _broker_stub(env, opt.broker)
+    resp = stub.call("ListTopics", mq.ListTopicsRequest(),
+                     mq.ListTopicsResponse)
+    for t in resp.topics:
+        env.println(f"{t.namespace}/{t.name}")
+    env.println(f"{len(resp.topics)} topics")
+
+
+@command("mq.topic.desc", "-topic ns/name: describe a topic's partitions")
+def cmd_mq_topic_desc(env: CommandEnv, args):
+    p = _mq_parser("mq.topic.desc")
+    p.add_argument("-topic", required=True)
+    opt = p.parse_args(args)
+    ns, _, name = opt.topic.partition("/")
+    stub = _broker_stub(env, opt.broker)
+    resp = stub.call("LookupTopicBrokers",
+                     mq.LookupTopicBrokersRequest(
+                         topic=mq.Topic(namespace=ns, name=name)),
+                     mq.LookupTopicBrokersResponse)
+    for a in resp.assignments:
+        env.println(f"partition [{a.partition.range_start},"
+                    f"{a.partition.range_stop}) -> {a.leader_broker}")
+    env.println(f"{len(resp.assignments)} partitions")
+
+
+@command("mq.topic.configure", "-topic ns/name -partitions N: create or "
+         "resize a topic")
+def cmd_mq_topic_configure(env: CommandEnv, args):
+    p = _mq_parser("mq.topic.configure")
+    p.add_argument("-topic", required=True)
+    p.add_argument("-partitions", type=int, default=4)
+    opt = p.parse_args(args)
+    ns, _, name = opt.topic.partition("/")
+    stub = _broker_stub(env, opt.broker)
+    stub.call("ConfigureTopic",
+              mq.ConfigureTopicRequest(
+                  topic=mq.Topic(namespace=ns, name=name),
+                  partition_count=opt.partitions),
+              mq.ConfigureTopicResponse)
+    env.println(f"configured {opt.topic} with {opt.partitions} partitions")

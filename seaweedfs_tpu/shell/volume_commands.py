@@ -540,3 +540,99 @@ def cmd_volume_check_disk(env: CommandEnv, args):
                     env.println(f"  fix {vid},{key:x}: {e}")
     env.println(f"check.disk: {diverged} divergent replicas, "
                 f"{fixed} needles re-copied")
+
+
+@command("volume.mount", "-volumeId N -node ip:port: open an on-disk volume "
+         "into serving", needs_lock=True)
+def cmd_volume_mount(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="volume.mount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    p.add_argument("-collection", default="")
+    opt = p.parse_args(args)
+    srv = {s["id"]: s for s in env.collect_volume_servers()}[opt.node]
+    _vs_stub(env, srv["id"], srv["grpc_port"]).call(
+        "VolumeMount", vpb.VolumeMountRequest(volume_id=opt.volumeId,
+                                              collection=opt.collection),
+        vpb.VolumeMountResponse)
+    env.println(f"mounted volume {opt.volumeId} on {opt.node}")
+
+
+@command("volume.unmount", "-volumeId N -node ip:port: close a volume "
+         "(files stay on disk)", needs_lock=True)
+def cmd_volume_unmount(env: CommandEnv, args):
+    p = argparse.ArgumentParser(prog="volume.unmount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    opt = p.parse_args(args)
+    srv = {s["id"]: s for s in env.collect_volume_servers()}[opt.node]
+    _vs_stub(env, srv["id"], srv["grpc_port"]).call(
+        "VolumeUnmount", vpb.VolumeUnmountRequest(volume_id=opt.volumeId),
+        vpb.VolumeUnmountResponse)
+    env.println(f"unmounted volume {opt.volumeId} on {opt.node}")
+
+
+@command("volume.copy", "-volumeId N -source ip:port -target ip:port: "
+         "replicate a volume onto another server", needs_lock=True)
+def cmd_volume_copy(env: CommandEnv, args):
+    """Reference command_volume_copy.go (move without source delete)."""
+    p = argparse.ArgumentParser(prog="volume.copy")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-source", required=True)
+    p.add_argument("-target", required=True)
+    opt = p.parse_args(args)
+    servers = {s["id"]: s for s in env.collect_volume_servers()}
+    src_srv, dst_srv = servers[opt.source], servers[opt.target]
+    info = next(v for d in src_srv["disks"].values() for v in d.volume_infos
+                if v.id == opt.volumeId)
+    _safe_copy_volume(env, opt.volumeId, info.collection, src_srv, dst_srv,
+                      delete_source=False)
+    env.println(f"copied volume {opt.volumeId} {opt.source} -> {opt.target}")
+
+
+@command("volume.delete.empty", "[-force]: delete volumes with no live "
+         "needles cluster-wide", needs_lock=True)
+def cmd_volume_delete_empty(env: CommandEnv, args):
+    """Reference command_volume_delete_empty.go."""
+    p = argparse.ArgumentParser(prog="volume.delete.empty")
+    p.add_argument("-force", action="store_true")
+    opt = p.parse_args(args)
+    deleted = 0
+    for srv in env.collect_volume_servers():
+        for disk in srv["disks"].values():
+            for v in disk.volume_infos:
+                if v.file_count - v.delete_count > 0:
+                    continue
+                if not opt.force:
+                    env.println(f"  would delete empty volume {v.id} "
+                                f"on {srv['id']} (use -force)")
+                    continue
+                _vs_stub(env, srv["id"], srv["grpc_port"]).call(
+                    "VolumeDelete",
+                    vpb.VolumeDeleteRequest(volume_id=v.id, only_empty=True),
+                    vpb.VolumeDeleteResponse)
+                deleted += 1
+    env.println(f"deleted {deleted} empty volumes")
+
+
+@command("volume.server.leave", "-node ip:port: drain a server from the "
+         "cluster (stops heartbeats)", needs_lock=True)
+def cmd_volume_server_leave(env: CommandEnv, args):
+    """Reference command_volume_server_leave.go."""
+    p = argparse.ArgumentParser(prog="volume.server.leave")
+    p.add_argument("-node", required=True)
+    opt = p.parse_args(args)
+    srv = {s["id"]: s for s in env.collect_volume_servers()}[opt.node]
+    _vs_stub(env, srv["id"], srv["grpc_port"]).call(
+        "VolumeServerLeave", vpb.VolumeServerLeaveRequest(),
+        vpb.VolumeServerLeaveResponse)
+    env.println(f"{opt.node} left the cluster (data service still up)")
+
+
+@command("cluster.raft.ps", "show raft quorum state")
+def cmd_cluster_raft_ps(env: CommandEnv, args):
+    """Reference command_cluster_raft_ps.go."""
+    env.println(f"leader: {env.mc.leader}")
+    for m in env.mc.masters:
+        env.println(f"member: {m}" + (" (leader)"
+                                      if m == env.mc.leader else ""))

@@ -63,6 +63,33 @@ class Store:
                 return v
         return None
 
+    def unmount_volume(self, vid: int) -> bool:
+        """Close a volume and drop it from serving; files stay on disk
+        (reference volume_grpc_admin.go VolumeUnmount)."""
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                with loc.lock:
+                    loc.volumes.pop(vid, None)
+                v.close()
+                return True
+        return False
+
+    def mount_volume(self, vid: int, collection: str = "") -> Volume:
+        """(Re)open an on-disk volume into serving (VolumeMount)."""
+        v = self.find_volume(vid)
+        if v is not None:
+            return v
+        for loc in self.locations:
+            base = Volume.path_for(loc.directory, collection, vid)
+            if os.path.exists(base + ".dat"):
+                v = Volume(loc.directory, collection, vid,
+                           create_if_missing=False)
+                with loc.lock:
+                    loc.volumes[vid] = v
+                return v
+        raise KeyError(f"volume {vid} not found on disk")
+
     def reload_volume(self, vid: int) -> Volume | None:
         """Re-open a volume whose backing changed (tier upload/download
         swaps the .dat between local disk and a remote backend)."""

@@ -62,8 +62,24 @@ def test_scaffold_verb_writes_file(tmp_path):
 def test_cpu_profile_trigger():
     from seaweedfs_tpu.utils import profiling
 
-    text = profiling.cpu_profile(seconds=0.1)
-    assert "cumulative" in text  # pstats table rendered
+    import threading
+    import time as _time
+
+    stop = threading.Event()
+
+    def busy():  # a worker thread the sampler must see
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    th = threading.Thread(target=busy, name="busy-worker")
+    th.start()
+    try:
+        text = profiling.cpu_profile(seconds=0.3)
+    finally:
+        stop.set()
+        th.join()
+    assert "hottest lines" in text
+    assert "busy" in text  # the OTHER thread's frames were sampled
 
 
 def test_master_debug_profile_endpoint(tmp_path):
@@ -98,6 +114,6 @@ def test_master_debug_profile_endpoint(tmp_path):
             f"http://127.0.0.1:{hport}/debug/profile?seconds=0.2",
             timeout=30)
         assert r.status_code == 200
-        assert "cumulative" in r.text
+        assert "hottest lines" in r.text
     finally:
         master.stop()

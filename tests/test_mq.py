@@ -206,3 +206,69 @@ class TestPubSub:
 
         with pytest.raises(grpc.RpcError):
             list(subscribe(broker_stack["broker"].address, "nope", "nope"))
+
+
+def test_standalone_broker_durable_local_dir(tmp_path):
+    """The standalone verb's LocalSegmentStore makes a filer-less broker
+    durable: messages survive a broker restart (r2 weak #5)."""
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.mq import BrokerServer
+    from seaweedfs_tpu.mq.client import Publisher, subscribe
+
+    ms = MasterServer(port=_fp(), pulse_seconds=0.3, maintenance_scripts=[])
+    ms.start()
+    try:
+        b = BrokerServer(ms.address, port=_fp(),
+                         data_dir=str(tmp_path / "mq")).start()
+        pub = Publisher(b.address, "dur", "p1")
+        for i in range(1200):  # > one sealed segment
+            pub.publish(b"k", f"m-{i}".encode())
+        pub.close()
+        b.stop()  # flushes the partial tail
+
+        b2 = BrokerServer(ms.address, port=_fp(),
+                          data_dir=str(tmp_path / "mq")).start()
+        try:
+            got = list(subscribe(b2.address, "dur", "p1", start_offset=0))
+            assert len(got) == 1200
+            assert got[0][2] == b"m-0"
+            assert got[-1][2] == b"m-1199"
+        finally:
+            b2.stop()
+    finally:
+        ms.stop()
+
+
+def test_mq_topic_shell_commands(tmp_path):
+    import io as iomod
+
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.mq import BrokerServer
+    from seaweedfs_tpu.mq.client import Publisher
+    from seaweedfs_tpu.shell import mq_commands  # noqa: F401
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    ms = MasterServer(port=_fp(), pulse_seconds=0.3, maintenance_scripts=[])
+    ms.start()
+    b = BrokerServer(ms.address, port=_fp()).start()
+    try:
+        pub = Publisher(b.address, "shellns", "t1")
+        pub.publish(b"k", b"v")
+        pub.close()
+        out = iomod.StringIO()
+        env = CommandEnv(ms.address, out=out)
+        try:
+            run_command(env, f"mq.topic.configure -broker {b.address} "
+                             "-topic shellns/t2 -partitions 2")
+            run_command(env, f"mq.topic.list -broker {b.address}")
+            text = out.getvalue()
+            assert "shellns/t1" in text and "shellns/t2" in text
+            out.truncate(0), out.seek(0)
+            run_command(env, f"mq.topic.desc -broker {b.address} "
+                             "-topic shellns/t2")
+            assert "partitions" in out.getvalue()
+        finally:
+            env.mc.stop()
+    finally:
+        b.stop()
+        ms.stop()

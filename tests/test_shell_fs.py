@@ -273,3 +273,63 @@ def test_volume_server_evacuate_unreplicated(tmp_path_factory):
         for vs in servers:
             vs.stop()
         ms.stop()
+
+
+def test_fs_mv_and_tree(env, stack):
+    stack["fs"].write_file("/mv/src.txt", b"move me")
+    text = _run(env, "fs.mv /mv/src.txt /mv/dst.txt")
+    assert "moved" in text
+    assert stack["fs"].filer.find_entry("/mv", "dst.txt") is not None
+    assert stack["fs"].filer.find_entry("/mv", "src.txt") is None
+    # mv into an existing directory keeps the name
+    _run(env, "fs.mkdir /mv/into")
+    _run(env, "fs.mv /mv/dst.txt /mv/into")
+    assert stack["fs"].filer.find_entry("/mv/into", "dst.txt") is not None
+    text = _run(env, "fs.tree /mv")
+    assert "into/" in text and "dst.txt" in text and "files" in text
+
+
+def test_fs_meta_save_load_cat(env, stack, tmp_path):
+    stack["fs"].write_file("/meta/a.txt", b"aaa")
+    snap = str(tmp_path / "meta.bin")
+    text = _run(env, f"fs.meta.save -o {snap} /meta")
+    assert "saved" in text
+    # wipe and restore
+    stack["fs"].filer.delete_entry("/meta", "a.txt")
+    assert stack["fs"].filer.find_entry("/meta", "a.txt") is None
+    text = _run(env, f"fs.meta.load -i {snap}")
+    assert "loaded" in text
+    assert stack["fs"].filer.find_entry("/meta", "a.txt") is not None
+    text = _run(env, "fs.meta.cat /meta/a.txt")
+    assert "a.txt" in text
+
+
+def test_fs_cd_pwd(env):
+    text = _run(env, "fs.pwd")
+    assert "/" in text
+    text = _run(env, "fs.cd /docs")
+    assert "/docs" in text
+    text = _run(env, "fs.pwd")
+    assert "/docs" in text
+
+
+def test_cluster_raft_ps(env, stack):
+    text = _run(env, "cluster.raft.ps")
+    assert "leader:" in text and "member:" in text
+
+
+def test_volume_mount_unmount_cycle(env, stack):
+    """unmount drops the volume from heartbeats; mount restores it."""
+    _run(env, "lock")
+    ms = stack["ms"]
+    # find a server that holds volume 1
+    srv = next(s for s in stack["servers"]
+               if s.store.find_volume(1) is not None)
+    node = f"127.0.0.1:{srv.port}"
+    text = _run(env, f"volume.unmount -volumeId 1 -node {node}")
+    assert "unmounted" in text
+    assert srv.store.find_volume(1) is None
+    text = _run(env, f"volume.mount -volumeId 1 -node {node}")
+    assert "mounted" in text
+    assert srv.store.find_volume(1) is not None
+    _run(env, "unlock")
