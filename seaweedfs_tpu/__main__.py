@@ -247,6 +247,211 @@ def run_backup(argv):
         mc.stop()
 
 
+def run_filer(argv):
+    """Standalone filer daemon (reference command/filer.go)."""
+    from .filer.filer_server import FilerServer
+    p = argparse.ArgumentParser(prog="filer")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-grpcPort", type=int, default=0)
+    p.add_argument("-store", default="",
+                   help="memory | sqlite:/path.db | logdb:/path.logdb "
+                        "(default: filer.toml or sqlite ./filer.db)")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-maxMB", type=int, default=4)
+    opt = p.parse_args(argv)
+    store = opt.store
+    if not store:
+        from .utils import config as cfg
+        store = cfg.get_dotted(cfg.load_config("filer"),
+                               "filer.options.store", "sqlite:./filer.db")
+    FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
+                grpc_port=opt.grpcPort or None,
+                meta_log_path="./filer-meta.log",
+                collection=opt.collection, replication=opt.replication,
+                chunk_size_mb=opt.maxMB).start()
+    _wait_forever()
+
+
+def run_s3_standalone(argv):
+    """Standalone S3 gateway over a remote filer (reference command/s3.go)."""
+    from .client.filer_client import FilerClient
+    from .s3.s3_server import S3Gateway
+    p = argparse.ArgumentParser(prog="s3")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-config", default="", help="identities json file")
+    opt = p.parse_args(argv)
+    import json as _json
+    iam_config = None
+    if opt.config:
+        with open(opt.config) as f:
+            iam_config = _json.load(f)
+    fc = FilerClient(opt.filer)
+    S3Gateway(fc, ip=opt.ip, port=opt.port, iam_config=iam_config).start()
+    _wait_forever()
+
+
+def run_webdav_standalone(argv):
+    """Standalone WebDAV gateway over a remote filer (command/webdav.go)."""
+    from .client.filer_client import FilerClient
+    from .webdav import WebDavServer
+    p = argparse.ArgumentParser(prog="webdav")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=7333)
+    opt = p.parse_args(argv)
+    WebDavServer(FilerClient(opt.filer), ip=opt.ip, port=opt.port).start()
+    _wait_forever()
+
+
+def run_filer_sync(argv):
+    """Continuous bidirectional filer synchronization
+    (reference command/filer_sync.go)."""
+    from .client.filer_client import FilerClient
+    from .replication.filer_sync import FilerSync
+    p = argparse.ArgumentParser(prog="filer.sync")
+    p.add_argument("-a", required=True, help="filer A host:port")
+    p.add_argument("-b", required=True, help="filer B host:port")
+    p.add_argument("-isActivePassive", action="store_true",
+                   help="only sync A -> B")
+    p.add_argument("-path", default="/", help="path prefix to sync")
+    opt = p.parse_args(argv)
+    fa, fb = FilerClient(opt.a), FilerClient(opt.b)
+    FilerSync(fa, fb, path_prefix=opt.path).start()
+    print(f"syncing {opt.a} -> {opt.b} under {opt.path}")
+    if not opt.isActivePassive:
+        FilerSync(fb, fa, path_prefix=opt.path).start()
+        print(f"syncing {opt.b} -> {opt.a} under {opt.path}")
+    _wait_forever()
+
+
+def run_filer_copy(argv):
+    """Copy local files/directories into the filer
+    (reference command/filer_copy.go)."""
+    import mimetypes
+    import os as _os
+
+    from .client.filer_client import FilerClient
+    p = argparse.ArgumentParser(prog="filer.copy")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("files", nargs="+",
+                   help="local files/dirs, last arg = filer dest dir")
+    opt = p.parse_args(argv)
+    *srcs, dest = opt.files
+    if not dest.startswith("/"):
+        print("destination must be an absolute filer path")
+        sys.exit(1)
+    fc = FilerClient(opt.filer)
+    n = 0
+    for src in srcs:
+        if _os.path.isdir(src):
+            base = _os.path.basename(src.rstrip("/"))
+            for root, _dirs, names in _os.walk(src):
+                rel = _os.path.relpath(root, src)
+                for name in names:
+                    local = _os.path.join(root, name)
+                    remote = "/".join(filter(
+                        lambda s: s not in ("", "."),
+                        [dest.rstrip("/"), base, rel, name]))
+                    with open(local, "rb") as f:
+                        fc.write_file("/" + remote.lstrip("/"), f.read(),
+                                      mime=mimetypes.guess_type(name)[0] or "")
+                    n += 1
+        else:
+            name = _os.path.basename(src)
+            with open(src, "rb") as f:
+                fc.write_file(f"{dest.rstrip('/')}/{name}", f.read(),
+                              mime=mimetypes.guess_type(name)[0] or "")
+            n += 1
+    print(f"copied {n} files to {opt.filer}{dest}")
+
+
+def run_filer_meta_tail(argv):
+    """Follow the filer metadata event stream
+    (reference command/filer_meta_tail.go)."""
+    import threading as _threading
+
+    from .client.filer_client import FilerClient
+    p = argparse.ArgumentParser(prog="filer.meta.tail")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-pathPrefix", default="/")
+    p.add_argument("-timeAgo", type=float, default=0,
+                   help="start N seconds in the past (0 = now)")
+    opt = p.parse_args(argv)
+    fc = FilerClient(opt.filer, client_name="meta-tail")
+    since = time.time_ns() - int(opt.timeAgo * 1e9)
+    stop = _threading.Event()
+    try:
+        for resp in fc.filer.subscribe(since, stop,
+                                       path_prefix=opt.pathPrefix):
+            ev = resp.event_notification
+            kind = ("delete" if not ev.new_entry.name
+                    else "create" if not ev.old_entry.name else "update")
+            name = ev.new_entry.name or ev.old_entry.name
+            print(f"{resp.ts_ns} {kind:7s} {resp.directory}/{name}")
+    except KeyboardInterrupt:
+        stop.set()
+
+
+def run_export(argv):
+    """Dump a volume's live needles to local files
+    (reference command/export.go)."""
+    import os as _os
+
+    from .storage.volume import Volume
+    p = argparse.ArgumentParser(prog="export")
+    p.add_argument("-dir", default=".", help="directory holding .dat/.idx")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-o", dest="output", default="export",
+                   help="output directory")
+    opt = p.parse_args(argv)
+    v = Volume(opt.dir, opt.collection, opt.volumeId,
+               create_if_missing=False)
+    _os.makedirs(opt.output, exist_ok=True)
+    keys, offs, sizes = v.nm.map.items_arrays()
+    n = 0
+    for i in range(keys.size):
+        needle = v.read_needle(int(keys[i]), cookie=None)
+        raw = (needle.name.decode(errors="replace")
+               if needle.name else f"{int(keys[i]):x}")
+        name = _os.path.basename(raw.replace("\\", "/"))  # no traversal
+        if not name or name in (".", ".."):
+            name = f"{int(keys[i]):x}"
+        with open(_os.path.join(opt.output, name), "wb") as f:
+            f.write(needle.data)
+        n += 1
+    v.close()
+    print(f"exported {n} needles from volume {opt.volumeId} to {opt.output}")
+
+
+def run_compact(argv):
+    """Offline-vacuum a volume in place (reference command/compact.go)."""
+    from .storage.vacuum import commit_compact, compact
+    from .storage.volume import Volume
+    p = argparse.ArgumentParser(prog="compact")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    opt = p.parse_args(argv)
+    v = Volume(opt.dir, opt.collection, opt.volumeId,
+               create_if_missing=False)
+    live, reclaimed = compact(v)
+    v = commit_compact(v)
+    v.close()
+    print(f"compacted volume {opt.volumeId}: {live} live needles, "
+          f"{reclaimed} bytes reclaimed")
+
+
+def run_version(argv):
+    from . import __version__ as ver
+    print(f"seaweedfs-tpu {ver}")
+
+
 def run_scaffold(argv):
     """Print default TOML config templates (reference command/scaffold.go +
     command/scaffold/*.toml); write with -output."""
@@ -389,6 +594,15 @@ VERBS = {
     "upload": run_upload,
     "backup": run_backup,
     "scaffold": run_scaffold,
+    "filer": run_filer,
+    "s3": run_s3_standalone,
+    "webdav": run_webdav_standalone,
+    "filer.sync": run_filer_sync,
+    "filer.copy": run_filer_copy,
+    "filer.meta.tail": run_filer_meta_tail,
+    "export": run_export,
+    "compact": run_compact,
+    "version": run_version,
     "download": run_download,
     "fix": run_fix,
     "benchmark": run_benchmark,

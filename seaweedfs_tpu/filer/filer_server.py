@@ -372,7 +372,8 @@ class FilerServer:
         def create(req, ctx):
             try:
                 f.create_entry(req.directory, req.entry, o_excl=req.o_excl,
-                               from_other_cluster=req.is_from_other_cluster)
+                               from_other_cluster=req.is_from_other_cluster,
+                               signatures=list(req.signatures))
                 return fpb.CreateEntryResponse()
             except (FileExistsError, OSError) as e:
                 return fpb.CreateEntryResponse(error=str(e))
@@ -428,7 +429,8 @@ class FilerServer:
                 return fpb.AssignVolumeResponse(
                     file_id=a.fid, location_url=a.location.url,
                     public_url=a.location.public_url, count=a.count,
-                    collection=collection, replication=replication)
+                    collection=collection, replication=replication,
+                    auth=a.auth)
             except Exception as e:  # noqa: BLE001
                 return fpb.AssignVolumeResponse(error=str(e))
 
@@ -445,6 +447,16 @@ class FilerServer:
                                        grpc_port=l["grpc_port"])
                 resp.locations_map[vid_str].CopyFrom(locs)
             return resp
+
+        @svc.unary("GetFilerConfiguration",
+                   fpb.GetFilerConfigurationRequest,
+                   fpb.GetFilerConfigurationResponse)
+        def get_configuration(req, ctx):
+            return fpb.GetFilerConfigurationResponse(
+                masters=self.mc.masters, collection=self.collection,
+                replication=self.replication,
+                max_mb=self.chunk_size >> 20,
+                signature=f.signature)
 
         @svc.unary("KvGet", fpb.KvGetRequest, fpb.KvGetResponse)
         def kv_get(req, ctx):

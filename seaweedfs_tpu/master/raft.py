@@ -142,32 +142,48 @@ class RaftNode:
                 # the inline log and orphan every entry
                 self._persist()
             else:
-                self.log = self._read_wal()
+                wal_start, self.log = self._read_wal()
+                if wal_start is not None:
+                    self.log_start = wal_start
             if self.snapshot_state:
                 self.apply_fn(dict(self.snapshot_state))
         except Exception as e:  # noqa: BLE001
             log.warning("raft state load: %s", e)
 
-    def _read_wal(self) -> "list[LogEntry]":
+    def _read_wal(self) -> "tuple[int | None, list[LogEntry]]":
+        """Returns (log_start from the WAL header, entries). The header is
+        written atomically WITH the entries, so on a crash between the WAL
+        and metadata rewrites the header is the authoritative log_start —
+        trusting the stale metadata would shift every entry's index."""
         wal = self.state_path + ".wal"
         out: list[LogEntry] = []
+        start = None
         if not os.path.exists(wal):
-            return out
+            return start, out
         with open(wal, "rb") as f:
-            for line in f:
+            for i, line in enumerate(f):
                 try:
                     e = json.loads(line)
+                    if i == 0 and "log_start" in e:
+                        start = e["log_start"]
+                        continue
                     out.append(LogEntry(e["t"], e["c"]))
                 except Exception:  # noqa: BLE001 — torn tail after a crash
                     break
-        return out
+        return start, out
 
     def _wal_handle(self):
         if self._wal is None:
             d = os.path.dirname(self.state_path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            self._wal = open(self.state_path + ".wal", "ab")
+            path = self.state_path + ".wal"
+            fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+            self._wal = open(path, "ab")
+            if fresh:
+                self._wal.write(
+                    json.dumps({"log_start": self.log_start}).encode()
+                    + b"\n")
         return self._wal
 
     def _persist_meta(self) -> None:
@@ -200,23 +216,29 @@ class RaftNode:
         os.fsync(f.fileno())
 
     def _persist(self) -> None:
-        """Full rewrite: metadata + WAL regenerated from self.log. Needed
-        after truncation/compaction/snapshot-install; appends use
-        _wal_append instead."""
+        """Full rewrite: WAL (with its log_start header) first, metadata
+        second — a crash in between leaves a consistent WAL whose header
+        overrides the stale metadata on reload. Needed after truncation/
+        compaction/snapshot-install; appends use _wal_append instead."""
         if not self.state_path:
             return
-        self._persist_meta()
+        d = os.path.dirname(self.state_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         if self._wal is not None:
             self._wal.close()
             self._wal = None
         tmp = self.state_path + ".wal.tmp"
         with open(tmp, "wb") as f:
+            f.write(json.dumps({"log_start": self.log_start}).encode()
+                    + b"\n")
             for e in self.log:
                 f.write(json.dumps({"t": e.term, "c": e.command}).encode()
                         + b"\n")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path + ".wal")
+        self._persist_meta()
 
     def _maybe_compact(self) -> None:
         """Fold committed prefix into the snapshot (caller holds lock).

@@ -249,6 +249,8 @@ class LocalSegmentStore:
         tmp = self._path(d, name) + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._path(d, name))
 
 

@@ -61,11 +61,13 @@ def _fs_parser(prog: str) -> argparse.ArgumentParser:
 
 
 def _abs(env: CommandEnv, path: str) -> str:
-    """Resolve a possibly-relative path against the shell cwd (fs.cd)."""
+    """Resolve a possibly-relative path against the shell cwd (fs.cd),
+    normalizing '.' and '..' segments."""
+    import posixpath
     if not path.startswith("/"):
         cwd = env.option.get("cwd", "/")
         path = cwd.rstrip("/") + "/" + path
-    return path
+    return posixpath.normpath(path)
 
 
 @command("fs.ls", "list a filer directory")
@@ -402,7 +404,7 @@ def cmd_fs_meta_load(env: CommandEnv, args):
     p.add_argument("-i", dest="input", default="filer-meta.bin")
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
-    n = 0
+    n = errors = 0
     with open(opt.input, "rb") as f:
         while True:
             hdr = f.read(4)
@@ -411,11 +413,18 @@ def cmd_fs_meta_load(env: CommandEnv, args):
             (ln,) = _struct.unpack("<I", hdr)
             fe = fpb.FullEntry()
             fe.ParseFromString(f.read(ln))
-            stub.call("CreateEntry",
-                      fpb.CreateEntryRequest(directory=fe.dir, entry=fe.entry),
-                      fpb.CreateEntryResponse)
-            n += 1
-    env.println(f"loaded {n} entries from {opt.input}")
+            resp = stub.call("CreateEntry",
+                             fpb.CreateEntryRequest(directory=fe.dir,
+                                                    entry=fe.entry),
+                             fpb.CreateEntryResponse)
+            if resp.error:
+                errors += 1
+                env.println(f"  error restoring {fe.dir}/{fe.entry.name}: "
+                            f"{resp.error}")
+            else:
+                n += 1
+    env.println(f"loaded {n} entries from {opt.input}"
+                + (f" ({errors} failed)" if errors else ""))
 
 
 @command("fs.meta.cat", "print one entry's metadata as text")

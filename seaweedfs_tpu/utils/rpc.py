@@ -128,6 +128,12 @@ class RpcService:
 def serve(bind: str, services: list[RpcService], max_workers: int = 16,
           auth_key: str = "") -> grpc.Server:
     from ..security.jwt import derive_cluster_key
+    port = int(bind.rsplit(":", 1)[1])
+    if not 0 < port < 65536:
+        # grpc silently wraps port numbers modulo 65536, so an overflowed
+        # "+10000 convention" port would bind somewhere surprising and
+        # clients would talk to the wrong server — fail loudly instead
+        raise ValueError(f"invalid port in bind address {bind!r}")
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         interceptors=([_AuthInterceptor(derive_cluster_key(auth_key))]
@@ -136,7 +142,9 @@ def serve(bind: str, services: list[RpcService], max_workers: int = 16,
                  ("grpc.max_send_message_length", 256 << 20)])
     for s in services:
         server.add_generic_rpc_handlers((s.generic_handler(),))
-    server.add_insecure_port(bind)
+    if server.add_insecure_port(bind) == 0:
+        # grpc signals bind failure by returning port 0, not raising
+        raise OSError(f"failed to bind gRPC server at {bind}")
     server.start()
     return server
 

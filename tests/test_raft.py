@@ -180,9 +180,10 @@ def test_wal_persistence_and_torn_tail(tmp_path):
         n._wal_append(n.log[-1:])
     n._persist_meta()
     n.stop()
-    # wal holds one line per entry; meta has no inline log
+    # wal = header (log_start) + one line per entry; meta has no inline log
     wal_lines = open(path + ".wal", "rb").read().splitlines()
-    assert len(wal_lines) == 5
+    assert len(wal_lines) == 6
+    assert json.loads(wal_lines[0]) == {"log_start": 0}
     assert "log" not in json.load(open(path))
 
     n2 = RaftNode("a:1", ["a:1"], applied.append, state_path=path)
@@ -196,6 +197,27 @@ def test_wal_persistence_and_torn_tail(tmp_path):
     n3 = RaftNode("a:1", ["a:1"], applied.append, state_path=path)
     assert len(n3.log) == 4
     n3.stop()
+
+    # crash between WAL rewrite and metadata rewrite: the WAL header's
+    # log_start overrides stale metadata so entry indices stay aligned
+    import copy
+    meta = json.load(open(path))
+    n5 = RaftNode("a:1", ["a:1"], applied.append, state_path=path)
+    n5.log_start = 3
+    n5.log = n5.log[3:]
+    tmp = path + ".wal.tmp"
+    with open(tmp, "wb") as f:  # simulate: WAL rewritten, meta NOT
+        f.write(json.dumps({"log_start": 3}).encode() + b"\n")
+        for e in n5.log:
+            f.write(json.dumps({"t": e.term, "c": e.command}).encode()
+                    + b"\n")
+    os.replace(tmp, path + ".wal")
+    n5.stop()
+    json.dump(meta, open(path, "w"))  # stale meta still says log_start=0
+    n6 = RaftNode("a:1", ["a:1"], applied.append, state_path=path)
+    assert n6.log_start == 3  # WAL header won
+    assert len(n6.log) == 1
+    n6.stop()
 
     # legacy format: inline log in the json, no wal
     legacy = str(tmp_path / "legacy.json")
