@@ -553,19 +553,29 @@ def _wait_forever():
 
 
 def run_mount(argv):
-    """Kernel FUSE mount (reference command/mount.go); needs fusepy.
-    The WeedFS logic itself is importable and testable without it."""
+    """Kernel FUSE mount (reference command/mount.go) via the built-in
+    ctypes libfuse binding — no fusepy needed."""
+    from .client.filer_client import FilerClient
+    from .mount.fuse_binding import fuse_loop
+    from .mount.weedfs import WeedFS
     p = argparse.ArgumentParser(prog="mount")
     p.add_argument("-filer", default="127.0.0.1:8888",
                    help="filer ip:port (its gRPC is port+10000)")
+    p.add_argument("-filerGrpc", default="",
+                   help="filer gRPC address override")
     p.add_argument("-dir", required=True, help="mountpoint")
     p.add_argument("-chunkSizeLimitMB", type=int, default=4)
     p.add_argument("-concurrentWriters", type=int, default=8)
+    p.add_argument("-allowOther", action="store_true")
     opt = p.parse_args(argv)
-    raise SystemExit(
-        "kernel mount requires the 'fuse' (fusepy) package, which is not "
-        "in this image; the mount subsystem (seaweedfs_tpu.mount.WeedFS) "
-        "is fully functional in-process — see tests/test_mount.py")
+    fc = FilerClient(opt.filer, grpc_address=opt.filerGrpc,
+                     client_name="mount")
+    wfs = WeedFS(fc, chunk_size_mb=opt.chunkSizeLimitMB,
+                 concurrency=opt.concurrentWriters)
+    print(f"mounting {opt.filer} at {opt.dir} (unmount: fusermount -u)")
+    code = fuse_loop(wfs, opt.dir, allow_other=opt.allowOther)
+    wfs.destroy()
+    sys.exit(code)
 
 
 def run_mq_broker(argv):

@@ -44,6 +44,12 @@ class FilerClient:
         self.collection = conf.collection
         self.replication = conf.replication
         self._vid_cache: dict[str, tuple[list[str], float]] = {}
+        # tiny blob LRU: kernel reads arrive in <=128 KiB slices, each
+        # resolving a multi-MB chunk — caching the last few chunks turns
+        # ~32 refetches per chunk into one (reference uses chunk_cache)
+        from collections import OrderedDict
+        self._blob_cache: "OrderedDict[str, bytes]" = OrderedDict()
+        self._blob_cache_max = 8
         self.filer = _FilerFacade(self, conf.signature)
 
     # -- data path -----------------------------------------------------------
@@ -71,12 +77,19 @@ class FilerClient:
     def _fetch_blob(self, fid: str) -> bytes:
         import requests
 
+        cached = self._blob_cache.get(fid)
+        if cached is not None:
+            self._blob_cache.move_to_end(fid)
+            return cached
         last = None
         for attempt in range(2):
             for url in self._lookup_fid(fid):
                 try:
                     r = requests.get(f"http://{url}/{fid}", timeout=30)
                     if r.status_code == 200:
+                        self._blob_cache[fid] = r.content
+                        if len(self._blob_cache) > self._blob_cache_max:
+                            self._blob_cache.popitem(last=False)
                         return r.content
                     last = f"HTTP {r.status_code}"
                 except Exception as e:  # noqa: BLE001
@@ -105,34 +118,43 @@ class FilerClient:
             buf[at:at + len(part)] = part
         return bytes(buf)
 
+    def _save_blob(self, data: bytes, ttl: str = "",
+                   path: str = "") -> fpb.FileChunk:
+        """Assign + upload ONE blob (the FUSE page-writer seam,
+        FilerServer._save_blob's remote twin)."""
+        from ..client import operation
+        from ..storage.types import TTL
+
+        ttl_sec = TTL.parse(ttl).seconds if ttl else 0
+        a = self.stub.call("AssignVolume",
+                           fpb.AssignVolumeRequest(count=1, path=path,
+                                                   ttl_sec=ttl_sec),
+                           fpb.AssignVolumeResponse)
+        if a.error:
+            raise IOError(f"assign: {a.error}")
+        target = a.public_url or a.location_url
+        res = operation.upload(f"{target}/{a.file_id}", data,
+                               gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
+        return fpb.FileChunk(file_id=a.file_id,
+                             size=res.get("size", len(data)),
+                             modified_ts_ns=time.time_ns(),
+                             e_tag=res.get("eTag", ""))
+
     def write_file(self, path: str, data: bytes, mime: str = "",
                    ttl_sec: int = 0, mode: int = 0o644,
                    signatures: "list[int] | None" = None) -> None:
         """Chunked upload straight into the blob cluster + CreateEntry,
         mirroring FilerServer.write_file."""
-        from ..client import operation
         from ..filer.filer import split_path
 
         directory, name = split_path(path)
         chunks = []
         for off in range(0, len(data), self.chunk_size):
             piece = data[off:off + self.chunk_size]
-            a = self.stub.call("AssignVolume",
-                               fpb.AssignVolumeRequest(count=1, path=path,
-                                                       ttl_sec=ttl_sec),
-                               fpb.AssignVolumeResponse)
-            if a.error:
-                raise IOError(f"assign: {a.error}")
-            target = a.public_url or a.location_url
-            res = operation.upload(f"{target}/{a.file_id}", piece,
-                                   gzip_if_worthwhile=False,
-                                   ttl=f"{ttl_sec}s" if ttl_sec else "",
-                                   jwt=a.auth)
-            chunks.append(fpb.FileChunk(
-                file_id=a.file_id, offset=off,
-                size=res.get("size", len(piece)),
-                modified_ts_ns=time.time_ns(),
-                e_tag=res.get("eTag", "")))
+            c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "",
+                                path=path)
+            c.offset = off
+            chunks.append(c)
         entry = fpb.Entry(name=name)
         entry.chunks.extend(chunks)
         at = entry.attributes
