@@ -620,6 +620,20 @@ VERBS = {
 }
 
 
+def _init_tls():
+    """Install cluster mTLS from security.toml [grpc] (reference tls.go)
+    for every verb — daemons serve TLS, tools dial TLS."""
+    try:
+        from .utils.rpc import load_tls_from_security_toml, set_tls_config
+        tls = load_tls_from_security_toml()
+    except Exception as e:  # noqa: BLE001 — FAIL CLOSED, never plaintext
+        print(f"fatal: mTLS configured but unusable: {e}", file=sys.stderr)
+        sys.exit(1)
+    if tls is not None:
+        set_tls_config(tls)
+        print("gRPC mTLS enabled (security.toml [grpc])", file=sys.stderr)
+
+
 def main():
     if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help", "help"):
         print("usage: python -m seaweedfs_tpu <verb> [flags]\n\nverbs:")
@@ -627,6 +641,7 @@ def main():
             print(f"  {v}")
         return 0
     verb = sys.argv[1]
+    _init_tls()
     fn = VERBS.get(verb)
     if fn is None:
         print(f"unknown verb {verb!r}", file=sys.stderr)

@@ -10,6 +10,7 @@ to remote RPCs / the device reconstruct path (reference store_ec.go:154-402).
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -64,13 +65,19 @@ class EcVolumeShard:
     def __post_init__(self):
         self._f = open(self.path, "rb")
         self.size = os.path.getsize(self.path)
+        self._mu = threading.Lock()  # read vs idle-close race
 
     def read_at(self, offset: int, length: int) -> bytes:
-        self._f.seek(offset)
-        return self._f.read(length)
+        with self._mu:
+            if self._f.closed:  # lazily reopen after an idle close
+                self._f = open(self.path, "rb")
+            self._f.seek(offset)
+            return self._f.read(length)
 
     def close(self):
-        self._f.close()
+        with self._mu:
+            if not self._f.closed:
+                self._f.close()
 
 
 class EcVolume:
@@ -109,6 +116,19 @@ class EcVolume:
 
     def shard_bits(self) -> ShardBits:
         return ShardBits().add(*self.shards.keys())
+
+    def close_idle(self, idle_s: float) -> bool:
+        """Fork behavior (ec_volume.go:303-319,348-353 IsExpire/idle close):
+        release file handles of EC volumes nobody read recently; reads
+        lazily reopen. Returns True if handles were closed."""
+        if time.time() - self.last_read_at < idle_s:
+            return False
+        closed = False
+        for shard in self.shards.values():
+            if not shard._f.closed:
+                shard.close()
+                closed = True
+        return closed
 
     # -- lookup ------------------------------------------------------------
     def find_needle(self, needle_id: int) -> tuple[int, int] | None:

@@ -52,6 +52,7 @@ class Filer:
         # filer server to hot-reload /etc/seaweedfs/filer.conf
         self.mutation_hooks: list = []
         self._dir_lock = threading.RLock()  # _ensure_parents recurses
+        self._hardlink_lock = threading.Lock()  # KV counter RMW atomicity
 
     # -- CRUD ---------------------------------------------------------------
     def create_entry(self, directory: str, entry: fpb.Entry,
@@ -67,7 +68,12 @@ class Filer:
             raise FileExistsError(join_path(directory, entry.name))
         self.store.insert_entry(directory, entry)
         if old is not None:
-            self._gc_replaced_chunks(old, entry)
+            if old.hard_link_id:
+                # overwriting ONE name of a hardlink set = unlink: the
+                # shared chunks belong to the remaining links
+                self._unlink_shared(old, is_delete_data=True)
+            else:
+                self._gc_replaced_chunks(old, entry)
         self._notify(directory, old, entry, delete_chunks=old is not None,
                      from_other_cluster=from_other_cluster,
                      signatures=signatures)
@@ -95,8 +101,27 @@ class Filer:
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
         entry.attributes.mtime = int(time.time())
-        self.store.update_entry(directory, entry)
-        self._gc_replaced_chunks(old, entry)
+        if old.hard_link_id:
+            # write-through: EVERY link sees the new content; the counter
+            # stays authoritative in the shared record
+            with self._hardlink_lock:
+                key = self._hardlink_key(old.hard_link_id)
+                raw = self.store.kv_get(key)
+                counter = 1
+                resolved_old = old
+                if raw:
+                    meta = fpb.Entry()
+                    meta.ParseFromString(raw)
+                    counter = meta.hard_link_counter
+                    resolved_old = meta
+                entry.hard_link_id = bytes(old.hard_link_id)
+                entry.hard_link_counter = counter
+                self.store.kv_put(key, entry.SerializeToString())
+                self.store.update_entry(directory, entry)
+            self._gc_replaced_chunks(resolved_old, entry)
+        else:
+            self.store.update_entry(directory, entry)
+            self._gc_replaced_chunks(old, entry)
         self._notify(directory, old, entry, delete_chunks=True,
                      from_other_cluster=from_other_cluster,
                      signatures=signatures)
@@ -131,7 +156,76 @@ class Filer:
             log.info("ttl-expired entry %s", join_path(directory, name))
             self.delete_entry(directory, name, is_delete_data=True)
             return None
-        return entry
+        return self._resolve_hardlink(entry)
+
+    # -- hardlinks (reference filerstore_hardlink.go) ----------------------
+    # Linked files share ONE metadata record in the store's KV space keyed
+    # by hard_link_id; each directory entry is a pointer carrying the id.
+    # The counter lives in the shared record; chunks are GC'd only when the
+    # last link goes.
+    _HARDLINK_PREFIX = b"hardlink/"
+
+    def _hardlink_key(self, hid: bytes) -> bytes:
+        return self._HARDLINK_PREFIX + bytes(hid)
+
+    def _resolve_hardlink(self, entry: fpb.Entry) -> fpb.Entry:
+        if not entry.hard_link_id:
+            return entry
+        raw = self.store.kv_get(self._hardlink_key(entry.hard_link_id))
+        if raw is None:
+            return entry
+        meta = fpb.Entry()
+        meta.ParseFromString(raw)
+        meta.name = entry.name
+        return meta
+
+    def _unlink_shared(self, entry: fpb.Entry, is_delete_data: bool) -> None:
+        """Drop one reference to a shared hardlink record; GC chunks only
+        when the LAST link goes (counter RMW under the hardlink lock)."""
+        with self._hardlink_lock:
+            key = self._hardlink_key(entry.hard_link_id)
+            raw = self.store.kv_get(key)
+            if not raw:
+                return
+            meta = fpb.Entry()
+            meta.ParseFromString(raw)
+            meta.hard_link_counter -= 1
+            last = meta.hard_link_counter <= 0
+            self.store.kv_put(key, b"" if last
+                              else meta.SerializeToString())
+        if last and is_delete_data:
+            self._delete_entry_chunks(meta)
+
+    def link(self, old_dir: str, old_name: str, new_dir: str,
+             new_name: str) -> fpb.Entry:
+        """Create a hardlink: both names share chunks + attributes."""
+        import os as _os
+        with self._hardlink_lock:
+            src = self.store.find_entry(old_dir, old_name)
+            if src is None:
+                raise FileNotFoundError(join_path(old_dir, old_name))
+            if src.is_directory:
+                raise IsADirectoryError(join_path(old_dir, old_name))
+            if not src.hard_link_id:
+                # first link: move the metadata into the shared record
+                src.hard_link_id = _os.urandom(16)
+                src.hard_link_counter = 1
+                self.store.kv_put(self._hardlink_key(src.hard_link_id),
+                                  src.SerializeToString())
+                self.store.update_entry(old_dir, src)
+            meta = fpb.Entry()
+            meta.ParseFromString(
+                self.store.kv_get(self._hardlink_key(src.hard_link_id)))
+            meta.hard_link_counter += 1
+            self.store.kv_put(self._hardlink_key(src.hard_link_id),
+                              meta.SerializeToString())
+            new_entry = fpb.Entry()
+            new_entry.CopyFrom(meta)
+            new_entry.name = new_name
+        self._ensure_parents(new_dir)
+        self.store.insert_entry(new_dir, new_entry)
+        self._notify(new_dir, None, new_entry)
+        return self._resolve_hardlink(new_entry)
 
     @staticmethod
     def _expired(entry: fpb.Entry) -> bool:
@@ -159,6 +253,8 @@ class Filer:
             if children and not is_recursive:
                 raise OSError(f"{path} is a non-empty folder")
             self._delete_subtree(path, is_delete_data)
+        elif entry.hard_link_id:
+            self._unlink_shared(entry, is_delete_data)
         elif is_delete_data:
             self._delete_entry_chunks(entry)
         self.store.delete_entry(directory, name)

@@ -135,6 +135,15 @@ class VolumeServer:
 
     def _heartbeat_messages(self):
         while not (self._stop.is_set() or self._leave.is_set()):
+            try:
+                # per-pulse housekeeping (fork store.go:389 reap +
+                # ec_volume.go idle-handle close)
+                reaped = self.store.delete_expired_ec_volumes()
+                if reaped:
+                    log.info("reaped expired ec volumes %s", reaped)
+                self.store.close_idle_ec_handles()
+            except Exception as e:  # noqa: BLE001
+                log.warning("ec housekeeping: %s", e)
             hb = self.store.collect_heartbeat()
             self._update_gauges(hb)
             msg = mpb.Heartbeat(

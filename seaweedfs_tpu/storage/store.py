@@ -320,6 +320,15 @@ class Store:
                 return v
         raise RuntimeError("location vanished")
 
+    def close_idle_ec_handles(self, idle_s: float = 3600.0) -> int:
+        """Idle-close EC shard handles (fork ec_volume.go:348 IsExpire)."""
+        n = 0
+        for loc in self.locations:
+            for ev in loc.ec_volumes.values():
+                if ev.close_idle(idle_s):
+                    n += 1
+        return n
+
     def delete_expired_ec_volumes(self) -> list[int]:
         """Fork behavior (store.go:389): reap EC volumes past DestroyTime."""
         now = time.time()

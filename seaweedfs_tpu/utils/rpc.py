@@ -27,6 +27,67 @@ import grpc
 _cluster_key: str = ""
 _cluster_key_lock = threading.Lock()
 
+# -- optional mTLS -----------------------------------------------------------
+# The reference's security.toml [grpc] section configures per-component
+# ca/cert/key (weed/security/tls.go:26 NewServerTLS, :92 NewClientTLS);
+# here one process-wide TlsConfig covers every serve() and Stub channel.
+# Both peers verify each other (require_client_auth) — configure it with
+# set_tls_config() before starting servers/clients. None = plaintext.
+
+
+class TlsConfig:
+    def __init__(self, ca_path: str, cert_path: str, key_path: str,
+                 server_name: str = "swtpu"):
+        self.server_name = server_name
+        with open(ca_path, "rb") as f:
+            self.ca = f.read()
+        with open(cert_path, "rb") as f:
+            self.cert = f.read()
+        with open(key_path, "rb") as f:
+            self.key = f.read()
+
+    def server_credentials(self):
+        return grpc.ssl_server_credentials(
+            [(self.key, self.cert)], root_certificates=self.ca,
+            require_client_auth=True)
+
+    def channel_credentials(self):
+        return grpc.ssl_channel_credentials(
+            root_certificates=self.ca, private_key=self.key,
+            certificate_chain=self.cert)
+
+
+_tls_config: "TlsConfig | None" = None
+
+
+def set_tls_config(tls: "TlsConfig | None") -> None:
+    """Install process-wide mTLS; drops cached plaintext channels so new
+    stubs dial securely."""
+    global _tls_config
+    with _channel_lock:
+        _tls_config = tls
+        for ch in _channel_cache.values():
+            ch.close()
+        _channel_cache.clear()
+
+
+def load_tls_from_security_toml() -> "TlsConfig | None":
+    """[grpc] ca / cert / key on the config tier chain (tls.go analogue).
+    A PARTIAL [grpc] section raises rather than silently running plaintext
+    (fail closed — the operator clearly intended TLS)."""
+    from . import config as cfg
+    sec = cfg.load_config("security")
+    ca = cfg.get_dotted(sec, "grpc.ca", "")
+    cert = cfg.get_dotted(sec, "grpc.cert", "")
+    key = cfg.get_dotted(sec, "grpc.key", "")
+    name = cfg.get_dotted(sec, "grpc.server_name", "swtpu")
+    if not (ca or cert or key):
+        return None
+    if not (ca and cert and key):
+        raise ValueError("security.toml [grpc] must set all of ca/cert/key "
+                         "(or none)")
+    return TlsConfig(ca, cert, key, server_name=name)
+
 
 def set_cluster_key(key: str) -> None:
     """Accepts the configured signing key; stores the DERIVED gRPC-plane
@@ -142,7 +203,12 @@ def serve(bind: str, services: list[RpcService], max_workers: int = 16,
                  ("grpc.max_send_message_length", 256 << 20)])
     for s in services:
         server.add_generic_rpc_handlers((s.generic_handler(),))
-    if server.add_insecure_port(bind) == 0:
+    if _tls_config is not None:
+        bound = server.add_secure_port(bind,
+                                       _tls_config.server_credentials())
+    else:
+        bound = server.add_insecure_port(bind)
+    if bound == 0:
         # grpc signals bind failure by returning port 0, not raising
         raise OSError(f"failed to bind gRPC server at {bind}")
     server.start()
@@ -157,10 +223,16 @@ def channel(address: str) -> grpc.Channel:
     with _channel_lock:
         ch = _channel_cache.get(address)
         if ch is None:
-            ch = grpc.insecure_channel(
-                address,
-                options=[("grpc.max_receive_message_length", 256 << 20),
-                         ("grpc.max_send_message_length", 256 << 20)])
+            opts = [("grpc.max_receive_message_length", 256 << 20),
+                    ("grpc.max_send_message_length", 256 << 20)]
+            if _tls_config is not None:
+                # cluster certs share one CN; targets are raw IPs
+                opts.append(("grpc.ssl_target_name_override",
+                             _tls_config.server_name))
+                ch = grpc.secure_channel(
+                    address, _tls_config.channel_credentials(), options=opts)
+            else:
+                ch = grpc.insecure_channel(address, options=opts)
             _channel_cache[address] = ch
         return ch
 

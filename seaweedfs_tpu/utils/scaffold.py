@@ -21,6 +21,18 @@ expires_after_seconds = 10
 [guard]
 # comma string or list of IPs/CIDRs allowed without a token
 white_list = ""
+
+[grpc]
+# mutual TLS for the whole gRPC plane (reference security.toml [grpc.*]
+# per-component certs; here one trio covers every daemon + client).
+# Generate with openssl: a CA plus a cert/key signed by it. The cert's CN
+# MUST equal server_name below (clients override the TLS target name to it
+# since cluster nodes are dialed by raw IP). Set all three or none —
+# a partial section refuses to start rather than run plaintext.
+ca = ""
+cert = ""
+key = ""
+server_name = "swtpu"
 """
 
 MASTER_TOML = """\

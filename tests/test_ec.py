@@ -304,3 +304,26 @@ def test_stream_encode_many_tiny_volumes_lazy_open(tmp_path):
                    (tmp_path / f"o{i}{files.shard_ext(s)}").read_bytes(), (i, s)
         # .vif written when the volume's last batch drained
         assert (tmp_path / f"t{i}.vif").exists()
+
+
+def test_idle_ecx_close_and_lazy_reopen(tmp_path):
+    """Fork ec_volume.go:348: idle EC volumes release file handles; the next
+    read transparently reopens them."""
+    import time as _time
+
+    coder = NumpyCoder(GEO.d, GEO.p)
+    v, payloads = make_volume(tmp_path, vid=3, count=10)
+    base = v.file_name()
+    encode_volume(base + ".dat", base, GEO, coder, idx_path=base + ".idx")
+    ev = EcVolume(base, 3, "", GEO)
+    nid, data = next(iter(payloads.items()))
+    assert ev.read_needle(nid, cookie=0xAB).data == data
+    assert not ev.close_idle(idle_s=3600)  # just read: not idle
+    ev.last_read_at = _time.time() - 7200
+    assert ev.close_idle(idle_s=3600)
+    assert all(s._f.closed for s in ev.shards.values())
+    # lazy reopen on next read
+    assert ev.read_needle(nid, cookie=0xAB).data == data
+    assert any(not s._f.closed for s in ev.shards.values())
+    ev.close()
+    v.close()

@@ -540,3 +540,30 @@ def test_fs_configure_shell_command(cluster, tmp_path):
         assert not any(r.location_prefix == "/cfg/" for r in fs.conf.rules)
     finally:
         fs.stop()
+
+
+def test_hardlinks(filer):
+    """Reference filerstore_hardlink.go: linked names share one metadata
+    record; chunks survive until the LAST link is unlinked."""
+    filer.create_entry("/hl", _file_entry("orig", ["7,aa", "7,bb"]))
+    linked = filer.link("/hl", "orig", "/hl", "alias")
+    assert [c.file_id for c in linked.chunks] == ["7,aa", "7,bb"]
+    # both names resolve to the shared chunks
+    for name in ("orig", "alias"):
+        e = filer.find_entry("/hl", name)
+        assert [c.file_id for c in e.chunks] == ["7,aa", "7,bb"], name
+    # updating THROUGH one name is visible through the other (shared record)
+    e = filer.find_entry("/hl", "orig")
+    assert e.hard_link_counter == 2
+    # link into another directory
+    filer.link("/hl", "orig", "/hl/sub", "deep")
+    assert filer.find_entry("/hl/sub", "deep") is not None
+    assert filer.find_entry("/hl", "orig").hard_link_counter == 3
+    # unlink two names: chunks NOT deleted yet
+    filer.delete_entry("/hl", "alias")
+    filer.delete_entry("/hl/sub", "deep")
+    assert filer._test_deleted == []
+    assert filer.find_entry("/hl", "orig").hard_link_counter == 1
+    # last unlink GCs the shared chunks
+    filer.delete_entry("/hl", "orig")
+    assert sorted(filer._test_deleted) == ["7,aa", "7,bb"]
