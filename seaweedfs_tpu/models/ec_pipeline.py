@@ -41,8 +41,14 @@ class ECPipeline:
 
     # -- single-chip forward (graft entry() target) -------------------------
     def forward(self, data: jax.Array) -> jax.Array:
-        """Jittable forward: stripe batch [B, d, L] -> parity [B, p, L]."""
-        from ..ops import rs_jax
+        """Jittable forward: stripe batch [B, d, L] -> parity [B, p, L].
+
+        Single-chip path rides the Pallas kernel on a real TPU (ops/
+        rs_pallas, ~3x the einsum formulation); the einsum path covers
+        CPU/virtual-mesh runs where Mosaic can't compile."""
+        from ..ops import rs_jax, rs_pallas
+        if rs_pallas.available() and data.ndim == 3:
+            return rs_pallas.encode_jit(data, self.d, self.p)
         return rs_jax.encode(data, self.d, self.p)
 
     # -- full distributed step (dryrun_multichip target) --------------------

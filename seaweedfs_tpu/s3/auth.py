@@ -300,3 +300,135 @@ def sign_streaming_request_v4(method: str, url: str, headers: dict[str, str],
         amz_date=now, scope=f"{date}/{region}/{service}/aws4_request",
         seed_signature=sig)
     return out, ctx
+
+
+# ---------------------------------------------------------------------------
+# Signature V2 (reference auth_signature_v2.go) — legacy SDK compatibility.
+# ---------------------------------------------------------------------------
+
+_SUBRESOURCES = ("acl", "delete", "lifecycle", "location", "logging",
+                 "notification", "partNumber", "policy", "requestPayment",
+                 "tagging", "torrent", "uploadId", "uploads", "versionId",
+                 "versioning", "versions", "website")
+
+
+def _canonical_resource_v2(path: str, query: dict) -> str:
+    sub = "&".join(f"{k}={query[k]}" if query[k] else k
+                   for k in sorted(query) if k in _SUBRESOURCES)
+    return path + (f"?{sub}" if sub else "")
+
+
+def _canonical_amz_headers_v2(headers: dict) -> str:
+    amz = sorted((k, v) for k, v in headers.items()
+                 if k.startswith("x-amz-"))
+    return "".join(f"{k}:{v}\n" for k, v in amz)
+
+
+def _string_to_sign_v2(method: str, path: str, query: dict,
+                       headers: dict, date_or_expires: str) -> str:
+    return "\n".join([
+        method,
+        headers.get("content-md5", ""),
+        headers.get("content-type", ""),
+        date_or_expires,
+    ]) + "\n" + _canonical_amz_headers_v2(headers) \
+        + _canonical_resource_v2(path, query)
+
+
+def sign_v2(secret: str, to_sign: str) -> str:
+    import base64
+    return base64.b64encode(
+        hmac.new(secret.encode(), to_sign.encode(),
+                 hashlib.sha1).digest()).decode()
+
+
+def verify_v2_header(iam: "IdentityAccessManagement", method: str, path: str,
+                     query: dict, headers: dict) -> "Identity":
+    """`Authorization: AWS AKID:sig` (doesSignatureMatchV2)."""
+    auth = headers.get("authorization", "")
+    cred = auth[len("AWS "):]
+    access_key, _, sig = cred.partition(":")
+    ident, secret = iam.lookup(access_key)
+    # with x-amz-date present the Date slot is empty (the amz date rides
+    # the canonical amz headers instead)
+    date = "" if headers.get("x-amz-date") else headers.get("date", "")
+    want = sign_v2(secret, _string_to_sign_v2(method, path, query, headers,
+                                              date))
+    if not hmac.compare_digest(want, sig):
+        raise ErrSignatureMismatch()
+    return ident
+
+
+def verify_v2_presigned(iam: "IdentityAccessManagement", method: str,
+                        path: str, query: dict, headers: dict) -> "Identity":
+    """?AWSAccessKeyId=&Expires=&Signature= (doesPresignedSignatureMatchV2)."""
+    import time as _time
+    ident, secret = iam.lookup(query.get("AWSAccessKeyId", ""))
+    expires = query.get("Expires", "0")
+    try:
+        if _time.time() > int(expires):
+            raise ErrRequestExpired()
+    except ValueError:
+        raise ErrSignatureMismatch() from None
+    q = {k: v for k, v in query.items()
+         if k not in ("AWSAccessKeyId", "Expires", "Signature")}
+    want = sign_v2(secret, _string_to_sign_v2(method, path, q, headers,
+                                              expires))
+    if not hmac.compare_digest(want, query.get("Signature", "")):
+        raise ErrSignatureMismatch()
+    return ident
+
+
+def verify_post_policy(iam: "IdentityAccessManagement",
+                       form: dict) -> "Identity":
+    """Browser form upload (reference policy_check + post-policy): the v4
+    signature covers the base64 policy document; expiration and bucket/key
+    conditions are enforced."""
+    import base64
+    import datetime
+    import json as _json
+
+    policy_b64 = form.get("policy", "")
+    cred = form.get("x-amz-credential", "").split("/")
+    if len(cred) != 5:
+        raise ErrSignatureMismatch()
+    access_key, date, region, service, _ = cred
+    ident, secret = iam.lookup(access_key)
+    key = IdentityAccessManagement._signing_key(secret, date, region, service)
+    want = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, form.get("x-amz-signature", "")):
+        raise ErrSignatureMismatch()
+    try:
+        policy = _json.loads(base64.b64decode(policy_b64))
+        exp = policy.get("expiration", "")
+        exp_ts = datetime.datetime.fromisoformat(
+            exp.replace("Z", "+00:00")).timestamp()
+    except Exception:  # noqa: BLE001
+        raise S3Error("InvalidPolicyDocument", "malformed policy", 400) \
+            from None
+    import time as _time
+    if _time.time() > exp_ts:
+        raise ErrRequestExpired()
+    # enforce the conditions we understand (bucket equality, key prefix)
+    for cond in policy.get("conditions", []):
+        if isinstance(cond, dict):
+            for k, v in cond.items():
+                if k in ("bucket", "key") and form.get(k) != v:
+                    raise S3Error("AccessDenied",
+                                  f"policy condition failed on {k}", 403)
+        elif isinstance(cond, list) and len(cond) == 3:
+            op, field, want = cond[0], str(cond[1]).lstrip("$"), cond[2]
+            have = str(form.get(field, ""))
+            if op == "starts-with":
+                ok = have.startswith(want)
+            elif op == "eq":
+                ok = have == str(want)
+            else:
+                # refuse rather than silently skip: an unknown operator is
+                # a restriction we cannot honor
+                raise S3Error("InvalidPolicyDocument",
+                              f"unsupported condition operator {op!r}", 400)
+            if not ok:
+                raise S3Error("AccessDenied",
+                              f"policy condition failed on {field}", 403)
+    return ident

@@ -27,6 +27,39 @@ from .auth import (ACTION_LIST, ACTION_READ, ACTION_TAGGING, ACTION_WRITE,
 log = logger("s3")
 
 BUCKETS_DIR = "/buckets"
+
+
+def _parse_multipart_form(body: bytes, content_type: str
+                          ) -> "tuple[dict, str, bytes]":
+    """(fields, file_name, file_bytes) from a multipart/form-data body."""
+    import email.parser
+    import email.policy
+
+    parser = email.parser.BytesParser(policy=email.policy.HTTP)
+    msg = parser.parsebytes(
+        b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body)
+    fields: dict = {}
+    file_name, file_bytes = "", b""
+    for part in msg.iter_parts():
+        name = part.get_param("name", header="content-disposition")
+        if not name:
+            continue
+        lower = name.lower()
+        if lower == "file":
+            file_name = part.get_filename() or ""
+            file_bytes = part.get_payload(decode=True) or b""
+            ctype = part.get_content_type()
+            if ctype and ctype != "text/plain":
+                fields.setdefault("Content-Type", ctype)
+        else:
+            payload = part.get_payload(decode=True) or b""
+            # AWS matches policy/x-amz-* form fields case-insensitively
+            key_name = (lower if lower.startswith("x-amz")
+                        or lower in ("policy", "key", "bucket",
+                                     "success_action_status",
+                                     "content-type") else name)
+            fields[key_name] = payload.decode("utf-8", errors="replace")
+    return fields, file_name, file_bytes
 UPLOADS_DIR = ".uploads"  # hidden per-bucket multipart staging dir
 TAG_PREFIX = "x-amz-tag-"
 HIGH = "\U0010FFFF"
@@ -149,8 +182,16 @@ class S3Gateway:
         action = self._classify_action(request.method, q, bucket, key)
         with self.breaker.acquire(action, bucket):
             body = await request.read()
-            seed_ctx = self._authorize(request, bucket, key, q, body, action)
-            body = self._maybe_decode_chunked(request, body, seed_ctx)
+            # browser post-policy uploads carry their signature IN the
+            # form; post_policy_upload authorizes from the policy fields
+            is_post_policy = (request.method == "POST" and bucket and not key
+                              and "delete" not in q
+                              and request.content_type.startswith(
+                                  "multipart/form-data"))
+            if not is_post_policy:
+                seed_ctx = self._authorize(request, bucket, key, q, body,
+                                           action)
+                body = self._maybe_decode_chunked(request, body, seed_ctx)
 
             if not bucket:
                 return self.list_buckets()
@@ -189,6 +230,33 @@ class S3Gateway:
                                            "UNSIGNED-PAYLOAD")
         headers = {k.lower(): v for k, v in request.headers.items()}
         seed_ctx = None
+        auth_hdr = headers.get("authorization", "")
+        if auth_hdr.startswith("AWS ") or (
+                "Signature" in q and "AWSAccessKeyId" in q):
+            # legacy signature V2 clients (reference auth_signature_v2.go)
+            from . import auth as auth_mod
+            path = urllib.parse.unquote(request.path)
+            if auth_hdr.startswith("AWS "):
+                md5_hdr = headers.get("content-md5", "")
+                if md5_hdr:
+                    import base64
+                    actual = base64.b64encode(
+                        hashlib.md5(body).digest()).decode()
+                    if actual != md5_hdr:
+                        raise S3Error("BadDigest",
+                                      "The Content-MD5 you specified did "
+                                      "not match what we received.", 400)
+                ident = auth_mod.verify_v2_header(
+                    self.iam, request.method, path, dict(request.query),
+                    headers)
+            else:
+                ident = auth_mod.verify_v2_presigned(
+                    self.iam, request.method, path, dict(request.query),
+                    headers)
+            from .auth import ErrAccessDenied
+            if not ident.allows(action, bucket):
+                raise ErrAccessDenied()
+            return None
         if payload_hash == STREAMING_PAYLOAD:
             ident, seed_ctx = self.iam.authenticate_streaming(
                 request.method, urllib.parse.unquote(request.path),
@@ -220,6 +288,9 @@ class S3Gateway:
             return self.delete_bucket(bucket)
         if m == "POST" and "delete" in q:
             return self.delete_multiple_objects(bucket, body)
+        if m == "POST" and request.content_type.startswith(
+                "multipart/form-data"):
+            return self.post_policy_upload(request, bucket, body)
         if m == "GET":
             if "uploads" in q:
                 return self.list_multipart_uploads(bucket, q)
@@ -307,10 +378,52 @@ class S3Gateway:
     def _object_path(self, bucket: str, key: str) -> str:
         return f"{self._bucket_dir(bucket)}/{key}"
 
+    def _check_quota(self, bucket: str) -> None:
+        """s3.bucket.quota.check marks over-quota buckets read-only in the
+        bucket entry's extended attrs (reference s3_bucket_quota)."""
+        e = self.fs.filer.find_entry(BUCKETS_DIR, bucket)
+        if e is not None and e.extended.get("quota_readonly") == b"1":
+            raise S3Error("QuotaExceeded",
+                          "bucket is over its configured quota", 403)
+
+    def post_policy_upload(self, request, bucket, body):
+        """Browser form upload (reference post-policy handling in
+        s3api_object_handlers_postpolicy.go)."""
+        from aiohttp import web
+
+        from . import auth as auth_mod
+
+        # full header WITH the boundary param (aiohttp's .content_type
+        # strips parameters)
+        fields, file_name, file_bytes = _parse_multipart_form(
+            body, request.headers.get("Content-Type", ""))
+        fields["bucket"] = bucket  # policy {"bucket": ...} condition input
+        if self.iam.enabled:
+            ident = auth_mod.verify_post_policy(self.iam, fields)
+            from .auth import ErrAccessDenied
+            if not ident.allows(ACTION_WRITE, bucket):
+                raise ErrAccessDenied()
+        key = fields.get("key", "")
+        if not key:
+            raise S3Error("InvalidArgument", "missing key field", 400)
+        key = key.replace("${filename}", file_name or "file")
+        self._require_bucket(bucket)
+        self._check_quota(bucket)
+        self.fs.write_file(self._object_path(bucket, key), file_bytes,
+                           mime=fields.get("Content-Type", ""))
+        try:
+            status = int(fields.get("success_action_status", "204"))
+        except ValueError:
+            status = 204  # AWS ignores junk values the same way
+        if status not in (200, 201, 204):
+            status = 204
+        return web.Response(status=status)
+
     def put_object(self, bucket, key, body, mime):
         from aiohttp import web
 
         self._require_bucket(bucket)
+        self._check_quota(bucket)
         if key.endswith("/"):  # directory object
             d, n = split_path(self._object_path(bucket, key))
             e = fpb.Entry(name=n, is_directory=True)
@@ -324,6 +437,7 @@ class S3Gateway:
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
 
     def copy_object(self, bucket, key, src):
+        self._check_quota(bucket)
         self._require_bucket(bucket)
         src = urllib.parse.unquote(src)
         src = src[src.startswith("/") and 1 or 0:]
@@ -543,6 +657,7 @@ class S3Gateway:
         return e
 
     def upload_part(self, bucket, key, q, body):
+        self._check_quota(bucket)
         from aiohttp import web
 
         self._require_bucket(bucket)
@@ -555,6 +670,7 @@ class S3Gateway:
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
 
     def complete_multipart(self, bucket, key, upload_id, body):
+        self._check_quota(bucket)
         self._require_bucket(bucket)
         self._find_upload(bucket, upload_id)
         updir = self._upload_dir(bucket, upload_id)

@@ -465,3 +465,97 @@ def cmd_fs_cd(env: CommandEnv, args):
 @command("fs.pwd", "print the shell's working filer directory")
 def cmd_fs_pwd(env: CommandEnv, args):
     env.println(env.option.get("cwd", "/"))
+
+
+@command("s3.bucket.quota", "-bucket B [-sizeMB N | -remove]: set or clear "
+         "a bucket size quota")
+def cmd_s3_bucket_quota(env: CommandEnv, args):
+    """Reference command_s3_bucket_quota.go: quota rides the bucket entry's
+    extended attributes."""
+    p = _fs_parser("s3.bucket.quota")
+    p.add_argument("-bucket", required=True)
+    p.add_argument("-sizeMB", type=int, default=0)
+    p.add_argument("-remove", action="store_true")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    resp = stub.call("LookupDirectoryEntry",
+                     fpb.LookupDirectoryEntryRequest(directory=BUCKETS_DIR,
+                                                     name=opt.bucket),
+                     fpb.LookupDirectoryEntryResponse)
+    entry = fpb.Entry()
+    entry.CopyFrom(resp.entry)
+    if opt.remove:
+        entry.extended.pop("quota_mb", None)
+        entry.extended.pop("quota_readonly", None)
+    else:
+        entry.extended["quota_mb"] = str(opt.sizeMB).encode()
+    stub.call("CreateEntry",
+              fpb.CreateEntryRequest(directory=BUCKETS_DIR, entry=entry),
+              fpb.CreateEntryResponse)
+    env.println(f"bucket {opt.bucket} quota "
+                + ("removed" if opt.remove else f"{opt.sizeMB} MB"))
+
+
+@command("s3.bucket.quota.check", "enforce bucket quotas: over-quota buckets "
+         "become read-only")
+def cmd_s3_bucket_quota_check(env: CommandEnv, args):
+    """Reference command_s3_bucket_quota_check.go."""
+    opt = _fs_parser("s3.bucket.quota.check").parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    for e in _list_entries(stub, BUCKETS_DIR):
+        if not e.is_directory:
+            continue
+        quota_mb = int(e.extended.get("quota_mb", b"0") or b"0")
+        if not quota_mb:
+            continue
+        used = sum(x.attributes.file_size
+                   for _p, x in _walk(stub, f"{BUCKETS_DIR}/{e.name}")
+                   if not x.is_directory)
+        over = used > quota_mb << 20
+        was = e.extended.get("quota_readonly") == b"1"
+        if over != was:
+            upd = fpb.Entry()
+            upd.CopyFrom(e)
+            if over:
+                upd.extended["quota_readonly"] = b"1"
+            else:
+                upd.extended.pop("quota_readonly", None)
+            stub.call("CreateEntry",
+                      fpb.CreateEntryRequest(directory=BUCKETS_DIR,
+                                             entry=upd),
+                      fpb.CreateEntryResponse)
+        env.println(f"  {e.name}: {used >> 20} / {quota_mb} MB"
+                    + (" READONLY" if over else ""))
+    env.println("quota check done")
+
+
+@command("s3.clean.uploads", "[-timeAgo 24h]: purge stale multipart upload "
+         "staging")
+def cmd_s3_clean_uploads(env: CommandEnv, args):
+    """Reference command_s3_clean_uploads.go: multipart staging lives under
+    /buckets/<b>/.uploads/<id>; abandoned ids older than -timeAgo go."""
+    import time as _time
+
+    from ..storage.types import TTL
+
+    p = _fs_parser("s3.clean.uploads")
+    p.add_argument("-timeAgo", default="24h")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    cutoff = _time.time() - TTL.parse(opt.timeAgo).seconds
+    removed = 0
+    for b in _list_entries(stub, BUCKETS_DIR):
+        if not b.is_directory:
+            continue
+        updir = f"{BUCKETS_DIR}/{b.name}/.uploads"
+        for u in _list_entries(stub, updir):
+            if (u.attributes.mtime or u.attributes.crtime) < cutoff:
+                stub.call("DeleteEntry",
+                          fpb.DeleteEntryRequest(directory=updir,
+                                                 name=u.name,
+                                                 is_delete_data=True,
+                                                 is_recursive=True),
+                          fpb.DeleteEntryResponse)
+                removed += 1
+                env.println(f"  removed {updir}/{u.name}")
+    env.println(f"cleaned {removed} stale uploads")

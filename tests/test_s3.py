@@ -527,3 +527,132 @@ def test_circuit_breaker_gateway_503(filer_server):
         assert requests.get(base, timeout=10).status_code == 200  # reads fine
     finally:
         gw.stop()
+
+
+# -- sigv2 / post-policy / quota (round-3 breadth) ---------------------------
+
+def test_sigv2_header_auth(s3_auth):
+    """Legacy `Authorization: AWS AKID:sig` clients work and tampering is
+    rejected (reference auth_signature_v2.go)."""
+    import email.utils
+
+    from seaweedfs_tpu.s3 import auth as auth_mod
+
+    gw, base = s3_auth
+    _signed("PUT", f"{base}/v2bkt")
+    date = email.utils.formatdate(usegmt=True)
+    body = b"v2 payload"
+    path = "/v2bkt/legacy.txt"
+    headers = {"date": date, "content-type": "text/plain"}
+    sts = auth_mod._string_to_sign_v2("PUT", path, {}, headers, date)
+    sig = auth_mod.sign_v2("sEcReT", sts)
+    r = requests.put(f"{base}{path}", data=body,
+                     headers={"Date": date, "Content-Type": "text/plain",
+                              "Authorization": f"AWS AKIDEXAMPLE:{sig}"},
+                     timeout=10)
+    assert r.status_code == 200, r.text
+    assert _signed("GET", f"{base}{path}").content == body
+    # wrong secret -> 403
+    bad = auth_mod.sign_v2("wrong", sts)
+    r = requests.put(f"{base}{path}", data=body,
+                     headers={"Date": date, "Content-Type": "text/plain",
+                              "Authorization": f"AWS AKIDEXAMPLE:{bad}"},
+                     timeout=10)
+    assert r.status_code == 403
+
+
+def test_sigv2_presigned(s3_auth):
+    from seaweedfs_tpu.s3 import auth as auth_mod
+
+    gw, base = s3_auth
+    _signed("PUT", f"{base}/v2pre")
+    _signed("PUT", f"{base}/v2pre/obj.txt", b"presigned-v2")
+    expires = str(int(time.time()) + 60)
+    path = "/v2pre/obj.txt"
+    sts = auth_mod._string_to_sign_v2("GET", path, {}, {}, expires)
+    sig = auth_mod.sign_v2("sEcReT", sts)
+    r = requests.get(f"{base}{path}", params={
+        "AWSAccessKeyId": "AKIDEXAMPLE", "Expires": expires,
+        "Signature": sig}, timeout=10)
+    assert r.status_code == 200
+    assert r.content == b"presigned-v2"
+    # expired -> rejected
+    old = str(int(time.time()) - 10)
+    sig = auth_mod.sign_v2("sEcReT",
+                           auth_mod._string_to_sign_v2("GET", path, {}, {},
+                                                       old))
+    r = requests.get(f"{base}{path}", params={
+        "AWSAccessKeyId": "AKIDEXAMPLE", "Expires": old, "Signature": sig},
+        timeout=10)
+    assert r.status_code == 403
+
+
+def test_post_policy_upload(s3_auth):
+    """Browser form upload: signed policy accepted, conditions enforced."""
+    import base64
+    import datetime
+    import hashlib as _hashlib
+    import hmac as _hmac
+    import json
+
+    from seaweedfs_tpu.s3.auth import IdentityAccessManagement
+
+    gw, base = s3_auth
+    _signed("PUT", f"{base}/formbkt")
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    policy = {
+        "expiration": (now + datetime.timedelta(minutes=5)
+                       ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "conditions": [{"bucket": "formbkt"},
+                       ["starts-with", "$key", "user/"]],
+    }
+    policy_b64 = base64.b64encode(json.dumps(policy).encode()).decode()
+    key = IdentityAccessManagement._signing_key("sEcReT", date, "us-east-1",
+                                                "s3")
+    sig = _hmac.new(key, policy_b64.encode(), _hashlib.sha256).hexdigest()
+    fields = {
+        "key": "user/form-upload.txt",
+        "policy": policy_b64,
+        "x-amz-credential": f"AKIDEXAMPLE/{date}/us-east-1/s3/aws4_request",
+        "x-amz-signature": sig,
+        "x-amz-date": amz_date,
+    }
+    r = requests.post(f"{base}/formbkt", data=fields,
+                      files={"file": ("hello.txt", b"form bytes",
+                                      "text/plain")}, timeout=10)
+    assert r.status_code == 204, r.text
+    got = _signed("GET", f"{base}/formbkt/user/form-upload.txt")
+    assert got.content == b"form bytes"
+    # key outside the policy prefix -> denied
+    fields["key"] = "outside/evil.txt"
+    r = requests.post(f"{base}/formbkt", data=fields,
+                      files={"file": ("x", b"no", "text/plain")}, timeout=10)
+    assert r.status_code == 403
+    # tampered signature -> denied
+    fields["key"] = "user/ok.txt"
+    fields["x-amz-signature"] = "0" * 64
+    r = requests.post(f"{base}/formbkt", data=fields,
+                      files={"file": ("x", b"no", "text/plain")}, timeout=10)
+    assert r.status_code == 403
+
+
+def test_bucket_quota_enforcement(s3, filer_server):
+    """quota_readonly on the bucket entry turns writes into 403
+    QuotaExceeded (reference s3_bucket_quota_check)."""
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+
+    gw, base = s3
+    requests.put(f"{base}/quotabkt", timeout=10)
+    requests.put(f"{base}/quotabkt/a.txt", data=b"x" * 1000, timeout=10)
+    e = filer_server.filer.find_entry("/buckets", "quotabkt")
+    upd = fpb.Entry()
+    upd.CopyFrom(e)
+    upd.extended["quota_readonly"] = b"1"
+    filer_server.filer.create_entry("/buckets", upd)
+    r = requests.put(f"{base}/quotabkt/b.txt", data=b"y", timeout=10)
+    assert r.status_code == 403
+    assert "QuotaExceeded" in r.text
+    # reads still fine
+    assert requests.get(f"{base}/quotabkt/a.txt", timeout=10).status_code == 200

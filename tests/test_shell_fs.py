@@ -333,3 +333,35 @@ def test_volume_mount_unmount_cycle(env, stack):
     assert "mounted" in text
     assert srv.store.find_volume(1) is not None
     _run(env, "unlock")
+
+
+def test_s3_bucket_quota_and_clean_uploads(env, stack):
+    """s3.bucket.quota / quota.check flip over-quota buckets read-only;
+    s3.clean.uploads purges stale multipart staging."""
+    fs = stack["fs"]
+    fs.write_file("/buckets/qb/data.bin", b"z" * (2 << 20))  # 2 MB
+    text = _run(env, "s3.bucket.quota -bucket qb -sizeMB 1")
+    assert "1 MB" in text
+    text = _run(env, "s3.bucket.quota.check")
+    assert "READONLY" in text
+    e = fs.filer.find_entry("/buckets", "qb")
+    assert e.extended.get("quota_readonly") == b"1"
+    # raise the quota: check clears the flag
+    _run(env, "s3.bucket.quota -bucket qb -sizeMB 100")
+    text = _run(env, "s3.bucket.quota.check")
+    assert "READONLY" not in text.split("qb:")[-1].splitlines()[0]
+    assert fs.filer.find_entry("/buckets",
+                               "qb").extended.get("quota_readonly") != b"1"
+
+    # stale multipart staging
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    fs.write_file("/buckets/qb/.uploads/oldid/part1", b"p")
+    old = fs.filer.find_entry("/buckets/qb/.uploads", "oldid")
+    upd = fpb.Entry()
+    upd.CopyFrom(old)
+    upd.attributes.mtime = 1  # epoch: ancient
+    # store-level update: Filer.update_entry would re-stamp mtime=now
+    fs.filer.store.update_entry("/buckets/qb/.uploads", upd)
+    text = _run(env, "s3.clean.uploads -timeAgo 1h")
+    assert "cleaned 1 stale uploads" in text
+    assert fs.filer.find_entry("/buckets/qb/.uploads", "oldid") is None
