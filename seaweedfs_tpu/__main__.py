@@ -82,6 +82,9 @@ def _add_volume_flags(p):
     p.add_argument("-disk", default="hdd")
     p.add_argument("-coder", default="auto",
                    help="erasure coder: auto|jax|native|numpy")
+    p.add_argument("-index", default="memory",
+                   help="needle map kind: memory|leveldb|sorted_file "
+                        "(reference -index flag)")
     _add_security_flags(p)
 
 
@@ -126,7 +129,8 @@ def run_volume(argv):
     _add_volume_flags(p)
     opt = p.parse_args(argv)
     store = Store(opt.ip, opt.port, f"{opt.ip}:{opt.port}",
-                  [DiskLocation(opt.dir, opt.disk, opt.max)],
+                  [DiskLocation(opt.dir, opt.disk, opt.max,
+                                needle_map_kind=opt.index)],
                   coder_name=opt.coder)
     vs = VolumeServer(store, opt.mserver, ip=opt.ip, port=opt.port,
                       grpc_port=opt.grpcPort or None,

@@ -26,8 +26,10 @@ _VIF_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.vif$")
 
 class DiskLocation:
     def __init__(self, directory: str, disk_type: str = "hdd",
-                 max_volume_count: int = 8, min_free_space_bytes: int = 0):
+                 max_volume_count: int = 8, min_free_space_bytes: int = 0,
+                 needle_map_kind: str = "memory"):
         self.directory = os.path.abspath(directory)
+        self.needle_map_kind = needle_map_kind
         self.disk_type = DiskType.parse(disk_type).value
         self.max_volume_count = max_volume_count
         self.min_free_space_bytes = min_free_space_bytes
@@ -46,7 +48,9 @@ class DiskLocation:
                     if vid not in self.volumes:
                         try:
                             self.volumes[vid] = Volume(
-                                self.directory, col, vid, create_if_missing=False)
+                                self.directory, col, vid,
+                                needle_map_kind=self.needle_map_kind,
+                                create_if_missing=False)
                         except Exception as e:  # noqa: BLE001
                             log.error("load volume %s: %s", name, e)
                     continue
@@ -63,6 +67,7 @@ class DiskLocation:
                             try:
                                 self.volumes[vid] = Volume(
                                     self.directory, col, vid,
+                                    needle_map_kind=self.needle_map_kind,
                                     create_if_missing=False)
                             except Exception as e:  # noqa: BLE001
                                 log.error("load tiered volume %s: %s",

@@ -128,6 +128,7 @@ class Store:
             raise FileExistsError(f"volume {vid} exists")
         loc = self._location_for(disk_type)
         v = Volume(loc.directory, collection, vid,
+                   needle_map_kind=loc.needle_map_kind,
                    replica_placement=t.ReplicaPlacement.parse(replication),
                    ttl=t.TTL.parse(ttl))
         with loc.lock:

@@ -59,6 +59,7 @@ def rebuild_idx_from_dat(dat_path: str, idx_path: str) -> int:
 
 class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
+                 needle_map_kind: str = "memory",
                  replica_placement: t.ReplicaPlacement | None = None,
                  ttl: t.TTL | None = None,
                  create_if_missing: bool = True):
@@ -67,6 +68,7 @@ class Volume:
         self.id = vid
         self.read_only = False
         self.last_append_at_ns = 0
+        self._nm_kind = needle_map_kind
         self._lock = threading.RLock()
 
         base = self.file_name()
@@ -92,7 +94,7 @@ class Volume:
                 f.write(self.super_block.to_bytes())
         self._dat = open(self.dat_path, "r+b")
         self.super_block = SuperBlock.from_bytes(self._dat.read(SUPER_BLOCK_SIZE))
-        self.nm = NeedleMap(self.idx_path)
+        self.nm = NeedleMap(self.idx_path, needle_map_kind)
         self._check_integrity()
         # a volume tiered with keep_local serves reads from the local
         # .dat but must stay read-only — writes would silently diverge
@@ -116,7 +118,7 @@ class Volume:
         self._dat.seek(0)
         self.super_block = SuperBlock.from_bytes(
             self._dat.read(SUPER_BLOCK_SIZE))
-        self.nm = NeedleMap(self.idx_path)
+        self.nm = NeedleMap(self.idx_path, self._nm_kind)
         self.read_only = True
         self._append_offset = self._dat.size
 

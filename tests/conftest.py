@@ -20,3 +20,26 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def free_port_pair() -> int:
+    """A free port whose +10000 sibling is also free and VALID (<65536) —
+    the fs-command/FilerClient gRPC convention. serve() now rejects
+    out-of-range ports loudly, so tests must allocate safe pairs."""
+    import socket
+
+    for _ in range(100):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        if port + 10000 >= 65536:
+            continue
+        try:
+            probe = socket.socket()
+            probe.bind(("127.0.0.1", port + 10000))
+            probe.close()
+            return port
+        except OSError:
+            continue
+    raise RuntimeError("no free port pair found")

@@ -512,7 +512,8 @@ def test_fs_configure_shell_command(cluster, tmp_path):
 
     master, servers, mc = cluster
     # fs.* shell commands use the grpc = http+10000 convention
-    port = free_port()
+    from conftest import free_port_pair
+    port = free_port_pair()
     fs = FilerServer(f"127.0.0.1:{master.port}", store_spec="memory",
                      port=port, grpc_port=port + 10000,
                      meta_log_path=str(tmp_path / "meta.log"))

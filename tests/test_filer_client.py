@@ -14,22 +14,7 @@ from test_cluster import cluster, free_port  # noqa: F401  (reuse fixture)
 from test_filer import filer_server  # noqa: F401
 
 
-def free_port_pair() -> int:
-    """A free port whose +10000 sibling is also free and VALID (<65536) —
-    the fs-command/FilerClient grpc convention."""
-    import socket
-    for _ in range(100):
-        port = free_port()
-        if port + 10000 >= 65536:
-            continue
-        try:
-            probe = socket.socket()
-            probe.bind(("127.0.0.1", port + 10000))
-            probe.close()
-            return port
-        except OSError:
-            continue
-    raise RuntimeError("no free port pair found")
+from conftest import free_port_pair  # noqa: E402
 
 
 @pytest.fixture()

@@ -26,18 +26,7 @@ def free_port():
     return p
 
 
-def free_port_pair():
-    while True:
-        p = free_port()
-        if p + 10000 >= 65536:
-            continue
-        try:
-            s = socket.socket()
-            s.bind(("127.0.0.1", p + 10000))
-            s.close()
-            return p
-        except OSError:
-            continue
+from conftest import free_port_pair  # noqa: E402
 
 
 @pytest.fixture()

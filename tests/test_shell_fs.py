@@ -33,7 +33,8 @@ def stack(tmp_path_factory):
     from seaweedfs_tpu.storage.disk_location import DiskLocation
     from seaweedfs_tpu.storage.store import Store
 
-    mport, fport = _fp(), _fp()
+    from conftest import free_port_pair
+    mport, fport = _fp(), free_port_pair()
     # "001" = one extra replica in the SAME rack, so both servers share r0
     # and fsck/check.disk/fs.verify run against a replicated cluster
     ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5,
