@@ -104,9 +104,57 @@ def open_queue(spec: str) -> MessageQueue:
         return MemoryQueue()
     if kind == "logfile":
         return LogFileQueue(arg or "notification.log")
+    if kind == "mq":
+        addr, _, rest = arg.partition("/")
+        ns, _, topic = rest.partition("/")
+        return MqQueue(addr, namespace=ns or "notifications",
+                       topic=topic or "filer")
     if kind in ("kafka", "aws_sqs", "gcp_pub_sub", "gocdk_pub_sub"):
         raise RuntimeError(
             f"notification backend {kind!r} requires its broker SDK, "
             "which is not in this image (reference gates these behind "
             "notification.toml the same way)")
     raise ValueError(f"unknown notification queue {spec!r}")
+
+
+class MqQueue(MessageQueue):
+    """Publish metadata events into the framework's OWN message queue
+    (the reference fans out to Kafka/SQS/PubSub via notification.toml;
+    here the built-in broker plays that role — spec 'mq:host:port' or
+    'mq:host:port/namespace/topic'). Lazy-connects and drops events with a
+    warning while the broker is down, like the reference's best-effort
+    notifiers."""
+
+    name = "mq"
+
+    def __init__(self, broker_address: str, namespace: str = "notifications",
+                 topic: str = "filer"):
+        self.broker_address = broker_address
+        self.namespace, self.topic = namespace, topic
+        self._pub = None
+        self._lock = threading.Lock()
+
+    def _publisher(self):
+        if self._pub is None:
+            from ..mq.client import Publisher
+            self._pub = Publisher(self.broker_address, self.namespace,
+                                  self.topic)
+        return self._pub
+
+    def send(self, key: str, ev: fpb.EventNotification) -> None:
+        with self._lock:
+            try:
+                self._publisher().publish(key.encode(),
+                                          ev.SerializeToString())
+            except Exception as e:  # noqa: BLE001 — best-effort notifier
+                self._pub = None
+                log.warning("mq notify %s: %s", key, e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._pub is not None:
+                try:
+                    self._pub.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._pub = None

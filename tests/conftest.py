@@ -43,3 +43,20 @@ def free_port_pair() -> int:
         except OSError:
             continue
     raise RuntimeError("no free port pair found")
+
+
+def wait_until(cond, timeout: float = 10.0, interval: float = 0.05,
+               msg: str = "condition"):
+    """Bounded polling instead of fixed sleeps (r2 weak #4: 68 time.sleep
+    calls made the suite slow and flaky-by-design)."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        _time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")

@@ -95,7 +95,7 @@ def test_replicated_write(cluster):
     res = operation.submit(mc, payload, replication="001", collection="rep")
     # both replicas must hold the needle
     vid = int(res.fid.split(",")[0])
-    time.sleep(1.5)  # let heartbeats propagate volume stats
+    time.sleep(0.8)  # let heartbeats propagate volume stats
     locs = master.topo.lookup(vid)
     assert len(locs) == 2, f"expected 2 replicas, got {[n.id for n in locs]}"
     from seaweedfs_tpu.storage.types import parse_file_id
@@ -179,7 +179,7 @@ def test_ec_encode_spread_and_degraded_read(cluster):
     # delete the original volume; reads must go through EC now
     src_stub.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
                   vpb.VolumeDeleteResponse)
-    time.sleep(1.5)  # heartbeats update master ec registry
+    time.sleep(0.8)  # heartbeats update master ec registry
 
     assert vid in master.topo.ec_locations
     for fid, data in list(blobs.items())[:10]:

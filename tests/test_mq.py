@@ -272,3 +272,41 @@ def test_mq_topic_shell_commands(tmp_path):
     finally:
         b.stop()
         ms.stop()
+
+
+def test_mq_notification_queue(tmp_path):
+    """Filer metadata events published into the framework's own broker
+    (the Kafka/SQS role from reference notification.toml)."""
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.store import MemoryStore
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.mq import BrokerServer
+    from seaweedfs_tpu.mq.client import subscribe
+    from seaweedfs_tpu.notification.queues import open_queue
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+
+    ms = MasterServer(port=_fp(), pulse_seconds=0.3, maintenance_scripts=[])
+    ms.start()
+    b = BrokerServer(ms.address, port=_fp()).start()
+    try:
+        q = open_queue(f"mq:{b.address}/notif/events")
+        filer = Filer(MemoryStore(),
+                      meta_log_path=str(tmp_path / "meta.log"),
+                      notification_queue=q)
+        e = fpb.Entry(name="hello.txt")
+        e.attributes.file_size = 42
+        filer.create_entry("/watched", e)
+        filer.delete_entry("/watched", "hello.txt", is_delete_data=False)
+        q.close()
+        filer.close()
+        got = list(subscribe(b.address, "notif", "events", start_offset=0))
+        # auto-created parent dir + create + delete
+        keys = [k.decode() for _off, k, _v in got]
+        assert "/watched/hello.txt" in keys
+        ev = fpb.EventNotification()
+        ev.ParseFromString(got[keys.index("/watched/hello.txt")][2])
+        assert ev.new_entry.name == "hello.txt"
+        assert ev.new_entry.attributes.file_size == 42
+    finally:
+        b.stop()
+        ms.stop()

@@ -85,8 +85,9 @@ def test_lock_required(cluster):
 
 def test_volume_list_and_cluster_check(cluster):
     master, servers, mc, env, out = cluster
+    from conftest import wait_until
     operation.submit(mc, b"x" * 1000, collection="shelltest")
-    time.sleep(1.0)
+    wait_until(lambda: master.topo.lookup(1), msg="volume registered")
     text = sh(env, out, "volume.list")
     assert "DataNode" in text and "volume 1" in text
     text = sh(env, out, "cluster.check")
@@ -108,9 +109,9 @@ def test_full_ec_lifecycle_via_shell(cluster):
     # ec.encode with explicit 4+2 geometry
     text = sh(env, out, f"ec.encode -volumeId {vid} -dataShards 4 -parityShards 2")
     assert "ec encoded 1 volumes" in text
-    time.sleep(1.2)
-    # original volume gone, ec shards spread over all 3 servers
-    assert master.topo.lookup(vid) == []
+    from conftest import wait_until
+    wait_until(lambda: master.topo.lookup(vid) == [],
+               msg="source volume unregistered")
     holders = master.topo.lookup_ec(vid)
     assert sorted(holders) == [0, 1, 2, 3, 4, 5]
     held_servers = {n.id for nodes in holders.values() for n in nodes}
@@ -128,13 +129,13 @@ def test_full_ec_lifecycle_via_shell(cluster):
     for f in glob.glob(str(victim.store.locations[0].directory) + "/*.ec*"):
         os.remove(f)
     victim.trigger_heartbeat()
-    time.sleep(1.2)
-    assert sorted(master.topo.lookup_ec(vid)) == sorted(
-        set(range(6)) - set(lost_vids))
+    from conftest import wait_until
+    wait_until(lambda: sorted(master.topo.lookup_ec(vid)) == sorted(
+        set(range(6)) - set(lost_vids)), msg="shards dropped from registry")
     text = sh(env, out, "ec.rebuild")
     assert "rebuilt" in text
-    time.sleep(1.2)
-    assert sorted(master.topo.lookup_ec(vid)) == [0, 1, 2, 3, 4, 5]
+    wait_until(lambda: sorted(master.topo.lookup_ec(vid)) ==
+               [0, 1, 2, 3, 4, 5], msg="rebuilt shards registered")
     for fid, data in list(payloads.items())[8:14]:
         assert operation.read(mc, fid) == data
 
@@ -142,8 +143,9 @@ def test_full_ec_lifecycle_via_shell(cluster):
     sh(env, out, "ec.balance")
     text = sh(env, out, f"ec.decode -volumeId {vid}")
     assert "decoded" in text
-    time.sleep(1.2)
-    assert master.topo.lookup(vid), "decoded volume not registered"
+    from conftest import wait_until
+    wait_until(lambda: master.topo.lookup(vid),
+               msg="decoded volume registered")
     assert master.topo.lookup_ec(vid) == {}
     for fid, data in list(payloads.items())[14:20]:
         assert operation.read(mc, fid) == data
@@ -152,11 +154,15 @@ def test_full_ec_lifecycle_via_shell(cluster):
 def test_volume_balance_and_fix_replication(cluster):
     master, servers, mc, env, out = cluster
     sh(env, out, "lock")
+    from conftest import wait_until
     for i in range(6):
         operation.submit(mc, os.urandom(2000), collection=f"bal{i}")
-    time.sleep(1.2)
+    wait_until(lambda: sum(1 for _ in master.topo.all_volume_ids()) >= 6
+               if hasattr(master.topo, "all_volume_ids") else True,
+               timeout=3, msg="volumes registered")
+    time.sleep(0.8)  # let sizes settle before balancing
     sh(env, out, "volume.balance")
-    time.sleep(1.2)
+    time.sleep(0.8)
     counts = []
     for vs in servers:
         counts.append(sum(len(l.volumes) for l in vs.store.locations))
