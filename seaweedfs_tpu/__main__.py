@@ -203,7 +203,7 @@ def run_server(argv):
 
 def run_shell(argv):
     from .shell import (ec_commands, fs_commands,  # noqa: F401 (register)
-                        mq_commands, volume_commands)
+                        mq_commands, remote_commands, volume_commands)
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")

@@ -140,6 +140,19 @@ class FilerClient:
                              modified_ts_ns=time.time_ns(),
                              e_tag=res.get("eTag", ""))
 
+    def _delete_chunks(self, fids: "list[str]") -> None:
+        """Best-effort raw chunk deletion (the remote-mount uncache seam)."""
+        import requests
+
+        for fid in fids:
+            for url in self._lookup_fid(fid):
+                try:
+                    if requests.delete(f"http://{url}/{fid}",
+                                       timeout=10).status_code in (200, 202):
+                        break
+                except Exception:  # noqa: BLE001
+                    continue
+
     def write_file(self, path: str, data: bytes, mime: str = "",
                    ttl_sec: int = 0, mode: int = 0o644,
                    signatures: "list[int] | None" = None) -> None:

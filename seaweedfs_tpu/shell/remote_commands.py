@@ -1,0 +1,104 @@
+"""remote.* shell commands (reference command_remote_mount.go,
+command_remote_cache.go, command_remote_uncache.go,
+command_remote_unmount.go, command_remote_configure.go). All drive a
+REMOTE filer through FilerClient — the same seam the standalone gateways
+use — so the shell needs no in-process filer."""
+
+from __future__ import annotations
+
+import argparse
+
+from .commands import CommandEnv, command
+
+
+def _remote_parser(prog: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog)
+    p.add_argument("-filer", default="")
+    return p
+
+
+def _fc(env: CommandEnv, opt_filer: str):
+    from ..client.filer_client import FilerClient
+    from .fs_commands import _filer_addr
+    return FilerClient(_filer_addr(env, opt_filer))
+
+
+@command("remote.mount", "-dir /path -remote spec [-prefix p]: mount a "
+         "remote bucket path into the namespace")
+def cmd_remote_mount(env: CommandEnv, args):
+    from ..remote import mount_remote
+
+    p = _remote_parser("remote.mount")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-remote", required=True,
+                   help="remote spec, e.g. local:///data or s3 endpoint spec")
+    p.add_argument("-prefix", default="")
+    opt = p.parse_args(args)
+    n = mount_remote(_fc(env, opt.filer), opt.dir, opt.remote, opt.prefix)
+    env.println(f"mounted {opt.remote} at {opt.dir}: {n} entries")
+
+
+@command("remote.unmount", "-dir /path: detach a remote mount")
+def cmd_remote_unmount(env: CommandEnv, args):
+    from ..remote import unmount_remote
+
+    p = _remote_parser("remote.unmount")
+    p.add_argument("-dir", required=True)
+    opt = p.parse_args(args)
+    unmount_remote(_fc(env, opt.filer), opt.dir)
+    env.println(f"unmounted {opt.dir}")
+
+
+@command("remote.cache", "-path /file: pull a remote-mounted entry's bytes "
+         "into local volumes")
+def cmd_remote_cache(env: CommandEnv, args):
+    from ..remote import cache_remote
+
+    p = _remote_parser("remote.cache")
+    p.add_argument("-path", required=True)
+    opt = p.parse_args(args)
+    cache_remote(_fc(env, opt.filer), opt.path)
+    env.println(f"cached {opt.path}")
+
+
+@command("remote.uncache", "-path /file: drop local chunks, keep the remote "
+         "reference")
+def cmd_remote_uncache(env: CommandEnv, args):
+    from ..remote import uncache_remote
+
+    p = _remote_parser("remote.uncache")
+    p.add_argument("-path", required=True)
+    opt = p.parse_args(args)
+    uncache_remote(_fc(env, opt.filer), opt.path)
+    env.println(f"uncached {opt.path}")
+
+
+@command("remote.configure", "list configured remote mounts")
+def cmd_remote_configure(env: CommandEnv, args):
+    from ..remote.remote_mount import _load_mappings
+
+    opt = _remote_parser("remote.configure").parse_args(args)
+    mappings = _load_mappings(_fc(env, opt.filer))
+    if not mappings:
+        env.println("(no remote mounts)")
+    for directory, m in sorted(mappings.items()):
+        env.println(f"{directory} -> {m['spec']} prefix={m.get('prefix', '')!r}")
+
+
+@command("remote.meta.sync", "-dir /path: re-import the remote listing "
+         "(pick up new/changed objects)")
+def cmd_remote_meta_sync(env: CommandEnv, args):
+    from ..remote import mount_remote
+    from ..remote.remote_mount import _load_mappings
+
+    p = _remote_parser("remote.meta.sync")
+    p.add_argument("-dir", required=True)
+    opt = p.parse_args(args)
+    fc = _fc(env, opt.filer)
+    mappings = _load_mappings(fc)
+    m = mappings.get(opt.dir)
+    if m is None:
+        env.println(f"{opt.dir} is not a remote mount")
+        return
+    n = mount_remote(fc, opt.dir, m["spec"], m.get("prefix", ""))
+    env.println(f"meta-synced {opt.dir}: {n} entries")

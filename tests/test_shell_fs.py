@@ -13,7 +13,7 @@ import time
 import pytest
 
 from seaweedfs_tpu.shell import ec_commands  # noqa: F401 (register)
-from seaweedfs_tpu.shell import fs_commands, volume_commands  # noqa: F401
+from seaweedfs_tpu.shell import fs_commands, remote_commands, volume_commands  # noqa: F401
 from seaweedfs_tpu.shell.commands import CommandEnv, run_command
 
 
@@ -366,3 +366,41 @@ def test_s3_bucket_quota_and_clean_uploads(env, stack):
     text = _run(env, "s3.clean.uploads -timeAgo 1h")
     assert "cleaned 1 stale uploads" in text
     assert fs.filer.find_entry("/buckets/qb/.uploads", "oldid") is None
+
+
+def test_remote_shell_commands(env, stack, tmp_path):
+    """remote.mount/configure/cache/uncache/meta.sync/unmount against a
+    local-dir remote through a REMOTE filer (FilerClient seam)."""
+    import os as _os
+
+    src_dir = tmp_path / "bucketdata"
+    (src_dir / "sub").mkdir(parents=True)
+    (src_dir / "a.txt").write_bytes(b"remote-a")
+    (src_dir / "sub" / "b.txt").write_bytes(b"remote-b")
+    spec = f"local://{src_dir}"
+
+    fs = stack["fs"]
+    text = _run(env, f"remote.mount -dir /cloud -remote {spec}")
+    assert "2 entries" in text
+    text = _run(env, "remote.configure")
+    assert "/cloud" in text and spec in text
+    # uncached entry readable straight from the remote via the filer
+    import requests
+    r = requests.get(f"http://{fs.url}/cloud/a.txt", timeout=10)
+    assert r.content == b"remote-a"
+    # cache pulls bytes into local volumes
+    text = _run(env, "remote.cache -path /cloud/sub/b.txt")
+    assert "cached" in text
+    e = fs.filer.find_entry("/cloud/sub", "b.txt")
+    assert len(e.chunks) >= 1
+    text = _run(env, "remote.uncache -path /cloud/sub/b.txt")
+    e = fs.filer.find_entry("/cloud/sub", "b.txt")
+    assert len(e.chunks) == 0
+    # new remote object appears after meta.sync
+    (src_dir / "c.txt").write_bytes(b"remote-c")
+    _run(env, "remote.meta.sync -dir /cloud")
+    assert requests.get(f"http://{fs.url}/cloud/c.txt",
+                        timeout=10).content == b"remote-c"
+    text = _run(env, "remote.unmount -dir /cloud")
+    assert "unmounted" in text
+    assert fs.filer.find_entry("/", "cloud") is None
