@@ -265,6 +265,9 @@ def run_filer(argv):
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-maxMB", type=int, default=4)
+    p.add_argument("-encryptVolumeData", action="store_true",
+                   help="AES-256-GCM encrypt chunks; keys live in filer "
+                        "metadata (reference filer -encryptVolumeData)")
     opt = p.parse_args(argv)
     store = opt.store
     if not store:
@@ -275,7 +278,8 @@ def run_filer(argv):
                 grpc_port=opt.grpcPort or None,
                 meta_log_path="./filer-meta.log",
                 collection=opt.collection, replication=opt.replication,
-                chunk_size_mb=opt.maxMB).start()
+                chunk_size_mb=opt.maxMB,
+                encrypt_data=opt.encryptVolumeData).start()
     _wait_forever()
 
 

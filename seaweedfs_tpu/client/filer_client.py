@@ -113,6 +113,10 @@ class FilerClient:
         buf = bytearray(size)
         for v in read_views(chunks, offset, size):
             blob = self._fetch_blob(v.file_id)
+            if v.cipher_key:
+                from ..filer.chunks import ChunkView  # noqa: F401
+                from ..security.cipher import decrypt
+                blob = decrypt(blob, v.cipher_key)
             part = blob[v.chunk_offset:v.chunk_offset + v.size]
             at = v.logical_offset - offset
             buf[at:at + len(part)] = part

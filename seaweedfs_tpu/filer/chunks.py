@@ -31,6 +31,7 @@ class ChunkView:
     chunk_offset: int   # offset inside the chunk blob
     size: int
     logical_offset: int
+    cipher_key: bytes = b""  # decrypt the fetched blob first when set
 
 
 def total_size(chunks: Iterable[fpb.FileChunk]) -> int:
@@ -101,7 +102,8 @@ def read_views(chunks: Iterable[fpb.FileChunk], offset: int, size: int) -> list[
             file_id=c.file_id,
             chunk_offset=lo - c.offset,
             size=hi - lo,
-            logical_offset=lo))
+            logical_offset=lo,
+            cipher_key=bytes(c.cipher_key)))
     return views
 
 
@@ -126,8 +128,12 @@ def resolve_manifests(chunks: Iterable[fpb.FileChunk],
         raise ValueError("manifest nesting too deep")
     manifests, data = separate_manifest_chunks(chunks)
     for m in manifests:
+        blob = fetch(m.file_id)
+        if m.cipher_key:  # encrypted manifest blob (util/cipher.go model)
+            from ..security.cipher import decrypt
+            blob = decrypt(blob, m.cipher_key)
         mf = fpb.FileChunkManifest()
-        mf.ParseFromString(fetch(m.file_id))
+        mf.ParseFromString(blob)
         data.extend(resolve_manifests(mf.chunks, fetch, depth + 1))
     return data
 

@@ -38,11 +38,15 @@ class FilerServer:
                  grpc_port: int | None = None,
                  meta_log_path: str | None = None,
                  collection: str = "", replication: str = "",
-                 chunk_size_mb: int = DEFAULT_CHUNK_MB):
+                 chunk_size_mb: int = DEFAULT_CHUNK_MB,
+                 encrypt_data: bool = False):
         self.ip, self.port = ip, port
         self.grpc_port = grpc_port or port + 10000
         self.collection, self.replication = collection, replication
         self.chunk_size = chunk_size_mb << 20
+        # at-rest chunk encryption (reference filer -encryptVolumeData +
+        # util/cipher.go): volume servers only ever see ciphertext
+        self.encrypt_data = encrypt_data
         self.mc = MasterClient(master_address, client_type="filer")
         self.filer = Filer(open_store(store_spec), meta_log_path,
                            chunk_deleter=self._delete_chunks)
@@ -134,15 +138,25 @@ class FilerServer:
     def _save_blob(self, data: bytes, ttl: str = "",
                    path: str = "") -> fpb.FileChunk:
         collection, replication, rule_ttl, disk = self._storage_rule(path)
+        cipher_key = b""
+        logical = len(data)
+        if self.encrypt_data:
+            from ..security.cipher import encrypt
+            data, cipher_key = encrypt(data)
         a = self.mc.assign(collection=collection,
                            replication=replication, ttl=ttl or rule_ttl,
                            disk_type=disk)
         target = a.location.public_url or a.location.url
         res = operation.upload(f"{target}/{a.fid}", data,
                                gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
-        return fpb.FileChunk(file_id=a.fid, size=res.get("size", len(data)),
+        # size stays LOGICAL (plaintext) — interval math never sees the
+        # nonce/tag overhead
+        return fpb.FileChunk(file_id=a.fid,
+                             size=logical if cipher_key
+                             else res.get("size", len(data)),
                              modified_ts_ns=time.time_ns(),
-                             e_tag=res.get("eTag", ""))
+                             e_tag=res.get("eTag", ""),
+                             cipher_key=cipher_key)
 
     def _fetch_blob(self, fid: str) -> bytes:
         return operation.read(self.mc, fid)
@@ -165,6 +179,9 @@ class FilerServer:
         buf = bytearray(size)
         for v in read_views(chunks, offset, size):
             blob = self._fetch_blob(v.file_id)
+            if v.cipher_key:
+                from ..security.cipher import decrypt
+                blob = decrypt(blob, v.cipher_key)
             part = blob[v.chunk_offset:v.chunk_offset + v.size]
             at = v.logical_offset - offset
             buf[at:at + len(part)] = part
