@@ -21,13 +21,15 @@ from ..utils.log import logger
 
 log = logger("admincron")
 
-# Reference default scripts (scaffold/master.toml:11-16), minus ec.encode
-# which needs a collection policy decision; repair/balance are always safe.
+# Reference default scripts (scaffold/master.toml:11-16): full volumes are
+# erasure-coded continuously (EC-on-ingest at volume granularity), lost
+# shards rebuilt, shards and volumes balanced, replication repaired.
 DEFAULT_SCRIPTS = [
-    "volume.fix.replication",
+    "ec.encode -collection '' -fullPercent 95",
     "ec.rebuild",
     "ec.balance",
     "volume.balance",
+    "volume.fix.replication",
 ]
 DEFAULT_INTERVAL_S = 17 * 60  # master_server.go:278 sleep_minutes default
 

@@ -156,3 +156,30 @@ def test_cron_skips_when_operator_holds_lock(cluster):
         run_command(env, "unlock")
     master.admin_cron.trigger()
     assert master.admin_cron.sweeps == 1
+
+
+def test_cron_ec_encodes_full_volumes(cluster):
+    """EC-on-ingest at volume granularity: once a volume crosses the
+    fullness bar, the next cron sweep erasure-codes it with no operator
+    (reference scaffold/master.toml ships ec.encode in the default cron)."""
+    master, servers, mc, geo = cluster
+    master.admin_cron.scripts = [
+        "ec.encode -collection cronec -fullPercent 0", "ec.balance"]
+    rng = np.random.default_rng(3)
+    payloads = {}
+    for _ in range(15):
+        data = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data, collection="cronec")
+        payloads[res.fid] = data
+    vid = int(next(iter(payloads)).split(",")[0])
+    wait_until(lambda: master.topo.lookup(vid), msg="volume registered")
+    time.sleep(0.7)  # one pulse: sizes settle
+
+    master.admin_cron.trigger()
+
+    wait_until(lambda: master.topo.lookup(vid) == [],
+               msg="source volume replaced by shards")
+    wait_until(lambda: len(_ec_holders(master)) == geo.n,
+               msg="all shards registered")
+    for fid, data in payloads.items():
+        assert operation.read(mc, fid) == data
