@@ -177,7 +177,11 @@ def open_remote(spec: str) -> RemoteStorageClient:
     kind, _, arg = spec.partition(":")
     if kind == "local":
         return LocalDirRemote(arg)
-    if kind == "s3":
+    if kind in ("s3", "b2", "gcs", "wasabi", "minio"):
+        # b2/gcs/wasabi/minio all speak the S3 protocol (B2 S3-compatible
+        # API, GCS XML API with HMAC keys) — one sigv4 client covers them,
+        # the kind names keep specs self-documenting (reference ships
+        # per-provider clients in weed/remote_storage/*)
         url, _, cred = arg.partition("?")
         base, _, bucket = url.rpartition("/")
         ak, _, sk = cred.partition(":")
