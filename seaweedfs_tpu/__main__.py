@@ -316,6 +316,22 @@ def run_webdav_standalone(argv):
     _wait_forever()
 
 
+def run_iam_standalone(argv):
+    """Standalone IAM API over a remote filer (reference command/iam.go)."""
+    from .client.filer_client import FilerClient
+    from .iam import IamApiServer
+    from .s3.auth import IdentityAccessManagement
+    p = argparse.ArgumentParser(prog="iam")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8111)
+    opt = p.parse_args(argv)
+    fc = FilerClient(opt.filer)
+    IamApiServer(IdentityAccessManagement(None), filer_server=fc,
+                 ip=opt.ip, port=opt.port).start()
+    _wait_forever()
+
+
 def run_filer_sync(argv):
     """Continuous bidirectional filer synchronization
     (reference command/filer_sync.go)."""
@@ -615,6 +631,7 @@ VERBS = {
     "filer": run_filer,
     "s3": run_s3_standalone,
     "webdav": run_webdav_standalone,
+    "iam": run_iam_standalone,
     "filer.sync": run_filer_sync,
     "filer.copy": run_filer_copy,
     "filer.meta.tail": run_filer_meta_tail,
