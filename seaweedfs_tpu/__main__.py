@@ -316,6 +316,43 @@ def run_webdav_standalone(argv):
     _wait_forever()
 
 
+def run_filer_backup(argv):
+    """Continuously mirror a filer subtree into a local directory
+    (reference command/filer_backup.go): subscribe to metadata events and
+    apply them through the local replication sink, resuming from the last
+    applied offset persisted in the SOURCE filer's kv space."""
+    import struct as _struct
+    import threading as _threading
+
+    from .client.filer_client import FilerClient
+    from .replication.replicator import Replicator
+    from .replication.sink import LocalSink
+
+    p = argparse.ArgumentParser(prog="filer.backup")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-dir", required=True, help="local mirror directory")
+    p.add_argument("-path", default="/", help="subtree to mirror")
+    opt = p.parse_args(argv)
+    fc = FilerClient(opt.filer, client_name="filer-backup")
+    repl = Replicator(LocalSink(opt.dir), fc.read_entry_bytes, opt.path)
+    offset_key = f"backup.offset.{opt.dir}".encode()
+    raw = fc.filer.kv_get(offset_key)
+    since = _struct.unpack("<q", raw)[0] if raw else 0
+    stop = _threading.Event()
+    print(f"backing up {opt.filer}{opt.path} -> {opt.dir} (since {since})")
+    try:
+        for resp in fc.filer.subscribe(since, stop, path_prefix=opt.path):
+            try:
+                repl.replicate(resp.directory, resp.event_notification)
+            except Exception as e:  # noqa: BLE001
+                print(f"apply {resp.directory}: {e}", file=sys.stderr)
+            if resp.ts_ns:
+                fc.filer.kv_put(offset_key,
+                                _struct.pack("<q", resp.ts_ns))
+    except KeyboardInterrupt:
+        stop.set()
+
+
 def run_iam_standalone(argv):
     """Standalone IAM API over a remote filer (reference command/iam.go)."""
     from .client.filer_client import FilerClient
@@ -632,6 +669,7 @@ VERBS = {
     "s3": run_s3_standalone,
     "webdav": run_webdav_standalone,
     "iam": run_iam_standalone,
+    "filer.backup": run_filer_backup,
     "filer.sync": run_filer_sync,
     "filer.copy": run_filer_copy,
     "filer.meta.tail": run_filer_meta_tail,

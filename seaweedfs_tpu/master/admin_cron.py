@@ -30,6 +30,7 @@ DEFAULT_SCRIPTS = [
     "ec.balance",
     "volume.balance",
     "volume.fix.replication",
+    "volume.vacuum",
 ]
 DEFAULT_INTERVAL_S = 17 * 60  # master_server.go:278 sleep_minutes default
 

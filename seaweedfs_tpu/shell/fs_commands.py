@@ -559,3 +559,37 @@ def cmd_s3_clean_uploads(env: CommandEnv, args):
                 removed += 1
                 env.println(f"  removed {updir}/{u.name}")
     env.println(f"cleaned {removed} stale uploads")
+
+
+@command("fs.log", "[-limit N] [-pathPrefix /p]: dump recent filer metadata "
+         "events")
+def cmd_fs_log(env: CommandEnv, args):
+    """Reference command_fs_log.go (meta event tail, bounded)."""
+    import threading as _threading
+
+    p = _fs_parser("fs.log")
+    p.add_argument("-limit", type=int, default=100)
+    p.add_argument("-pathPrefix", default="/")
+    opt = p.parse_args(args)
+    stub = _filer_stub(env, opt.filer)
+    stop = _threading.Event()
+    n = 0
+    stream = stub.call_stream(
+        "SubscribeMetadata",
+        fpb.SubscribeMetadataRequest(client_name="fs.log",
+                                     path_prefix=opt.pathPrefix,
+                                     since_ns=1),
+        fpb.SubscribeMetadataResponse, timeout=5)
+    try:
+        for resp in stream:
+            ev = resp.event_notification
+            kind = ("delete" if not ev.new_entry.name
+                    else "create" if not ev.old_entry.name else "update")
+            name = ev.new_entry.name or ev.old_entry.name
+            env.println(f"{resp.ts_ns} {kind:7s} {resp.directory}/{name}")
+            n += 1
+            if n >= opt.limit:
+                break
+    except Exception:  # noqa: BLE001 — stream timeout ends the backlog drain
+        pass
+    env.println(f"({n} events)")
