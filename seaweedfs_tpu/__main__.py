@@ -294,12 +294,41 @@ def run_s3_standalone(argv):
     p.add_argument("-config", default="", help="identities json file")
     opt = p.parse_args(argv)
     import json as _json
+    import threading as _threading
     iam_config = None
     if opt.config:
         with open(opt.config) as f:
             iam_config = _json.load(f)
     fc = FilerClient(opt.filer)
-    S3Gateway(fc, ip=opt.ip, port=opt.port, iam_config=iam_config).start()
+    gw = S3Gateway(fc, ip=opt.ip, port=opt.port, iam_config=iam_config)
+
+    def _load_filer_identities():
+        entry = fc.filer.find_entry("/etc/iam", "identity.json")
+        if entry is not None:
+            gw.iam.load(_json.loads(fc.read_entry_bytes(entry)))
+            print("s3: identities loaded from filer /etc/iam/identity.json",
+                  file=sys.stderr)
+
+    if not opt.config:
+        # IAM-managed credentials live in the filer; load now and hot-reload
+        # on changes (reference auth_credentials_subscribe.go)
+        try:
+            _load_filer_identities()
+        except Exception as e:  # noqa: BLE001
+            print(f"s3: identity load: {e}", file=sys.stderr)
+
+        def _watch():
+            stop = _threading.Event()
+            for resp in fc.filer.subscribe(time.time_ns(), stop,
+                                           path_prefix="/etc/iam"):
+                try:
+                    _load_filer_identities()
+                except Exception as e:  # noqa: BLE001
+                    print(f"s3: identity reload: {e}", file=sys.stderr)
+
+        _threading.Thread(target=_watch, daemon=True,
+                          name="s3-iam-watch").start()
+    gw.start()
     _wait_forever()
 
 
@@ -342,10 +371,19 @@ def run_filer_backup(argv):
     print(f"backing up {opt.filer}{opt.path} -> {opt.dir} (since {since})")
     try:
         for resp in fc.filer.subscribe(since, stop, path_prefix=opt.path):
-            try:
-                repl.replicate(resp.directory, resp.event_notification)
-            except Exception as e:  # noqa: BLE001
-                print(f"apply {resp.directory}: {e}", file=sys.stderr)
+            applied = False
+            for attempt in range(5):  # FilerSync-style retry + dead-letter
+                try:
+                    repl.replicate(resp.directory, resp.event_notification)
+                    applied = True
+                    break
+                except Exception as e:  # noqa: BLE001
+                    print(f"apply {resp.directory} (try {attempt + 1}/5): "
+                          f"{e}", file=sys.stderr)
+                    time.sleep(0.2 * 2 ** attempt)
+            if not applied:
+                print(f"DEAD-LETTER {resp.directory}: mirror may diverge; "
+                      "re-run with -path to re-scan", file=sys.stderr)
             if resp.ts_ns:
                 fc.filer.kv_put(offset_key,
                                 _struct.pack("<q", resp.ts_ns))

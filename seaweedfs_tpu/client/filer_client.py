@@ -114,7 +114,6 @@ class FilerClient:
         for v in read_views(chunks, offset, size):
             blob = self._fetch_blob(v.file_id)
             if v.cipher_key:
-                from ..filer.chunks import ChunkView  # noqa: F401
                 from ..security.cipher import decrypt
                 blob = decrypt(blob, v.cipher_key)
             part = blob[v.chunk_offset:v.chunk_offset + v.size]
@@ -143,19 +142,6 @@ class FilerClient:
                              size=res.get("size", len(data)),
                              modified_ts_ns=time.time_ns(),
                              e_tag=res.get("eTag", ""))
-
-    def _delete_chunks(self, fids: "list[str]") -> None:
-        """Best-effort raw chunk deletion (the remote-mount uncache seam)."""
-        import requests
-
-        for fid in fids:
-            for url in self._lookup_fid(fid):
-                try:
-                    if requests.delete(f"http://{url}/{fid}",
-                                       timeout=10).status_code in (200, 202):
-                        break
-                except Exception:  # noqa: BLE001
-                    continue
 
     def write_file(self, path: str, data: bytes, mime: str = "",
                    ttl_sec: int = 0, mode: int = 0o644,

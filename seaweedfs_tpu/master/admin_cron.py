@@ -25,7 +25,7 @@ log = logger("admincron")
 # erasure-coded continuously (EC-on-ingest at volume granularity), lost
 # shards rebuilt, shards and volumes balanced, replication repaired.
 DEFAULT_SCRIPTS = [
-    "ec.encode -collection '' -fullPercent 95",
+    "ec.encode -collection '*' -fullPercent 95",
     "ec.rebuild",
     "ec.balance",
     "volume.balance",

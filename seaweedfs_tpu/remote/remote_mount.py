@@ -138,7 +138,7 @@ def uncache_remote(fs, path: str) -> None:
         raise ValueError(f"{path} is not a remote entry")
     if not entry.chunks:
         return
-    fs._delete_chunks([c.file_id for c in entry.chunks])
+    # update_entry's replaced-chunk GC deletes the dropped chunks server-side
     updated = fpb.Entry()
     updated.CopyFrom(entry)
     del updated.chunks[:]

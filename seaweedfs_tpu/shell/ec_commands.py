@@ -66,8 +66,9 @@ def balanced_ec_distribution(servers: list[dict], n_shards: int) -> list[dict]:
 
 
 @command("ec.encode",
-         "-volumeId N | -collection C [-fullPercent 95] [-sourceDiskType ssd]: "
-         "erasure-code volumes and spread shards", needs_lock=True)
+         "-volumeId N | -collection C|'*' [-fullPercent 95] "
+         "[-sourceDiskType ssd]: erasure-code volumes and spread shards",
+         needs_lock=True)
 def cmd_ec_encode(env: CommandEnv, args):
     p = argparse.ArgumentParser(prog="ec.encode")
     p.add_argument("-volumeId", type=int, default=0)
@@ -88,7 +89,9 @@ def cmd_ec_encode(env: CommandEnv, args):
                 if opt.volumeId and v.id != opt.volumeId:
                     continue
                 if not opt.volumeId:
-                    if opt.collection is None or v.collection != opt.collection:
+                    if opt.collection is None or (
+                            opt.collection != "*"
+                            and v.collection != opt.collection):
                         continue
                     if limit and v.size < limit * opt.fullPercent / 100:
                         continue

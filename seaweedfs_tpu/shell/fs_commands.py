@@ -571,25 +571,29 @@ def cmd_fs_log(env: CommandEnv, args):
     p.add_argument("-limit", type=int, default=100)
     p.add_argument("-pathPrefix", default="/")
     opt = p.parse_args(args)
+    import collections
+
+    import grpc as _grpc
+
     stub = _filer_stub(env, opt.filer)
-    stop = _threading.Event()
-    n = 0
     stream = stub.call_stream(
         "SubscribeMetadata",
         fpb.SubscribeMetadataRequest(client_name="fs.log",
                                      path_prefix=opt.pathPrefix,
                                      since_ns=1),
         fpb.SubscribeMetadataResponse, timeout=5)
+    tail: collections.deque = collections.deque(maxlen=opt.limit)
     try:
-        for resp in stream:
-            ev = resp.event_notification
-            kind = ("delete" if not ev.new_entry.name
-                    else "create" if not ev.old_entry.name else "update")
-            name = ev.new_entry.name or ev.old_entry.name
-            env.println(f"{resp.ts_ns} {kind:7s} {resp.directory}/{name}")
-            n += 1
-            if n >= opt.limit:
-                break
-    except Exception:  # noqa: BLE001 — stream timeout ends the backlog drain
-        pass
-    env.println(f"({n} events)")
+        for resp in stream:  # drain the backlog; keep the NEWEST N
+            tail.append(resp)
+    except _grpc.RpcError as e:
+        if e.code() != _grpc.StatusCode.DEADLINE_EXCEEDED:
+            env.println(f"error: {e.code().name}: {e.details()}")
+            return
+    for resp in tail:
+        ev = resp.event_notification
+        kind = ("delete" if not ev.new_entry.name
+                else "create" if not ev.old_entry.name else "update")
+        name = ev.new_entry.name or ev.old_entry.name
+        env.println(f"{resp.ts_ns} {kind:7s} {resp.directory}/{name}")
+    env.println(f"({len(tail)} events)")
