@@ -345,6 +345,53 @@ def run_webdav_standalone(argv):
     _wait_forever()
 
 
+def run_master_follow(argv):
+    """Read-only master follower (reference command/master_follower.go):
+    maintains the leader's vid map via the KeepConnected push stream and
+    answers LookupVolume / /dir/lookup locally — read scaling without
+    raft membership."""
+    from .client.master_client import MasterClient
+    from .pb import master_pb2 as mpb
+    from .utils.rpc import MASTER_SERVICE, RpcService, serve
+
+    p = argparse.ArgumentParser(prog="master.follow")
+    p.add_argument("-masters", default="127.0.0.1:9333",
+                   help="leader quorum to follow")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9334)
+    opt = p.parse_args(argv)
+    mc = MasterClient(opt.masters, client_type="master-follower").start()
+    mc.wait_connected()
+
+    svc = RpcService(MASTER_SERVICE)
+
+    @svc.unary("LookupVolume", mpb.LookupVolumeRequest,
+               mpb.LookupVolumeResponse)
+    def lookup(req, ctx):
+        resp = mpb.LookupVolumeResponse()
+        for vid_str in req.volume_or_file_ids:
+            e = resp.volume_id_locations.add(volume_or_file_id=vid_str)
+            try:
+                for l in mc.lookup(int(vid_str.split(",")[0])):
+                    e.locations.add(url=l["url"],
+                                    public_url=l["public_url"],
+                                    grpc_port=l["grpc_port"])
+            except Exception as ex:  # noqa: BLE001
+                e.error = str(ex)
+        return resp
+
+    @svc.unary("GetMasterConfiguration",
+               mpb.GetMasterConfigurationRequest,
+               mpb.GetMasterConfigurationResponse)
+    def conf(req, ctx):
+        return mpb.GetMasterConfigurationResponse(leader=mc.leader)
+
+    serve(f"{opt.ip}:{opt.port}", [svc])
+    print(f"master follower on {opt.ip}:{opt.port} tracking {mc.leader} "
+          "(lookup-only)")
+    _wait_forever()
+
+
 def run_filer_backup(argv):
     """Continuously mirror a filer subtree into a local directory
     (reference command/filer_backup.go): subscribe to metadata events and
@@ -708,6 +755,7 @@ VERBS = {
     "webdav": run_webdav_standalone,
     "iam": run_iam_standalone,
     "filer.backup": run_filer_backup,
+    "master.follow": run_master_follow,
     "filer.sync": run_filer_sync,
     "filer.copy": run_filer_copy,
     "filer.meta.tail": run_filer_meta_tail,
