@@ -281,7 +281,9 @@ class S3Gateway:
     async def _route_bucket(self, request, bucket, q, body):
         m = request.method
         if m == "PUT":
-            return self.put_bucket(bucket)
+            if "acl" in q:
+                return self.put_acl(bucket, "", request, body)
+            return self.put_bucket(bucket, acl=self._canned_acl(request))
         if m == "HEAD":
             return self.head_bucket(bucket)
         if m == "DELETE":
@@ -292,6 +294,8 @@ class S3Gateway:
                 "multipart/form-data"):
             return self.post_policy_upload(request, bucket, body)
         if m == "GET":
+            if "acl" in q:
+                return self.get_acl(bucket, "")
             if "uploads" in q:
                 return self.list_multipart_uploads(bucket, q)
             return self.list_objects(bucket, q)
@@ -302,19 +306,26 @@ class S3Gateway:
         if m == "PUT":
             if "partNumber" in q and "uploadId" in q:
                 return self.upload_part(bucket, key, q, body)
+            if "acl" in q:
+                return self.put_acl(bucket, key, request, body)
             if "tagging" in q:
                 return self.put_object_tagging(bucket, key, body)
             src = request.headers.get("x-amz-copy-source")
             if src:
-                return self.copy_object(bucket, key, src)
+                return self.copy_object(bucket, key, src,
+                                        acl=self._canned_acl(request))
             return self.put_object(bucket, key, body,
-                                   request.content_type or "")
+                                   request.content_type or "",
+                                   acl=self._canned_acl(request))
         if m == "POST":
             if "uploads" in q:
-                return self.initiate_multipart(bucket, key)
+                return self.initiate_multipart(
+                    bucket, key, acl=self._canned_acl(request))
             if "uploadId" in q:
                 return self.complete_multipart(bucket, key, q["uploadId"], body)
         if m in ("GET", "HEAD"):
+            if "acl" in q:
+                return self.get_acl(bucket, key)
             if "tagging" in q:
                 return self.get_object_tagging(bucket, key)
             if "uploadId" in q:
@@ -349,13 +360,18 @@ class S3Gateway:
             ET.SubElement(b, "CreationDate").text = _iso(e.attributes.crtime)
         return _xml_response(root)
 
-    def put_bucket(self, bucket):
+    def put_bucket(self, bucket, acl: str | None = None):
         from aiohttp import web
 
-        if self.fs.filer.find_entry(BUCKETS_DIR, bucket) is None:
+        existing = self.fs.filer.find_entry(BUCKETS_DIR, bucket)
+        if existing is None:
             e = fpb.Entry(name=bucket, is_directory=True)
             e.attributes.file_mode = 0o40755
+            if acl:
+                e.extended["acl"] = acl.encode()
             self.fs.filer.create_entry(BUCKETS_DIR, e)
+        elif acl:
+            self._store_acl(BUCKETS_DIR, existing, acl)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     def head_bucket(self, bucket):
@@ -409,8 +425,12 @@ class S3Gateway:
         key = key.replace("${filename}", file_name or "file")
         self._require_bucket(bucket)
         self._check_quota(bucket)
-        self.fs.write_file(self._object_path(bucket, key), file_bytes,
-                           mime=fields.get("Content-Type", ""))
+        acl = self._validate_canned(fields.get("acl"))
+        entry = self.fs.write_file(self._object_path(bucket, key), file_bytes,
+                                   mime=fields.get("Content-Type", ""))
+        if acl:
+            d, _n = split_path(self._object_path(bucket, key))
+            self._store_acl(d, entry, acl)
         try:
             status = int(fields.get("success_action_status", "204"))
         except ValueError:
@@ -419,7 +439,88 @@ class S3Gateway:
             status = 204
         return web.Response(status=status)
 
-    def put_object(self, bucket, key, body, mime):
+    _CANNED_ACLS = ("private", "public-read", "public-read-write",
+                    "authenticated-read", "bucket-owner-read",
+                    "bucket-owner-full-control")
+
+    def _validate_canned(self, canned: str | None) -> str | None:
+        if canned is not None and canned not in self._CANNED_ACLS:
+            raise S3Error("InvalidArgument",
+                          f"unsupported ACL {canned!r}", 400)
+        return canned
+
+    def _canned_acl(self, request) -> str | None:
+        return self._validate_canned(request.headers.get("x-amz-acl"))
+
+    def _acl_entry(self, bucket, key):
+        self._require_bucket(bucket)
+        if key:
+            return self._find_object(bucket, key)
+        return BUCKETS_DIR, bucket, self.fs.filer.find_entry(
+            BUCKETS_DIR, bucket)
+
+    def _store_acl(self, d: str, e: fpb.Entry, canned: str) -> None:
+        upd = fpb.Entry()
+        upd.CopyFrom(e)
+        upd.extended["acl"] = canned.encode()
+        self.fs.filer.update_entry(d, upd)
+
+    def put_acl(self, bucket, key, request, body):
+        """Canned ACLs via the x-amz-acl header (reference
+        s3api_object_handlers_acl.go). Explicit grant-XML bodies are not
+        interpreted — they fail loudly rather than silently mis-apply."""
+        from aiohttp import web
+
+        canned = self._canned_acl(request)
+        if canned is None:
+            if body:
+                raise S3Error(
+                    "NotImplemented",
+                    "AccessControlPolicy grant bodies are not supported; "
+                    "use the x-amz-acl canned header.", 501)
+            raise S3Error("InvalidArgument", "missing x-amz-acl header", 400)
+        d, _n, e = self._acl_entry(bucket, key)
+        self._store_acl(d, e, canned)
+        return web.Response(status=200)
+
+    _ALL_USERS = "http://acs.amazonaws.com/groups/global/AllUsers"
+    _AUTH_USERS = "http://acs.amazonaws.com/groups/global/AuthenticatedUsers"
+    _XSI = "http://www.w3.org/2001/XMLSchema-instance"
+
+    def get_acl(self, bucket, key):
+        _d, _n, e = self._acl_entry(bucket, key)
+        canned = (e.extended.get("acl") or b"private").decode()
+        root = ET.Element("AccessControlPolicy")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = "owner"
+        acl = ET.SubElement(root, "AccessControlList")
+
+        def grant(perm: str, group_uri: str | None = None,
+                  user_id: str = "owner"):
+            g = ET.SubElement(acl, "Grant")
+            gt = ET.SubElement(g, "Grantee", {"xmlns:xsi": self._XSI})
+            if group_uri:
+                gt.set("xsi:type", "Group")
+                ET.SubElement(gt, "URI").text = group_uri
+            else:
+                gt.set("xsi:type", "CanonicalUser")
+                ET.SubElement(gt, "ID").text = user_id
+            ET.SubElement(g, "Permission").text = perm
+
+        grant("FULL_CONTROL")
+        if canned.startswith("public-read"):
+            grant("READ", self._ALL_USERS)
+        if canned == "public-read-write":
+            grant("WRITE", self._ALL_USERS)
+        elif canned == "authenticated-read":
+            grant("READ", self._AUTH_USERS)
+        elif canned == "bucket-owner-read":
+            grant("READ", user_id="bucket-owner")
+        elif canned == "bucket-owner-full-control":
+            grant("FULL_CONTROL", user_id="bucket-owner")
+        return _xml_response(root)
+
+    def put_object(self, bucket, key, body, mime, acl: str | None = None):
         from aiohttp import web
 
         self._require_bucket(bucket)
@@ -428,15 +529,23 @@ class S3Gateway:
             d, n = split_path(self._object_path(bucket, key))
             e = fpb.Entry(name=n, is_directory=True)
             e.attributes.file_mode = 0o40755
-            if self.fs.filer.find_entry(d, n) is None:
+            if acl:
+                e.extended["acl"] = acl.encode()
+            existing = self.fs.filer.find_entry(d, n)
+            if existing is None:
                 self.fs.filer.create_entry(d, e)
+            elif acl:
+                self._store_acl(d, existing, acl)
             return web.Response(status=200, headers={"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
         entry = self.fs.write_file(self._object_path(bucket, key), body,
                                    mime=mime)
+        if acl:
+            d, _n = split_path(self._object_path(bucket, key))
+            self._store_acl(d, entry, acl)
         return web.Response(status=200,
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
 
-    def copy_object(self, bucket, key, src):
+    def copy_object(self, bucket, key, src, acl: str | None = None):
         self._check_quota(bucket)
         self._require_bucket(bucket)
         src = urllib.parse.unquote(src)
@@ -449,6 +558,9 @@ class S3Gateway:
         data = self.fs.read_entry_bytes(entry)
         new = self.fs.write_file(self._object_path(bucket, key), data,
                                  mime=entry.attributes.mime)
+        if acl:
+            dd, _n = split_path(self._object_path(bucket, key))
+            self._store_acl(dd, new, acl)
         root = ET.Element("CopyObjectResult")
         ET.SubElement(root, "ETag").text = f'"{new.attributes.md5.hex()}"'
         ET.SubElement(root, "LastModified").text = _iso(new.attributes.mtime)
@@ -636,12 +748,14 @@ class S3Gateway:
     def _upload_dir(self, bucket: str, upload_id: str) -> str:
         return f"{self._bucket_dir(bucket)}/{UPLOADS_DIR}/{upload_id}"
 
-    def initiate_multipart(self, bucket, key):
+    def initiate_multipart(self, bucket, key, acl: str | None = None):
         self._require_bucket(bucket)
         upload_id = uuid.uuid4().hex
         d, n = split_path(self._upload_dir(bucket, upload_id))
         e = fpb.Entry(name=n, is_directory=True)
         e.extended["key"] = key.encode()
+        if acl:
+            e.extended["acl"] = acl.encode()
         self.fs.filer.create_entry(d, e)
         root = ET.Element("InitiateMultipartUploadResult")
         ET.SubElement(root, "Bucket").text = bucket
@@ -672,7 +786,7 @@ class S3Gateway:
     def complete_multipart(self, bucket, key, upload_id, body):
         self._check_quota(bucket)
         self._require_bucket(bucket)
-        self._find_upload(bucket, upload_id)
+        upload = self._find_upload(bucket, upload_id)
         updir = self._upload_dir(bucket, upload_id)
         req = ET.fromstring(body) if body else None
         wanted: list[int] | None = None
@@ -708,6 +822,8 @@ class S3Gateway:
         final.attributes.mime = "application/octet-stream"
         etag = f"{md5s.hexdigest()}-{len(order)}"
         final.extended["s3-etag"] = etag.encode()
+        if upload.extended.get("acl"):
+            final.extended["acl"] = upload.extended["acl"]
         self.fs.filer.create_entry(d, final)
         # drop staging metadata but never the chunks (now owned by `final`)
         pdir, pname = split_path(updir)

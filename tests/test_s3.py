@@ -656,3 +656,108 @@ def test_bucket_quota_enforcement(s3, filer_server):
     assert "QuotaExceeded" in r.text
     # reads still fine
     assert requests.get(f"{base}/quotabkt/a.txt", timeout=10).status_code == 200
+
+
+def test_acl_roundtrip(s3):
+    gw, base = s3
+    requests.put(f"{base}/aclbkt", timeout=10)
+    requests.put(f"{base}/aclbkt/obj.txt", data=b"acl", timeout=10)
+    # default: private
+    r = requests.get(f"{base}/aclbkt/obj.txt?acl", timeout=10)
+    assert r.status_code == 200
+    assert "FULL_CONTROL" in r.text and "AllUsers" not in r.text
+    # set public-read via canned header (object + bucket)
+    r = requests.put(f"{base}/aclbkt/obj.txt?acl",
+                     headers={"x-amz-acl": "public-read"}, timeout=10)
+    assert r.status_code == 200
+    r = requests.get(f"{base}/aclbkt/obj.txt?acl", timeout=10)
+    assert "AllUsers" in r.text and "<Permission>READ</Permission>" in r.text
+    r = requests.put(f"{base}/aclbkt?acl",
+                     headers={"x-amz-acl": "public-read-write"}, timeout=10)
+    assert r.status_code == 200
+    assert "WRITE" in requests.get(f"{base}/aclbkt?acl", timeout=10).text
+    # junk canned value rejected; missing object 404s
+    r = requests.put(f"{base}/aclbkt/obj.txt?acl",
+                     headers={"x-amz-acl": "world-domination"}, timeout=10)
+    assert r.status_code == 400
+    assert requests.get(f"{base}/aclbkt/nope?acl",
+                        timeout=10).status_code == 404
+    # grant-XML bodies fail loudly instead of silently collapsing
+    r = requests.put(f"{base}/aclbkt/obj.txt?acl",
+                     data=b"<AccessControlPolicy/>", timeout=10)
+    assert r.status_code == 501 and "NotImplemented" in r.text
+
+
+def test_acl_canned_header_on_write_paths(s3):
+    gw, base = s3
+    # bucket creation carries x-amz-acl
+    requests.put(f"{base}/aclwr", headers={"x-amz-acl": "public-read"},
+                 timeout=10)
+    assert "AllUsers" in requests.get(f"{base}/aclwr?acl", timeout=10).text
+    # plain object PUT carries x-amz-acl (aws s3 cp --acl public-read)
+    requests.put(f"{base}/aclwr/o.txt", data=b"x",
+                 headers={"x-amz-acl": "public-read"}, timeout=10)
+    assert "AllUsers" in requests.get(f"{base}/aclwr/o.txt?acl",
+                                      timeout=10).text
+    # multipart initiate ACL survives through complete
+    r = requests.post(f"{base}/aclwr/mp.bin?uploads",
+                      headers={"x-amz-acl": "public-read"}, timeout=10)
+    uid = r.text.split("<UploadId>")[1].split("</UploadId>")[0]
+    requests.put(f"{base}/aclwr/mp.bin?partNumber=1&uploadId={uid}",
+                 data=b"p" * 16, timeout=10)
+    r = requests.post(f"{base}/aclwr/mp.bin?uploadId={uid}", timeout=10)
+    assert r.status_code == 200
+    assert "AllUsers" in requests.get(f"{base}/aclwr/mp.bin?acl",
+                                      timeout=10).text
+    # junk canned value on a plain write path is rejected up front
+    r = requests.put(f"{base}/aclwr/bad.txt", data=b"x",
+                     headers={"x-amz-acl": "nope"}, timeout=10)
+    assert r.status_code == 400
+    # server-side copy carries (and validates) the canned header
+    r = requests.put(f"{base}/aclwr/copy.txt",
+                     headers={"x-amz-copy-source": "/aclwr/o.txt",
+                              "x-amz-acl": "public-read"}, timeout=10)
+    assert r.status_code == 200
+    assert "AllUsers" in requests.get(f"{base}/aclwr/copy.txt?acl",
+                                      timeout=10).text
+    r = requests.put(f"{base}/aclwr/copy2.txt",
+                     headers={"x-amz-copy-source": "/aclwr/o.txt",
+                              "x-amz-acl": "junk"}, timeout=10)
+    assert r.status_code == 400
+    # directory objects accept the header too
+    requests.put(f"{base}/aclwr/dir/", headers={"x-amz-acl": "public-read"},
+                 timeout=10)
+    assert "AllUsers" in requests.get(f"{base}/aclwr/dir/?acl",
+                                      timeout=10).text
+    # all six canned values round-trip distinguishably
+    seen = set()
+    for canned in ("private", "public-read", "public-read-write",
+                   "authenticated-read", "bucket-owner-read",
+                   "bucket-owner-full-control"):
+        requests.put(f"{base}/aclwr/o.txt?acl",
+                     headers={"x-amz-acl": canned}, timeout=10)
+        seen.add(requests.get(f"{base}/aclwr/o.txt?acl", timeout=10).text)
+    assert len(seen) == 6
+
+
+def test_acl_post_policy_field(s3):
+    gw, base = s3
+    requests.put(f"{base}/aclpp", timeout=10)
+    boundary = "xxbound"
+    parts = {"key": "form.txt", "acl": "public-read"}
+    body = b""
+    for k, v in parts.items():
+        body += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                 f'name="{k}"\r\n\r\n{v}\r\n').encode()
+    body += (f"--{boundary}\r\nContent-Disposition: form-data; "
+             f'name="file"; filename="f"\r\n\r\n').encode()
+    body += b"form-bytes\r\n" + f"--{boundary}--\r\n".encode()
+    r = requests.post(
+        f"{base}/aclpp", data=body,
+        headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        timeout=10)
+    assert r.status_code == 204, r.text
+    assert requests.get(f"{base}/aclpp/form.txt", timeout=10).content == \
+        b"form-bytes"
+    assert "AllUsers" in requests.get(f"{base}/aclpp/form.txt?acl",
+                                      timeout=10).text
