@@ -38,11 +38,13 @@ DEFAULT_INTERVAL_S = 17 * 60  # master_server.go:278 sleep_minutes default
 class AdminCron:
     def __init__(self, master_address: str, scripts: "list[str] | None" = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
-                 is_leader=lambda: True):
+                 is_leader=lambda: True,
+                 vacuum_enabled=lambda: True):
         self.master_address = master_address
         self.scripts = list(DEFAULT_SCRIPTS if scripts is None else scripts)
         self.interval_s = interval_s
         self.is_leader = is_leader
+        self.vacuum_enabled = vacuum_enabled
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._env = None
@@ -103,6 +105,9 @@ class AdminCron:
             return
         try:
             for line in self.scripts:
+                if line.startswith("volume.vacuum") and not self.vacuum_enabled():
+                    out.write(f"skipped (vacuum disabled): {line}\n")
+                    continue
                 try:
                     # renew the admin lease before each line: the master's
                     # lease expires after 60s (master_server.py LeaseAdminToken)

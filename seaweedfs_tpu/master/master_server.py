@@ -71,11 +71,16 @@ class MasterServer:
         self._stop = threading.Event()
         # Self-driving maintenance (reference startAdminScripts
         # master_server.go:269): [] disables, None -> repair/balance defaults.
+        # DisableVacuum/EnableVacuum RPC toggle: suppresses the cron's
+        # vacuum line only (reference command_volume_vacuum_disable.go:
+        # "volume.vacuum still works")
+        self.vacuum_disabled = False
         from .admin_cron import DEFAULT_INTERVAL_S, AdminCron
         self.admin_cron = AdminCron(
             self.address, scripts=maintenance_scripts,
             interval_s=maintenance_interval_s or DEFAULT_INTERVAL_S,
-            is_leader=lambda: self.is_leader)
+            is_leader=lambda: self.is_leader,
+            vacuum_enabled=lambda: not self.vacuum_disabled)
 
     @property
     def is_leader(self) -> bool:
@@ -492,6 +497,62 @@ class MasterServer:
             if cur and cur[0] == req.previous_token:
                 ms._admin_locks.pop(req.lock_name, None)
             return pb.ReleaseAdminTokenResponse()
+
+        # -- vacuum automation toggle (reference DisableVacuum/EnableVacuum
+        # RPCs; explicit `volume.vacuum` shell runs still work) -------------
+        @svc.unary("DisableVacuum", pb.DisableVacuumRequest,
+                   pb.DisableVacuumResponse)
+        def disable_vacuum(req, context):
+            ms.vacuum_disabled = True
+            return pb.DisableVacuumResponse()
+
+        @svc.unary("EnableVacuum", pb.EnableVacuumRequest,
+                   pb.EnableVacuumResponse)
+        def enable_vacuum(req, context):
+            ms.vacuum_disabled = False
+            return pb.EnableVacuumResponse()
+
+        # -- raft membership (reference RaftAddServer/RaftRemoveServer/
+        # RaftListClusterServers; command_cluster_raft_*.go) ----------------
+        @svc.unary("RaftAddServer", pb.RaftAddServerRequest,
+                   pb.RaftAddServerResponse)
+        def raft_add_server(req, context):
+            if ms.raft is None:
+                context.abort(12, "this master runs without raft")
+            if not ms.raft.is_leader:
+                context.abort(9, f"not the leader; try {ms.leader_address}")
+            if not ms.raft.add_server(req.address):
+                context.abort(10, "membership change did not commit")
+            ms.peers = list(ms.raft.cluster_members)
+            return pb.RaftAddServerResponse()
+
+        @svc.unary("RaftRemoveServer", pb.RaftRemoveServerRequest,
+                   pb.RaftRemoveServerResponse)
+        def raft_remove_server(req, context):
+            if ms.raft is None:
+                context.abort(12, "this master runs without raft")
+            if not ms.raft.is_leader:
+                context.abort(9, f"not the leader; try {ms.leader_address}")
+            if req.id not in ms.raft.cluster_members:
+                # members are keyed by address; a name that matches nothing
+                # must error, not silently commit an unchanged list
+                context.abort(5, f"{req.id!r} is not a member "
+                                 f"(members: {ms.raft.cluster_members})")
+            if not ms.raft.remove_server(req.id):
+                context.abort(10, "membership change did not commit")
+            ms.peers = list(ms.raft.cluster_members)
+            return pb.RaftRemoveServerResponse()
+
+        @svc.unary("RaftListClusterServers", pb.RaftListClusterServersRequest,
+                   pb.RaftListClusterServersResponse)
+        def raft_list_servers(req, context):
+            members = (ms.raft.cluster_members if ms.raft is not None
+                       else [ms.address])
+            return pb.RaftListClusterServersResponse(cluster_servers=[
+                pb.RaftListClusterServersResponse.ClusterServer(
+                    id=m, address=m, is_leader=(m == ms.leader_address),
+                    suffrage="Voter")
+                for m in members])
 
         @svc.unary("Ping", pb.PingRequest, pb.PingResponse)
         def ping(req, context):

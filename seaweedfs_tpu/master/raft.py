@@ -50,6 +50,11 @@ def _fold(state: dict, command: dict) -> dict:
     mvid = command.get("max_volume_id")
     if mvid:
         state["max_volume_id"] = max(state.get("max_volume_id", 0), mvid)
+    members = command.get("raft_members")
+    if members:
+        # membership rides the snapshot so a compacted log still tells a
+        # restarting/lagging node who the cluster is
+        state["_members"] = sorted(members)
     return state
 
 
@@ -62,6 +67,7 @@ class RaftNode:
                  rpc_timeout: float = 0.3):
         self.address = address
         self.peers = [p for p in peers if p != address]
+        self.cluster_members = sorted(set(list(peers) + [address]))
         self.apply_fn = apply_fn
         self.state_path = state_path
         self.election_timeout = election_timeout
@@ -76,19 +82,22 @@ class RaftNode:
         self.snapshot_state: dict = {}   # folded commands below log_start
         self.snapshot_term = 0      # term of entry log_start-1
         self._wal = None            # append handle for <state_path>.wal
-        self._load()
 
-        # volatile
+        # volatile — initialized BEFORE _load(): a loaded snapshot may
+        # carry a membership config that _apply_config folds into this
+        # state (role, election deadline, peer indices)
         self.role = FOLLOWER
         self.leader_address: str | None = None
-        self.commit_index = self.log_start - 1
-        self.last_applied = self.log_start - 1
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         self._quorum_seen = time.monotonic()
+        self._election_deadline = 0.0
+        self._removed = False       # self decommissioned via raft_members
+        self._load()
+        self.commit_index = self.log_start - 1
+        self.last_applied = self.log_start - 1
 
         self._lock = threading.RLock()
-        self._election_deadline = 0.0
         self._stop = threading.Event()
         self._commit_cv = threading.Condition(self._lock)
         self._thread: threading.Thread | None = None
@@ -146,6 +155,8 @@ class RaftNode:
                 if wal_start is not None:
                     self.log_start = wal_start
             if self.snapshot_state:
+                if self.snapshot_state.get("_members"):
+                    self._apply_config(self.snapshot_state["_members"])
                 self.apply_fn(dict(self.snapshot_state))
         except Exception as e:  # noqa: BLE001
             log.warning("raft state load: %s", e)
@@ -275,6 +286,11 @@ class RaftNode:
         return self.role == LEADER
 
     def _reset_election_timer(self) -> None:
+        if self._removed:
+            # a decommissioned node never campaigns again (not even after
+            # restart — _load replays the config that set the flag)
+            self._election_deadline = float("inf")
+            return
         lo, hi = self.election_timeout
         self._election_deadline = time.monotonic() + random.uniform(lo, hi)
 
@@ -438,10 +454,68 @@ class RaftNode:
             self.last_applied += 1
             try:
                 cmd = self._entry(self.last_applied).command
-                if cmd:
+                if cmd.get("raft_members"):
+                    new_members = set(cmd["raft_members"])
+                    if self.role == LEADER:
+                        # courtesy final append so removed peers learn of
+                        # their removal (and go quiet) instead of finding
+                        # out by silence
+                        for peer in [p for p in self.peers
+                                     if p not in new_members]:
+                            try:
+                                args = self._append_args_for(peer)
+                                args.pop("_ni")
+                                self._pool.submit(self._call, peer,
+                                                  "AppendEntries", args)
+                            except Exception:  # noqa: BLE001
+                                pass
+                    self._apply_config(cmd["raft_members"])
+                elif cmd:
                     self.apply_fn(cmd)
             except Exception as e:  # noqa: BLE001
                 log.error("raft apply %d: %s", self.last_applied, e)
+
+    # -- membership change (reference master.proto RaftAddServer/Remove;
+    # single-server change applied at commit like hashicorp AddVoter) -------
+    def _apply_config(self, members: list[str]) -> None:
+        """Adopt a committed membership list (caller holds lock, or is in
+        single-threaded _load)."""
+        members = sorted(set(members))
+        self.cluster_members = members
+        if self.address not in members:
+            # removed from the cluster: stop voting/campaigning entirely so
+            # a stale node can't disrupt the remaining quorum with elections
+            self.peers = []
+            self.role = FOLLOWER
+            self.leader_address = None
+            self._removed = True
+            self._election_deadline = float("inf")
+            log.info("%s: removed from raft cluster", self.address)
+            return
+        self._removed = False
+        self.peers = [m for m in members if m != self.address]
+        if self.role == LEADER:
+            n = self._last_index + 1
+            for p in self.peers:
+                self.next_index.setdefault(p, n)
+                self.match_index.setdefault(p, -1)
+        log.info("%s: raft membership now %s", self.address, members)
+
+    def add_server(self, address: str, timeout: float = 5.0) -> bool:
+        """Leader-only: commit a membership list including `address`. The
+        new node starts (or restarts) with any seed peer list — the leader
+        streams it the log/snapshot, whose config entry teaches it the
+        real membership."""
+        with self._lock:
+            members = set(self.cluster_members) | {address}
+        return self.propose({"raft_members": sorted(members)}, timeout)
+
+    def remove_server(self, address: str, timeout: float = 5.0) -> bool:
+        """Leader-only; removing the leader itself commits first, then the
+        leader steps down when the entry applies."""
+        with self._lock:
+            members = set(self.cluster_members) - {address}
+        return self.propose({"raft_members": sorted(members)}, timeout)
 
     def propose(self, command: dict, timeout: float = 5.0) -> bool:
         """Leader-only: append + replicate; returns True once committed."""
@@ -537,6 +611,22 @@ class RaftNode:
     # -- RPC handlers (any role) ---------------------------------------------
     def _on_request_vote(self, p: dict) -> dict:
         with self._lock:
+            now = time.monotonic()
+            leader_alive = (
+                (self.role == LEADER
+                 and now - self._quorum_seen < self.election_timeout[0])
+                or (self.role != LEADER and self.leader_address is not None
+                    and now < self._election_deadline))
+            if leader_alive and p["candidate"] not in self.cluster_members:
+                # Leader stickiness (hashicorp CheckQuorum analogue): while
+                # a live leader exists, a candidate outside our committed
+                # membership (removed, or not yet added) can't win or even
+                # bump our term — a stale removed node would otherwise
+                # depose the leader forever. With NO live leader we vote by
+                # the normal rules regardless of config view, else a
+                # cluster whose joiner hasn't applied the latest config
+                # entry could never elect anyone (liveness).
+                return {"term": self.current_term, "granted": False}
             if p["term"] > self.current_term:
                 self._become_follower(p["term"], None)
             granted = False
@@ -569,6 +659,8 @@ class RaftNode:
                 self.last_applied = max(self.last_applied,
                                         snap["last_index"])
                 if self.snapshot_state:
+                    if self.snapshot_state.get("_members"):
+                        self._apply_config(self.snapshot_state["_members"])
                     self.apply_fn(dict(self.snapshot_state))
                 self._persist()
             prev_idx = p["prev_log_index"]

@@ -149,7 +149,8 @@ def cmd_volume_mark(env: CommandEnv, args):
 
 
 def _safe_copy_volume(env: CommandEnv, vid: int, collection: str,
-                      src: dict, dst: dict, *, delete_source: bool) -> None:
+                      src: dict, dst: dict, *, delete_source: bool,
+                      disk_type: str = "") -> None:
     """Copy a volume src->dst with writes frozen for the duration.
 
     VolumeCopy streams .dat then .idx through separate CopyFile calls; an
@@ -171,7 +172,7 @@ def _safe_copy_volume(env: CommandEnv, vid: int, collection: str,
                       vpb.VolumeMarkReadonlyResponse)
     try:
         dst_stub.call("VolumeCopy", vpb.VolumeCopyRequest(
-            volume_id=vid, collection=collection,
+            volume_id=vid, collection=collection, disk_type=disk_type,
             source_data_node=env.grpc_addr(src["id"], src["grpc_port"])),
             vpb.VolumeCopyResponse, timeout=600)
     except Exception:
@@ -632,7 +633,122 @@ def cmd_volume_server_leave(env: CommandEnv, args):
 @command("cluster.raft.ps", "show raft quorum state")
 def cmd_cluster_raft_ps(env: CommandEnv, args):
     """Reference command_cluster_raft_ps.go."""
+    try:
+        resp = Stub(env.mc.leader, MASTER_SERVICE).call(
+            "RaftListClusterServers", mpb.RaftListClusterServersRequest(),
+            mpb.RaftListClusterServersResponse)
+        for s in resp.cluster_servers:
+            env.println(f"member: {s.address} {s.suffrage}"
+                        + (" (leader)" if s.is_leader else ""))
+        return
+    except Exception:  # noqa: BLE001 — pre-membership-RPC master
+        pass
     env.println(f"leader: {env.mc.leader}")
     for m in env.mc.masters:
         env.println(f"member: {m}" + (" (leader)"
                                       if m == env.mc.leader else ""))
+
+
+@command("cluster.raft.add", "-id name -address host:port: add a raft voter",
+         needs_lock=True)
+def cmd_cluster_raft_add(env: CommandEnv, args):
+    """Reference command_cluster_raft_add.go — single-server membership
+    change committed through the log; the new master may be started with
+    any seed peer list and learns the real membership from the leader."""
+    p = argparse.ArgumentParser(prog="cluster.raft.add")
+    p.add_argument("-id", dest="id", default="")
+    p.add_argument("-address", required=True)
+    opt = p.parse_args(args)
+    Stub(env.mc.leader, MASTER_SERVICE).call(
+        "RaftAddServer", mpb.RaftAddServerRequest(
+            id=opt.id or opt.address, address=opt.address),
+        mpb.RaftAddServerResponse)
+    env.println(f"added raft server {opt.address}")
+
+
+@command("cluster.raft.remove", "-id host:port: remove a raft member",
+         needs_lock=True)
+def cmd_cluster_raft_remove(env: CommandEnv, args):
+    """Reference command_cluster_raft_remove.go."""
+    p = argparse.ArgumentParser(prog="cluster.raft.remove")
+    p.add_argument("-id", dest="id", required=True)
+    opt = p.parse_args(args)
+    Stub(env.mc.leader, MASTER_SERVICE).call(
+        "RaftRemoveServer", mpb.RaftRemoveServerRequest(id=opt.id, force=True),
+        mpb.RaftRemoveServerResponse)
+    env.println(f"removed raft server {opt.id}")
+
+
+@command("volume.vacuum.disable", "pause the master's automated vacuum",
+         needs_lock=True)
+def cmd_volume_vacuum_disable(env: CommandEnv, args):
+    """Reference command_volume_vacuum_disable.go: stops the maintenance
+    cron's vacuum line; explicit `volume.vacuum` still works."""
+    Stub(env.mc.leader, MASTER_SERVICE).call(
+        "DisableVacuum", mpb.DisableVacuumRequest(), mpb.DisableVacuumResponse)
+    env.println("automated vacuum disabled")
+
+
+@command("volume.vacuum.enable", "resume the master's automated vacuum",
+         needs_lock=True)
+def cmd_volume_vacuum_enable(env: CommandEnv, args):
+    """Reference command_volume_vacuum_enable.go."""
+    Stub(env.mc.leader, MASTER_SERVICE).call(
+        "EnableVacuum", mpb.EnableVacuumRequest(), mpb.EnableVacuumResponse)
+    env.println("automated vacuum enabled")
+
+
+@command("volume.tier.move", "-fromDiskType hdd -toDiskType ssd "
+         "[-collection c] [-volumeId N]: migrate volumes between disk types",
+         needs_lock=True)
+def cmd_volume_tier_move(env: CommandEnv, args):
+    """Reference command_volume_tier_move.go: for every matching volume
+    sitting on a `fromDiskType` disk, copy it to a DIFFERENT server that
+    has a `toDiskType` disk, then delete the source copy. (VolumeCopy
+    refuses a same-server copy, so same-server cross-tier moves are not
+    supported.) The copy lands on the target tier because VolumeCopy
+    carries disk_type (volume_server.py handler picks the location by it)."""
+    p = argparse.ArgumentParser(prog="volume.tier.move")
+    p.add_argument("-fromDiskType", required=True)
+    p.add_argument("-toDiskType", required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-volumeId", type=int, default=0)
+    opt = p.parse_args(args)
+    if opt.fromDiskType == opt.toDiskType:
+        env.println("source and target disk types are the same; nothing to do")
+        return
+    servers = env.collect_volume_servers()
+    targets = [s for s in servers
+               if any(dt == opt.toDiskType for dt in s["disks"])]
+    if not targets:
+        env.println(f"no server has a {opt.toDiskType!r} disk")
+        return
+    # target-tier volume count per server, updated locally as moves land
+    # (re-collecting topology mid-sweep races heartbeat propagation)
+    load = {s["id"]: len(s["disks"][opt.toDiskType].volume_infos)
+            for s in targets if opt.toDiskType in s["disks"]}
+    moved = 0
+    for src in servers:
+        for dt, disk in src["disks"].items():
+            if dt != opt.fromDiskType:
+                continue
+            for v in list(disk.volume_infos):
+                if opt.volumeId and v.id != opt.volumeId:
+                    continue
+                if opt.collection and v.collection != opt.collection:
+                    continue
+                cands = [s for s in targets if s["id"] != src["id"]]
+                if not cands:
+                    env.println(f"  volume {v.id}: no other server has a "
+                                f"{opt.toDiskType!r} disk; skipped")
+                    continue
+                dst = min(cands, key=lambda s: load.get(s["id"], 0))
+                env.println(f"  moving volume {v.id} {src['id']}"
+                            f"({opt.fromDiskType}) -> {dst['id']}"
+                            f"({opt.toDiskType})")
+                _safe_copy_volume(env, v.id, v.collection, src, dst,
+                                  delete_source=True,
+                                  disk_type=opt.toDiskType)
+                load[dst["id"]] = load.get(dst["id"], 0) + 1
+                moved += 1
+    env.println(f"moved {moved} volume(s) to {opt.toDiskType}")

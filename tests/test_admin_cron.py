@@ -183,3 +183,33 @@ def test_cron_ec_encodes_full_volumes(cluster):
                msg="all shards registered")
     for fid, data in payloads.items():
         assert operation.read(mc, fid) == data
+
+
+def test_vacuum_disable_enable(cluster):
+    """volume.vacuum.disable pauses the cron's vacuum line only (reference
+    DisableVacuum RPC: explicit volume.vacuum still works); enable resumes."""
+    master, servers, mc, geo = cluster
+    env = CommandEnv(master.address, mc=mc, out=io.StringIO())
+    env.acquire_lock()
+    try:
+        run_command(env, "volume.vacuum.disable")
+        assert master.vacuum_disabled
+    finally:
+        run_command(env, "unlock")
+    old_scripts = master.admin_cron.scripts
+    master.admin_cron.scripts = ["volume.vacuum"]
+    try:
+        master.admin_cron.trigger()
+        assert "skipped (vacuum disabled)" in master.admin_cron.last_output
+        env.acquire_lock()
+        try:
+            # explicit vacuum still allowed while automation is off
+            run_command(env, "volume.vacuum")
+            run_command(env, "volume.vacuum.enable")
+        finally:
+            run_command(env, "unlock")
+        assert not master.vacuum_disabled
+        master.admin_cron.trigger()
+        assert "skipped" not in master.admin_cron.last_output
+    finally:
+        master.admin_cron.scripts = old_scripts

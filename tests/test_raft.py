@@ -229,3 +229,61 @@ def test_wal_persistence_and_torn_tail(tmp_path):
     assert n4.current_term == 7
     assert n4.log[0].command == {"max_volume_id": 9}
     n4.stop()
+
+
+class TestMembership:
+    """cluster.raft.add / cluster.raft.remove (reference
+    command_cluster_raft_add.go, command_cluster_raft_remove.go,
+    master RaftAddServer/RaftRemoveServer RPCs)."""
+
+    def test_add_server_learns_and_replicates(self, quorum, tmp_path):
+        leader = _wait_for_leader(quorum)
+        newport = _fp()
+        addr = f"127.0.0.1:{newport}"
+        # the joiner seeds only itself + one existing member; the config
+        # entry in the replicated log teaches it the real membership
+        joiner = MasterServer(port=newport, volume_size_limit_mb=64,
+                              peers=[addr, leader.address],
+                              raft_state_path=str(tmp_path / "raft-new.json"))
+        joiner.start()
+        try:
+            assert leader.raft.add_server(addr)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if set(joiner.raft.cluster_members) == \
+                        set(leader.raft.cluster_members) and \
+                        len(leader.raft.cluster_members) == 4:
+                    break
+                time.sleep(0.05)
+            assert len(leader.raft.cluster_members) == 4
+            assert set(joiner.raft.cluster_members) == \
+                set(leader.raft.cluster_members)
+            # state replicates to the joiner
+            assert leader.raft.propose({"max_volume_id": 77})
+            deadline = time.time() + 5
+            while time.time() < deadline and joiner.topo.max_volume_id < 77:
+                time.sleep(0.05)
+            assert joiner.topo.max_volume_id >= 77
+        finally:
+            joiner.stop()
+
+    def test_remove_follower_quiesces_it(self, quorum):
+        leader = _wait_for_leader(quorum)
+        time.sleep(0.3)
+        victim = next(m for m in quorum if m is not leader)
+        assert leader.raft.remove_server(victim.address)
+        assert victim.address not in leader.raft.cluster_members
+        # remaining pair still commits (quorum of 2)
+        assert leader.raft.propose({"max_volume_id": 99})
+        # the victim learns of its removal via the courtesy append and
+        # stops campaigning instead of disrupting the survivors
+        deadline = time.time() + 5
+        while time.time() < deadline and victim.raft.peers:
+            time.sleep(0.05)
+        assert victim.raft.peers == []
+        # survivors refuse votes to the removed node (no term bumps)
+        term_before = leader.raft.current_term
+        time.sleep(1.2)   # long enough for the victim to have campaigned
+        assert _wait_for_leader([m for m in quorum if m is not victim]) \
+            is leader
+        assert leader.raft.current_term == term_before

@@ -167,3 +167,75 @@ def test_volume_balance_and_fix_replication(cluster):
     for vs in servers:
         counts.append(sum(len(l.volumes) for l in vs.store.locations))
     assert max(counts) - min(counts) <= 1, counts
+
+
+def test_volume_tier_move(tmp_path):
+    """volume.tier.move migrates volumes between disk types (reference
+    command_volume_tier_move.go): the copy lands on the target tier via
+    VolumeCopy's disk_type and the source copy is deleted."""
+    from seaweedfs_tpu.client import operation
+
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3)
+    master.start()
+    servers = []
+    try:
+        for i, dt in enumerate(("hdd", "ssd")):
+            d = tmp_path / f"tier{i}"
+            d.mkdir()
+            port = free_port()
+            store = Store("127.0.0.1", port, "",
+                          [DiskLocation(str(d), disk_type=dt,
+                                        max_volume_count=10)],
+                          coder_name="numpy")
+            vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                              grpc_port=free_port(), pulse_seconds=0.3)
+            vs.start()
+            servers.append(vs)
+        import requests
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) < 2:
+            time.sleep(0.05)
+        for vs in servers:
+            while time.time() < deadline:
+                try:
+                    if requests.get(f"http://127.0.0.1:{vs.port}/status",
+                                    timeout=1).ok:
+                        break
+                except Exception:
+                    time.sleep(0.05)
+        mc = MasterClient(f"127.0.0.1:{mport}").start()
+        try:
+            res = operation.submit(mc, b"tiered payload")
+            vid = int(res.fid.split(",")[0])
+            hdd_vs, ssd_vs = servers
+            assert vid in hdd_vs.store.locations[0].volumes
+            out = io.StringIO()
+            env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=out)
+            env.acquire_lock()
+            run_command(env, "volume.tier.move -fromDiskType hdd"
+                             " -toDiskType ssd")
+            run_command(env, "unlock")
+            assert vid in ssd_vs.store.locations[0].volumes
+            assert vid not in hdd_vs.store.locations[0].volumes
+            # master learns the new holder on the next heartbeat; the
+            # blob stays readable through the normal lookup path
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                locs = master.topo.lookup(vid)
+                if locs and all(f"{ssd_vs.store.ip}:{ssd_vs.port}" ==
+                                loc.url for loc in locs):
+                    break
+                time.sleep(0.1)
+            mc.refresh_lookup(vid)
+            assert operation.read(mc, res.fid) == b"tiered payload"
+        finally:
+            mc.stop()
+    finally:
+        for vs in servers:
+            try:
+                vs.stop()
+            except Exception:
+                pass
+        master.stop()
