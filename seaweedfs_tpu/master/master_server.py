@@ -73,7 +73,10 @@ class MasterServer:
         # master_server.go:269): [] disables, None -> repair/balance defaults.
         # DisableVacuum/EnableVacuum RPC toggle: suppresses the cron's
         # vacuum line only (reference command_volume_vacuum_disable.go:
-        # "volume.vacuum still works")
+        # "volume.vacuum still works"). In-memory per-master, NOT raft-
+        # replicated or persisted — matching the reference, whose flag is
+        # a plain topology bool (topology.go:42 isDisableVacuum); operators
+        # re-disable after a failover.
         self.vacuum_disabled = False
         from .admin_cron import DEFAULT_INTERVAL_S, AdminCron
         self.admin_cron = AdminCron(

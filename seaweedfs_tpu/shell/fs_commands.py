@@ -597,3 +597,212 @@ def cmd_fs_log(env: CommandEnv, args):
         name = ev.new_entry.name or ev.old_entry.name
         env.println(f"{resp.ts_ns} {kind:7s} {resp.directory}/{name}")
     env.println(f"({len(tail)} events)")
+
+
+# -- chunk-rewriting maintenance commands ---------------------------------
+
+def _collect_volumes(env: CommandEnv) -> "tuple[dict, int]":
+    """{vid: VolumeInformationMessage} (first replica wins) + size limit."""
+    resp = env.mc.volume_list()
+    limit = (resp.volume_size_limit_mb or 30_000) << 20
+    vols: dict[int, object] = {}
+    for dc in resp.topology_info.data_center_infos:
+        for rack in dc.rack_infos:
+            for node in rack.data_node_infos:
+                for disk in node.disk_infos.values():
+                    for v in disk.volume_infos:
+                        vols.setdefault(v.id, v)
+    return vols, limit
+
+
+def _rewrite_chunks(env: CommandEnv, stub: Stub, directory: str,
+                    rewrite_fn, move_data: bool) -> "tuple[int, int]":
+    """Walk `directory`; for each file chunk, rewrite_fn(vid) -> new vid or
+    None. With move_data the blob is copied into the new volume under the
+    same key+cookie first (reference command_fs_merge_volumes.go moveChunk:
+    only the volume id changes, so the fid stays unique and cacheable).
+    Returns (chunks changed, failures)."""
+    from ..client import operation
+
+    changed_n = failed = 0
+    for path, e in _walk(stub, directory):
+        if e.is_directory or not e.chunks:
+            continue
+        if any(ch.is_chunk_manifest for ch in e.chunks):
+            env.println(f"  {path}: manifest-chunked file not supported; "
+                        "skipped")
+            continue
+        entry_changed = False
+        for ch in e.chunks:
+            vid, _, _ = parse_file_id(ch.file_id)
+            to_vid = rewrite_fn(vid)
+            if to_vid is None or to_vid == vid:
+                continue
+            to_fid = f"{to_vid},{ch.file_id.split(',', 1)[1]}"
+            try:
+                if move_data:
+                    data = operation.read(env.mc, ch.file_id)
+                    locs = env.mc.lookup(to_vid)
+                    if not locs:
+                        raise RuntimeError(f"volume {to_vid} has no location")
+                    operation.upload(f"{locs[0]['url']}/{to_fid}", data,
+                                     gzip_if_worthwhile=False,
+                                     jwt=env.mc.lookup_file_id_jwt(to_fid))
+                env.println(f"  {path}: {ch.file_id} -> {to_fid}")
+                ch.file_id = to_fid
+                entry_changed = True
+                changed_n += 1
+            except Exception as ex:  # noqa: BLE001 — keep sweeping
+                failed += 1
+                env.println(f"  failed {path} {ch.file_id}: {ex}")
+        if entry_changed:
+            d = path.rsplit("/", 1)[0] or "/"
+            stub.call("UpdateEntry",
+                      fpb.UpdateEntryRequest(directory=d, entry=e),
+                      fpb.UpdateEntryResponse)
+    return changed_n, failed
+
+
+@command("fs.merge.volumes", "[-dir /] [-collection '*'] [-fromVolumeId x] "
+         "[-toVolumeId y] [-apply]: re-locate chunks out of lighter volumes "
+         "so vacuum can clear them")
+def cmd_fs_merge_volumes(env: CommandEnv, args):
+    """Reference command_fs_merge_volumes.go: plan light->full merges among
+    compatible volumes (same collection/ttl/replication, projected size
+    within the limit), then rewrite chunk fids keeping key+cookie. The
+    filer's replaced-chunk GC deletes the old needles, after which the
+    light volumes are empty and vacuum/volume.delete.empty reclaims them."""
+    p = _fs_parser("fs.merge.volumes")
+    p.add_argument("-dir", default="/")
+    p.add_argument("-collection", default="*")
+    p.add_argument("-fromVolumeId", type=int, default=0)
+    p.add_argument("-toVolumeId", type=int, default=0)
+    p.add_argument("-apply", action="store_true")
+    opt = p.parse_args(args)
+    vols, limit = _collect_volumes(env)
+
+    def live(vid: int) -> int:
+        v = vols[vid]
+        return max(0, v.size - v.deleted_byte_count)
+
+    usable = sorted(
+        (vid for vid, v in vols.items()
+         if not v.read_only and live(vid) > 0
+         and (opt.collection == "*" or v.collection == opt.collection)),
+        key=live, reverse=True)
+    plan: dict[int, int] = {}
+    for i in range(len(usable) - 1, -1, -1):  # lightest volumes first
+        src = usable[i]
+        if opt.fromVolumeId and src != opt.fromVolumeId:
+            continue
+        for j in range(i):  # into the fullest compatible candidate
+            cand = usable[j]
+            if opt.toVolumeId and cand != opt.toVolumeId:
+                continue
+            sv, cv = vols[src], vols[cand]
+            if (sv.collection, sv.ttl, sv.replica_placement) != \
+                    (cv.collection, cv.ttl, cv.replica_placement):
+                continue
+            projected = live(cand) + live(src) + sum(
+                live(s) for s, d in plan.items() if d == cand)
+            if projected > limit:
+                continue
+            plan[src] = cand
+            break
+    if not plan:
+        env.println("no mergeable volumes")
+        return
+    for src, dst in sorted(plan.items()):
+        env.println(f"volume {src} ({live(src) >> 20} MB) "
+                    f"=> volume {dst} ({live(dst) >> 20} MB)")
+    if not opt.apply:
+        env.println("dry run; pass -apply to relocate chunks")
+        return
+    stub = _filer_stub(env, opt.filer)
+    moved, failed = _rewrite_chunks(env, stub, _abs(env, opt.dir),
+                                    plan.get, move_data=True)
+    env.println(f"moved {moved} chunk(s), {failed} failure(s)")
+
+
+@command("fs.meta.changeVolumeId", "-dir /path (-fromVolumeId x "
+         "-toVolumeId y | -mapping file) [-force]: rewrite chunk volume ids "
+         "in metadata")
+def cmd_fs_meta_change_volume_id(env: CommandEnv, args):
+    """Reference command_fs_meta_change_volume_id.go: metadata-only fixup
+    after volumes were physically renumbered/migrated out of band — no
+    blob data moves."""
+    p = _fs_parser("fs.meta.changeVolumeId")
+    p.add_argument("-dir", default="/")
+    p.add_argument("-fromVolumeId", type=int, default=0)
+    p.add_argument("-toVolumeId", type=int, default=0)
+    p.add_argument("-mapping", default="",
+                   help="file of lines 'x => y' (one change per line)")
+    p.add_argument("-force", action="store_true")
+    opt = p.parse_args(args)
+    mapping: dict[int, int] = {}
+    if opt.mapping:
+        with open(opt.mapping) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                a, _, b = line.partition("=>")
+                mapping[int(a.strip())] = int(b.strip())
+    else:
+        if not opt.fromVolumeId or not opt.toVolumeId:
+            env.println("need -mapping or -fromVolumeId/-toVolumeId")
+            return
+        if opt.fromVolumeId == opt.toVolumeId:
+            env.println("no volume id changes")
+            return
+        mapping[opt.fromVolumeId] = opt.toVolumeId
+    stub = _filer_stub(env, opt.filer)
+    if not opt.force:
+        n = 0
+        for path, e in _walk(stub, _abs(env, opt.dir)):
+            for ch in e.chunks:
+                vid, _, _ = parse_file_id(ch.file_id)
+                if vid in mapping:
+                    env.println(f"  would change {path}: {ch.file_id}")
+                    n += 1
+        env.println(f"dry run: {n} chunk(s); pass -force to apply")
+        return
+    changed, failed = _rewrite_chunks(env, stub, _abs(env, opt.dir),
+                                      mapping.get, move_data=False)
+    env.println(f"changed {changed} chunk(s), {failed} failure(s)")
+
+
+@command("fs.meta.notify", "[-dir /path] -queue spec: replay directory tree "
+         "metadata into a notification queue")
+def cmd_fs_meta_notify(env: CommandEnv, args):
+    """Reference command_fs_meta_notify.go: recursively send every entry
+    as a new-entry EventNotification so a downstream replicator can
+    bootstrap from existing state. Queue spec as in notification.toml
+    ('memory' is useless here; use 'logfile:/path' or 'mq:host:port')."""
+    from ..notification import open_queue
+
+    p = _fs_parser("fs.meta.notify")
+    p.add_argument("-dir", default="/")
+    p.add_argument("-queue", default="",
+                   help="notification spec; default from notification.toml")
+    opt = p.parse_args(args)
+    spec = opt.queue
+    if not spec:
+        from ..utils.config import load_config
+        spec = (load_config("notification") or {}).get("queue", "")
+    if not spec:
+        env.println("no queue: pass -queue or configure notification.toml")
+        return
+    q = open_queue(spec)
+    stub = _filer_stub(env, opt.filer)
+    dirs = files = 0
+    try:
+        for path, e in _walk(stub, _abs(env, opt.dir)):
+            q.send(path, fpb.EventNotification(new_entry=e))
+            if e.is_directory:
+                dirs += 1
+            else:
+                files += 1
+    finally:
+        q.close()
+    env.println(f"notified {dirs} directories, {files} files")

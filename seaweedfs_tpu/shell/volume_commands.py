@@ -637,6 +637,7 @@ def cmd_cluster_raft_ps(env: CommandEnv, args):
         resp = Stub(env.mc.leader, MASTER_SERVICE).call(
             "RaftListClusterServers", mpb.RaftListClusterServersRequest(),
             mpb.RaftListClusterServersResponse)
+        env.println(f"leader: {env.mc.leader}")
         for s in resp.cluster_servers:
             env.println(f"member: {s.address} {s.suffrage}"
                         + (" (leader)" if s.is_leader else ""))
@@ -727,6 +728,7 @@ def cmd_volume_tier_move(env: CommandEnv, args):
     # (re-collecting topology mid-sweep races heartbeat propagation)
     load = {s["id"]: len(s["disks"][opt.toDiskType].volume_infos)
             for s in targets if opt.toDiskType in s["disks"]}
+    moved_to: dict[str, set] = {}  # dst id -> vids landed this sweep
     moved = 0
     for src in servers:
         for dt, disk in src["disks"].items():
@@ -737,18 +739,30 @@ def cmd_volume_tier_move(env: CommandEnv, args):
                     continue
                 if opt.collection and v.collection != opt.collection:
                     continue
-                cands = [s for s in targets if s["id"] != src["id"]]
+                # exclude the source AND any server already holding a copy
+                # of vid on any tier (replicated volumes, or a prior sweep
+                # iteration) — VolumeCopy aborts on "already here"
+                holders = {h["id"] for h in _volume_holders(env, v.id)}
+                holders.update(s_id for s_id, vids in moved_to.items()
+                               if v.id in vids)
+                cands = [s for s in targets
+                         if s["id"] != src["id"] and s["id"] not in holders]
                 if not cands:
-                    env.println(f"  volume {v.id}: no other server has a "
-                                f"{opt.toDiskType!r} disk; skipped")
+                    env.println(f"  volume {v.id}: no eligible "
+                                f"{opt.toDiskType!r} server; skipped")
                     continue
                 dst = min(cands, key=lambda s: load.get(s["id"], 0))
                 env.println(f"  moving volume {v.id} {src['id']}"
                             f"({opt.fromDiskType}) -> {dst['id']}"
                             f"({opt.toDiskType})")
-                _safe_copy_volume(env, v.id, v.collection, src, dst,
-                                  delete_source=True,
-                                  disk_type=opt.toDiskType)
+                try:
+                    _safe_copy_volume(env, v.id, v.collection, src, dst,
+                                      delete_source=True,
+                                      disk_type=opt.toDiskType)
+                except Exception as e:  # noqa: BLE001 — keep sweeping
+                    env.println(f"  volume {v.id}: move failed: {e}")
+                    continue
+                moved_to.setdefault(dst["id"], set()).add(v.id)
                 load[dst["id"]] = load.get(dst["id"], 0) + 1
                 moved += 1
     env.println(f"moved {moved} volume(s) to {opt.toDiskType}")

@@ -404,3 +404,107 @@ def test_remote_shell_commands(env, stack, tmp_path):
     text = _run(env, "remote.unmount -dir /cloud")
     assert "unmounted" in text
     assert fs.filer.find_entry("/", "cloud") is None
+
+
+def _sh(env, out, line):
+    out.truncate(0)
+    out.seek(0)
+    run_command(env, line)
+    return out.getvalue()
+
+
+def test_fs_meta_notify(env, stack, tmp_path):
+    """fs.meta.notify replays the tree into a notification queue
+    (reference command_fs_meta_notify.go)."""
+    from seaweedfs_tpu.notification import LogFileQueue
+
+    e, out = env
+    log_path = tmp_path / "notify.log"
+    got = _sh(e, out, f"fs.meta.notify -dir /docs -queue logfile:{log_path}")
+    assert "files" in got
+    keys = {rec.directory for _, rec in LogFileQueue(str(log_path)).read()}
+    assert "/docs/report.txt" in keys
+    assert "/docs/sub/data.bin" in keys
+
+
+def test_fs_meta_change_volume_id(env, stack):
+    """fs.meta.changeVolumeId rewrites chunk fids per mapping, metadata
+    only (reference command_fs_meta_change_volume_id.go)."""
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    from seaweedfs_tpu.storage.types import parse_file_id
+
+    e, out = env
+    fs = stack["fs"]
+    fs.write_file("/reloc/a.bin", b"x" * 512)
+    entry = fs.filer.find_entry("/reloc", "a.bin")
+    vid = parse_file_id(entry.chunks[0].file_id)[0]
+    # dry run changes nothing
+    got = _sh(e, out, f"fs.meta.changeVolumeId -dir /reloc "
+                      f"-fromVolumeId {vid} -toVolumeId {vid + 100}")
+    assert "dry run" in got
+    entry = fs.filer.find_entry("/reloc", "a.bin")
+    assert parse_file_id(entry.chunks[0].file_id)[0] == vid
+    got = _sh(e, out, f"fs.meta.changeVolumeId -dir /reloc "
+                      f"-fromVolumeId {vid} -toVolumeId {vid + 100} -force")
+    entry = fs.filer.find_entry("/reloc", "a.bin")
+    assert parse_file_id(entry.chunks[0].file_id)[0] == vid + 100
+    # revert so later tests still read their blobs
+    _sh(e, out, f"fs.meta.changeVolumeId -dir /reloc "
+                f"-fromVolumeId {vid + 100} -toVolumeId {vid} -force")
+
+
+def test_fs_merge_volumes(env, stack):
+    """fs.merge.volumes relocates chunks from a light volume into a fuller
+    compatible one and the file stays readable (reference
+    command_fs_merge_volumes.go)."""
+    from seaweedfs_tpu.storage.types import parse_file_id
+
+    e, out = env
+    fs = stack["fs"]
+    ms = stack["ms"]
+    # dedicated collection: module-fixture siblings mutate the default
+    # collection's volumes (replication/readonly), breaking compatibility
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    mc = MasterClient(ms.address).start()
+    try:
+        big = operation.submit(mc, b"B" * 40960, collection="mergecol")
+        vid_big = int(big.fid.split(",")[0])
+        from seaweedfs_tpu.master.volume_growth import GrowRequest
+        ms.growth.grow(GrowRequest(collection="mergecol",
+                                   replication=ms.default_replication,
+                                   ttl="", disk_type="hdd", count=1))
+        small_fid = None
+        for _ in range(40):
+            a = mc.assign(collection="mergecol")
+            if int(a.fid.split(",")[0]) != vid_big:
+                operation.upload(f"{a.location.url}/{a.fid}",
+                                 b"small chunk", jwt=a.auth)
+                small_fid = a.fid
+                break
+        if small_fid is None:
+            import pytest
+            pytest.skip("could not get a second volume")
+        vid_small = int(small_fid.split(",")[0])
+        fs.filer.create_entry("/merge", _entry_with_chunk(
+            "big.bin", big.fid, 40960))
+        fs.filer.create_entry("/merge", _entry_with_chunk(
+            "small.bin", small_fid, len(b"small chunk")))
+        time.sleep(1.2)  # heartbeat: sizes reach the master
+        got = _sh(e, out, "fs.merge.volumes -dir /merge -collection mergecol")
+        assert f"=> volume {vid_big}" in got, got
+        got = _sh(e, out,
+                  "fs.merge.volumes -dir /merge -collection mergecol -apply")
+        entry = fs.filer.find_entry("/merge", "small.bin")
+        new_vid = parse_file_id(entry.chunks[0].file_id)[0]
+        assert new_vid == vid_big, got
+        assert operation.read(mc, entry.chunks[0].file_id) == b"small chunk"
+    finally:
+        mc.stop()
+
+
+def _entry_with_chunk(name, fid, size):
+    from seaweedfs_tpu.pb import filer_pb2 as fpb
+    return fpb.Entry(name=name, is_directory=False, chunks=[
+        fpb.FileChunk(file_id=fid, offset=0, size=size)],
+        attributes=fpb.FuseAttributes(file_size=size, file_mode=0o644))
