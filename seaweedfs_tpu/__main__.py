@@ -309,25 +309,38 @@ def run_s3_standalone(argv):
             print("s3: identities loaded from filer /etc/iam/identity.json",
                   file=sys.stderr)
 
-    if not opt.config:
-        # IAM-managed credentials live in the filer; load now and hot-reload
-        # on changes (reference auth_credentials_subscribe.go)
+    def _load_circuit_breaker():
+        entry = fc.filer.find_entry("/etc/s3", "circuit_breaker.json")
+        if entry is not None:
+            gw.breaker.load(_json.loads(fc.read_entry_bytes(entry)))
+            print("s3: circuit breaker loaded from filer "
+                  "/etc/s3/circuit_breaker.json", file=sys.stderr)
+
+    # cluster config lives in the filer and hot-reloads on change
+    # (reference auth_credentials_subscribe.go + s3api_circuit_breaker.go);
+    # each loader fails independently so a bad identity file can't leave
+    # the breaker silently disabled (or vice versa)
+    def _load_all(stage: str):
+        if not opt.config:
+            try:
+                _load_filer_identities()
+            except Exception as e:  # noqa: BLE001
+                print(f"s3: identity {stage}: {e}", file=sys.stderr)
         try:
-            _load_filer_identities()
+            _load_circuit_breaker()
         except Exception as e:  # noqa: BLE001
-            print(f"s3: identity load: {e}", file=sys.stderr)
+            print(f"s3: circuit breaker {stage}: {e}", file=sys.stderr)
 
-        def _watch():
-            stop = _threading.Event()
-            for resp in fc.filer.subscribe(time.time_ns(), stop,
-                                           path_prefix="/etc/iam"):
-                try:
-                    _load_filer_identities()
-                except Exception as e:  # noqa: BLE001
-                    print(f"s3: identity reload: {e}", file=sys.stderr)
+    _load_all("load")
 
-        _threading.Thread(target=_watch, daemon=True,
-                          name="s3-iam-watch").start()
+    def _watch():
+        stop = _threading.Event()
+        for resp in fc.filer.subscribe(time.time_ns(), stop,
+                                       path_prefix="/etc"):
+            _load_all("reload")
+
+    _threading.Thread(target=_watch, daemon=True,
+                      name="s3-conf-watch").start()
     gw.start()
     _wait_forever()
 
@@ -718,8 +731,17 @@ def run_mount(argv):
                      client_name="mount")
     wfs = WeedFS(fc, chunk_size_mb=opt.chunkSizeLimitMB,
                  concurrency=opt.concurrentWriters)
-    print(f"mounting {opt.filer} at {opt.dir} (unmount: fusermount -u)")
-    code = fuse_loop(wfs, opt.dir, allow_other=opt.allowOther)
+    # local control socket for `shell mount.configure` (reference dials
+    # /tmp/seaweedfs-mount-<hash>.sock, command_mount_configure.go)
+    from .mount.control import mount_socket_path, serve_mount_control
+    sock_path = mount_socket_path(opt.dir)
+    stop_ctl = serve_mount_control(wfs, sock_path)
+    print(f"mounting {opt.filer} at {opt.dir} (unmount: fusermount -u; "
+          f"control: {sock_path})")
+    try:
+        code = fuse_loop(wfs, opt.dir, allow_other=opt.allowOther)
+    finally:
+        stop_ctl()
     wfs.destroy()
     sys.exit(code)
 

@@ -60,6 +60,10 @@ class MasterServer:
         # (reference master_grpc_server_assign.go JWT minting).
         self.guard = guard
         self._subscribers: dict[int, tuple[str, queue.Queue]] = {}
+        # sid -> (address, client_type, version, created_at_ns): the
+        # cluster membership ListClusterNodes reports (reference
+        # cluster.go:104 tracks filers/brokers the same way)
+        self._sub_meta: dict[int, tuple[str, str, str, int]] = {}
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
         self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
@@ -327,6 +331,9 @@ class MasterServer:
                 ms._sub_seq += 1
                 sid = ms._sub_seq
                 ms._subscribers[sid] = (first.client_address, q)
+                ms._sub_meta[sid] = (first.client_address,
+                                     first.client_type or "client",
+                                     first.version, time.time_ns())
             log.info("client %s (%s) subscribed", first.client_address,
                      first.client_type)
             try:
@@ -355,6 +362,7 @@ class MasterServer:
             finally:
                 with ms._sub_lock:
                     ms._subscribers.pop(sid, None)
+                    ms._sub_meta.pop(sid, None)
 
         @svc.unary("Assign", pb.AssignRequest, pb.AssignResponse)
         def assign(req, context):
@@ -556,6 +564,19 @@ class MasterServer:
                     id=m, address=m, is_leader=(m == ms.leader_address),
                     suffrage="Voter")
                 for m in members])
+
+        @svc.unary("ListClusterNodes", pb.ListClusterNodesRequest,
+                   pb.ListClusterNodesResponse)
+        def list_cluster_nodes(req, context):
+            """Reference cluster.go ListClusterNodes: live filers/brokers
+            (anything holding a KeepConnected stream) by client type."""
+            with ms._sub_lock:
+                metas = list(ms._sub_meta.values())
+            return pb.ListClusterNodesResponse(cluster_nodes=[
+                pb.ListClusterNodesResponse.ClusterNode(
+                    address=addr, version=ver, created_at_ns=ts)
+                for addr, ctype, ver, ts in metas
+                if not req.client_type or ctype == req.client_type])
 
         @svc.unary("Ping", pb.PingRequest, pb.PingResponse)
         def ping(req, context):

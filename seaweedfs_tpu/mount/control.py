@@ -1,0 +1,89 @@
+"""Local control socket for a live kernel mount.
+
+Reference: `weed shell mount.configure` dials the mount process over a
+unix socket derived from the mount directory
+(command_mount_configure.go: /tmp/seaweedfs-mount-<hash>.sock) and calls
+the mount_pb Configure RPC (CollectionCapacity quota). Same shape here
+with newline-delimited JSON instead of gRPC — the socket only ever
+carries one tiny local RPC.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+
+
+def mount_socket_path(mount_dir: str) -> str:
+    """Stable per-mountpoint socket path (reference HashToInt32 of the
+    dir; any stable digest works as long as shell and mount agree)."""
+    h = hashlib.md5(os.path.abspath(mount_dir).encode()).hexdigest()[:12]
+    return f"/tmp/swtpu-mount-{h}.sock"
+
+
+def serve_mount_control(wfs, sock_path: str):
+    """Listen for {"collection_capacity": N} lines; apply to the live
+    WeedFS. Returns a stop() closure."""
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(2)
+    stop_flag = threading.Event()
+
+    def loop():
+        while not stop_flag.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    conn.settimeout(5.0)  # a silent client must not wedge
+                    line = conn.makefile("rb").readline()
+                    req = json.loads(line or b"{}")
+                    if "collection_capacity" in req:
+                        wfs.configure(req["collection_capacity"])
+                    resp = {"ok": True,
+                            "collection_capacity": wfs.collection_capacity}
+                except Exception as e:  # noqa: BLE001
+                    resp = {"ok": False, "error": str(e)}
+                try:
+                    conn.sendall(json.dumps(resp).encode() + b"\n")
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=loop, daemon=True, name="mount-control")
+    t.start()
+
+    def stop():
+        stop_flag.set()
+        try:
+            srv.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(sock_path)
+        except FileNotFoundError:
+            pass
+
+    return stop
+
+
+def configure_mount(mount_dir: str, collection_capacity: int) -> dict:
+    """Client side (the shell command): one request/response."""
+    path = mount_socket_path(mount_dir)
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.settimeout(5.0)
+    try:
+        c.connect(path)
+        c.sendall(json.dumps(
+            {"collection_capacity": collection_capacity}).encode() + b"\n")
+        return json.loads(c.makefile("rb").readline() or b"{}")
+    finally:
+        c.close()

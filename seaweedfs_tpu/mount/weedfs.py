@@ -56,6 +56,9 @@ class WeedFS:
         self._handles: dict[int, FileHandle] = {}
         self._next_fh = 2
         self._lock = threading.Lock()
+        # mount.configure quota (reference mount_pb ConfigureRequest
+        # CollectionCapacity): 0 = unlimited; reported via statfs
+        self.collection_capacity = 0
 
     # -- helpers -------------------------------------------------------------
     @staticmethod
@@ -261,10 +264,44 @@ class WeedFS:
                 h.size = length
                 h.entry = updated
 
+    def configure(self, collection_capacity: int) -> None:
+        """mount.configure RPC body (reference weedfs_grpc_server.go
+        Configure): adjust the quota on a live mount."""
+        self.collection_capacity = max(0, int(collection_capacity))
+        self._usage_cached_at = 0.0  # force re-measure on next statfs
+
+    _usage_cached_at = 0.0
+    _usage_cached = 0
+    USAGE_TTL_S = 5.0  # statfs is a kernel hot path; don't walk per call
+
     def statfs(self) -> dict:
+        if self.collection_capacity:
+            import time as _time
+            bsize = self.chunk_size
+            blocks = max(1, self.collection_capacity // bsize)
+            now = _time.monotonic()
+            if now - self._usage_cached_at > self.USAGE_TTL_S:
+                try:
+                    self._usage_cached = sum(
+                        (e.attributes.file_size or 0)
+                        for _, e in self._walk_all("/"))
+                    self._usage_cached_at = now
+                except Exception:  # noqa: BLE001 — quota display best-effort
+                    pass
+            free = max(0, blocks - self._usage_cached // bsize)
+            return {"f_bsize": bsize, "f_blocks": blocks,
+                    "f_bfree": free, "f_bavail": free,
+                    "f_files": 1 << 20, "f_ffree": 1 << 20}
         return {"f_bsize": self.chunk_size, "f_blocks": 1 << 30,
                 "f_bfree": 1 << 30, "f_bavail": 1 << 30,
                 "f_files": 1 << 20, "f_ffree": 1 << 20}
+
+    def _walk_all(self, directory: str):
+        for e in self.meta.list(directory):
+            path = (directory.rstrip("/") + "/" + e.name)
+            yield path, e
+            if e.is_directory:
+                yield from self._walk_all(path)
 
     def forget(self, inode: int, nlookup: int = 1) -> None:
         self.inodes.forget(inode, nlookup)

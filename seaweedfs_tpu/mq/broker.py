@@ -393,6 +393,29 @@ class BrokerServer:
                 a.partition.ring_size = p.ring_size
             return resp
 
+        @svc.unary("BalanceTopics", mq.BalanceTopicsRequest,
+                   mq.BalanceTopicsResponse)
+        def balance_topics(req, ctx):
+            """Reference mq.proto BalanceTopics (shell mq.balance): re-derive
+            every topic's partition ring from its configured count — healing
+            any drift — and report the resulting assignment. Ownership stays
+            deterministic over the ring (broker docstring), so no partition
+            hand-off messages are needed."""
+            resp = mq.BalanceTopicsResponse()
+            with broker._lock:  # one lock span: a concurrent
+                # ConfigureTopic must not be reverted from a stale snapshot
+                for full in sorted(broker.topics):
+                    rebuilt = split_ring(len(broker.topics[full]))
+                    broker.topics[full] = rebuilt
+                    ns, _, name = full.partition(".")
+                    a = resp.assignments.add()
+                    a.topic.namespace, a.topic.name = ns, name
+                    for p in rebuilt:
+                        a.partitions.add(range_start=p.range_start,
+                                         range_stop=p.range_stop,
+                                         ring_size=p.ring_size)
+            return resp
+
         @svc.unary("ListTopics", mq.ListTopicsRequest, mq.ListTopicsResponse)
         def list_topics(req, ctx):
             resp = mq.ListTopicsResponse()

@@ -30,13 +30,20 @@ class ErrTooManyRequests(S3Error):
 
 class CircuitBreaker:
     def __init__(self, config: "dict | None" = None):
-        config = config or {}
-        self.global_limits: dict[str, int] = dict(config.get("global", {}))
-        self.bucket_limits: dict[str, dict[str, int]] = {
-            b: dict(v) for b, v in (config.get("buckets") or {}).items()}
-        self.enabled = bool(self.global_limits or self.bucket_limits)
         self._lock = threading.Lock()
         self._inflight: dict[tuple[str, str], int] = {}  # (scope, action)
+        self.load(config)
+
+    def load(self, config: "dict | None") -> None:
+        """(Re)apply a config — hot-reloaded from the filer at
+        /etc/s3/circuit_breaker.json (reference s3api_circuit_breaker.go
+        subscribes to the same path). In-flight counters survive."""
+        config = config or {}
+        with self._lock:
+            self.global_limits = dict(config.get("global", {}))
+            self.bucket_limits = {
+                b: dict(v) for b, v in (config.get("buckets") or {}).items()}
+            self.enabled = bool(self.global_limits or self.bucket_limits)
 
     @contextmanager
     def acquire(self, action: str, bucket: str):

@@ -806,3 +806,141 @@ def cmd_fs_meta_notify(env: CommandEnv, args):
     finally:
         q.close()
     env.println(f"notified {dirs} directories, {files} files")
+
+
+# -- s3 cluster configuration (stored in the filer, hot-reloaded) ---------
+
+IAM_DIR, IAM_FILE = "/etc/iam", "identity.json"
+CB_DIR, CB_FILE = "/etc/s3", "circuit_breaker.json"
+
+
+def _read_filer_json(env: CommandEnv, opt_filer: str, d: str, n: str) -> dict:
+    import json
+
+    from ..client.filer_client import FilerClient
+    fc = FilerClient(_filer_addr(env, opt_filer))
+    entry = fc.filer.find_entry(d, n)
+    if entry is None:
+        return {}
+    return json.loads(fc.read_entry_bytes(entry) or b"{}")
+
+
+def _write_filer_json(env: CommandEnv, opt_filer: str, d: str, n: str,
+                      obj: dict) -> None:
+    import json
+
+    from ..client.filer_client import FilerClient
+    fc = FilerClient(_filer_addr(env, opt_filer))
+    fc.write_file(f"{d}/{n}", json.dumps(obj, indent=2).encode(),
+                  mime="application/json")
+
+
+@command("s3.configure", "[-user u] [-access_key ak -secret_key sk] "
+         "[-actions Read,Write[:bucket]] [-buckets b1,b2] [-delete] "
+         "[-apply]: manage S3 identities stored in the filer")
+def cmd_s3_configure(env: CommandEnv, args):
+    """Reference command_s3_configure.go: edits /etc/iam/identity.json in
+    the filer; running S3 gateways hot-reload it (standalone s3 verb
+    subscribes to /etc). Without -apply, prints the resulting config."""
+    import json
+
+    p = _fs_parser("s3.configure")
+    p.add_argument("-user", default="")
+    p.add_argument("-access_key", default="")
+    p.add_argument("-secret_key", default="")
+    p.add_argument("-actions", default="",
+                   help="comma list: Read,Write,List,Tagging,Admin, "
+                        "optionally scoped Action:bucket")
+    p.add_argument("-buckets", default="",
+                   help="scope every -actions entry to these buckets")
+    p.add_argument("-delete", action="store_true")
+    p.add_argument("-apply", action="store_true")
+    opt = p.parse_args(args)
+    conf = _read_filer_json(env, opt.filer, IAM_DIR, IAM_FILE)
+    idents = conf.setdefault("identities", [])
+    if opt.user:
+        ident = next((i for i in idents if i.get("name") == opt.user), None)
+        if opt.delete:
+            if ident is None:
+                env.println(f"user {opt.user!r} not found")
+                return
+            idents.remove(ident)
+        else:
+            if ident is None:
+                ident = {"name": opt.user, "credentials": [], "actions": []}
+                idents.append(ident)
+            if opt.access_key:
+                ident.setdefault("credentials", [])
+                cred = {"accessKey": opt.access_key,
+                        "secretKey": opt.secret_key}
+                ident["credentials"] = [
+                    c for c in ident["credentials"]
+                    if c.get("accessKey") != opt.access_key] + [cred]
+            if opt.actions:
+                actions = [a.strip() for a in opt.actions.split(",")
+                           if a.strip()]
+                if opt.buckets:
+                    actions = [f"{a}:{b.strip()}"
+                               for a in actions
+                               for b in opt.buckets.split(",") if b.strip()]
+                ident["actions"] = sorted(set(ident.get("actions", []))
+                                          | set(actions))
+    env.println(json.dumps(conf, indent=2))
+    if not opt.apply:
+        env.println("dry run; pass -apply to save")
+        return
+    _write_filer_json(env, opt.filer, IAM_DIR, IAM_FILE, conf)
+    env.println(f"saved {IAM_DIR}/{IAM_FILE}")
+
+
+@command("s3.circuitbreaker", "[-global] [-buckets b1,b2] "
+         "[-actions Read,Write] [-countLimit N] [-disable] [-apply]: "
+         "manage the S3 concurrent-request breaker config")
+def cmd_s3_circuitbreaker(env: CommandEnv, args):
+    """Reference command_s3_circuitbreaker.go: edits
+    /etc/s3/circuit_breaker.json in the filer; gateways hot-reload it.
+    Limits are concurrent in-flight requests per action; exceeding one
+    returns 503 SlowDown (s3/circuit_breaker.py)."""
+    import json
+
+    p = _fs_parser("s3.circuitbreaker")
+    p.add_argument("-global", dest="global_", action="store_true",
+                   help="apply -countLimit to the global scope")
+    p.add_argument("-buckets", default="",
+                   help="apply -countLimit to these buckets")
+    p.add_argument("-actions", default="Read,Write",
+                   help="actions to limit (Read,Write,List,Admin)")
+    p.add_argument("-countLimit", type=int, default=0)
+    p.add_argument("-disable", action="store_true",
+                   help="remove the selected limits")
+    p.add_argument("-apply", action="store_true")
+    opt = p.parse_args(args)
+    conf = _read_filer_json(env, opt.filer, CB_DIR, CB_FILE)
+    actions = [a.strip() for a in opt.actions.split(",") if a.strip()]
+    if opt.global_:
+        g = conf.setdefault("global", {})
+        for a in actions:
+            if opt.disable:
+                g.pop(a, None)
+            elif opt.countLimit:
+                g[a] = opt.countLimit
+    for b in [b.strip() for b in opt.buckets.split(",") if b.strip()]:
+        bl = conf.setdefault("buckets", {}).setdefault(b, {})
+        for a in actions:
+            if opt.disable:
+                bl.pop(a, None)
+            elif opt.countLimit:
+                bl[a] = opt.countLimit
+    # prune empty scopes so 'disabled' really disables
+    conf["buckets"] = {b: v for b, v in (conf.get("buckets") or {}).items()
+                       if v}
+    if not conf.get("buckets"):
+        conf.pop("buckets", None)
+    if not conf.get("global"):
+        conf.pop("global", None)
+    env.println(json.dumps(conf, indent=2) if conf else "(breaker disabled)")
+    if not opt.apply:
+        env.println("dry run; pass -apply to save")
+        return
+    _write_filer_json(env, opt.filer, CB_DIR, CB_FILE, conf)
+    env.println(f"saved {CB_DIR}/{CB_FILE}")

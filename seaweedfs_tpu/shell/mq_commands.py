@@ -13,11 +13,33 @@ from .commands import CommandEnv, command
 MQ_SERVICE = "swtpu.mq.Broker"
 
 
+def _broker_addr(env: CommandEnv, opt_broker: str) -> str:
+    """One resolution chain for every mq command: explicit flag, shell
+    option, then master-cluster discovery."""
+    return opt_broker or env.option.get("broker", "") or _find_broker(env)
+
+
 def _broker_stub(env: CommandEnv, opt_broker: str) -> Stub:
-    addr = opt_broker or env.option.get("broker", "")
+    addr = _broker_addr(env, opt_broker)
     if not addr:
         raise RuntimeError("no broker configured; pass -broker host:port")
     return Stub(addr, MQ_SERVICE)
+
+
+def _find_broker(env: CommandEnv) -> str:
+    """Discover a live broker from the master cluster list (reference
+    findBrokerBalancer: brokers register via KeepConnected)."""
+    from ..pb import master_pb2 as mpb
+    from ..utils.rpc import MASTER_SERVICE
+    try:
+        resp = Stub(env.mc.leader, MASTER_SERVICE).call(
+            "ListClusterNodes",
+            mpb.ListClusterNodesRequest(client_type="broker"),
+            mpb.ListClusterNodesResponse)
+        nodes = sorted(resp.cluster_nodes, key=lambda n: n.created_at_ns)
+        return nodes[0].address if nodes else ""
+    except Exception:  # noqa: BLE001
+        return ""
 
 
 def _mq_parser(prog: str) -> argparse.ArgumentParser:
@@ -69,3 +91,21 @@ def cmd_mq_topic_configure(env: CommandEnv, args):
                   partition_count=opt.partitions),
               mq.ConfigureTopicResponse)
     env.println(f"configured {opt.topic} with {opt.partitions} partitions")
+
+
+@command("mq.balance", "re-derive topic partition assignments on the broker")
+def cmd_mq_balance(env: CommandEnv, args):
+    """Reference command_mq_balance.go: find the balancer broker via the
+    master cluster list, trigger BalanceTopics, print the assignment."""
+    opt = _mq_parser("mq.balance").parse_args(args)
+    addr = _broker_addr(env, opt.broker)
+    if not addr:
+        env.println("no live broker in the cluster")
+        return
+    env.println(f"balancer: {addr}")
+    resp = Stub(addr, MQ_SERVICE).call(
+        "BalanceTopics", mq.BalanceTopicsRequest(), mq.BalanceTopicsResponse)
+    for a in resp.assignments:
+        env.println(f"{a.topic.namespace}/{a.topic.name}: "
+                    f"{len(a.partitions)} partitions")
+    env.println(f"balanced {len(resp.assignments)} topic(s)")

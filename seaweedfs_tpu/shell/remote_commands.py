@@ -102,3 +102,76 @@ def cmd_remote_meta_sync(env: CommandEnv, args):
         return
     n = mount_remote(fc, opt.dir, m["spec"], m.get("prefix", ""))
     env.println(f"meta-synced {opt.dir}: {n} entries")
+
+
+@command("mount.configure", "-dir /mnt [-quotaMB N]: set the quota on a "
+         "live kernel mount (local machine only)")
+def cmd_mount_configure(env: CommandEnv, args):
+    """Reference command_mount_configure.go: dials the mount process's
+    local control socket (derived from the mount directory) and applies
+    CollectionCapacity."""
+    from ..mount.control import configure_mount
+
+    p = argparse.ArgumentParser(prog="mount.configure")
+    p.add_argument("-dir", required=True)
+    p.add_argument("-quotaMB", type=int, default=0)
+    opt = p.parse_args(args)
+    resp = configure_mount(opt.dir, opt.quotaMB << 20)
+    if not resp.get("ok"):
+        env.println(f"mount.configure failed: {resp.get('error')}")
+        return
+    env.println(f"{opt.dir}: collection capacity "
+                f"{resp['collection_capacity'] >> 20} MB")
+
+
+@command("remote.mount.buckets", "[-remote name] [-bucketPattern p] "
+         "[-apply]: mount every bucket of a configured remote")
+def cmd_remote_mount_buckets(env: CommandEnv, args):
+    """Reference command_remote_mount_buckets.go: list the remote's
+    buckets, mount each under /buckets/<name>; dry-run without -apply."""
+    import fnmatch
+
+    from ..remote import mount_remote
+    from ..remote.remote_mount import _load_mappings
+    from ..storage.backend import open_remote
+
+    p = _remote_parser("remote.mount.buckets")
+    p.add_argument("-remote", default="",
+                   help="remote spec, e.g. s3:http://host:port[?ak:sk] "
+                        "or local:/dir (bucket = subdir)")
+    p.add_argument("-bucketPattern", default="")
+    p.add_argument("-apply", action="store_true")
+    opt = p.parse_args(args)
+    fc = _fc(env, opt.filer)
+    if not opt.remote:
+        mappings = _load_mappings(fc)
+        if not mappings:
+            env.println("(no remote mounts)")
+        for directory, m in sorted(mappings.items()):
+            env.println(f"{directory} -> {m['spec']}")
+        return
+    client = open_remote(opt.remote if ":" in opt.remote
+                         else f"local:{opt.remote}")
+    buckets = client.list_buckets()
+    if opt.bucketPattern:
+        buckets = [b for b in buckets
+                   if fnmatch.fnmatch(b, opt.bucketPattern)]
+    for b in buckets:
+        env.println(f"bucket {b} -> /buckets/{b}")
+        if opt.apply:
+            spec = _bucket_spec(opt.remote, b)
+            n = mount_remote(fc, f"/buckets/{b}", spec, "")
+            env.println(f"  mounted ({n} entries)")
+    if not opt.apply:
+        env.println(f"{len(buckets)} bucket(s); pass -apply to mount")
+
+
+def _bucket_spec(remote: str, bucket: str) -> str:
+    """Derive the per-bucket spec from a root remote spec."""
+    kind, _, arg = remote.partition(":")
+    if kind == "local" or ":" not in remote:
+        root = arg or remote
+        return f"local:{root.rstrip('/')}/{bucket}"
+    # s3-family: 's3:http://host:port[?ak:sk]' -> append /bucket to the url
+    url, q, cred = arg.partition("?")
+    return f"{kind}:{url.rstrip('/')}/{bucket}" + (q + cred if q else "")

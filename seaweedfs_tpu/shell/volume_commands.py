@@ -451,6 +451,17 @@ def cmd_cluster_ps(env: CommandEnv, args):
         ecs = sum(len(d.ec_shard_infos) for d in s["disks"].values())
         env.println(f"  volume server {s['id']} dc={s['dc']} "
                     f"rack={s['rack']} volumes={vols} ec={ecs}")
+    # filers/brokers registered through KeepConnected (cluster.go:104)
+    for ctype in ("filer", "broker"):
+        try:
+            resp = Stub(env.mc.leader, MASTER_SERVICE).call(
+                "ListClusterNodes",
+                mpb.ListClusterNodesRequest(client_type=ctype),
+                mpb.ListClusterNodesResponse)
+        except Exception:  # noqa: BLE001 — pre-RPC master
+            continue
+        for n in resp.cluster_nodes:
+            env.println(f"  {ctype} {n.address}")
 
 
 @command("volume.check.disk", "sync divergent replicas by needle-map diff",

@@ -62,6 +62,10 @@ class RemoteStorageClient:
     def list_keys(self, prefix: str = "") -> list[str]:
         raise NotImplementedError
 
+    def list_buckets(self) -> list[str]:
+        """Top-level containers (shell remote.mount.buckets)."""
+        raise NotImplementedError
+
 
 class LocalDirRemote(RemoteStorageClient):
     name = "local"
@@ -93,6 +97,10 @@ class LocalDirRemote(RemoteStorageClient):
             os.unlink(self._p(key))
         except FileNotFoundError:
             pass
+
+    def list_buckets(self) -> list[str]:
+        return sorted(d for d in os.listdir(self.root)
+                      if os.path.isdir(os.path.join(self.root, d)))
 
     def list_keys(self, prefix: str = "") -> list[str]:
         out = []
@@ -170,6 +178,25 @@ class S3Remote(RemoteStorageClient):
         ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
         return [e.findtext(f"{ns}Key") for e in root.iter(f"{ns}Contents")]
 
+    def list_buckets(self) -> list[str]:
+        """GET service root = ListAllMyBuckets (works bucket-scoped or
+        service-scoped: the endpoint is the service URL either way)."""
+        import xml.etree.ElementTree as ET
+
+        import requests
+
+        url = f"{self.endpoint}/"
+        headers = {}
+        if self.ak:
+            from ..s3.auth import sign_request_v4
+            headers = sign_request_v4("GET", url, {}, b"", self.ak, self.sk)
+        r = requests.get(url, headers=headers, timeout=60)
+        if r.status_code >= 300:
+            raise OSError(f"ListBuckets: HTTP {r.status_code}")
+        root = ET.fromstring(r.content)
+        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+        return [b.findtext(f"{ns}Name") for b in root.iter(f"{ns}Bucket")]
+
 
 def open_remote(spec: str) -> RemoteStorageClient:
     """spec: 'local:/dir' or 's3:http://host:port/bucket[?ak:sk]'
@@ -183,7 +210,14 @@ def open_remote(spec: str) -> RemoteStorageClient:
         # the kind names keep specs self-documenting (reference ships
         # per-provider clients in weed/remote_storage/*)
         url, _, cred = arg.partition("?")
-        base, _, bucket = url.rpartition("/")
+        scheme, sep, rest = url.partition("://")
+        if sep:
+            # 'http://host:port[/bucket]' — a bucket-less spec is valid
+            # for service-level ops (remote.mount.buckets ListBuckets)
+            host, _, bucket = rest.partition("/")
+            base = f"{scheme}://{host}"
+        else:
+            base, _, bucket = url.rpartition("/")
         ak, _, sk = cred.partition(":")
         return S3Remote(base, bucket, ak, sk)
     raise ValueError(f"unknown remote backend {spec!r}")

@@ -275,3 +275,58 @@ class TestWeedFS:
     def test_statfs(self, wfs):
         st = wfs.statfs()
         assert st["f_bsize"] > 0 and st["f_blocks"] > 0
+
+
+class TestMountControl:
+    """mount.configure control socket (reference command_mount_configure.go
+    + mount_pb Configure)."""
+
+    def test_configure_roundtrip(self, tmp_path):
+        from seaweedfs_tpu.mount.control import (configure_mount,
+                                                 mount_socket_path,
+                                                 serve_mount_control)
+
+        class FakeWFS:
+            collection_capacity = 0
+
+            def configure(self, cap):
+                self.collection_capacity = cap
+
+        wfs = FakeWFS()
+        mnt = str(tmp_path / "mnt")
+        stop = serve_mount_control(wfs, mount_socket_path(mnt))
+        try:
+            resp = configure_mount(mnt, 128 << 20)
+            assert resp["ok"] and resp["collection_capacity"] == 128 << 20
+            assert wfs.collection_capacity == 128 << 20
+            # shell command path
+            import io
+
+            from seaweedfs_tpu.shell import remote_commands  # noqa: F401
+            from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+            out = io.StringIO()
+            env = CommandEnv.__new__(CommandEnv)
+            env.out = out
+            env.option = {}
+            run_command(env, f"mount.configure -dir {mnt} -quotaMB 64")
+            assert "64 MB" in out.getvalue()
+            assert wfs.collection_capacity == 64 << 20
+        finally:
+            stop()
+
+    def test_statfs_reflects_quota(self):
+        from seaweedfs_tpu.mount.weedfs import WeedFS
+
+        wfs = WeedFS.__new__(WeedFS)
+        wfs.chunk_size = 1 << 20
+        wfs.collection_capacity = 0
+        assert WeedFS.statfs(wfs)["f_blocks"] == 1 << 30
+        wfs.configure(64 << 20)
+
+        class _Meta:
+            def list(self, d):
+                return []
+        wfs.meta = _Meta()
+        st = WeedFS.statfs(wfs)
+        assert st["f_blocks"] == 64
+        assert st["f_bfree"] == 64

@@ -310,3 +310,44 @@ def test_mq_notification_queue(tmp_path):
     finally:
         b.stop()
         ms.stop()
+
+
+def test_mq_balance_via_shell(broker_stack):
+    """mq.balance discovers the broker through the master cluster list
+    (ListClusterNodes) and triggers BalanceTopics (reference
+    command_mq_balance.go)."""
+    import io
+
+    from seaweedfs_tpu.mq.topic import TopicRef
+    from seaweedfs_tpu.shell import mq_commands  # noqa: F401 (register)
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    broker = broker_stack["broker"]
+    broker.configure_topic(TopicRef("ns", "balanced"), 4)
+    out = io.StringIO()
+    env = CommandEnv(broker_stack["ms"].address, out=out)
+    # no -broker flag: auto-discovery through the master
+    run_command(env, "mq.balance")
+    got = out.getvalue()
+    assert f"balancer: {broker.address}" in got, got
+    assert "ns/balanced: 4 partitions" in got
+    env.mc.stop()
+
+
+def test_list_cluster_nodes_rpc(broker_stack):
+    """Master ListClusterNodes reports live filers and brokers by type
+    (reference cluster.go:104)."""
+    from seaweedfs_tpu.pb import master_pb2 as mpb
+    from seaweedfs_tpu.utils.rpc import MASTER_SERVICE, Stub
+
+    ms = broker_stack["ms"]
+    stub = Stub(ms.address, MASTER_SERVICE)
+    brokers = stub.call("ListClusterNodes",
+                        mpb.ListClusterNodesRequest(client_type="broker"),
+                        mpb.ListClusterNodesResponse)
+    assert broker_stack["broker"].address in \
+        [n.address for n in brokers.cluster_nodes]
+    filers = stub.call("ListClusterNodes",
+                       mpb.ListClusterNodesRequest(client_type="filer"),
+                       mpb.ListClusterNodesResponse)
+    assert len(filers.cluster_nodes) >= 1

@@ -508,3 +508,89 @@ def _entry_with_chunk(name, fid, size):
     return fpb.Entry(name=name, is_directory=False, chunks=[
         fpb.FileChunk(file_id=fid, offset=0, size=size)],
         attributes=fpb.FuseAttributes(file_size=size, file_mode=0o644))
+
+
+def test_s3_configure(env, stack):
+    """s3.configure manages identities in /etc/iam/identity.json
+    (reference command_s3_configure.go)."""
+    import json
+
+    e, out = env
+    got = _sh(e, out, "s3.configure -user alice -access_key AKALICE "
+                      "-secret_key sk1 -actions Read,Write -buckets docs")
+    assert "dry run" in got
+    fs = stack["fs"]
+    assert fs.filer.find_entry("/etc/iam", "identity.json") is None
+    got = _sh(e, out, "s3.configure -user alice -access_key AKALICE "
+                      "-secret_key sk1 -actions Read,Write -buckets docs "
+                      "-apply")
+    entry = fs.filer.find_entry("/etc/iam", "identity.json")
+    conf = json.loads(fs.read_entry_bytes(entry))
+    alice = next(i for i in conf["identities"] if i["name"] == "alice")
+    assert {"accessKey": "AKALICE", "secretKey": "sk1"} in \
+        alice["credentials"]
+    assert "Read:docs" in alice["actions"]
+    assert "Write:docs" in alice["actions"]
+    # delete removes the user
+    _sh(e, out, "s3.configure -user alice -delete -apply")
+    entry = fs.filer.find_entry("/etc/iam", "identity.json")
+    conf = json.loads(fs.read_entry_bytes(entry))
+    assert not any(i["name"] == "alice" for i in conf["identities"])
+
+
+def test_s3_circuitbreaker(env, stack):
+    """s3.circuitbreaker edits /etc/s3/circuit_breaker.json; the config
+    shape loads into the gateway breaker (reference
+    command_s3_circuitbreaker.go)."""
+    import json
+
+    from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker
+
+    e, out = env
+    _sh(e, out, "s3.circuitbreaker -global -actions Read,Write "
+                "-countLimit 16 -apply")
+    _sh(e, out, "s3.circuitbreaker -buckets docs -actions Write "
+                "-countLimit 2 -apply")
+    fs = stack["fs"]
+    entry = fs.filer.find_entry("/etc/s3", "circuit_breaker.json")
+    conf = json.loads(fs.read_entry_bytes(entry))
+    assert conf["global"] == {"Read": 16, "Write": 16}
+    assert conf["buckets"]["docs"] == {"Write": 2}
+    cb = CircuitBreaker()
+    assert not cb.enabled
+    cb.load(conf)  # the standalone s3 verb hot-reloads exactly this way
+    assert cb.enabled and cb.global_limits["Read"] == 16
+    # disable prunes back to nothing
+    _sh(e, out, "s3.circuitbreaker -global -actions Read,Write "
+                "-disable -apply")
+    _sh(e, out, "s3.circuitbreaker -buckets docs -actions Write "
+                "-disable -apply")
+    entry = fs.filer.find_entry("/etc/s3", "circuit_breaker.json")
+    conf = json.loads(fs.read_entry_bytes(entry))
+    cb.load(conf)
+    assert not cb.enabled
+
+
+def test_remote_mount_buckets(env, stack, tmp_path):
+    """remote.mount.buckets lists a remote's buckets and mounts each
+    under /buckets (reference command_remote_mount_buckets.go)."""
+    from seaweedfs_tpu.remote.remote_mount import _load_mappings
+
+    e, out = env
+    root = tmp_path / "cloud"
+    for b, files in {"alpha": ["x.txt"], "beta": ["y.txt", "z.txt"]}.items():
+        (root / b).mkdir(parents=True)
+        for f in files:
+            (root / b / f).write_text(f"data-{f}")
+    got = _sh(e, out, f"remote.mount.buckets -remote local:{root}")
+    assert "bucket alpha" in got and "bucket beta" in got
+    assert "pass -apply" in got
+    got = _sh(e, out, f"remote.mount.buckets -remote local:{root} "
+                      f"-bucketPattern 'b*' -apply")
+    assert "bucket beta" in got and "alpha" not in got
+    fs = stack["fs"]
+    from seaweedfs_tpu.client.filer_client import FilerClient
+    fc = FilerClient(fs.url)
+    mappings = _load_mappings(fc)
+    assert "/buckets/beta" in mappings
+    assert fs.filer.find_entry("/buckets/beta", "y.txt") is not None
