@@ -15,6 +15,7 @@ Verbs (subset of reference command/command.go:12-44, growing):
 
 from __future__ import annotations
 
+import os
 import argparse
 import sys
 import time
@@ -763,6 +764,331 @@ def run_mq_broker(argv):
     _wait_forever()
 
 
+def run_filer_cat(argv):
+    """Print a filer file's bytes, reading chunks straight from the
+    volume servers (reference command/filer_cat.go)."""
+    from .client.filer_client import FilerClient
+    from .filer.filer import split_path
+    p = argparse.ArgumentParser(prog="filer.cat")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("path", help="absolute filer path")
+    opt = p.parse_args(argv)
+    fc = FilerClient(opt.filer, client_name="filer-cat")
+    d, n = split_path(opt.path)
+    entry = fc.filer.find_entry(d, n)
+    if entry is None:
+        print(f"{opt.path}: not found", file=sys.stderr)
+        sys.exit(1)
+    if entry.is_directory:
+        print(f"{opt.path}: is a directory", file=sys.stderr)
+        sys.exit(1)
+    sys.stdout.buffer.write(fc.read_entry_bytes(entry))
+    sys.stdout.buffer.flush()
+
+
+def run_filer_meta_backup(argv):
+    """Continuously back up filer METADATA into a local sqlite store
+    (reference command/filer_meta_backup.go): full-tree scan on first
+    run or -restart, then tail the event stream, resuming from the
+    offset persisted in the backup store itself."""
+    import struct as _struct
+    import threading as _threading
+
+    from .client.filer_client import FilerClient
+    from .filer.filer import split_path
+    from .filer.store import SqliteStore
+    p = argparse.ArgumentParser(prog="filer.meta.backup")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-store", default="meta_backup.db",
+                   help="sqlite backup store path")
+    p.add_argument("-path", default="/", help="subtree to back up")
+    p.add_argument("-restart", action="store_true",
+                   help="discard the stored offset and re-scan the tree")
+    opt = p.parse_args(argv)
+    fc = FilerClient(opt.filer, client_name="meta-backup")
+    store = SqliteStore(opt.store)
+    offset_key = b"meta.backup.offset"
+    raw = None if opt.restart else store.kv_get(offset_key)
+    since = _struct.unpack("<q", raw)[0] if raw else 0
+    if since == 0:
+        t0 = time.time_ns()
+        n = 0
+
+        def scan(directory):
+            nonlocal n
+            for e in fc.filer.list_entries(directory):
+                store.delete_entry(directory, e.name)
+                store.insert_entry(directory, e)
+                n += 1
+                if e.is_directory:
+                    scan(join_dir(directory, e.name))
+
+        def join_dir(d, name):
+            return (d.rstrip("/") + "/" + name) if d != "/" else "/" + name
+
+        scan(opt.path)
+        since = t0
+        store.kv_put(offset_key, _struct.pack("<q", since))
+        print(f"full scan: {n} entries into {opt.store}")
+    stop = _threading.Event()
+    print(f"tailing {opt.filer}{opt.path} metadata -> {opt.store} "
+          f"(since {since})")
+    try:
+        for resp in fc.filer.subscribe(since, stop, path_prefix=opt.path):
+            ev = resp.event_notification
+            try:
+                if ev.HasField("old_entry") and ev.old_entry.name:
+                    store.delete_entry(resp.directory, ev.old_entry.name)
+                if ev.HasField("new_entry") and ev.new_entry.name:
+                    d = ev.new_parent_path or resp.directory
+                    store.delete_entry(d, ev.new_entry.name)
+                    store.insert_entry(d, ev.new_entry)
+            except Exception as e:  # noqa: BLE001
+                print(f"apply {resp.directory}: {e}", file=sys.stderr)
+            if resp.ts_ns:
+                store.kv_put(offset_key, _struct.pack("<q", resp.ts_ns))
+    except KeyboardInterrupt:
+        stop.set()
+
+
+def _open_sink(spec: str):
+    """Replication sink from a spec string (reference replication.toml
+    picks the enabled sink the same way): 'local:/dir',
+    'filer:host:port[/prefix]', 's3:http://host:port/bucket[?ak:sk]'."""
+    from .replication.sink import FilerSink, LocalSink, S3Sink
+    kind, _, arg = spec.partition(":")
+    if kind == "local":
+        return LocalSink(arg)
+    if kind == "filer":
+        from .client.filer_client import FilerClient
+        addr, slash, prefix = arg.partition("/")
+        return FilerSink(FilerClient(addr), dir_prefix=slash + prefix
+                         if prefix else "")
+    if kind in ("s3", "b2", "gcs", "wasabi", "minio"):
+        url, _, cred = arg.partition("?")
+        scheme, sep, rest = url.partition("://")
+        host, _, bucket = rest.partition("/")
+        ak, _, sk = cred.partition(":")
+        return S3Sink(f"{scheme}://{host}", bucket, ak, sk)
+    raise ValueError(f"unknown sink spec {spec!r}")
+
+
+def run_filer_replicate(argv):
+    """Consume a notification queue and apply events through a
+    replication sink (reference command/filer_replicate.go — the
+    queue-decoupled alternative to filer.sync)."""
+    from .client.filer_client import FilerClient
+    from .notification.queues import LogFileQueue
+    from .replication.replicator import Replicator
+    p = argparse.ArgumentParser(prog="filer.replicate")
+    p.add_argument("-filer", default="127.0.0.1:8888",
+                   help="source filer (chunk reads)")
+    p.add_argument("-queue", required=True,
+                   help="notification source: logfile:/path (durable log "
+                        "written by the filer/fs.meta.notify)")
+    p.add_argument("-sink", required=True,
+                   help="local:/dir | filer:host:port | "
+                        "s3:http://host:port/bucket[?ak:sk]")
+    p.add_argument("-offsetFile", default="",
+                   help="resume-offset path (default <queue>.offset)")
+    opt = p.parse_args(argv)
+    kind, _, qpath = opt.queue.partition(":")
+    if kind != "logfile":
+        print("filer.replicate consumes a durable queue; use "
+              "logfile:/path (mq consumers: use filer.sync)",
+              file=sys.stderr)
+        sys.exit(1)
+    fc = FilerClient(opt.filer, client_name="filer-replicate")
+    repl = Replicator(_open_sink(opt.sink), fc.read_entry_bytes)
+    queue = LogFileQueue(qpath)
+    off_path = opt.offsetFile or qpath + ".offset"
+    offset = 0
+    if os.path.exists(off_path):
+        with open(off_path) as f:
+            offset = int(f.read().strip() or 0)
+    print(f"replicating {opt.queue} -> {opt.sink} (offset {offset})")
+    try:
+        while True:
+            progressed = False
+            for next_off, rec in queue.read(offset):
+                try:
+                    repl.replicate(rec.directory, rec.event_notification)
+                except Exception as e:  # noqa: BLE001
+                    print(f"apply {rec.directory}: {e}", file=sys.stderr)
+                offset = next_off
+                progressed = True
+                with open(off_path, "w") as f:
+                    f.write(str(offset))
+            if not progressed:
+                time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+
+
+def run_filer_remote_sync(argv):
+    """Write LOCAL changes under remote-mounted directories back to the
+    remote store (reference command/filer_remote_sync.go)."""
+    import threading as _threading
+
+    from .client.filer_client import FilerClient
+    from .remote.remote_mount import _load_mappings, apply_event_to_remote
+    p = argparse.ArgumentParser(prog="filer.remote.sync")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-dir", default="",
+                   help="only sync this mounted directory")
+    opt = p.parse_args(argv)
+    from .remote.remote_mount import MOUNT_CONF
+    fc = FilerClient(opt.filer, client_name="remote-sync")
+
+    def load_mappings():
+        m = _load_mappings(fc)
+        return {d: v for d, v in m.items() if d == opt.dir} if opt.dir else m
+
+    mappings = load_mappings()
+    if not mappings:
+        print("no remote mounts to sync", file=sys.stderr)
+        sys.exit(1)
+    stop = _threading.Event()
+    prefix = opt.dir or "/"
+    since = time.time_ns()  # BEFORE the ready print: events landing in
+    # the print->subscribe gap replay from `since`, so a caller that
+    # waits for the ready line cannot race the subscription
+    print(f"remote-sync watching {opt.filer}{prefix} "
+          f"({len(mappings)} mounts)")
+    try:
+        for resp in fc.filer.subscribe(since, stop,
+                                       path_prefix=prefix):
+            ev0 = resp.event_notification
+            if MOUNT_CONF == f"{resp.directory}/" \
+                    f"{ev0.new_entry.name or ev0.old_entry.name}":
+                # a remote.mount/unmount changed the mapping table
+                # (visible when watching "/"): pick it up
+                mappings = load_mappings()
+                continue
+            try:
+                act = apply_event_to_remote(fc, mappings, resp.directory,
+                                            resp.event_notification)
+                if act:
+                    print(act)
+            except Exception as e:  # noqa: BLE001
+                print(f"sync {resp.directory}: {e}", file=sys.stderr)
+    except KeyboardInterrupt:
+        stop.set()
+
+
+def run_filer_remote_gateway(argv):
+    """Mirror bucket creation/deletion under /buckets into a remote
+    store, then behave like filer.remote.sync for their contents
+    (reference command/filer_remote_gateway.go)."""
+    import threading as _threading
+
+    from .client.filer_client import FilerClient
+    from .remote.remote_mount import (_load_mappings, _save_mappings,
+                                      apply_event_to_remote)
+    from .storage.backend import bucket_spec, open_remote
+    p = argparse.ArgumentParser(prog="filer.remote.gateway")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-createBucketAt", required=True,
+                   help="remote spec new buckets are created on")
+    opt = p.parse_args(argv)
+    root_spec = (opt.createBucketAt if ":" in opt.createBucketAt
+                 else f"local:{opt.createBucketAt}")
+    fc = FilerClient(opt.filer, client_name="remote-gateway")
+    client = open_remote(root_spec)
+    stop = _threading.Event()
+    # mappings cached; this process is the only writer under /buckets so
+    # its own updates keep the cache fresh (no per-event filer re-read)
+    mappings = _load_mappings(fc)
+    since = time.time_ns()  # before the ready print (see remote.sync)
+    print(f"remote-gateway: /buckets <-> {opt.createBucketAt}")
+    try:
+        for resp in fc.filer.subscribe(since, stop,
+                                       path_prefix="/buckets"):
+            ev = resp.event_notification
+            try:
+                is_bucket_level = resp.directory == "/buckets"
+                if is_bucket_level and ev.HasField("new_entry") and \
+                        ev.new_entry.is_directory and ev.new_entry.name:
+                    b = ev.new_entry.name
+                    client.create_bucket(b)
+                    mappings[f"/buckets/{b}"] = {
+                        "spec": bucket_spec(root_spec, b), "prefix": ""}
+                    _save_mappings(fc, mappings)
+                    print(f"created bucket {b}")
+                elif is_bucket_level and ev.HasField("old_entry") and \
+                        ev.old_entry.is_directory and ev.old_entry.name \
+                        and not (ev.HasField("new_entry")
+                                 and ev.new_entry.name):
+                    b = ev.old_entry.name
+                    client.delete_bucket(b)
+                    mappings.pop(f"/buckets/{b}", None)
+                    _save_mappings(fc, mappings)
+                    print(f"deleted bucket {b}")
+                else:
+                    act = apply_event_to_remote(fc, mappings,
+                                                resp.directory, ev)
+                    if act:
+                        print(act)
+            except Exception as e:  # noqa: BLE001
+                print(f"gateway {resp.directory}: {e}", file=sys.stderr)
+    except KeyboardInterrupt:
+        stop.set()
+
+
+def run_fuse(argv):
+    """/etc/fstab-compatible mount wrapper (reference command/fuse.go):
+    `swtpu fuse <mountpoint> -o "filer=host:port,chunkSizeLimitMB=4"`."""
+    p = argparse.ArgumentParser(prog="fuse")
+    p.add_argument("mountpoint")
+    p.add_argument("-o", default="", help="comma-separated options")
+    opt = p.parse_args(argv)
+    opts = dict(kv.partition("=")[::2] for kv in opt.o.split(",") if kv)
+    fwd = ["-dir", opt.mountpoint,
+           "-filer", opts.get("filer", "127.0.0.1:8888")]
+    if "chunkSizeLimitMB" in opts:
+        fwd += ["-chunkSizeLimitMB", opts["chunkSizeLimitMB"]]
+    if opts.get("allowOthers") in ("", "true") and "allowOthers" in opts:
+        fwd += ["-allowOther"]
+    run_mount(fwd)
+
+
+AUTOCOMPLETE_MARK = "# swtpu-autocomplete"
+
+
+def run_autocomplete(argv):
+    """Install bash completion for the verb table into ~/.bashrc
+    (reference command/autocomplete.go via posener/complete)."""
+    rc = os.path.expanduser("~/.bashrc")
+    line = (f'complete -W "{" ".join(sorted(VERBS))}" -o default swtpu '
+            f"{AUTOCOMPLETE_MARK}\n")
+    existing = ""
+    if os.path.exists(rc):
+        with open(rc) as f:
+            existing = f.read()
+    if AUTOCOMPLETE_MARK in existing:
+        print("autocomplete already installed")
+        return
+    with open(rc, "a") as f:
+        f.write(line)
+    print(f"bash completion installed in {rc}; restart your shell")
+
+
+def run_unautocomplete(argv):
+    rc = os.path.expanduser("~/.bashrc")
+    if not os.path.exists(rc):
+        print("nothing to remove")
+        return
+    with open(rc) as f:
+        lines = f.readlines()
+    kept = [l for l in lines if AUTOCOMPLETE_MARK not in l]
+    if len(kept) == len(lines):
+        print("nothing to remove")
+        return
+    with open(rc, "w") as f:
+        f.writelines(kept)
+    print("bash completion removed")
+
+
 VERBS = {
     "master": run_master,
     "mq.broker": run_mq_broker,
@@ -788,6 +1114,14 @@ VERBS = {
     "fix": run_fix,
     "benchmark": run_benchmark,
     "mount": run_mount,
+    "fuse": run_fuse,
+    "filer.cat": run_filer_cat,
+    "filer.meta.backup": run_filer_meta_backup,
+    "filer.replicate": run_filer_replicate,
+    "filer.remote.sync": run_filer_remote_sync,
+    "filer.remote.gateway": run_filer_remote_gateway,
+    "autocomplete": run_autocomplete,
+    "unautocomplete": run_unautocomplete,
 }
 
 

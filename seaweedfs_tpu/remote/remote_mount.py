@@ -144,3 +144,83 @@ def uncache_remote(fs, path: str) -> None:
     del updated.chunks[:]
     updated.attributes.file_size = ref.get("size", 0)
     fs.filer.update_entry(d, updated)
+
+
+# -- write-back sync (weed filer.remote.sync / filer.remote.gateway) ------
+
+def find_mapping(mappings: dict, path: str) -> "tuple[str, dict] | None":
+    """Longest mounted-directory prefix covering `path`."""
+    best = None
+    for directory, m in mappings.items():
+        if path == directory or path.startswith(directory.rstrip("/") + "/"):
+            if best is None or len(directory) > len(best[0]):
+                best = (directory, m)
+    return best
+
+
+def remote_key_for(mount_dir: str, m: dict, path: str) -> str:
+    rel = path[len(mount_dir):].lstrip("/")
+    prefix = (m.get("prefix") or "").strip("/")
+    return f"{prefix}/{rel}" if prefix else rel
+
+
+def apply_event_to_remote(fs, mappings: dict, directory: str,
+                          ev: fpb.EventNotification) -> "str | None":
+    """Write one filer metadata event back to the remote store backing
+    its mount (reference command/filer_remote_sync.go). Returns a short
+    action string, or None when the event doesn't touch a mount.
+
+    Events whose entry carries ONLY a remote ref (no local chunks) came
+    FROM the remote import itself and are skipped — without this guard
+    the sync would re-upload every object right after remote.mount."""
+    has_old = ev.HasField("old_entry") and bool(ev.old_entry.name)
+    has_new = ev.HasField("new_entry") and bool(ev.new_entry.name)
+    old_path = join_path(directory, ev.old_entry.name) if has_old else ""
+    new_dir = ev.new_parent_path or directory
+    new_path = join_path(new_dir, ev.new_entry.name) if has_new else ""
+
+    is_rename = has_old and has_new and new_path != old_path
+    actions = []
+    if has_new and not ev.new_entry.is_directory:
+        hit = find_mapping(mappings, new_path)
+        if hit:
+            client = open_remote(hit[1]["spec"])
+            key = remote_key_for(hit[0], hit[1], new_path)
+            if ev.new_entry.chunks:
+                # metadata-only updates (chmod/utime) keep the chunk list
+                # identical — don't re-upload a large unchanged object
+                same_content = (not is_rename and has_old and
+                                [c.file_id for c in ev.old_entry.chunks] ==
+                                [c.file_id for c in ev.new_entry.chunks])
+                if not same_content:
+                    client.write_object_bytes(
+                        key, fs.read_entry_bytes(ev.new_entry))
+                    actions.append(f"upload {key}")
+            elif is_rename and remote_ref(ev.new_entry) is not None:
+                # rename of a remote-only file: copy remote-side BEFORE
+                # the delete below, or the object is lost
+                old_hit = find_mapping(mappings, old_path)
+                if old_hit:
+                    src = open_remote(old_hit[1]["spec"])
+                    old_key = remote_key_for(old_hit[0], old_hit[1],
+                                             old_path)
+                    size = src.object_size(old_key)
+                    client.write_object_bytes(
+                        key, src.read_object(old_key, 0, size))
+                    actions.append(f"copy {old_key} -> {key}")
+            elif remote_ref(ev.new_entry) is None and not has_old:
+                # empty local file (no chunks, no ref)
+                client.write_object_bytes(key, b"")
+                actions.append(f"upload {key}")
+    if has_old and (not has_new or is_rename):
+        hit = find_mapping(mappings, old_path)
+        if hit and old_path != hit[0]:
+            client = open_remote(hit[1]["spec"])
+            key = remote_key_for(hit[0], hit[1], old_path)
+            if ev.old_entry.is_directory:
+                for k in client.list_keys(key + "/"):
+                    client.delete_object(k)
+            else:
+                client.delete_object(key)
+            actions.append(f"delete {key}")
+    return "; ".join(actions) if actions else None

@@ -156,22 +156,12 @@ def cmd_remote_mount_buckets(env: CommandEnv, args):
     if opt.bucketPattern:
         buckets = [b for b in buckets
                    if fnmatch.fnmatch(b, opt.bucketPattern)]
+    from ..storage.backend import bucket_spec
     for b in buckets:
         env.println(f"bucket {b} -> /buckets/{b}")
         if opt.apply:
-            spec = _bucket_spec(opt.remote, b)
-            n = mount_remote(fc, f"/buckets/{b}", spec, "")
+            n = mount_remote(fc, f"/buckets/{b}", bucket_spec(opt.remote, b),
+                             "")
             env.println(f"  mounted ({n} entries)")
     if not opt.apply:
         env.println(f"{len(buckets)} bucket(s); pass -apply to mount")
-
-
-def _bucket_spec(remote: str, bucket: str) -> str:
-    """Derive the per-bucket spec from a root remote spec."""
-    kind, _, arg = remote.partition(":")
-    if kind == "local" or ":" not in remote:
-        root = arg or remote
-        return f"local:{root.rstrip('/')}/{bucket}"
-    # s3-family: 's3:http://host:port[?ak:sk]' -> append /bucket to the url
-    url, q, cred = arg.partition("?")
-    return f"{kind}:{url.rstrip('/')}/{bucket}" + (q + cred if q else "")

@@ -66,6 +66,20 @@ class RemoteStorageClient:
         """Top-level containers (shell remote.mount.buckets)."""
         raise NotImplementedError
 
+    def write_object_bytes(self, key: str, data: bytes) -> int:
+        """Upload from memory (filer.remote.sync write-back)."""
+        import tempfile
+        with tempfile.NamedTemporaryFile() as tf:
+            tf.write(data)
+            tf.flush()
+            return self.write_object(key, tf.name)
+
+    def create_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
+    def delete_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
 
 class LocalDirRemote(RemoteStorageClient):
     name = "local"
@@ -101,6 +115,13 @@ class LocalDirRemote(RemoteStorageClient):
     def list_buckets(self) -> list[str]:
         return sorted(d for d in os.listdir(self.root)
                       if os.path.isdir(os.path.join(self.root, d)))
+
+    def create_bucket(self, bucket: str) -> None:
+        os.makedirs(os.path.join(self.root, bucket), exist_ok=True)
+
+    def delete_bucket(self, bucket: str) -> None:
+        import shutil
+        shutil.rmtree(os.path.join(self.root, bucket), ignore_errors=True)
 
     def list_keys(self, prefix: str = "") -> list[str]:
         out = []
@@ -178,6 +199,32 @@ class S3Remote(RemoteStorageClient):
         ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
         return [e.findtext(f"{ns}Key") for e in root.iter(f"{ns}Contents")]
 
+    def create_bucket(self, bucket: str) -> None:
+        import requests
+        url = f"{self.endpoint}/{bucket}"
+        headers = {}
+        if self.ak:
+            from ..s3.auth import sign_request_v4
+            headers = sign_request_v4("PUT", url, {}, b"", self.ak, self.sk)
+        r = requests.put(url, headers=headers, timeout=60)
+        if r.status_code >= 300:
+            raise OSError(f"CreateBucket {bucket}: HTTP {r.status_code}")
+
+    def delete_bucket(self, bucket: str) -> None:
+        import requests
+        url = f"{self.endpoint}/{bucket}"
+        headers = {}
+        if self.ak:
+            from ..s3.auth import sign_request_v4
+            headers = sign_request_v4("DELETE", url, {}, b"",
+                                      self.ak, self.sk)
+        r = requests.delete(url, headers=headers, timeout=60)
+        # 404 = already gone (idempotent); anything else failing must
+        # surface — e.g. 409 BucketNotEmpty, or the caller will drop its
+        # mapping while the remote bucket lives on
+        if r.status_code >= 300 and r.status_code != 404:
+            raise OSError(f"DeleteBucket {bucket}: HTTP {r.status_code}")
+
     def list_buckets(self) -> list[str]:
         """GET service root = ListAllMyBuckets (works bucket-scoped or
         service-scoped: the endpoint is the service URL either way)."""
@@ -196,6 +243,18 @@ class S3Remote(RemoteStorageClient):
         root = ET.fromstring(r.content)
         ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
         return [b.findtext(f"{ns}Name") for b in root.iter(f"{ns}Bucket")]
+
+
+def bucket_spec(remote: str, bucket: str) -> str:
+    """Derive the per-bucket spec from a root remote spec (shared by
+    shell remote.mount.buckets and the filer.remote.gateway verb)."""
+    kind, _, arg = remote.partition(":")
+    if kind == "local" or ":" not in remote:
+        root = arg or remote
+        return f"local:{root.rstrip('/')}/{bucket}"
+    # s3-family: '<kind>:http://host:port[?ak:sk]' -> append /bucket
+    url, q, cred = arg.partition("?")
+    return f"{kind}:{url.rstrip('/')}/{bucket}" + (q + cred if q else "")
 
 
 def open_remote(spec: str) -> RemoteStorageClient:
