@@ -269,18 +269,25 @@ def run_filer(argv):
     p.add_argument("-encryptVolumeData", action="store_true",
                    help="AES-256-GCM encrypt chunks; keys live in filer "
                         "metadata (reference filer -encryptVolumeData)")
+    p.add_argument("-noPeerMeta", action="store_true",
+                   help="disable the multi-filer metadata mesh (reference "
+                        "filers aggregate peer metadata by default)")
     opt = p.parse_args(argv)
     store = opt.store
     if not store:
         from .utils import config as cfg
         store = cfg.get_dotted(cfg.load_config("filer"),
-                               "filer.options.store", "sqlite:./filer.db")
+                               "filer.options.store",
+                               f"sqlite:./filer-{opt.port}.db")
+    # per-port defaults: two filers started from one cwd (the obvious
+    # way to try the peer mesh) must not share a meta log or store
     FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
                 grpc_port=opt.grpcPort or None,
-                meta_log_path="./filer-meta.log",
+                meta_log_path=f"./filer-meta-{opt.port}.log",
                 collection=opt.collection, replication=opt.replication,
                 chunk_size_mb=opt.maxMB,
-                encrypt_data=opt.encryptVolumeData).start()
+                encrypt_data=opt.encryptVolumeData,
+                meta_aggregate=not opt.noPeerMeta).start()
     _wait_forever()
 
 
@@ -811,7 +818,7 @@ def run_filer_meta_backup(argv):
     raw = None if opt.restart else store.kv_get(offset_key)
     since = _struct.unpack("<q", raw)[0] if raw else 0
     if since == 0:
-        t0 = time.time_ns()
+        t0 = fc.filer.server_now_ns()  # filer clock (skew-safe offset)
         n = 0
 
         def scan(directory):
@@ -950,9 +957,9 @@ def run_filer_remote_sync(argv):
         sys.exit(1)
     stop = _threading.Event()
     prefix = opt.dir or "/"
-    since = time.time_ns()  # BEFORE the ready print: events landing in
-    # the print->subscribe gap replay from `since`, so a caller that
-    # waits for the ready line cannot race the subscription
+    since = fc.filer.server_now_ns()  # the FILER's clock, taken BEFORE
+    # the ready print: a skewed client clock would silently drop events;
+    # events landing in the print->subscribe gap replay from `since`
     print(f"remote-sync watching {opt.filer}{prefix} "
           f"({len(mappings)} mounts)")
     try:
@@ -999,7 +1006,8 @@ def run_filer_remote_gateway(argv):
     # mappings cached; this process is the only writer under /buckets so
     # its own updates keep the cache fresh (no per-event filer re-read)
     mappings = _load_mappings(fc)
-    since = time.time_ns()  # before the ready print (see remote.sync)
+    since = fc.filer.server_now_ns()  # filer clock, before the ready
+    # print (see remote.sync)
     print(f"remote-gateway: /buckets <-> {opt.createBucketAt}")
     try:
         for resp in fc.filer.subscribe(since, stop,

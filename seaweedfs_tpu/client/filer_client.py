@@ -43,6 +43,7 @@ class FilerClient:
         self.chunk_size = (conf.max_mb or 4) << 20
         self.collection = conf.collection
         self.replication = conf.replication
+        self.signature = conf.signature  # the filer's identity (mesh)
         self._vid_cache: dict[str, tuple[list[str], float]] = {}
         # tiny blob LRU: kernel reads arrive in <=128 KiB slices, each
         # resolving a multi-MB chunk — caching the last few chunks turns
@@ -251,14 +252,32 @@ class _FilerFacade:
                           fpb.KvPutResponse)
 
     # -- meta subscription ---------------------------------------------------
+    def server_now_ns(self) -> int:
+        """The FILER's clock for use as a subscribe offset — the caller's
+        clock may be skewed, and events stamped between a skewed `since`
+        and now would silently never be delivered."""
+        conf = self.fc.stub.call("GetFilerConfiguration",
+                                 fpb.GetFilerConfigurationRequest(),
+                                 fpb.GetFilerConfigurationResponse)
+        import time as _time
+        return conf.now_ns or _time.time_ns()
+
+    def subscribe_local(self, since_ns: int, stop: threading.Event,
+                        path_prefix: str = "/"):
+        """SubscribeLocalMetadata: only events originated at that filer
+        (the peer-mesh feed, reference meta_aggregator.go)."""
+        yield from self.subscribe(since_ns, stop, path_prefix,
+                                  method="SubscribeLocalMetadata")
+
     def subscribe(self, since_ns: int, stop: threading.Event,
-                  path_prefix: str = "/"):
+                  path_prefix: str = "/",
+                  method: str = "SubscribeMetadata"):
         """SubscribeMetadata stream shaped like MetaLog.subscribe: yields
         responses with .directory / .event_notification / .ts_ns."""
         while not stop.is_set():
             try:
                 for resp in self.fc.stub.call_stream(
-                        "SubscribeMetadata",
+                        method,
                         fpb.SubscribeMetadataRequest(
                             client_name=self.fc.client_name,
                             path_prefix=path_prefix, since_ns=since_ns),

@@ -57,7 +57,12 @@ class Filer:
     # -- CRUD ---------------------------------------------------------------
     def create_entry(self, directory: str, entry: fpb.Entry,
                      o_excl: bool = False, from_other_cluster: bool = False,
-                     signatures: list[int] | None = None) -> None:
+                     signatures: list[int] | None = None,
+                     gc_chunks: bool = True) -> None:
+        """`gc_chunks=False` is the metadata-only apply the peer mesh
+        uses: chunks are shared cluster-wide, and GC-ing the replaced
+        version's chunks on EVERY mesh filer would delete both sides of
+        a concurrent update (the origin filer already GCs once)."""
         if not entry.attributes.crtime:
             entry.attributes.crtime = int(time.time())
         if not entry.attributes.mtime:
@@ -71,8 +76,8 @@ class Filer:
             if old.hard_link_id:
                 # overwriting ONE name of a hardlink set = unlink: the
                 # shared chunks belong to the remaining links
-                self._unlink_shared(old, is_delete_data=True)
-            else:
+                self._unlink_shared(old, is_delete_data=gc_chunks)
+            elif gc_chunks:
                 self._gc_replaced_chunks(old, entry)
         self._notify(directory, old, entry, delete_chunks=old is not None,
                      from_other_cluster=from_other_cluster,
@@ -96,7 +101,8 @@ class Filer:
 
     def update_entry(self, directory: str, entry: fpb.Entry,
                      from_other_cluster: bool = False,
-                     signatures: list[int] | None = None) -> None:
+                     signatures: list[int] | None = None,
+                     gc_chunks: bool = True) -> None:
         old = self.store.find_entry(directory, entry.name)
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
@@ -118,10 +124,12 @@ class Filer:
                 entry.hard_link_counter = counter
                 self.store.kv_put(key, entry.SerializeToString())
                 self.store.update_entry(directory, entry)
-            self._gc_replaced_chunks(resolved_old, entry)
+            if gc_chunks:
+                self._gc_replaced_chunks(resolved_old, entry)
         else:
             self.store.update_entry(directory, entry)
-            self._gc_replaced_chunks(old, entry)
+            if gc_chunks:
+                self._gc_replaced_chunks(old, entry)
         self._notify(directory, old, entry, delete_chunks=True,
                      from_other_cluster=from_other_cluster,
                      signatures=signatures)

@@ -39,7 +39,8 @@ class FilerServer:
                  meta_log_path: str | None = None,
                  collection: str = "", replication: str = "",
                  chunk_size_mb: int = DEFAULT_CHUNK_MB,
-                 encrypt_data: bool = False):
+                 encrypt_data: bool = False,
+                 meta_aggregate: bool = False):
         self.ip, self.port = ip, port
         self.grpc_port = grpc_port or port + 10000
         self.collection, self.replication = collection, replication
@@ -47,7 +48,14 @@ class FilerServer:
         # at-rest chunk encryption (reference filer -encryptVolumeData +
         # util/cipher.go): volume servers only ever see ciphertext
         self.encrypt_data = encrypt_data
-        self.mc = MasterClient(master_address, client_type="filer")
+        # register under the real service address so peers can discover
+        # this filer via ListClusterNodes (reference cluster.go:104)
+        self.mc = MasterClient(master_address, client_type="filer",
+                               client_address=f"{ip}:{port}")
+        # peer metadata mesh (reference meta_aggregator.go): every filer
+        # in the master cluster tails every other filer's LOCAL stream
+        self.meta_aggregate = meta_aggregate
+        self.aggregator = None
         self.filer = Filer(open_store(store_spec), meta_log_path,
                            chunk_deleter=self._delete_chunks)
         # path-prefix storage rules, hot-reloaded on conf-entry mutation
@@ -78,6 +86,9 @@ class FilerServer:
         self._http_thread = threading.Thread(target=self._run_http, daemon=True,
                                              name=f"filer-http-{self.port}")
         self._http_thread.start()
+        if self.meta_aggregate:
+            from .meta_aggregator import MetaAggregator
+            self.aggregator = MetaAggregator(self).start()
         log.info("filer %s up (grpc :%d, store %s)", self.url, self.grpc_port,
                  self.filer.store.name)
         return self
@@ -86,6 +97,8 @@ class FilerServer:
         if self._stop.is_set():
             return
         self._stop.set()
+        if self.aggregator is not None:
+            self.aggregator.stop()
         if self._grpc:
             self._grpc.stop(grace=0.5)
         self.mc.stop()
@@ -469,11 +482,12 @@ class FilerServer:
                    fpb.GetFilerConfigurationRequest,
                    fpb.GetFilerConfigurationResponse)
         def get_configuration(req, ctx):
+            import time as _time
             return fpb.GetFilerConfigurationResponse(
                 masters=self.mc.masters, collection=self.collection,
                 replication=self.replication,
                 max_mb=self.chunk_size >> 20,
-                signature=f.signature)
+                signature=f.signature, now_ns=_time.time_ns())
 
         @svc.unary("KvGet", fpb.KvGetRequest, fpb.KvGetResponse)
         def kv_get(req, ctx):
@@ -502,6 +516,30 @@ class FilerServer:
                 if req.signature and req.signature in \
                         resp.event_notification.signatures:
                     continue  # skip events this subscriber itself caused
+                yield resp
+
+        @svc.unary_stream("SubscribeLocalMetadata",
+                          fpb.SubscribeMetadataRequest,
+                          fpb.SubscribeMetadataResponse)
+        def subscribe_local(req, ctx):
+            """Reference SubscribeLocalMetadata (filer.proto): only events
+            that ORIGINATED at this filer — i.e. NOT relayed from a mesh
+            peer. Mesh-relayed events carry a known peer filer's
+            signature; externally-signed local writes (filer.sync imports
+            from another cluster, which tag the source cluster's
+            signature) still count as local and must propagate through
+            the mesh."""
+            stop = threading.Event()
+            ctx.add_callback(stop.set)
+            for resp in f.meta_log.subscribe(req.since_ns, stop):
+                if req.path_prefix and not _under_prefix(resp.directory,
+                                                         req.path_prefix):
+                    continue
+                sigs = set(resp.event_notification.signatures)
+                peer_sigs = (set(self.aggregator.peer_signatures)
+                             if self.aggregator is not None else set())
+                if sigs & peer_sigs:
+                    continue  # relayed from a mesh peer: never re-relay
                 yield resp
 
         return svc

@@ -208,8 +208,9 @@ def apply_event_to_remote(fs, mappings: dict, directory: str,
                     client.write_object_bytes(
                         key, src.read_object(old_key, 0, size))
                     actions.append(f"copy {old_key} -> {key}")
-            elif remote_ref(ev.new_entry) is None and not has_old:
-                # empty local file (no chunks, no ref)
+            elif remote_ref(ev.new_entry) is None:
+                # empty local file: fresh create OR truncate-to-empty of
+                # existing content — both must land remote-side
                 client.write_object_bytes(key, b"")
                 actions.append(f"upload {key}")
     if has_old and (not has_new or is_rename):
