@@ -276,14 +276,21 @@ def run_filer(argv):
     store = opt.store
     if not store:
         from .utils import config as cfg
+        # legacy single-filer layouts keep working: prefer ./filer.db
+        # if it already exists, else the per-port default
+        legacy = "./filer.db"
+        fallback = (f"sqlite:{legacy}" if os.path.exists(legacy)
+                    else f"sqlite:./filer-{opt.port}.db")
         store = cfg.get_dotted(cfg.load_config("filer"),
-                               "filer.options.store",
-                               f"sqlite:./filer-{opt.port}.db")
+                               "filer.options.store", fallback)
     # per-port defaults: two filers started from one cwd (the obvious
-    # way to try the peer mesh) must not share a meta log or store
+    # way to try the peer mesh) must not share a meta log or store; a
+    # pre-existing legacy ./filer-meta.log keeps its name
+    meta_log = ("./filer-meta.log" if os.path.exists("./filer-meta.log")
+                else f"./filer-meta-{opt.port}.log")
     FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
                 grpc_port=opt.grpcPort or None,
-                meta_log_path=f"./filer-meta-{opt.port}.log",
+                meta_log_path=meta_log,
                 collection=opt.collection, replication=opt.replication,
                 chunk_size_mb=opt.maxMB,
                 encrypt_data=opt.encryptVolumeData,

@@ -87,6 +87,13 @@ class FilerServer:
                                              name=f"filer-http-{self.port}")
         self._http_thread.start()
         if self.meta_aggregate:
+            if self.grpc_port != self.port + 10000:
+                # peers dial each other by the grpc = http+10000
+                # convention (FilerClient); a custom grpc port makes this
+                # filer unreachable to its mesh peers
+                log.warning("meta mesh: grpc port %d breaks the port+10000 "
+                            "convention; peers cannot dial this filer",
+                            self.grpc_port)
             from .meta_aggregator import MetaAggregator
             self.aggregator = MetaAggregator(self).start()
         log.info("filer %s up (grpc :%d, store %s)", self.url, self.grpc_port,

@@ -39,6 +39,10 @@ class MetaAggregator:
         # to tell mesh-relayed events (drop) from externally-signed local
         # writes like filer.sync imports (relay)
         self.peer_signatures: dict[int, str] = {}
+        # peer -> newest applied ts not yet persisted (flushed by the
+        # discovery tick and on batch thresholds)
+        self._pending_offsets: dict[str, int] = {}
+        self._offset_lock = threading.Lock()
         self._discover_thread: threading.Thread | None = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -73,7 +77,21 @@ class MetaAggregator:
                                  self.fs.url, addr)
             except Exception as e:  # noqa: BLE001 — master may be electing
                 log.warning("peer discovery: %s", e)
+            for peer in list(self._pending_offsets):
+                self._flush_offset(peer)
             self._stop.wait(DISCOVER_INTERVAL_S)
+
+    def _flush_offset(self, peer: str) -> None:
+        with self._offset_lock:
+            ts = self._pending_offsets.pop(peer, None)
+        if ts is not None:
+            try:
+                self.fs.filer.store.kv_put(self._offset_key(peer),
+                                           struct.pack("<q", ts))
+            except Exception as e:  # noqa: BLE001
+                log.warning("offset persist for %s: %s", peer, e)
+                with self._offset_lock:
+                    self._pending_offsets.setdefault(peer, ts)
 
     def _list_filers(self) -> list[str]:
         resp = Stub(self.fs.mc.leader, MASTER_SERVICE).call(
@@ -105,6 +123,13 @@ class MetaAggregator:
         raw = self.fs.filer.store.kv_get(key)
         since = struct.unpack("<q", raw)[0] if raw else 0
         own = self.fs.filer.signature
+        # batch offset persistence: one kv_put per event doubles store
+        # writes under a burst; re-applying a few events after a crash is
+        # idempotent (create-or-update, delete tolerant of missing). The
+        # discovery tick flushes _pending_offsets so an idle tail still
+        # records its last event within a couple of seconds.
+        last_ts = since
+        pending = 0
         for resp in fc.filer.subscribe_local(since, self._stop):
             ev = resp.event_notification
             if own in ev.signatures:
@@ -124,8 +149,14 @@ class MetaAggregator:
                 log.error("DEAD-LETTER %s from %s: this filer's metadata "
                           "may diverge", resp.directory, peer)
             if resp.ts_ns:
-                self.fs.filer.store.kv_put(key,
-                                           struct.pack("<q", resp.ts_ns))
+                last_ts = resp.ts_ns
+                pending += 1
+                with self._offset_lock:
+                    self._pending_offsets[peer] = last_ts
+                if pending >= 64:
+                    self._flush_offset(peer)
+                    pending = 0
+        self._flush_offset(peer)
 
     def _apply(self, directory: str, ev: fpb.EventNotification) -> None:
         """Metadata-only apply: chunks are shared cluster-wide, so no

@@ -208,9 +208,11 @@ def apply_event_to_remote(fs, mappings: dict, directory: str,
                     client.write_object_bytes(
                         key, src.read_object(old_key, 0, size))
                     actions.append(f"copy {old_key} -> {key}")
-            elif remote_ref(ev.new_entry) is None:
-                # empty local file: fresh create OR truncate-to-empty of
-                # existing content — both must land remote-side
+            elif remote_ref(ev.new_entry) is None and \
+                    (not has_old or is_rename or ev.old_entry.chunks):
+                # empty local file: fresh create, rename, or
+                # truncate-to-empty — but NOT a metadata-only touch of an
+                # already-empty file (old also chunkless)
                 client.write_object_bytes(key, b"")
                 actions.append(f"upload {key}")
     if has_old and (not has_new or is_rename):

@@ -279,14 +279,33 @@ class S3Gateway:
         return seed_ctx
 
     async def _route_bucket(self, request, bucket, q, body):
+        from aiohttp import web
         m = request.method
         if m == "PUT":
             if "acl" in q:
                 return self.put_acl(bucket, "", request, body)
+            if "lifecycle" in q:
+                return self.put_bucket_lifecycle(bucket, body)
+            if "policy" in q:
+                # reference parity: PutBucketPolicyHandler -> NotImplemented
+                # (s3api_bucket_skip_handlers.go:35)
+                raise S3Error("NotImplemented",
+                              "Bucket policies are not implemented.", 501)
+            if "versioning" in q:
+                raise S3Error("NotImplemented",  # skip_handlers.go:47
+                              "Versioning cannot be enabled.", 501)
+            if "object-lock" in q:
+                # bucket-level subresource; acknowledged no-op like the
+                # reference's PutObjectLockConfigurationHandler (204)
+                return web.Response(status=204)
             return self.put_bucket(bucket, acl=self._canned_acl(request))
         if m == "HEAD":
             return self.head_bucket(bucket)
         if m == "DELETE":
+            if "lifecycle" in q:
+                return self.delete_bucket_lifecycle(bucket)
+            if "policy" in q:  # skip_handlers.go:41 returns 204
+                return web.Response(status=204)
             return self.delete_bucket(bucket)
         if m == "POST" and "delete" in q:
             return self.delete_multiple_objects(bucket, body)
@@ -296,6 +315,17 @@ class S3Gateway:
         if m == "GET":
             if "acl" in q:
                 return self.get_acl(bucket, "")
+            if "lifecycle" in q:
+                return self.get_bucket_lifecycle(bucket)
+            if "policy" in q:  # skip_handlers.go:29
+                raise S3Error("NoSuchBucketPolicy",
+                              "The bucket policy does not exist", 404)
+            if "versioning" in q:
+                return self.get_bucket_versioning(bucket)
+            if "object-lock" in q:
+                raise S3Error("ObjectLockConfigurationNotFoundError",
+                              "Object Lock configuration does not exist "
+                              "for this bucket", 404)
             if "uploads" in q:
                 return self.list_multipart_uploads(bucket, q)
             return self.list_objects(bucket, q)
@@ -310,6 +340,11 @@ class S3Gateway:
                 return self.put_acl(bucket, key, request, body)
             if "tagging" in q:
                 return self.put_object_tagging(bucket, key, body)
+            if "retention" in q or "legal-hold" in q:
+                # reference parity: PutObjectRetention/LegalHold are
+                # acknowledged no-ops (object_handlers_skip.go:25-37)
+                from aiohttp import web
+                return web.Response(status=204)
             src = request.headers.get("x-amz-copy-source")
             if src:
                 return self.copy_object(bucket, key, src,
@@ -328,6 +363,12 @@ class S3Gateway:
                 return self.get_acl(bucket, key)
             if "tagging" in q:
                 return self.get_object_tagging(bucket, key)
+            if "retention" in q or "legal-hold" in q:
+                # never set (the PUTs are no-ops): answer not-found, not
+                # the object body
+                raise S3Error("NoSuchObjectLockConfiguration",
+                              "The specified object does not have an "
+                              "ObjectLock configuration", 404)
             if "uploadId" in q:
                 return self.list_parts(bucket, key, q)
             return self.get_object(bucket, key, request)
@@ -340,6 +381,121 @@ class S3Gateway:
         raise S3Error("MethodNotAllowed", "Method not allowed.", 405)
 
     # -- buckets -------------------------------------------------------------
+    # -- bucket lifecycle (reference s3api_bucket_handlers.go:300-470:
+    # expiration rules map onto filer.conf TTL path rules; transitions
+    # and date-based expiry are NotImplemented there too) ------------------
+    def _read_filer_conf(self):
+        from ..filer.filer_conf import CONF_DIR, CONF_NAME, FilerConf
+        entry = self.fs.filer.find_entry(CONF_DIR, CONF_NAME)
+        raw = self.fs.read_entry_bytes(entry) if entry is not None else b""
+        return FilerConf.from_bytes(raw)
+
+    def _save_filer_conf(self, conf) -> None:
+        from ..filer.filer_conf import CONF_PATH
+        self.fs.write_file(CONF_PATH, conf.to_bytes(),
+                           mime="application/json")
+
+    def put_bucket_lifecycle(self, bucket, body):
+        from aiohttp import web
+
+        from ..filer.filer_conf import PathRule
+        self._require_bucket(bucket)
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML", "Invalid lifecycle XML.", 400)
+        ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
+        conf = self._read_filer_conf()
+        changed = False
+        for rule in root.iter(f"{ns}Rule"):
+            if (rule.findtext(f"{ns}Status") or "").strip() != "Enabled":
+                continue
+            prefix = (rule.findtext(f"{ns}Filter/{ns}Prefix")
+                      or rule.findtext(f"{ns}Prefix") or "").strip()
+            exp = rule.find(f"{ns}Expiration")
+            try:
+                days = int(exp.findtext(f"{ns}Days") or 0) \
+                    if exp is not None else 0
+            except ValueError:
+                raise S3Error("MalformedXML", "Invalid expiration days.",
+                              400)
+            if exp is not None and exp.find(f"{ns}Date") is not None or \
+                    rule.find(f"{ns}Transition") is not None:
+                raise S3Error("NotImplemented",
+                              "Only Days-based expiration is supported.",
+                              501)
+            if days <= 0:
+                continue
+            lp = f"{BUCKETS_DIR}/{bucket}/{prefix}"
+            # merge into any admin-set rule for the prefix: the lifecycle
+            # owns only the TTL, never replication/collection/disk_type
+            import dataclasses
+            existing = next((r for r in conf.rules
+                             if r.location_prefix == lp), None)
+            conf.upsert(dataclasses.replace(existing, ttl=f"{days}d")
+                        if existing is not None
+                        else PathRule(location_prefix=lp, ttl=f"{days}d"))
+            changed = True
+        if changed:
+            self._save_filer_conf(conf)
+        return web.Response(status=200)
+
+    def get_bucket_lifecycle(self, bucket):
+        self._require_bucket(bucket)
+        conf = self._read_filer_conf()
+        prefix = f"{BUCKETS_DIR}/{bucket}/"
+        rules = [(r.location_prefix[len(prefix):], r.ttl)
+                 for r in conf.rules
+                 if r.location_prefix.startswith(prefix)
+                 and r.ttl.endswith("d")]
+        if not rules:
+            raise S3Error("NoSuchLifecycleConfiguration",
+                          "The lifecycle configuration does not exist.", 404)
+        root = ET.Element("LifecycleConfiguration")
+        for i, (p, ttl) in enumerate(sorted(rules)):
+            rule = ET.SubElement(root, "Rule")
+            ET.SubElement(rule, "ID").text = f"rule-{i + 1}"
+            f = ET.SubElement(rule, "Filter")
+            ET.SubElement(f, "Prefix").text = p
+            ET.SubElement(rule, "Status").text = "Enabled"
+            exp = ET.SubElement(rule, "Expiration")
+            ET.SubElement(exp, "Days").text = ttl[:-1]
+        return _xml_response(root)
+
+    def delete_bucket_lifecycle(self, bucket):
+        import dataclasses
+
+        from aiohttp import web
+        self._require_bucket(bucket)
+        conf = self._read_filer_conf()
+        prefix = f"{BUCKETS_DIR}/{bucket}/"
+        changed = False
+        for r in list(conf.rules):
+            if not (r.location_prefix.startswith(prefix)
+                    and r.ttl.endswith("d")):
+                continue
+            # drop only the TTL; a rule an admin enriched with
+            # replication/collection/disk_type survives without it
+            stripped = dataclasses.replace(r, ttl="")
+            if any(getattr(stripped, k) not in ("", False, 0)
+                   for k in ("collection", "replication", "disk_type",
+                             "fsync", "volume_growth_count")):
+                conf.upsert(stripped)
+            else:
+                conf.delete(r.location_prefix)
+            changed = True
+        if changed:
+            self._save_filer_conf(conf)
+        return web.Response(status=204)
+
+    def get_bucket_versioning(self, bucket):
+        """Reference GetBucketVersioningHandler: always Suspended
+        (s3api_bucket_handlers.go:651)."""
+        self._require_bucket(bucket)
+        root = ET.Element("VersioningConfiguration")
+        ET.SubElement(root, "Status").text = "Suspended"
+        return _xml_response(root)
+
     def _bucket_dir(self, bucket: str) -> str:
         return f"{BUCKETS_DIR}/{bucket}"
 

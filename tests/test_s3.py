@@ -761,3 +761,80 @@ def test_acl_post_policy_field(s3):
         b"form-bytes"
     assert "AllUsers" in requests.get(f"{base}/aclpp/form.txt?acl",
                                       timeout=10).text
+
+
+def test_bucket_lifecycle_configuration(s3, filer_server):
+    """PutBucketLifecycleConfiguration maps Days-based expiration rules
+    onto filer.conf TTL path rules (reference
+    s3api_bucket_handlers.go PutBucketLifecycleConfigurationHandler);
+    Get reads them back; Delete removes them."""
+    gw, base = s3
+    requests.put(f"{base}/lcbkt", timeout=10)
+    xml = """<LifecycleConfiguration>
+      <Rule><ID>r1</ID><Status>Enabled</Status>
+        <Filter><Prefix>logs/</Prefix></Filter>
+        <Expiration><Days>7</Days></Expiration></Rule>
+      <Rule><ID>r2</ID><Status>Disabled</Status>
+        <Prefix>tmp/</Prefix>
+        <Expiration><Days>1</Days></Expiration></Rule>
+    </LifecycleConfiguration>"""
+    r = requests.put(f"{base}/lcbkt?lifecycle", data=xml, timeout=10)
+    assert r.status_code == 200, r.text
+    # rule landed in the filer conf (enabled rule only)
+    from seaweedfs_tpu.filer.filer_conf import CONF_DIR, CONF_NAME, FilerConf
+    entry = filer_server.filer.find_entry(CONF_DIR, CONF_NAME)
+    conf = FilerConf.from_bytes(filer_server.read_entry_bytes(entry))
+    rule = conf.match("/buckets/lcbkt/logs/app.log")
+    assert rule is not None and rule.ttl == "7d"
+    assert conf.match("/buckets/lcbkt/tmp/x") is None or \
+        conf.match("/buckets/lcbkt/tmp/x").location_prefix != \
+        "/buckets/lcbkt/tmp/"
+    # read back
+    r = requests.get(f"{base}/lcbkt?lifecycle", timeout=10)
+    assert r.status_code == 200
+    assert "<Days>7</Days>" in r.text and "logs/" in r.text
+    # unsupported shapes are refused like the reference
+    bad = ("<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+           "<Expiration><Date>2030-01-01T00:00:00Z</Date></Expiration>"
+           "</Rule></LifecycleConfiguration>")
+    assert requests.put(f"{base}/lcbkt?lifecycle", data=bad,
+                        timeout=10).status_code == 501
+    # delete
+    assert requests.delete(f"{base}/lcbkt?lifecycle",
+                           timeout=10).status_code == 204
+    assert requests.get(f"{base}/lcbkt?lifecycle",
+                        timeout=10).status_code == 404
+    requests.delete(f"{base}/lcbkt", timeout=10)
+
+
+def test_policy_versioning_lock_parity_stubs(s3):
+    """Reference-faithful behavior for the surfaces the reference itself
+    stubs: bucket policy (skip_handlers.go:29-41), versioning
+    (handlers.go:651 always Suspended / skip:47), object lock trio
+    (object_handlers_skip.go: 204)."""
+    gw, base = s3
+    requests.put(f"{base}/stubbkt", timeout=10)
+    assert requests.get(f"{base}/stubbkt?policy",
+                        timeout=10).status_code == 404
+    assert requests.put(f"{base}/stubbkt?policy", data="{}",
+                        timeout=10).status_code == 501
+    assert requests.delete(f"{base}/stubbkt?policy",
+                           timeout=10).status_code == 204
+    r = requests.get(f"{base}/stubbkt?versioning", timeout=10)
+    assert r.status_code == 200 and "Suspended" in r.text
+    assert requests.put(f"{base}/stubbkt?versioning", data="<x/>",
+                        timeout=10).status_code == 501
+    # object-lock configuration is a BUCKET subresource
+    assert requests.put(f"{base}/stubbkt?object-lock", data="<x/>",
+                        timeout=10).status_code == 204
+    assert requests.get(f"{base}/stubbkt?object-lock",
+                        timeout=10).status_code == 404
+    requests.put(f"{base}/stubbkt/locked.txt", data=b"x", timeout=10)
+    for sub in ("retention", "legal-hold"):
+        assert requests.put(f"{base}/stubbkt/locked.txt?{sub}",
+                            data="<x/>", timeout=10).status_code == 204
+        # never set -> not-found, NOT the object body
+        assert requests.get(f"{base}/stubbkt/locked.txt?{sub}",
+                            timeout=10).status_code == 404
+    requests.delete(f"{base}/stubbkt/locked.txt", timeout=10)
+    requests.delete(f"{base}/stubbkt", timeout=10)
