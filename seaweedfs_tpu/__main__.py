@@ -261,7 +261,7 @@ def run_filer(argv):
     p.add_argument("-port", type=int, default=8888)
     p.add_argument("-grpcPort", type=int, default=0)
     p.add_argument("-store", default="",
-                   help="memory | sqlite:/path.db | logdb:/path.logdb "
+                   help="memory | sqlite:/path.db | logdb:/path.logdb | lsm:/dir "
                         "(default: filer.toml or sqlite ./filer.db)")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
@@ -276,17 +276,21 @@ def run_filer(argv):
     store = opt.store
     if not store:
         from .utils import config as cfg
-        # legacy single-filer layouts keep working: prefer ./filer.db
-        # if it already exists, else the per-port default
+        # legacy single-filer layouts keep working, but ONLY on the
+        # default port — a second filer on another port must never
+        # adopt (and corrupt) the shared legacy files
         legacy = "./filer.db"
-        fallback = (f"sqlite:{legacy}" if os.path.exists(legacy)
+        fallback = (f"sqlite:{legacy}"
+                    if opt.port == 8888 and os.path.exists(legacy)
                     else f"sqlite:./filer-{opt.port}.db")
         store = cfg.get_dotted(cfg.load_config("filer"),
                                "filer.options.store", fallback)
     # per-port defaults: two filers started from one cwd (the obvious
     # way to try the peer mesh) must not share a meta log or store; a
-    # pre-existing legacy ./filer-meta.log keeps its name
-    meta_log = ("./filer-meta.log" if os.path.exists("./filer-meta.log")
+    # pre-existing legacy ./filer-meta.log keeps its name on the
+    # default port only (same rule as the store above)
+    meta_log = ("./filer-meta.log"
+                if opt.port == 8888 and os.path.exists("./filer-meta.log")
                 else f"./filer-meta-{opt.port}.log")
     FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
                 grpc_port=opt.grpcPort or None,

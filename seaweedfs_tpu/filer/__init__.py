@@ -8,10 +8,11 @@ and gRPC APIs."""
 from .chunks import ChunkView, read_views, resolve_chunks, total_size
 from .filer import Filer, join_path, split_path
 from .filer_server import FilerServer
-from .store import FilerStore, LogDbStore, MemoryStore, SqliteStore, open_store
+from .store import (FilerStore, LogDbStore, LsmStore, MemoryStore,
+                    SqliteStore, open_store)
 
 __all__ = [
-    "ChunkView", "Filer", "FilerServer", "FilerStore", "LogDbStore",
+    "ChunkView", "Filer", "FilerServer", "FilerStore", "LogDbStore", "LsmStore",
     "MemoryStore", "SqliteStore", "join_path", "open_store", "read_views",
     "resolve_chunks", "split_path", "total_size",
 ]

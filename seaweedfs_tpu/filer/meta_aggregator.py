@@ -82,16 +82,22 @@ class MetaAggregator:
             self._stop.wait(DISCOVER_INTERVAL_S)
 
     def _flush_offset(self, peer: str) -> None:
+        # the kv_put happens INSIDE the lock: two racing flushers (the
+        # discovery tick + the subscriber's batch path) must not let an
+        # older offset land after a newer one and regress the resume point
         with self._offset_lock:
             ts = self._pending_offsets.pop(peer, None)
-        if ts is not None:
+            if ts is None:
+                return
             try:
-                self.fs.filer.store.kv_put(self._offset_key(peer),
-                                           struct.pack("<q", ts))
+                key = self._offset_key(peer)
+                raw = self.fs.filer.store.kv_get(key)
+                if raw and struct.unpack("<q", raw)[0] >= ts:
+                    return
+                self.fs.filer.store.kv_put(key, struct.pack("<q", ts))
             except Exception as e:  # noqa: BLE001
                 log.warning("offset persist for %s: %s", peer, e)
-                with self._offset_lock:
-                    self._pending_offsets.setdefault(peer, ts)
+                self._pending_offsets.setdefault(peer, ts)
 
     def _list_filers(self) -> list[str]:
         resp = Stub(self.fs.mc.leader, MASTER_SERVICE).call(

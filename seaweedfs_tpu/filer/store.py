@@ -313,5 +313,252 @@ def open_store(spec: str) -> FilerStore:
         return SqliteStore(arg or "filer.sqlite")
     if kind == "logdb":
         return LogDbStore(arg or "filer.logdb")
-    raise ValueError(f"unknown filer store {spec!r} "
-                     f"(supported: memory, sqlite:<path>, logdb:<path>)")
+    if kind in ("lsm", "leveldb"):
+        # "leveldb" accepted for reference-flag familiarity: LsmStore is
+        # the from-scratch leveldb analogue
+        return LsmStore(arg or "filer-lsm")
+    raise ValueError(f"unknown filer store {spec!r} (supported: memory, "
+                     f"sqlite:<path>, logdb:<path>, lsm:<dir>)")
+
+
+class LsmStore(FilerStore):
+    """Log-structured merge store: WAL + memtable + sorted SSTables with
+    merge compaction — a from-scratch leveldb analogue (the reference's
+    most common backend, weed/filer/leveldb; this image has no leveldb
+    binding, so the storage engine itself is implemented here).
+
+    Layout under `path/`:
+      wal.log      length-prefixed mutations, fsync'd, replayed at open
+      sst-<n>.sst  immutable sorted (key, value) runs; newest wins
+    Keyspace: b"E" + dir + b"\\x00" + name for entries, b"K" + key for KV;
+    deletes are tombstones that compaction drops.
+    """
+
+    name = "lsm"
+    MEMTABLE_LIMIT = 1024
+    COMPACT_AT = 6
+    _REC = struct.Struct("<BII")  # op (0 put / 1 del), klen, vlen
+
+    def __init__(self, path: str, memtable_limit: int | None = None):
+        self.dir = path
+        os.makedirs(path, exist_ok=True)
+        if memtable_limit:
+            self.MEMTABLE_LIMIT = memtable_limit
+        self._lock = threading.RLock()
+        # memtable: key -> value bytes | None (tombstone)
+        self._mem: dict[bytes, bytes | None] = {}
+        # ssts: list of (seq, {key: (offset, vlen) | None}) newest LAST;
+        # key indexes live in memory, values read on demand
+        self._ssts: list[tuple[int, dict]] = []
+        self._next_seq = 0
+        for fn in sorted(os.listdir(path)):
+            if fn.startswith("sst-") and fn.endswith(".sst"):
+                seq = int(fn[4:-4])
+                self._ssts.append((seq, self._load_index(self._sst_path(seq))))
+                self._next_seq = max(self._next_seq, seq + 1)
+        self._ssts.sort(key=lambda t: t[0])
+        self._wal_path = os.path.join(path, "wal.log")
+        self._replay_wal()
+        self._wal = open(self._wal_path, "ab")
+
+    # -- file plumbing ------------------------------------------------------
+    def _sst_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"sst-{seq}.sst")
+
+    def _load_index(self, path: str) -> dict:
+        idx: dict[bytes, "tuple[int, int] | None"] = {}
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(self._REC.size)
+                if len(hdr) < self._REC.size:
+                    break
+                op, klen, vlen = self._REC.unpack(hdr)
+                key = f.read(klen)
+                if op == 1:
+                    idx[key] = None  # tombstone
+                    continue
+                idx[key] = (f.tell(), vlen)
+                f.seek(vlen, 1)
+        return idx
+
+    def _read_value(self, seq: int, pos: "tuple[int, int]") -> bytes:
+        with open(self._sst_path(seq), "rb") as f:
+            f.seek(pos[0])
+            return f.read(pos[1])
+
+    def _replay_wal(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            while True:
+                hdr = f.read(self._REC.size)
+                if len(hdr) < self._REC.size:
+                    break
+                op, klen, vlen = self._REC.unpack(hdr)
+                body = f.read(klen + vlen)
+                if len(body) < klen + vlen:
+                    break  # torn tail: drop the partial record
+                key = body[:klen]
+                self._mem[key] = None if op == 1 else body[klen:]
+
+    def _log(self, key: bytes, value: "bytes | None") -> None:
+        rec = self._REC.pack(1 if value is None else 0, len(key),
+                             0 if value is None else len(value))
+        self._wal.write(rec + key + (value or b""))
+        self._wal.flush()
+        os.fsync(self._wal.fileno())
+
+    # -- core write path ----------------------------------------------------
+    def _put(self, key: bytes, value: "bytes | None") -> None:
+        with self._lock:
+            self._log(key, value)
+            self._mem[key] = value
+            if len(self._mem) >= self.MEMTABLE_LIMIT:
+                self._flush_memtable()
+
+    def _flush_memtable(self) -> None:
+        """Write the memtable as a new SST, truncate the WAL (caller
+        holds lock)."""
+        if not self._mem:
+            return
+        seq = self._next_seq
+        self._next_seq += 1
+        tmp = self._sst_path(seq) + ".tmp"
+        with open(tmp, "wb") as f:
+            for key in sorted(self._mem):
+                value = self._mem[key]
+                f.write(self._REC.pack(1 if value is None else 0, len(key),
+                                       0 if value is None else len(value)))
+                f.write(key + (value or b""))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._sst_path(seq))
+        self._ssts.append((seq, self._load_index(self._sst_path(seq))))
+        self._mem.clear()
+        self._wal.close()
+        self._wal = open(self._wal_path, "wb")  # truncate
+        if len(self._ssts) >= self.COMPACT_AT:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Full merge: newest wins, tombstones dropped (caller holds
+        lock)."""
+        merged: dict[bytes, bytes] = {}
+        for seq, idx in self._ssts:  # oldest -> newest
+            for key, pos in idx.items():
+                if pos is None:
+                    merged.pop(key, None)
+                else:
+                    merged[key] = self._read_value(seq, pos)
+        seq = self._next_seq
+        self._next_seq += 1
+        tmp = self._sst_path(seq) + ".tmp"
+        with open(tmp, "wb") as f:
+            for key in sorted(merged):
+                value = merged[key]
+                f.write(self._REC.pack(0, len(key), len(value)))
+                f.write(key + value)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._sst_path(seq))
+        old = self._ssts
+        self._ssts = [(seq, self._load_index(self._sst_path(seq)))]
+        for oseq, _ in old:
+            try:
+                os.unlink(self._sst_path(oseq))
+            except FileNotFoundError:
+                pass
+
+    # -- reads --------------------------------------------------------------
+    def _get(self, key: bytes) -> "bytes | None":
+        with self._lock:
+            if key in self._mem:
+                return self._mem[key]
+            for seq, idx in reversed(self._ssts):  # newest first
+                if key in idx:
+                    pos = idx[key]
+                    return None if pos is None else self._read_value(seq, pos)
+        return None
+
+    def _scan(self, lo: bytes, hi: bytes) -> "Iterator[tuple[bytes, bytes]]":
+        """Sorted live (key, value) pairs in [lo, hi); newest wins.
+        Materialized under the lock, yielded outside it — a slow
+        consumer must not block writers, and a concurrent compaction
+        may unlink the SST a lazy (seq, pos) would point at."""
+        with self._lock:
+            view: dict[bytes, "tuple[int, tuple | bytes | None]"] = {}
+            for seq, idx in self._ssts:  # oldest -> newest overwrites
+                for key, pos in idx.items():
+                    if lo <= key < hi:
+                        view[key] = (seq, pos)
+            for key, value in self._mem.items():
+                if lo <= key < hi:
+                    view[key] = (-1, value)
+            pairs: list[tuple[bytes, bytes]] = []
+            for key in sorted(view):
+                src, payload = view[key]
+                if src == -1:
+                    if payload is not None:
+                        pairs.append((key, payload))
+                elif payload is not None:
+                    pairs.append((key, self._read_value(src, payload)))
+        yield from pairs
+
+    # -- FilerStore contract ------------------------------------------------
+    @staticmethod
+    def _ekey(directory: str, name: str = "") -> bytes:
+        return b"E" + directory.encode() + b"\x00" + name.encode()
+
+    def insert_entry(self, directory, entry):
+        self._put(self._ekey(directory, entry.name),
+                  entry.SerializeToString())
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory, name):
+        raw = self._get(self._ekey(directory, name))
+        if raw is None:
+            return None
+        e = fpb.Entry()
+        e.ParseFromString(raw)
+        return e
+
+    def delete_entry(self, directory, name):
+        self._put(self._ekey(directory, name), None)
+
+    def delete_folder_children(self, directory):
+        lo = self._ekey(directory)
+        hi = lo[:-1] + b"\x01"
+        for key, _ in list(self._scan(lo, hi)):
+            self._put(key, None)
+
+    def list_entries(self, directory, start_from="", inclusive=False,
+                     limit=2**31, prefix=""):
+        base = self._ekey(directory)
+        lo, hi = base, base[:-1] + b"\x01"
+        n = 0
+        for key, raw in self._scan(lo, hi):
+            name = key[len(base):].decode()
+            if prefix and not name.startswith(prefix):
+                continue
+            if start_from:
+                if name < start_from or (name == start_from
+                                         and not inclusive):
+                    continue
+            if n >= limit:
+                return
+            e = fpb.Entry()
+            e.ParseFromString(raw)
+            n += 1
+            yield e
+
+    def kv_get(self, key):
+        return self._get(b"K" + key)
+
+    def kv_put(self, key, value):
+        self._put(b"K" + key, value)
+
+    def close(self):
+        with self._lock:
+            self._flush_memtable()
+            self._wal.close()

@@ -209,10 +209,12 @@ def apply_event_to_remote(fs, mappings: dict, directory: str,
                         key, src.read_object(old_key, 0, size))
                     actions.append(f"copy {old_key} -> {key}")
             elif remote_ref(ev.new_entry) is None and \
-                    (not has_old or is_rename or ev.old_entry.chunks):
+                    (not has_old or is_rename or ev.old_entry.chunks
+                     or remote_ref(ev.old_entry) is not None):
                 # empty local file: fresh create, rename, or
-                # truncate-to-empty — but NOT a metadata-only touch of an
-                # already-empty file (old also chunkless)
+                # truncate-to-empty of content that existed locally
+                # (chunks) OR remote-only (ref) — but NOT a metadata-only
+                # touch of an already-empty file
                 client.write_object_bytes(key, b"")
                 actions.append(f"upload {key}")
     if has_old and (not has_new or is_rename):

@@ -406,7 +406,10 @@ class S3Gateway:
             raise S3Error("MalformedXML", "Invalid lifecycle XML.", 400)
         ns = root.tag.split("}")[0] + "}" if root.tag.startswith("{") else ""
         conf = self._read_filer_conf()
-        changed = False
+        # S3 semantics: PUT REPLACES the whole lifecycle configuration —
+        # strip the TTLs a previous PUT installed before applying the new
+        # rules (a PUT that only drops a rule must not be a no-op)
+        changed = self._strip_lifecycle_ttls(conf, bucket)
         for rule in root.iter(f"{ns}Rule"):
             if (rule.findtext(f"{ns}Status") or "").strip() != "Enabled":
                 continue
@@ -462,20 +465,17 @@ class S3Gateway:
             ET.SubElement(exp, "Days").text = ttl[:-1]
         return _xml_response(root)
 
-    def delete_bucket_lifecycle(self, bucket):
+    def _strip_lifecycle_ttls(self, conf, bucket: str) -> bool:
+        """Remove the TTLs lifecycle PUTs own under the bucket; rules an
+        admin enriched with replication/collection/disk_type survive
+        (TTL-less). Returns whether anything changed."""
         import dataclasses
-
-        from aiohttp import web
-        self._require_bucket(bucket)
-        conf = self._read_filer_conf()
         prefix = f"{BUCKETS_DIR}/{bucket}/"
         changed = False
         for r in list(conf.rules):
             if not (r.location_prefix.startswith(prefix)
                     and r.ttl.endswith("d")):
                 continue
-            # drop only the TTL; a rule an admin enriched with
-            # replication/collection/disk_type survives without it
             stripped = dataclasses.replace(r, ttl="")
             if any(getattr(stripped, k) not in ("", False, 0)
                    for k in ("collection", "replication", "disk_type",
@@ -484,7 +484,13 @@ class S3Gateway:
             else:
                 conf.delete(r.location_prefix)
             changed = True
-        if changed:
+        return changed
+
+    def delete_bucket_lifecycle(self, bucket):
+        from aiohttp import web
+        self._require_bucket(bucket)
+        conf = self._read_filer_conf()
+        if self._strip_lifecycle_ttls(conf, bucket):
             self._save_filer_conf(conf)
         return web.Response(status=204)
 

@@ -793,6 +793,18 @@ def test_bucket_lifecycle_configuration(s3, filer_server):
     r = requests.get(f"{base}/lcbkt?lifecycle", timeout=10)
     assert r.status_code == 200
     assert "<Days>7</Days>" in r.text and "logs/" in r.text
+    # PUT replaces the WHOLE configuration (S3 semantics): the logs/
+    # rule must disappear when a new config names only tmp/
+    repl = ("<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+            "<Filter><Prefix>tmp/</Prefix></Filter>"
+            "<Expiration><Days>2</Days></Expiration></Rule>"
+            "</LifecycleConfiguration>")
+    assert requests.put(f"{base}/lcbkt?lifecycle", data=repl,
+                        timeout=10).status_code == 200
+    r = requests.get(f"{base}/lcbkt?lifecycle", timeout=10)
+    assert "tmp/" in r.text and "logs/" not in r.text
+    assert requests.put(f"{base}/lcbkt?lifecycle", data=xml,
+                        timeout=10).status_code == 200
     # unsupported shapes are refused like the reference
     bad = ("<LifecycleConfiguration><Rule><Status>Enabled</Status>"
            "<Expiration><Date>2030-01-01T00:00:00Z</Date></Expiration>"
