@@ -525,6 +525,13 @@ class FilerServer:
                     continue  # skip events this subscriber itself caused
                 yield resp
 
+        @svc.unary("PurgeMetaLog", fpb.PurgeMetaLogRequest,
+                   fpb.PurgeMetaLogResponse)
+        def purge_meta_log(req, ctx):
+            """shell fs.log.purge (reference command_fs_log_purge.go)."""
+            return fpb.PurgeMetaLogResponse(
+                purged=f.meta_log.purge(req.before_ns))
+
         @svc.unary_stream("SubscribeLocalMetadata",
                           fpb.SubscribeMetadataRequest,
                           fpb.SubscribeMetadataResponse)

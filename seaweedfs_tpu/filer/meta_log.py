@@ -47,6 +47,44 @@ class MetaLog:
             self._cond.notify_all()
         return ts
 
+    def purge(self, before_ns: int) -> int:
+        """Drop persisted events older than `before_ns` (shell
+        fs.log.purge; the reference deletes dated log files under
+        /topics/.system/log the same way, command_fs_log_purge.go).
+        Returns the number of purged records."""
+        if not self._path or not os.path.exists(self._path):
+            return 0
+        with self._cond:
+            # single streaming pass straight into the replacement file:
+            # O(1) memory, and the (unavoidable) lock hold is one
+            # read+write sweep, not two passes plus a buffered list
+            dropped = 0
+            tmp = self._path + ".tmp"
+            with open(self._path, "rb") as src, open(tmp, "wb") as dst:
+                while True:
+                    hdr = src.read(_HDR.size)
+                    if len(hdr) < _HDR.size:
+                        break
+                    ts, ln = _HDR.unpack(hdr)
+                    blob = src.read(ln)
+                    if len(blob) < ln:
+                        break
+                    if ts < before_ns:
+                        dropped += 1
+                    else:
+                        dst.write(hdr + blob)
+                dst.flush()
+                os.fsync(dst.fileno())
+            if not dropped:
+                os.unlink(tmp)
+                return 0
+            if self._f:
+                self._f.close()
+            os.replace(tmp, self._path)
+            if self._f:
+                self._f = open(self._path, "ab")
+            return dropped
+
     def _read_persisted(self, since_ns: int) -> list[tuple[int, bytes]]:
         if not self._path or not os.path.exists(self._path):
             return []

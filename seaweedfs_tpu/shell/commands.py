@@ -28,9 +28,15 @@ class Command:
     needs_lock: bool = False
 
 
-def command(name: str, help: str, needs_lock: bool = False):
+def command(name: str, help: str, needs_lock: bool = False,
+            aliases: tuple = ()):
+    """`aliases` carries the reference's exact Name() spellings (e.g.
+    volumeServer.evacuate) so migrating operators find them; registered
+    at import time alongside the canonical name."""
     def deco(fn):
         COMMANDS[name] = Command(name, help, fn, needs_lock)
+        for a in aliases:
+            COMMANDS[a] = Command(a, f"alias of {name}", fn, needs_lock)
         return fn
     return deco
 
@@ -133,3 +139,4 @@ def repl(env: CommandEnv) -> None:
         except Exception as e:  # noqa: BLE001
             env.println(f"error: {e}")
     env.release_lock()
+

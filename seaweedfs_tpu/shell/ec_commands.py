@@ -419,7 +419,8 @@ def cmd_ec_decode(env: CommandEnv, args):
 
 
 @command("ec.volume.delete", "-volumeId N [-collection C]: delete an ec "
-         "volume's shards everywhere", needs_lock=True)
+         "volume's shards everywhere", needs_lock=True,
+         aliases=("ecVolume.delete",))
 def cmd_ec_volume_delete(env: CommandEnv, args):
     """Reference command_ecVolume_delete.go (fork)."""
     p = argparse.ArgumentParser(prog="ec.volume.delete")

@@ -497,7 +497,7 @@ def cmd_s3_bucket_quota(env: CommandEnv, args):
 
 
 @command("s3.bucket.quota.check", "enforce bucket quotas: over-quota buckets "
-         "become read-only")
+         "become read-only", aliases=("s3.bucket.quota.enforce",))
 def cmd_s3_bucket_quota_check(env: CommandEnv, args):
     """Reference command_s3_bucket_quota_check.go."""
     opt = _fs_parser("s3.bucket.quota.check").parse_args(args)
@@ -665,7 +665,7 @@ def _rewrite_chunks(env: CommandEnv, stub: Stub, directory: str,
 
 @command("fs.merge.volumes", "[-dir /] [-collection '*'] [-fromVolumeId x] "
          "[-toVolumeId y] [-apply]: re-locate chunks out of lighter volumes "
-         "so vacuum can clear them")
+         "so vacuum can clear them", aliases=("fs.mergeVolumes",))
 def cmd_fs_merge_volumes(env: CommandEnv, args):
     """Reference command_fs_merge_volumes.go: plan light->full merges among
     compatible volumes (same collection/ttl/replication, projected size
@@ -944,3 +944,20 @@ def cmd_s3_circuitbreaker(env: CommandEnv, args):
         return
     _write_filer_json(env, opt.filer, CB_DIR, CB_FILE, conf)
     env.println(f"saved {CB_DIR}/{CB_FILE}")
+
+
+@command("fs.log.purge", "[-daysAgo N]: drop filer meta-log events older "
+         "than N days")
+def cmd_fs_log_purge(env: CommandEnv, args):
+    """Reference command_fs_log_purge.go (it deletes dated log files under
+    /topics/.system/log; our filer compacts its meta log in place)."""
+    import time as _time
+
+    p = _fs_parser("fs.log.purge")
+    p.add_argument("-daysAgo", type=float, default=365)
+    opt = p.parse_args(args)
+    before = _time.time_ns() - int(opt.daysAgo * 86400 * 1e9)
+    resp = _filer_stub(env, opt.filer).call(
+        "PurgeMetaLog", fpb.PurgeMetaLogRequest(before_ns=before),
+        fpb.PurgeMetaLogResponse)
+    env.println(f"purged {resp.purged} meta-log event(s)")

@@ -59,7 +59,8 @@ def cmd_mq_topic_list(env: CommandEnv, args):
     env.println(f"{len(resp.topics)} topics")
 
 
-@command("mq.topic.desc", "-topic ns/name: describe a topic's partitions")
+@command("mq.topic.desc", "-topic ns/name: describe a topic's "
+         "partitions", aliases=("mq.topic.describe",))
 def cmd_mq_topic_desc(env: CommandEnv, args):
     p = _mq_parser("mq.topic.desc")
     p.add_argument("-topic", required=True)

@@ -374,7 +374,7 @@ def cmd_collection_delete(env: CommandEnv, args):
 
 
 @command("volume.server.evacuate",
-         "move every volume and EC shard off one server", needs_lock=True)
+         "move every volume and EC shard off one server", needs_lock=True, aliases=("volumeServer.evacuate",))
 def cmd_volume_server_evacuate(env: CommandEnv, args):
     """Reference shell/command_volume_server_evacuate.go: drain a server
     before decommissioning."""
@@ -603,7 +603,7 @@ def cmd_volume_copy(env: CommandEnv, args):
 
 
 @command("volume.delete.empty", "[-force]: delete volumes with no live "
-         "needles cluster-wide", needs_lock=True)
+         "needles cluster-wide", needs_lock=True, aliases=("volume.deleteEmpty",))
 def cmd_volume_delete_empty(env: CommandEnv, args):
     """Reference command_volume_delete_empty.go."""
     p = argparse.ArgumentParser(prog="volume.delete.empty")
@@ -628,7 +628,8 @@ def cmd_volume_delete_empty(env: CommandEnv, args):
 
 
 @command("volume.server.leave", "-node ip:port: drain a server from the "
-         "cluster (stops heartbeats)", needs_lock=True)
+         "cluster (stops heartbeats)", needs_lock=True,
+         aliases=("volumeServer.leave",))
 def cmd_volume_server_leave(env: CommandEnv, args):
     """Reference command_volume_server_leave.go."""
     p = argparse.ArgumentParser(prog="volume.server.leave")

@@ -594,3 +594,42 @@ def test_remote_mount_buckets(env, stack, tmp_path):
     mappings = _load_mappings(fc)
     assert "/buckets/beta" in mappings
     assert fs.filer.find_entry("/buckets/beta", "y.txt") is not None
+
+
+def test_fs_log_purge(env, stack, tmp_path):
+    """fs.log.purge compacts the filer meta log in place (reference
+    command_fs_log_purge.go semantics over our single-file log)."""
+    import re
+
+    from conftest import free_port_pair
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+
+    e, out = env
+    fport = free_port_pair()
+    fs2 = FilerServer(stack["ms"].address, store_spec="memory", port=fport,
+                      grpc_port=fport + 10000,
+                      meta_log_path=str(tmp_path / "meta.log"))
+    fs2.start()
+    try:
+        fs2.write_file("/purge/old.txt", b"generate an event")
+        # everything so far is "older than -1 days" => purged
+        got = _sh(e, out, f"fs.log.purge -filer {fs2.url} -daysAgo -1")
+        n = int(re.search(r"purged (\d+)", got).group(1))
+        assert n > 0
+        # a fresh event survives a 1-day purge, and the log stays readable
+        fs2.write_file("/purge/new.txt", b"fresh")
+        got = _sh(e, out, f"fs.log.purge -filer {fs2.url} -daysAgo 1")
+        assert "purged 0" in got
+        assert fs2.filer.meta_log._read_persisted(0)  # fresh event kept
+    finally:
+        fs2.stop()
+
+
+def test_reference_name_aliases(env):
+    """Operators migrating from the reference find its exact command
+    names (command_*.go Name() spellings)."""
+    from seaweedfs_tpu.shell.commands import COMMANDS
+    for alias in ("ecVolume.delete", "volumeServer.evacuate",
+                  "fs.mergeVolumes", "s3.bucket.quota.enforce"):
+        assert alias in COMMANDS
+        assert "alias of" in COMMANDS[alias].help
