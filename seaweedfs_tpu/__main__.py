@@ -1054,6 +1054,31 @@ def run_filer_remote_gateway(argv):
         stop.set()
 
 
+def run_ftp(argv):
+    """FTP gateway over a remote filer (reference weed/ftpd is an unwired
+    81-line skeleton; this verb serves a working RFC 959 subset)."""
+    from .client.filer_client import FilerClient
+    from .ftpd import FtpServer
+    p = argparse.ArgumentParser(prog="ftp")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=2121)
+    p.add_argument("-root", default="/", help="filer subtree to expose")
+    p.add_argument("-user", default="", help="require this login "
+                                             "(default anonymous)")
+    p.add_argument("-password", default="")
+    p.add_argument("-passivePortStart", type=int, default=0)
+    p.add_argument("-passivePortStop", type=int, default=0)
+    opt = p.parse_args(argv)
+    users = {opt.user: opt.password} if opt.user else None
+    rng = ((opt.passivePortStart, opt.passivePortStop)
+           if opt.passivePortStart and opt.passivePortStop else None)
+    FtpServer(FilerClient(opt.filer, client_name="ftpd"), ip=opt.ip,
+              port=opt.port, root=opt.root, users=users,
+              passive_ports=rng).start()
+    _wait_forever()
+
+
 def run_fuse(argv):
     """/etc/fstab-compatible mount wrapper (reference command/fuse.go):
     `swtpu fuse <mountpoint> -o "filer=host:port,chunkSizeLimitMB=4"`."""
@@ -1133,6 +1158,7 @@ VERBS = {
     "fix": run_fix,
     "benchmark": run_benchmark,
     "mount": run_mount,
+    "ftp": run_ftp,
     "fuse": run_fuse,
     "filer.cat": run_filer_cat,
     "filer.meta.backup": run_filer_meta_backup,

@@ -956,8 +956,15 @@ def cmd_fs_log_purge(env: CommandEnv, args):
     p = _fs_parser("fs.log.purge")
     p.add_argument("-daysAgo", type=float, default=365)
     opt = p.parse_args(args)
-    before = _time.time_ns() - int(opt.daysAgo * 86400 * 1e9)
-    resp = _filer_stub(env, opt.filer).call(
+    stub = _filer_stub(env, opt.filer)
+    # destructive cutoff from the FILER's clock — shell-host skew must
+    # not purge events the filer stamped moments ago
+    conf = stub.call("GetFilerConfiguration",
+                     fpb.GetFilerConfigurationRequest(),
+                     fpb.GetFilerConfigurationResponse)
+    now_ns = conf.now_ns or _time.time_ns()
+    before = now_ns - int(opt.daysAgo * 86400 * 1e9)
+    resp = stub.call(
         "PurgeMetaLog", fpb.PurgeMetaLogRequest(before_ns=before),
         fpb.PurgeMetaLogResponse)
     env.println(f"purged {resp.purged} meta-log event(s)")

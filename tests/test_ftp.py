@@ -1,0 +1,191 @@
+"""FTP gateway driven by the stdlib ftplib client — an independent
+protocol oracle (reference weed/ftpd/ftp_server.go is an unwired
+81-line skeleton; ours actually serves RFC 959)."""
+
+import ftplib
+import io
+import socket
+import time
+
+import pytest
+
+from conftest import free_port_pair
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def ftp_stack(tmp_path_factory):
+    import requests
+
+    from seaweedfs_tpu.client.filer_client import FilerClient
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.ftpd import FtpServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    ms = MasterServer(port=free_port(), pulse_seconds=0.3,
+                      maintenance_scripts=[])
+    ms.start()
+    vport = free_port()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path_factory.mktemp("ftpvol")),
+                                max_volume_count=10)], coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
+                      pulse_seconds=0.3)
+    vs.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(ms.topo.nodes) < 1:
+        time.sleep(0.05)
+    while time.time() < deadline:
+        try:
+            if requests.get(f"http://127.0.0.1:{vport}/status",
+                            timeout=1).ok:
+                break
+        except Exception:
+            time.sleep(0.05)
+    fport = free_port_pair()
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=fport + 10000, chunk_size_mb=1)
+    fs.start()
+    fs.write_file("/pub/hello.txt", b"hello ftp world")
+    fs.write_file("/pub/sub/inner.bin", b"\x01\x02" * 100)
+    ftp = FtpServer(FilerClient(fs.url), port=free_port()).start()
+    yield {"ftp": ftp, "fs": fs}
+    ftp.stop()
+    fs.stop()
+    vs.stop()
+    ms.stop()
+
+
+def _client(ftp_stack) -> ftplib.FTP:
+    c = ftplib.FTP()
+    c.connect("127.0.0.1", ftp_stack["ftp"].port, timeout=10)
+    c.login()  # anonymous
+    return c
+
+
+def test_login_pwd_cwd(ftp_stack):
+    c = _client(ftp_stack)
+    assert c.pwd() == "/"
+    c.cwd("/pub")
+    assert c.pwd() == "/pub"
+    c.cwd("..")
+    assert c.pwd() == "/"
+    with pytest.raises(ftplib.error_perm):
+        c.cwd("/does-not-exist")
+    c.quit()
+
+
+def test_list_and_nlst(ftp_stack):
+    c = _client(ftp_stack)
+    lines = []
+    c.retrlines("LIST /pub", lines.append)
+    assert any("hello.txt" in l for l in lines)
+    assert any(l.startswith("d") and "sub" in l for l in lines)
+    names = c.nlst("/pub")
+    assert "hello.txt" in names and "sub" in names
+    c.quit()
+
+
+def test_retr_stor_roundtrip(ftp_stack):
+    c = _client(ftp_stack)
+    buf = io.BytesIO()
+    c.retrbinary("RETR /pub/hello.txt", buf.write)
+    assert buf.getvalue() == b"hello ftp world"
+    payload = bytes(range(256)) * 10
+    c.storbinary("STOR /pub/uploaded.bin", io.BytesIO(payload))
+    buf = io.BytesIO()
+    c.retrbinary("RETR /pub/uploaded.bin", buf.write)
+    assert buf.getvalue() == payload
+    # visible through the filer too (same namespace)
+    fs = ftp_stack["fs"]
+    e = fs.filer.find_entry("/pub", "uploaded.bin")
+    assert e is not None and e.attributes.file_size == len(payload)
+    assert c.size("/pub/uploaded.bin") == len(payload)
+    c.quit()
+
+
+def test_mkd_rmd_dele_rename(ftp_stack):
+    c = _client(ftp_stack)
+    c.mkd("/pub/newdir")
+    assert "newdir" in c.nlst("/pub")
+    c.storbinary("STOR /pub/newdir/f.txt", io.BytesIO(b"move me"))
+    c.rename("/pub/newdir/f.txt", "/pub/newdir/g.txt")
+    assert "g.txt" in c.nlst("/pub/newdir")
+    c.delete("/pub/newdir/g.txt")
+    assert "g.txt" not in c.nlst("/pub/newdir")
+    c.rmd("/pub/newdir")
+    assert "newdir" not in c.nlst("/pub")
+    c.quit()
+
+
+def test_auth_required(ftp_stack, tmp_path):
+    from seaweedfs_tpu.client.filer_client import FilerClient
+    from seaweedfs_tpu.ftpd import FtpServer
+
+    fs = ftp_stack["fs"]
+    srv = FtpServer(FilerClient(fs.url), port=free_port(),
+                    users={"alice": "secret"}).start()
+    try:
+        c = ftplib.FTP()
+        c.connect("127.0.0.1", srv.port, timeout=10)
+        with pytest.raises(ftplib.error_perm):
+            c.login()  # anonymous refused
+        c2 = ftplib.FTP()
+        c2.connect("127.0.0.1", srv.port, timeout=10)
+        with pytest.raises(ftplib.error_perm):
+            c2.login("alice", "wrong")
+        c3 = ftplib.FTP()
+        c3.connect("127.0.0.1", srv.port, timeout=10)
+        c3.login("alice", "secret")
+        assert c3.pwd() == "/"
+        c3.quit()
+    finally:
+        srv.stop()
+
+
+def test_root_jail(ftp_stack):
+    """-root confines the session to a filer subtree."""
+    from seaweedfs_tpu.client.filer_client import FilerClient
+    from seaweedfs_tpu.ftpd import FtpServer
+
+    fs = ftp_stack["fs"]
+    srv = FtpServer(FilerClient(fs.url), port=free_port(),
+                    root="/pub").start()
+    try:
+        c = ftplib.FTP()
+        c.connect("127.0.0.1", srv.port, timeout=10)
+        c.login()
+        assert "hello.txt" in c.nlst("/")
+        c.cwd("/..")  # normalizes back to the jail root
+        assert c.pwd() == "/"
+        buf = io.BytesIO()
+        c.retrbinary("RETR /hello.txt", buf.write)
+        assert buf.getvalue() == b"hello ftp world"
+        c.quit()
+    finally:
+        srv.stop()
+
+
+def test_dele_refuses_directories_and_root(ftp_stack):
+    """RFC 959: DELE removes files only; a typo'd DELE must never
+    recursively destroy a subtree, and '/' is untouchable."""
+    c = _client(ftp_stack)
+    with pytest.raises(ftplib.error_perm, match="directory"):
+        c.delete("/pub/sub")
+    with pytest.raises(ftplib.error_perm):
+        c.delete("/")
+    with pytest.raises(ftplib.error_perm):
+        c.rmd("/")
+    # subtree intact
+    assert "inner.bin" in c.nlst("/pub/sub")
+    c.quit()
