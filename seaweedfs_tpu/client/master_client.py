@@ -56,7 +56,7 @@ class VidMap:
 
 class MasterClient:
     def __init__(self, master_address: str, client_type: str = "client",
-                 client_address: str = ""):
+                 client_address: str = "", grpc_port: int = 0):
         # comma-separated master quorum; leader discovered via hints
         # (reference masterclient.go:190 tryConnectToMaster round-robin)
         self.masters = [m for m in master_address.split(",") if m]
@@ -65,6 +65,7 @@ class MasterClient:
         self._master_rr = 0
         self.client_type = client_type
         self.client_address = client_address or f"pyclient-{random.getrandbits(24):x}"
+        self.grpc_port = grpc_port  # advertised service grpc port
         self.vid_map = VidMap()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -79,6 +80,12 @@ class MasterClient:
 
     def stop(self) -> None:
         self._stop.set()
+        stream = getattr(self, "_active_stream", None)
+        if stream is not None:
+            try:
+                stream.cancel()
+            except Exception:  # noqa: BLE001
+                pass
 
     def wait_connected(self, timeout: float = 5.0) -> bool:
         return self._connected.wait(timeout)
@@ -91,7 +98,8 @@ class MasterClient:
                 def reqs():
                     yield pb.KeepConnectedRequest(
                         client_type=self.client_type,
-                        client_address=self.client_address, version="swtpu")
+                        client_address=self.client_address, version="swtpu",
+                        grpc_port=self.grpc_port)
                     while not self._stop.is_set():
                         time.sleep(1)
                         return  # half-close after initial message
@@ -99,6 +107,18 @@ class MasterClient:
                 stream = stub.stream_stream("KeepConnected", reqs(),
                                             pb.KeepConnectedRequest,
                                             pb.KeepConnectedResponse)
+                # kept for stop(): cancelling tears the stream down so the
+                # master drops this client from its cluster list promptly
+                # instead of listing a dead filer/broker until the channel
+                # times out
+                self._active_stream = stream
+                if self._stop.is_set():
+                    # stop() may have raced the assignment and cancelled
+                    # the PREVIOUS stream (or None); close this one too or
+                    # the thread blocks forever on a quiet cluster and the
+                    # master lists a ghost client
+                    stream.cancel()
+                    return
                 self._connected.set()
                 for resp in stream:
                     if self._stop.is_set():

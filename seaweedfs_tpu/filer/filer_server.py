@@ -51,7 +51,8 @@ class FilerServer:
         # register under the real service address so peers can discover
         # this filer via ListClusterNodes (reference cluster.go:104)
         self.mc = MasterClient(master_address, client_type="filer",
-                               client_address=f"{ip}:{port}")
+                               client_address=f"{ip}:{port}",
+                               grpc_port=self.grpc_port)
         # peer metadata mesh (reference meta_aggregator.go): every filer
         # in the master cluster tails every other filer's LOCAL stream
         self.meta_aggregate = meta_aggregate
@@ -87,13 +88,9 @@ class FilerServer:
                                              name=f"filer-http-{self.port}")
         self._http_thread.start()
         if self.meta_aggregate:
-            if self.grpc_port != self.port + 10000:
-                # peers dial each other by the grpc = http+10000
-                # convention (FilerClient); a custom grpc port makes this
-                # filer unreachable to its mesh peers
-                log.warning("meta mesh: grpc port %d breaks the port+10000 "
-                            "convention; peers cannot dial this filer",
-                            self.grpc_port)
+            # peers learn this filer's real grpc port from the master
+            # registration (KeepConnectedRequest.grpc_port), so a custom
+            # port no longer breaks mesh dialing
             from .meta_aggregator import MetaAggregator
             self.aggregator = MetaAggregator(self).start()
         log.info("filer %s up (grpc :%d, store %s)", self.url, self.grpc_port,
@@ -524,6 +521,13 @@ class FilerServer:
                         resp.event_notification.signatures:
                     continue  # skip events this subscriber itself caused
                 yield resp
+
+        @svc.unary("Ping", fpb.PingRequest, fpb.PingResponse)
+        def ping(req, ctx):
+            import time as _time
+            now = _time.time_ns()
+            return fpb.PingResponse(start_time_ns=now, remote_time_ns=now,
+                                    stop_time_ns=_time.time_ns())
 
         @svc.unary("PurgeMetaLog", fpb.PurgeMetaLogRequest,
                    fpb.PurgeMetaLogResponse)

@@ -64,11 +64,11 @@ class MetaAggregator:
     def _discover_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                for addr in self._list_filers():
+                for addr, gport in self._list_filers():
                     if addr != self.fs.url and \
                             addr not in self._peer_threads:
                         t = threading.Thread(
-                            target=self._sync_peer, args=(addr,),
+                            target=self._sync_peer, args=(addr, gport),
                             daemon=True,
                             name=f"meta-aggr-{self.fs.port}-{addr}")
                         self._peer_threads[addr] = t
@@ -99,20 +99,20 @@ class MetaAggregator:
                 log.warning("offset persist for %s: %s", peer, e)
                 self._pending_offsets.setdefault(peer, ts)
 
-    def _list_filers(self) -> list[str]:
+    def _list_filers(self) -> "list[tuple[str, int]]":
         resp = Stub(self.fs.mc.leader, MASTER_SERVICE).call(
             "ListClusterNodes",
             mpb.ListClusterNodesRequest(client_type="filer"),
             mpb.ListClusterNodesResponse)
-        return [n.address for n in resp.cluster_nodes]
+        return [(n.address, n.grpc_port) for n in resp.cluster_nodes]
 
     # -- per-peer tail ------------------------------------------------------
     def _offset_key(self, peer: str) -> bytes:
         return OFFSET_KEY_FMT.format(peer=peer).encode()
 
-    def _sync_peer(self, peer: str) -> None:
+    def _sync_peer(self, peer: str, grpc_port: int = 0) -> None:
         try:
-            self._sync_peer_inner(peer)
+            self._sync_peer_inner(peer, grpc_port)
         except Exception as e:  # noqa: BLE001
             log.warning("peer %s tail died: %s (will redial)", peer, e)
         finally:
@@ -121,9 +121,12 @@ class MetaAggregator:
             # must not be lost forever
             self._peer_threads.pop(peer, None)
 
-    def _sync_peer_inner(self, peer: str) -> None:
+    def _sync_peer_inner(self, peer: str, grpc_port: int = 0) -> None:
         from ..client.filer_client import FilerClient
-        fc = FilerClient(peer, client_name=f"aggr-{self.fs.url}")
+        host = peer.rsplit(":", 1)[0]
+        grpc_addr = f"{host}:{grpc_port}" if grpc_port else ""
+        fc = FilerClient(peer, grpc_address=grpc_addr,
+                         client_name=f"aggr-{self.fs.url}")
         self.peer_signatures[fc.signature] = peer
         key = self._offset_key(peer)
         raw = self.fs.filer.store.kv_get(key)

@@ -317,6 +317,12 @@ class _Session:
 
     def do_STOR(self, arg):
         vpath = self._abs(arg)
+        existing = self._entry(vpath)
+        if existing is not None and existing.is_directory:
+            # silently replacing a directory entry with a file would
+            # orphan its children in the store
+            self.send(550, "is a directory")
+            return
         self.send(150, "ok to send data")
         conn = self._data_conn()
         chunks = []
@@ -352,6 +358,9 @@ class _Session:
 
     def do_MKD(self, arg):
         vpath = self._abs(arg)
+        if self._entry(vpath) is not None:
+            self.send(550, "already exists")
+            return
         d, n = self._split(vpath)
         e = fpb.Entry(name=n, is_directory=True)
         e.attributes.file_mode = 0o40755

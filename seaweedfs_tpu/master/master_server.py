@@ -60,10 +60,10 @@ class MasterServer:
         # (reference master_grpc_server_assign.go JWT minting).
         self.guard = guard
         self._subscribers: dict[int, tuple[str, queue.Queue]] = {}
-        # sid -> (address, client_type, version, created_at_ns): the
-        # cluster membership ListClusterNodes reports (reference
-        # cluster.go:104 tracks filers/brokers the same way)
-        self._sub_meta: dict[int, tuple[str, str, str, int]] = {}
+        # sid -> (address, client_type, version, created_at_ns,
+        # grpc_port): the cluster membership ListClusterNodes reports
+        # (reference cluster.go:104 tracks filers/brokers the same way)
+        self._sub_meta: dict[int, tuple[str, str, str, int, int]] = {}
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
         self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
@@ -333,7 +333,8 @@ class MasterServer:
                 ms._subscribers[sid] = (first.client_address, q)
                 ms._sub_meta[sid] = (first.client_address,
                                      first.client_type or "client",
-                                     first.version, time.time_ns())
+                                     first.version, time.time_ns(),
+                                     first.grpc_port)
             log.info("client %s (%s) subscribed", first.client_address,
                      first.client_type)
             try:
@@ -574,8 +575,9 @@ class MasterServer:
                 metas = list(ms._sub_meta.values())
             return pb.ListClusterNodesResponse(cluster_nodes=[
                 pb.ListClusterNodesResponse.ClusterNode(
-                    address=addr, version=ver, created_at_ns=ts)
-                for addr, ctype, ver, ts in metas
+                    address=addr, version=ver, created_at_ns=ts,
+                    grpc_port=gport)
+                for addr, ctype, ver, ts, gport in metas
                 if not req.client_type or ctype == req.client_type])
 
         @svc.unary("Ping", pb.PingRequest, pb.PingResponse)

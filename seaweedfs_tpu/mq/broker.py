@@ -393,6 +393,10 @@ class BrokerServer:
                 a.partition.ring_size = p.ring_size
             return resp
 
+        @svc.unary("Ping", mq.PingRequest, mq.PingResponse)
+        def ping(req, ctx):
+            return mq.PingResponse(remote_time_ns=time.time_ns())
+
         @svc.unary("BalanceTopics", mq.BalanceTopicsRequest,
                    mq.BalanceTopicsResponse)
         def balance_topics(req, ctx):

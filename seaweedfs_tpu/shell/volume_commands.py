@@ -73,6 +73,34 @@ def cmd_cluster_check(env: CommandEnv, args):
         except Exception as e:  # noqa: BLE001
             env.println(f"  volume server {srv['id']}: UNREACHABLE ({e})")
     env.println(f"{ok} volume servers healthy")
+    # filers and brokers answer Ping too (reference: every service has a
+    # Ping RPC, master.proto:50)
+    from ..pb import filer_pb2 as fpb
+    from ..pb import mq_pb2 as mqpb
+    from ..utils.rpc import FILER_SERVICE
+    from .mq_commands import MQ_SERVICE
+    for ctype, svc_name, req, resp in (
+            ("filer", FILER_SERVICE, fpb.PingRequest(), fpb.PingResponse),
+            ("broker", MQ_SERVICE, mqpb.PingRequest(), mqpb.PingResponse)):
+        try:
+            nodes = Stub(env.mc.leader, MASTER_SERVICE).call(
+                "ListClusterNodes",
+                mpb.ListClusterNodesRequest(client_type=ctype),
+                mpb.ListClusterNodesResponse).cluster_nodes
+        except Exception:  # noqa: BLE001
+            continue
+        for n in nodes:
+            try:
+                addr = n.address
+                if ctype == "filer":
+                    # filer registers its http address; dial the
+                    # advertised grpc port (else +10000 convention)
+                    host, _, port = addr.rpartition(":")
+                    addr = f"{host}:{n.grpc_port or int(port) + 10000}"
+                Stub(addr, svc_name).call("Ping", req, resp, timeout=5)
+                env.println(f"  {ctype} {n.address}: ok")
+            except Exception as e:  # noqa: BLE001
+                env.println(f"  {ctype} {n.address}: UNREACHABLE ({e})")
 
 
 @command("collection.list", "list collections")

@@ -351,3 +351,36 @@ def test_list_cluster_nodes_rpc(broker_stack):
                        mpb.ListClusterNodesRequest(client_type="filer"),
                        mpb.ListClusterNodesResponse)
     assert len(filers.cluster_nodes) >= 1
+
+
+def test_cluster_check_pings_filers_and_brokers(broker_stack):
+    """cluster.check reaches filers and brokers through their Ping RPCs
+    (reference: every service answers Ping, master.proto:50)."""
+    import io
+
+    from seaweedfs_tpu.shell import volume_commands  # noqa: F401
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    from seaweedfs_tpu.pb import master_pb2 as mpb
+    from seaweedfs_tpu.utils.rpc import MASTER_SERVICE, Stub
+
+    ms = broker_stack["ms"]
+    # brokers stopped by earlier tests drop off the cluster list when
+    # their cancelled KeepConnected streams unwind (~1s); wait for the
+    # list to settle to the one live broker before health-checking
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        nodes = Stub(ms.address, MASTER_SERVICE).call(
+            "ListClusterNodes",
+            mpb.ListClusterNodesRequest(client_type="broker"),
+            mpb.ListClusterNodesResponse).cluster_nodes
+        if [n.address for n in nodes] == [broker_stack["broker"].address]:
+            break
+        time.sleep(0.2)
+    out = io.StringIO()
+    env = CommandEnv(ms.address, out=out)
+    run_command(env, "cluster.check")
+    got = out.getvalue()
+    assert f"broker {broker_stack['broker'].address}: ok" in got, got
+    assert "filer" in got and "UNREACHABLE" not in got, got
+    env.mc.stop()
