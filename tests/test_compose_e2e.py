@@ -1,0 +1,194 @@
+"""Whole-system topology test — the reference's docker-compose analogue
+(SURVEY §4.5: local-cluster-compose.yml = 3 masters + replicated volume
+servers + filer + s3, exercised by restarting containers).
+
+One process, every plane: a 3-master raft quorum, 3 replicated volume
+servers, 2 mesh filers, and S3 + WebDAV + FTP gateways sharing the
+namespace. Asserts cross-protocol consistency, then survives a master
+leader kill and a volume-server kill.
+"""
+
+import ftplib
+import io
+import socket
+import time
+
+import pytest
+import requests
+
+from conftest import free_port_pair, wait_until
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def compose(tmp_path_factory):
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.ftpd import FtpServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.s3.s3_server import S3Gateway
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.webdav.webdav_server import WebDavServer
+
+    tmp = tmp_path_factory.mktemp("compose")
+    mports = [free_port() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in mports]
+    masters = [MasterServer(port=p, volume_size_limit_mb=64,
+                            pulse_seconds=0.3, peers=peers,
+                            default_replication="001",
+                            raft_state_path=str(tmp / f"raft-{p}.json"),
+                            maintenance_scripts=[])
+               for p in mports]
+    for m in masters:
+        m.start()
+    wait_until(lambda: sum(m.is_leader for m in masters) == 1,
+               msg="leader elected")
+    quorum = ",".join(peers)
+    vservers = []
+    for i in range(3):
+        d = tmp / f"vol{i}"
+        d.mkdir()
+        vport = free_port()
+        store = Store("127.0.0.1", vport, "",
+                      [DiskLocation(str(d), max_volume_count=10)],
+                      coder_name="numpy")
+        vs = VolumeServer(store, quorum, port=vport, grpc_port=free_port(),
+                          pulse_seconds=0.3, rack="r0")
+        vs.start()
+        vservers.append(vs)
+    leader = next(m for m in masters if m.is_leader)
+    wait_until(lambda: len(leader.topo.nodes) == 3, msg="3 nodes registered")
+    for vs in vservers:
+        wait_until(lambda vs=vs: requests.get(
+            f"http://{vs.url}/status", timeout=1).ok, msg="vs http up")
+    filers = []
+    for i in range(2):
+        fport = free_port_pair()
+        f = FilerServer(quorum, store_spec="memory", port=fport,
+                        grpc_port=fport + 10000, chunk_size_mb=1,
+                        meta_aggregate=True)
+        f.start()
+        filers.append(f)
+    for f in filers:
+        wait_until(lambda f=f: len(f.aggregator.peers) == 1,
+                   msg=f"{f.url} sees its peer")
+    fa, fb = filers
+    s3 = S3Gateway(fa, port=free_port()).start()
+    wait_until(lambda: requests.get(f"http://{s3.url}", timeout=1).ok,
+               msg="s3 up")
+    # the shared bucket every test uses (tests must pass in isolation)
+    wait_until(lambda: requests.put(f"http://{s3.url}/xproto",
+                                    timeout=10).status_code == 200,
+               msg="bucket created")
+    dav = WebDavServer(fb, port=free_port()).start()
+    from seaweedfs_tpu.client.filer_client import FilerClient
+    ftp = FtpServer(FilerClient(fb.url), port=free_port()).start()
+    yield {"masters": masters, "vservers": vservers, "filers": filers,
+           "s3": s3, "dav": dav, "ftp": ftp}
+    ftp.stop()
+    dav.stop()
+    s3.stop()
+    for f in filers:
+        f.stop()
+    for vs in vservers:
+        vs.stop()
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:
+            pass
+
+
+def test_cross_protocol_consistency(compose):
+    """An object PUT through S3 on filer A reads back through WebDAV,
+    FTP, and filer HTTP on filer B (mesh + shared blob plane)."""
+    s3 = compose["s3"]
+    fb = compose["filers"][1]
+    base = f"http://{s3.url}"
+    body = b"one object, four protocols"
+    r = requests.put(f"{base}/xproto/obj.txt", data=body, timeout=10)
+    assert r.status_code == 200
+    # mesh: appears on filer B
+    wait_until(lambda: fb.filer.find_entry("/buckets/xproto", "obj.txt")
+               is not None, msg="mesh propagation")
+    # filer B HTTP
+    got = requests.get(f"http://{fb.url}/buckets/xproto/obj.txt", timeout=10)
+    assert got.content == body
+    # WebDAV (on filer B)
+    dav = compose["dav"]
+    got = requests.get(f"http://{dav.url}/buckets/xproto/obj.txt",
+                       timeout=10)
+    assert got.content == body
+    # FTP (on filer B)
+    c = ftplib.FTP()
+    c.connect("127.0.0.1", compose["ftp"].port, timeout=10)
+    c.login()
+    buf = io.BytesIO()
+    c.retrbinary("RETR /buckets/xproto/obj.txt", buf.write)
+    assert buf.getvalue() == body
+    # and write back the other way: FTP -> S3
+    c.storbinary("STOR /buckets/xproto/from-ftp.bin", io.BytesIO(b"ftp->s3"))
+    c.quit()
+    wait_until(lambda: requests.get(f"{base}/xproto/from-ftp.bin",
+                                    timeout=10).status_code == 200,
+               msg="ftp->s3 via mesh")
+    assert requests.get(f"{base}/xproto/from-ftp.bin",
+                        timeout=10).content == b"ftp->s3"
+
+
+def test_survives_master_leader_kill(compose):
+    """Raft failover: kill the leader, the S3 write path keeps working
+    (volume servers and filers re-home to the new leader)."""
+    masters = compose["masters"]
+    s3 = compose["s3"]
+    base = f"http://{s3.url}"
+    leader = next(m for m in masters if m.is_leader)
+    leader.stop()
+    rest = [m for m in masters if m is not leader]
+    wait_until(lambda: sum(m.is_leader for m in rest) == 1,
+               msg="new leader elected")
+
+    def write_ok():
+        r = requests.put(f"{base}/xproto/after-failover.txt",
+                         data=b"post-failover", timeout=10)
+        return r.status_code == 200
+
+    wait_until(write_ok, timeout=30, msg="write after failover")
+    got = requests.get(f"{base}/xproto/after-failover.txt", timeout=10)
+    assert got.content == b"post-failover"
+
+
+def test_survives_volume_server_kill(compose):
+    """Replication 001: killing one replica holder leaves every blob
+    readable through the surviving replicas."""
+    s3 = compose["s3"]
+    base = f"http://{s3.url}"
+    # seed a handful of objects (replicated 001 across the rack)
+    bodies = {}
+    for i in range(6):
+        body = f"replicated object {i}".encode() * 50
+        assert requests.put(f"{base}/xproto/kill-{i}.bin", data=body,
+                            timeout=10).status_code == 200
+        bodies[f"kill-{i}.bin"] = body
+    victim = next(vs for vs in compose["vservers"]
+                  if vs.store.status()["volumes"])
+    victim.stop()
+    time.sleep(0.5)
+
+    def all_readable():
+        for name, body in bodies.items():
+            r = requests.get(f"{base}/xproto/{name}", timeout=10)
+            if r.status_code != 200 or r.content != body:
+                return False
+        return True
+
+    wait_until(all_readable, timeout=30,
+               msg="all blobs readable with a dead replica holder")
