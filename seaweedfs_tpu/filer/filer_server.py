@@ -278,8 +278,28 @@ class FilerServer:
 
         from ..stats.metrics import aiohttp_metrics_handler
 
+        async def status_ui(request):
+            # human status UI (reference weed/server/filer_ui)
+            from ..utils.ui import render_page
+            rows = [[e.name + ("/" if e.is_directory else ""),
+                     e.attributes.file_size, len(e.chunks)]
+                    for e in self.filer.store.list_entries("/", limit=200)]
+            mesh = (", ".join(self.aggregator.peers)
+                    if self.aggregator is not None else "off")
+            page = render_page(
+                f"swtpu filer {self.url}",
+                {"Master": self.mc.leader, "Store": self.filer.store.name,
+                 "gRPC port": self.grpc_port,
+                 "Chunk size": f"{self.chunk_size >> 20} MB",
+                 "Mesh peers": mesh or "(none yet)",
+                 "Signature": self.filer.signature},
+                [("Root entries (first 200)",
+                  ["name", "size", "chunks"], rows)])
+            return web.Response(text=page, content_type="text/html")
+
         def routes(app):
             app.router.add_get("/__status__", status)
+            app.router.add_get("/__ui__", status_ui)
             app.router.add_get("/__metrics__", aiohttp_metrics_handler)
             app.router.add_route("*", "/{path:.*}", handle)
 

@@ -233,6 +233,33 @@ class MasterServer:
                         "Leader": ms.leader_address,
                         "Peers": [p for p in ms.peers
                                   if p != ms.address]}).encode())
+                elif url.path == "/":
+                    # human status UI (reference weed/server/master_ui)
+                    from ..utils.ui import render_page
+                    rows = []
+                    with ms.topo.lock:  # heartbeats mutate per-disk dicts
+                        nodes = list(ms.topo.all_nodes())
+                        for node in nodes:
+                            vols = list(node.all_volumes())
+                            ecs = list(node.all_ec_shards())
+                            rack = getattr(node.rack, "id", "-") or "-"
+                            rows.append([
+                                node.id, rack, len(vols), len(ecs),
+                                f"{sum(v.size for v in vols) >> 20} MB"])
+                    page = render_page(
+                        f"swtpu master {ms.address}",
+                        {"Leader": ms.leader_address or "(electing)",
+                         "IsLeader": ms.is_leader,
+                         "Peers": ", ".join(p for p in ms.peers
+                                            if p != ms.address) or "-",
+                         "Volume servers": len(nodes),
+                         "Max volume id": ms.topo.max_volume_id,
+                         "Vacuum automation":
+                             "disabled" if ms.vacuum_disabled else "on"},
+                        [("Volume servers",
+                          ["node", "rack", "volumes", "ec volumes",
+                           "bytes"], rows)])
+                    self._send(200, page.encode(), "text/html")
                 elif url.path == "/debug/profile":
                     # pprof-style CPU profile trigger (reference exposes
                     # net/http/pprof on -debug.port, command/imports.go:4)

@@ -255,8 +255,38 @@ class VolumeServer:
             return web.Response(text=profiling.start_jax_profiler(port),
                                 content_type="text/plain")
 
+        async def status_ui(request):
+            # human status UI (reference weed/server/volume_server_ui)
+            from ..utils.ui import render_page
+            st = self.store.status()
+            rows = []
+            ec_rows = []
+            for loc in self.store.locations:
+                with loc.lock:  # allocate/mount mutate these dicts
+                    vols = sorted(loc.volumes.items())
+                    ecs = sorted(loc.ec_volumes.items())
+                for vid, v in vols:
+                    rows.append([vid, v.collection or "-", loc.disk_type,
+                                 f"{v.content_size >> 20} MB",
+                                 v.file_count, v.deleted_count,
+                                 "ro" if v.read_only else "rw"])
+                for vid, ev in ecs:
+                    ec_rows.append([vid, ev.collection or "-",
+                                    sorted(ev.shards)])
+            page = render_page(
+                f"swtpu volume server {self.url}",
+                {"Master": ", ".join(self.masters),
+                 "Volumes": st["volumes"], "EC volumes": len(ec_rows),
+                 "Rack": self.rack or "-",
+                 "Data center": self.data_center or "-"},
+                [("Volumes", ["id", "collection", "disk", "size", "files",
+                              "deleted", "mode"], rows),
+                 ("EC volumes", ["id", "collection", "shards"], ec_rows)])
+            return web.Response(text=page, content_type="text/html")
+
         def routes(app):
             app.router.add_get("/status", status)
+            app.router.add_get("/ui", status_ui)
             app.router.add_get("/metrics", aiohttp_metrics_handler)
             # pprof-style triggers (reference -debug.port net/http/pprof)
             app.router.add_get("/debug/profile", debug_profile)

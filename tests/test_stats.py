@@ -152,3 +152,71 @@ class TestScrapeEndpoints:
                and VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") < 1):
             time.sleep(0.1)
         assert VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") >= 1
+
+
+def test_status_ui_pages(tmp_path):
+    """Every daemon serves a human status page (reference master_ui /
+    volume_server_ui / filer_ui)."""
+    import socket
+    import time
+
+    import requests
+
+    from conftest import free_port_pair
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    def fp():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    hport = fp()
+    ms = MasterServer(port=fp(), pulse_seconds=0.3, http_port=hport,
+                      maintenance_scripts=[])
+    ms.start()
+    vport = fp()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path / "v"), max_volume_count=8)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=fp(),
+                      pulse_seconds=0.3)
+    vs.start()
+    fport = free_port_pair()
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=fport + 10000)
+    fs.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(ms.topo.nodes) < 1:
+            time.sleep(0.05)
+        while time.time() < deadline:
+            try:
+                if requests.get(f"http://{vs.url}/status", timeout=1).ok:
+                    break
+            except Exception:
+                time.sleep(0.05)
+        fs.write_file("/ui-probe.txt", b"x")
+        while time.time() < deadline:
+            try:
+                requests.get(f"http://127.0.0.1:{hport}/", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.05)
+        r = requests.get(f"http://127.0.0.1:{hport}/", timeout=5)
+        assert r.ok and "swtpu master" in r.text
+        assert "Volume servers" in r.text
+        r = requests.get(f"http://{vs.url}/ui", timeout=5)
+        assert r.ok and "swtpu volume server" in r.text
+        r = requests.get(f"http://{fs.url}/__ui__", timeout=5)
+        assert r.ok and "swtpu filer" in r.text
+        assert "ui-probe.txt" in r.text
+    finally:
+        fs.stop()
+        vs.stop()
+        ms.stop()
