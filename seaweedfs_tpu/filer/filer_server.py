@@ -279,11 +279,15 @@ class FilerServer:
         from ..stats.metrics import aiohttp_metrics_handler
 
         async def status_ui(request):
-            # human status UI (reference weed/server/filer_ui)
+            # human status UI (reference weed/server/filer_ui); store I/O
+            # off the event loop like every other handler here
+            import asyncio as _asyncio
+
             from ..utils.ui import render_page
-            rows = [[e.name + ("/" if e.is_directory else ""),
-                     e.attributes.file_size, len(e.chunks)]
-                    for e in self.filer.store.list_entries("/", limit=200)]
+            rows = await _asyncio.to_thread(lambda: [
+                [e.name + ("/" if e.is_directory else ""),
+                 e.attributes.file_size, len(e.chunks)]
+                for e in self.filer.store.list_entries("/", limit=200)])
             mesh = (", ".join(self.aggregator.peers)
                     if self.aggregator is not None else "off")
             page = render_page(

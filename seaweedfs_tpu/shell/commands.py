@@ -140,3 +140,23 @@ def repl(env: CommandEnv) -> None:
             env.println(f"error: {e}")
     env.release_lock()
 
+
+
+def discover_cluster_node(env: "CommandEnv", client_type: str
+                          ) -> "tuple[str, int]":
+    """Oldest live node of a type from the master cluster list
+    (reference cluster.go:104): ('', 0) if none. Shared by filer and
+    broker discovery so fixes (grpc ports, retries) land once."""
+    from ..pb import master_pb2 as mpb
+    from ..utils.rpc import MASTER_SERVICE
+    try:
+        resp = Stub(env.mc.leader, MASTER_SERVICE).call(
+            "ListClusterNodes",
+            mpb.ListClusterNodesRequest(client_type=client_type),
+            mpb.ListClusterNodesResponse)
+        nodes = sorted(resp.cluster_nodes, key=lambda n: n.created_at_ns)
+        if nodes:
+            return nodes[0].address, nodes[0].grpc_port
+    except Exception:  # noqa: BLE001
+        pass
+    return "", 0

@@ -22,19 +22,39 @@ BUCKETS_DIR = "/buckets"
 
 
 def _filer_addr(env: CommandEnv, opt_filer: str) -> str:
-    addr = opt_filer or env.option.get("filer", "")
+    addr = opt_filer or env.option.get("filer", "") \
+        or _discover_filer(env)
     if not addr:
         raise RuntimeError("no filer configured; pass -filer host:port")
     return addr
 
 
-def _filer_grpc(addr: str) -> str:
+def _discover_filer(env: CommandEnv) -> str:
+    """Resolve a live filer from the master cluster list (the reference
+    shell resolves filers the same way; cluster.go:104). Cached on the
+    env — including the advertised grpc port, which _filer_grpc must
+    honor for filers off the +10000 convention."""
+    cached = env.option.get("_discovered_filer")
+    if cached:
+        return cached
+    from .commands import discover_cluster_node
+    addr, gport = discover_cluster_node(env, "filer")
+    if addr:
+        env.option["_discovered_filer"] = addr
+        if gport:
+            env.option.setdefault("_filer_grpc_ports", {})[addr] = gport
+    return addr
+
+
+def _filer_grpc(addr: str, grpc_port: int = 0) -> str:
     host, _, port = addr.rpartition(":")
-    return f"{host}:{int(port) + 10000}"  # FilerServer grpc convention
+    return f"{host}:{grpc_port or int(port) + 10000}"  # +10000 convention
 
 
 def _filer_stub(env: CommandEnv, opt_filer: str) -> Stub:
-    return Stub(_filer_grpc(_filer_addr(env, opt_filer)), FILER_SERVICE)
+    addr = _filer_addr(env, opt_filer)
+    gport = env.option.get("_filer_grpc_ports", {}).get(addr, 0)
+    return Stub(_filer_grpc(addr, gport), FILER_SERVICE)
 
 
 def _list_entries(stub: Stub, directory: str):

@@ -28,18 +28,10 @@ def _broker_stub(env: CommandEnv, opt_broker: str) -> Stub:
 
 def _find_broker(env: CommandEnv) -> str:
     """Discover a live broker from the master cluster list (reference
-    findBrokerBalancer: brokers register via KeepConnected)."""
-    from ..pb import master_pb2 as mpb
-    from ..utils.rpc import MASTER_SERVICE
-    try:
-        resp = Stub(env.mc.leader, MASTER_SERVICE).call(
-            "ListClusterNodes",
-            mpb.ListClusterNodesRequest(client_type="broker"),
-            mpb.ListClusterNodesResponse)
-        nodes = sorted(resp.cluster_nodes, key=lambda n: n.created_at_ns)
-        return nodes[0].address if nodes else ""
-    except Exception:  # noqa: BLE001
-        return ""
+    findBrokerBalancer: brokers register via KeepConnected; brokers
+    serve gRPC on their registered address directly)."""
+    from .commands import discover_cluster_node
+    return discover_cluster_node(env, "broker")[0]
 
 
 def _mq_parser(prog: str) -> argparse.ArgumentParser:

@@ -152,3 +152,47 @@ def test_late_joiner_bootstraps(mesh):
         assert fd.read_entry_bytes(entry) == b"pre-existing"
     finally:
         fd.stop()
+
+
+def test_concurrent_update_no_chunk_loss(mesh):
+    """Concurrent updates of the same file on two mesh filers must not
+    delete each other's chunks (metadata-only apply, gc_chunks=False):
+    whichever entry wins, its chunks are still readable."""
+    fa, fb, fc = mesh["filers"]
+    fa.write_file("/race/f.bin", b"base version")
+    for f in (fb, fc):
+        wait_until(lambda f=f: f.filer.find_entry("/race", "f.bin")
+                   is not None, msg="base propagated")
+    # near-simultaneous divergent updates on A and B
+    fa.write_file("/race/f.bin", b"version from A " * 10)
+    fb.write_file("/race/f.bin", b"version from B " * 10)
+    time.sleep(2.0)  # mesh settles (either version may win)
+    for f in (fa, fb, fc):
+        entry = f.filer.find_entry("/race", "f.bin")
+        assert entry is not None and entry.chunks
+        data = f.read_entry_bytes(entry)
+        assert data in (b"version from A " * 10, b"version from B " * 10), \
+            f"{f.url}: winning entry's chunks must be readable"
+
+
+def test_shell_filer_autodiscovery(mesh):
+    """fs.* commands resolve a filer from the master cluster list when
+    none is configured (reference shell behavior)."""
+    import io
+
+    from seaweedfs_tpu.shell import fs_commands  # noqa: F401
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    fa = mesh["filers"][0]
+    fa.write_file("/disco/hello.txt", b"found me")
+    # propagate so ANY discovered filer serves it
+    for f in mesh["filers"][1:]:
+        wait_until(lambda f=f: f.filer.find_entry("/disco", "hello.txt")
+                   is not None, msg="propagated")
+    out = io.StringIO()
+    env = CommandEnv(mesh["ms"].address, out=out)  # NO filer configured
+    try:
+        run_command(env, "fs.ls /disco")
+        assert "hello.txt" in out.getvalue()
+    finally:
+        env.mc.stop()
