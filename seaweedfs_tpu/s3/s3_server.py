@@ -256,6 +256,7 @@ class S3Gateway:
             from .auth import ErrAccessDenied
             if not ident.allows(action, bucket):
                 raise ErrAccessDenied()
+            request["s3_identity"] = ident
             return None
         if payload_hash == STREAMING_PAYLOAD:
             ident, seed_ctx = self.iam.authenticate_streaming(
@@ -276,6 +277,7 @@ class S3Gateway:
 
         if not ident.allows(action, bucket):
             raise ErrAccessDenied()
+        request["s3_identity"] = ident
         return seed_ctx
 
     async def _route_bucket(self, request, bucket, q, body):
@@ -335,6 +337,12 @@ class S3Gateway:
         m = request.method
         if m == "PUT":
             if "partNumber" in q and "uploadId" in q:
+                src = request.headers.get("x-amz-copy-source")
+                if src:
+                    return self.upload_part_copy(
+                        bucket, key, q, src,
+                        request.headers.get("x-amz-copy-source-range", ""),
+                        request)
                 return self.upload_part(bucket, key, q, body)
             if "acl" in q:
                 return self.put_acl(bucket, key, request, body)
@@ -348,7 +356,8 @@ class S3Gateway:
             src = request.headers.get("x-amz-copy-source")
             if src:
                 return self.copy_object(bucket, key, src,
-                                        acl=self._canned_acl(request))
+                                        acl=self._canned_acl(request),
+                                        request=request)
             return self.put_object(bucket, key, body,
                                    request.content_type or "",
                                    acl=self._canned_acl(request))
@@ -707,16 +716,29 @@ class S3Gateway:
         return web.Response(status=200,
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
 
-    def copy_object(self, bucket, key, src, acl: str | None = None):
-        self._check_quota(bucket)
-        self._require_bucket(bucket)
+    def _resolve_copy_source(self, src: str, request):
+        """(src_bucket, src_key, entry) for an x-amz-copy-source value.
+        Enforces READ on the SOURCE bucket — without this, write access
+        to one bucket would exfiltrate objects from any other."""
         src = urllib.parse.unquote(src)
         src = src[src.startswith("/") and 1 or 0:]
         sb, _, sk = src.partition("/")
+        ident = request.get("s3_identity") if request is not None else None
+        if self.iam.enabled and ident is not None \
+                and not ident.allows(ACTION_READ, sb):
+            from .auth import ErrAccessDenied
+            raise ErrAccessDenied()
         d, n = split_path(self._object_path(sb, sk))
         entry = self.fs.filer.find_entry(d, n)
         if entry is None:
             raise ErrNoSuchKey(sk)
+        return sb, sk, entry
+
+    def copy_object(self, bucket, key, src, acl: str | None = None,
+                    request=None):
+        self._check_quota(bucket)
+        self._require_bucket(bucket)
+        _sb, _sk, entry = self._resolve_copy_source(src, request)
         data = self.fs.read_entry_bytes(entry)
         new = self.fs.write_file(self._object_path(bucket, key), data,
                                  mime=entry.attributes.mime)
@@ -944,6 +966,43 @@ class S3Gateway:
         entry = self.fs.write_file(path, body)
         return web.Response(status=200,
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
+
+    def upload_part_copy(self, bucket, key, q, src, src_range: str,
+                         request=None):
+        """UploadPartCopy (reference CopyObjectPartHandler,
+        s3api_server.go:165): the part's bytes come from an existing
+        object, optionally a byte range (fetched as a slice — a ranged
+        copy out of a huge object must not materialize the whole
+        source)."""
+        self._check_quota(bucket)
+        self._require_bucket(bucket)
+        upload_id = q["uploadId"]
+        self._find_upload(bucket, upload_id)
+        _sb, _sk, entry = self._resolve_copy_source(src, request)
+        size = entry.attributes.file_size or total_size(entry.chunks)
+        if src_range:
+            m = src_range.removeprefix("bytes=")
+            lo_s, _, hi_s = m.partition("-")
+            try:
+                lo, hi = int(lo_s), int(hi_s)
+            except ValueError:
+                raise S3Error("InvalidRange",
+                              "The requested range is not satisfiable",
+                              416)
+            if lo > hi or hi >= size:
+                raise S3Error("InvalidRange",
+                              "The requested range is not satisfiable",
+                              416)
+            data = self.fs.read_entry_bytes(entry, lo, hi - lo + 1)
+        else:
+            data = self.fs.read_entry_bytes(entry)
+        part = int(q["partNumber"])
+        path = f"{self._upload_dir(bucket, upload_id)}/{part:05d}.part"
+        new = self.fs.write_file(path, data)
+        root = ET.Element("CopyPartResult")
+        ET.SubElement(root, "ETag").text = f'"{new.attributes.md5.hex()}"'
+        ET.SubElement(root, "LastModified").text = _iso(new.attributes.mtime)
+        return _xml_response(root)
 
     def complete_multipart(self, bucket, key, upload_id, body):
         self._check_quota(bucket)

@@ -850,3 +850,94 @@ def test_policy_versioning_lock_parity_stubs(s3):
                             timeout=10).status_code == 404
     requests.delete(f"{base}/stubbkt/locked.txt", timeout=10)
     requests.delete(f"{base}/stubbkt", timeout=10)
+
+
+def test_upload_part_copy(s3):
+    """UploadPartCopy: multipart parts sourced from an existing object,
+    whole and ranged (reference CopyObjectPartHandler)."""
+    gw, base = s3
+    requests.put(f"{base}/partcopy", timeout=10)
+    src_body = bytes(range(256)) * 40  # 10240 bytes
+    requests.put(f"{base}/partcopy/src.bin", data=src_body, timeout=10)
+    # initiate multipart for the destination
+    r = requests.post(f"{base}/partcopy/dst.bin?uploads", timeout=10)
+    upload_id = r.text.split("<UploadId>")[1].split("<")[0]
+    # part 1: whole source object
+    r = requests.put(
+        f"{base}/partcopy/dst.bin?partNumber=1&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/partcopy/src.bin"}, timeout=10)
+    assert r.status_code == 200 and "<CopyPartResult>" in r.text, r.text
+    # part 2: a byte range
+    r = requests.put(
+        f"{base}/partcopy/dst.bin?partNumber=2&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/partcopy/src.bin",
+                 "x-amz-copy-source-range": "bytes=0-4095"}, timeout=10)
+    assert r.status_code == 200 and "<CopyPartResult>" in r.text
+    # bad range -> 416
+    r = requests.put(
+        f"{base}/partcopy/dst.bin?partNumber=3&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/partcopy/src.bin",
+                 "x-amz-copy-source-range": "bytes=5-999999"}, timeout=10)
+    assert r.status_code == 416
+    # complete with the two copied parts
+    xml = ("<CompleteMultipartUpload>"
+           "<Part><PartNumber>1</PartNumber></Part>"
+           "<Part><PartNumber>2</PartNumber></Part>"
+           "</CompleteMultipartUpload>")
+    r = requests.post(
+        f"{base}/partcopy/dst.bin?uploadId={upload_id}", data=xml,
+        timeout=10)
+    assert r.status_code == 200, r.text
+    got = requests.get(f"{base}/partcopy/dst.bin", timeout=10)
+    assert got.content == src_body + src_body[:4096]
+
+
+def test_copy_source_requires_read_on_source_bucket(s3_auth):
+    """Write access to one bucket must not exfiltrate another bucket's
+    objects via x-amz-copy-source (CopyObject or UploadPartCopy)."""
+    gw, base = s3_auth
+    # admin seeds a secret in its own bucket
+    assert _signed("PUT", f"{base}/adminonly").status_code == 200
+    assert _signed("PUT", f"{base}/adminonly/secret.txt",
+                   b"top secret").status_code == 200
+    # grant a writer-only identity scoped to its own bucket
+    gw.iam.load({"identities": [
+        {"name": "admin",
+         "credentials": [{"accessKey": "AKIDEXAMPLE",
+                          "secretKey": "sEcReT"}],
+         "actions": ["Admin"]},
+        {"name": "writer",
+         "credentials": [{"accessKey": "WRONLY", "secretKey": "wsec"}],
+         "actions": ["Write:mine", "Read:mine", "List:mine"]},
+    ]})
+    try:
+        assert _signed("PUT", f"{base}/mine", access="WRONLY",
+                       secret="wsec").status_code == 200
+        # CopyObject from the foreign bucket -> denied
+        import requests as _rq
+
+        from seaweedfs_tpu.s3.auth import sign_request_v4
+        url = f"{base}/mine/stolen.txt"
+        hdrs = sign_request_v4("PUT", url, {}, b"", "WRONLY", "wsec")
+        hdrs["x-amz-copy-source"] = "/adminonly/secret.txt"
+        r = _rq.put(url, headers=hdrs, timeout=10)
+        assert r.status_code == 403, r.text
+        # UploadPartCopy from the foreign bucket -> denied
+        r = _signed("POST", f"{base}/mine/part.bin?uploads",
+                    access="WRONLY", secret="wsec")
+        upload_id = r.text.split("<UploadId>")[1].split("<")[0]
+        url = f"{base}/mine/part.bin?partNumber=1&uploadId={upload_id}"
+        hdrs = sign_request_v4("PUT", url, {}, b"", "WRONLY", "wsec")
+        hdrs["x-amz-copy-source"] = "/adminonly/secret.txt"
+        r = _rq.put(url, headers=hdrs, timeout=10)
+        assert r.status_code == 403, r.text
+        # same-bucket copy still allowed
+        assert _signed("PUT", f"{base}/mine/own.txt", b"mine",
+                       access="WRONLY", secret="wsec").status_code == 200
+        url = f"{base}/mine/own-copy.txt"
+        hdrs = sign_request_v4("PUT", url, {}, b"", "WRONLY", "wsec")
+        hdrs["x-amz-copy-source"] = "/mine/own.txt"
+        r = _rq.put(url, headers=hdrs, timeout=10)
+        assert r.status_code == 200, r.text
+    finally:
+        gw.iam.load(IAM_CONFIG)
