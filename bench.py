@@ -111,10 +111,13 @@ def bench_device(out: dict, B: int, C: int, repeats: int, smoke: bool) -> None:
     use_pallas = rs_pallas.available()
 
     if use_pallas:
+        # 4x loops: the kernel is ~3x faster than the einsum path, so at
+        # einsum-sized loop counts its marginal diff (~18 ms) rides the
+        # tunneled chip's dispatch jitter (~12% spread)
         ests = marginal_time(
             lambda x, i: rs_pallas.encode_seeded_jit(
                 x, jnp.full((1,), i & 7, jnp.int32), D, P),
-            g, n1, n2, repeats)
+            g, n1 * 4, n2 * 4, repeats)
         m, s = med_spread([nbytes / e / 1e9 for e in ests])
         out["value"], out["spread"] = round(m, 3), round(s, 4)
         log(f"device encode (pallas): {m:.2f} GB/s (spread {s:.1%})")
@@ -139,7 +142,10 @@ def bench_device(out: dict, B: int, C: int, repeats: int, smoke: bool) -> None:
         else:
             fn = lambda x, i, _l=lost, _p=present: rs_jax.reconstruct(
                 x ^ jnp.uint8(i & 7), _p, _l, D, P)
-        ests = marginal_time(fn, g, n1, n2, repeats)
+        # 4x the encode loop counts: rebuild calls are fast enough that
+        # the marginal diff otherwise sits near dispatch jitter (~13%
+        # spread on the tunneled chip)
+        ests = marginal_time(fn, g, n1 * 4, n2 * 4, repeats)
         m, s = med_spread([nbytes / e / 1e9 for e in ests])
         key = f"ec_rebuild_{len(lost)}lost_GBps"
         out[key], out[key + "_spread"] = round(m, 3), round(s, 4)
@@ -153,8 +159,12 @@ def bench_device(out: dict, B: int, C: int, repeats: int, smoke: bool) -> None:
     gb = jax.device_put(blocks)
     jax.block_until_ready(gb)
     crc_jit = jax.jit(lambda x: crcmod.device_crc_states(x, chunk=512))
+    # CRC per call is ~100x faster than an encode; the marginal diff at
+    # encode-sized loop counts is a few ms — smaller than dispatch jitter
+    # on a tunneled chip, which made the spread ~67%. 16x longer loops
+    # put >100 ms inside each measurement.
     ests = marginal_time(lambda x, i: crc_jit(x ^ jnp.uint8(i & 7)),
-                         gb, n1, n2, repeats)
+                         gb, n1 * 16, n2 * 16, repeats)
     m, s = med_spread([nb / e for e in ests])
     out["crc_scrub_needles_per_s"] = round(m) if m == m else None
     out["crc_scrub_spread"] = round(s, 4)
