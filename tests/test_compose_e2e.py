@@ -171,12 +171,16 @@ def test_survives_volume_server_kill(compose):
     readable through the surviving replicas."""
     s3 = compose["s3"]
     base = f"http://{s3.url}"
-    # seed a handful of objects (replicated 001 across the rack)
+    # seed a handful of objects (replicated 001 across the rack); retry
+    # each PUT — this test may run right after the leader-kill test and a
+    # seed write can race the cluster re-homing to the new leader
     bodies = {}
     for i in range(6):
         body = f"replicated object {i}".encode() * 50
-        assert requests.put(f"{base}/xproto/kill-{i}.bin", data=body,
-                            timeout=10).status_code == 200
+        wait_until(lambda b=body, i=i: requests.put(
+            f"{base}/xproto/kill-{i}.bin", data=b,
+            timeout=10).status_code == 200, timeout=30,
+            msg=f"seed kill-{i}.bin")
         bodies[f"kill-{i}.bin"] = body
     victim = next(vs for vs in compose["vservers"]
                   if vs.store.status()["volumes"])
