@@ -1079,6 +1079,17 @@ def run_ftp(argv):
     _wait_forever()
 
 
+def run_update(argv):
+    """Self-update verb (reference command/update.go downloads the
+    latest release binary). This build is a source checkout with no
+    release channel or egress; say so instead of failing cryptically."""
+    p = argparse.ArgumentParser(prog="update")
+    p.add_argument("-output", default="", help="(reference parity)")
+    p.parse_args(argv)
+    print("update: this is a source installation; update with "
+          "`git pull` in the repository checkout")
+
+
 def run_fuse(argv):
     """/etc/fstab-compatible mount wrapper (reference command/fuse.go):
     `swtpu fuse <mountpoint> -o "filer=host:port,chunkSizeLimitMB=4"`."""
@@ -1165,6 +1176,7 @@ VERBS = {
     "filer.replicate": run_filer_replicate,
     "filer.remote.sync": run_filer_remote_sync,
     "filer.remote.gateway": run_filer_remote_gateway,
+    "update": run_update,
     "autocomplete": run_autocomplete,
     "unautocomplete": run_unautocomplete,
 }
