@@ -67,6 +67,8 @@ class VolumeServer:
         # degraded-read fan-out pool (store_ec.go:367 goroutine fan-out)
         self._ec_loc_cache: dict[int, tuple[dict, float, bool]] = {}
         self._ec_loc_lock = threading.Lock()
+        # replica-set cache for the write fan-out (see _lookup_replicas_cached)
+        self._replica_cache: dict[int, tuple[float, list[str]]] = {}
         from concurrent.futures import ThreadPoolExecutor
         self._ec_read_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="ec-degraded-read")
@@ -347,7 +349,13 @@ class VolumeServer:
         """Synchronous fan-out to replica peers (store_replicate.go:25),
         preserving the needle attributes (name/mime/gzip flag)."""
         vid = int(fid.split(",")[0])
-        peers = [u for u in self._lookup_replicas(vid) if u != self.url]
+        # single-copy volumes need no peer lookup at all: the superblock
+        # carries the xyz placement, and '000' means this write is final
+        # (reference checks ReplicaPlacement.GetCopyCount() == 1 the same way)
+        v = self.store.find_volume(vid)
+        if v is not None and v.super_block.replica_placement.copy_count == 1:
+            return
+        peers = [u for u in self._lookup_replicas_cached(vid) if u != self.url]
         if not peers:
             return
         import aiohttp
@@ -376,6 +384,17 @@ class VolumeServer:
         tok = gen_jwt_for_volume_server(self.guard.signing_key,
                                         self.guard.expires_after_sec, fid)
         return "&jwt=" + urllib.parse.quote(tok)
+
+    def _lookup_replicas_cached(self, vid: int) -> list[str]:
+        """Replica sets move only on evacuate/rebalance; a short-TTL cache
+        keeps the per-write master round-trip off the hot path."""
+        now = time.monotonic()
+        hit = self._replica_cache.get(vid)
+        if hit is not None and now - hit[0] < 5.0:
+            return hit[1]
+        urls = self._lookup_replicas(vid)
+        self._replica_cache[vid] = (now, urls)
+        return urls
 
     def _lookup_replicas(self, vid: int) -> list[str]:
         try:
