@@ -44,6 +44,8 @@ def _percentiles(lat: list[float]) -> dict:
 def run(argv=None) -> dict:
     p = argparse.ArgumentParser(prog="benchmark")
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-masterHttp", default="",
+                   help="master HTTP API address for fast-path assigns")
     p.add_argument("-n", type=int, default=10000, help="number of files")
     p.add_argument("-size", type=int, default=1024, help="file size bytes")
     p.add_argument("-c", type=int, default=16, help="concurrency")
@@ -52,7 +54,7 @@ def run(argv=None) -> dict:
     p.add_argument("-read", action="store_true", default=True)
     opt = p.parse_args(argv)
 
-    mc = MasterClient(opt.master).start()
+    mc = MasterClient(opt.master, http_address=opt.masterHttp).start()
     mc.wait_connected()
     payload = FakeReader(opt.size, 42).data
 

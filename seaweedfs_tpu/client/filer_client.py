@@ -99,6 +99,19 @@ class FilerClient:
             self._vid_cache.pop(fid.split(",")[0], None)
         raise IOError(f"chunk {fid} unreadable: {last}")
 
+    def _fill_window(self, chunks, offset: int, size: int) -> bytes:
+        """Assemble [offset, offset+size) from resolved chunk views."""
+        buf = bytearray(size)
+        for v in read_views(chunks, offset, size):
+            blob = self._fetch_blob(v.file_id)
+            if v.cipher_key:
+                from ..security.cipher import decrypt
+                blob = decrypt(blob, v.cipher_key)
+            part = blob[v.chunk_offset:v.chunk_offset + v.size]
+            at = v.logical_offset - offset
+            buf[at:at + len(part)] = part
+        return bytes(buf)
+
     def read_entry_bytes(self, entry: fpb.Entry, offset: int = 0,
                          size: int | None = None) -> bytes:
         if entry.content:
@@ -111,21 +124,36 @@ class FilerClient:
         if size is None:
             size = fsize - offset
         size = max(0, min(size, fsize - offset))
-        buf = bytearray(size)
-        for v in read_views(chunks, offset, size):
-            blob = self._fetch_blob(v.file_id)
-            if v.cipher_key:
-                from ..security.cipher import decrypt
-                blob = decrypt(blob, v.cipher_key)
-            part = blob[v.chunk_offset:v.chunk_offset + v.size]
-            at = v.logical_offset - offset
-            buf[at:at + len(part)] = part
-        return bytes(buf)
+        return self._fill_window(chunks, offset, size)
+
+    def iter_entry_bytes(self, entry: fpb.Entry, window: int = 0):
+        """Yield the entry's content in bounded windows (gateway streaming:
+        one FTP RETR must not materialize a multi-GB file in memory).
+        The window defaults to chunk_size so chunk-aligned files are
+        fetched (and decrypted) once per chunk, not once per window."""
+        if entry.content:
+            yield bytes(entry.content)
+            return
+        window = window or self.chunk_size
+        from ..filer.chunks import resolve_manifests
+        chunks = resolve_manifests(list(entry.chunks), self._fetch_blob)
+        fsize = max(total_size(chunks), entry.attributes.file_size)
+        off = 0
+        while off < fsize:
+            size = min(window, fsize - off)
+            yield self._fill_window(chunks, off, size)
+            off += size
 
     def _save_blob(self, data: bytes, ttl: str = "",
                    path: str = "") -> fpb.FileChunk:
         """Assign + upload ONE blob (the FUSE page-writer seam,
         FilerServer._save_blob's remote twin)."""
+        return self._save_blob_full(data, ttl=ttl, path=path)[0]
+
+    def _save_blob_full(self, data: bytes, ttl: str = "", path: str = ""
+                        ) -> "tuple[fpb.FileChunk, str, str]":
+        """(chunk, blob_url, jwt) — the url+jwt let a failed multi-chunk
+        write delete what it already uploaded."""
         from ..client import operation
         from ..storage.types import TTL
 
@@ -137,36 +165,74 @@ class FilerClient:
         if a.error:
             raise IOError(f"assign: {a.error}")
         target = a.public_url or a.location_url
-        res = operation.upload(f"{target}/{a.file_id}", data,
+        url = f"{target}/{a.file_id}"
+        res = operation.upload(url, data,
                                gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
         return fpb.FileChunk(file_id=a.file_id,
                              size=res.get("size", len(data)),
                              modified_ts_ns=time.time_ns(),
-                             e_tag=res.get("eTag", ""))
+                             e_tag=res.get("eTag", "")), url, a.auth
 
     def write_file(self, path: str, data: bytes, mime: str = "",
                    ttl_sec: int = 0, mode: int = 0o644,
                    signatures: "list[int] | None" = None) -> None:
         """Chunked upload straight into the blob cluster + CreateEntry,
         mirroring FilerServer.write_file."""
+        self.write_file_stream(path, (data,), mime=mime, ttl_sec=ttl_sec,
+                               mode=mode, signatures=signatures)
+
+    def write_file_stream(self, path: str, blocks, mime: str = "",
+                          ttl_sec: int = 0, mode: int = 0o644,
+                          signatures: "list[int] | None" = None) -> int:
+        """write_file over an iterable of byte blocks: repacks into
+        chunk_size pieces and uploads as they arrive, so a gateway upload
+        (FTP STOR) holds at most one chunk in memory. Returns total bytes."""
         from ..filer.filer import split_path
 
         directory, name = split_path(path)
+        ttl = f"{ttl_sec}s" if ttl_sec else ""
         chunks = []
-        for off in range(0, len(data), self.chunk_size):
-            piece = data[off:off + self.chunk_size]
-            c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "",
-                                path=path)
-            c.offset = off
-            chunks.append(c)
+        uploaded: "list[tuple[str, str]]" = []  # (url, jwt) for rollback
+        buf = bytearray()
+        off = 0
+
+        def flush(final: bool) -> None:
+            nonlocal off
+            while len(buf) >= self.chunk_size or (final and buf):
+                piece = bytes(buf[:self.chunk_size])
+                del buf[:self.chunk_size]
+                c, url, jwt = self._save_blob_full(piece, ttl=ttl, path=path)
+                uploaded.append((url, jwt))
+                c.offset = off
+                off += len(piece)
+                chunks.append(c)
+
+        try:
+            for block in blocks:
+                if block:
+                    buf += block
+                    flush(final=False)
+            flush(final=True)
+        except BaseException:
+            # the source died mid-stream (e.g. an aborted FTP STOR): no
+            # entry will ever reference what we uploaded, so delete it
+            # now instead of leaking unreferenced needles
+            from ..client import http_util
+            for url, jwt in uploaded:
+                try:
+                    http_util.delete(url, params={"jwt": jwt} if jwt else None)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+            raise
         entry = fpb.Entry(name=name)
         entry.chunks.extend(chunks)
         at = entry.attributes
-        at.file_size = len(data)
+        at.file_size = off
         at.mime = mime
         at.file_mode = mode
         at.ttl_sec = ttl_sec
         self.filer.create_entry(directory, entry, signatures=signatures)
+        return off
 
 
 class _FilerFacade:

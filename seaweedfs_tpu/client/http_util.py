@@ -1,28 +1,32 @@
 """Lean keep-alive HTTP client for the cluster data plane.
 
 `requests` costs ~1 ms of client CPU per call (session plumbing, cookie
-jars, urllib3 pooling); on a loopback cluster that dwarfs the server's own
-work. This pool keeps one persistent `http.client` connection per
-(thread, host) — the same connection-reuse model the reference's Go
-`http.Client` transport gives every component for free
+jars, urllib3 pooling) and stdlib `http.client` still ~90 us; on a loopback
+cluster both dwarf the server's own work. This is a minimal HTTP/1.1 client
+on raw sockets — one persistent connection per (thread, host), flat
+request-bytes assembly, buffered-reader response parse (~15 us/round-trip).
+It plays the role the reference's shared Go `http.Client` transport does
 (reference: weed/util/http/http_global_client_util.go).
 
-All cluster-internal callers (operation.py, bench_tool, replication fan-out)
-share it via the module-level `request()` helper.
+All cluster-internal callers (operation.py, master_client assigns,
+bench_tool) share it via the module-level request()/get()/post() helpers.
 """
 
 from __future__ import annotations
 
-import http.client
+import socket
 import threading
 import urllib.parse
 import uuid
 
 
+from ..utils.fastweb import Headers  # shared case-insensitive header dict
+
+
 class Response:
     __slots__ = ("status", "headers", "content")
 
-    def __init__(self, status: int, headers, content: bytes):
+    def __init__(self, status: int, headers: Headers, content: bytes):
         self.status = status
         self.headers = headers
         self.content = content
@@ -36,17 +40,41 @@ class Response:
         return 200 <= self.status < 300
 
 
+class _Conn:
+    __slots__ = ("sock", "rfile")
+
+    def __init__(self, netloc: str, timeout: float):
+        host, _, port = netloc.rpartition(":")
+        self.sock = socket.create_connection((host or netloc,
+                                              int(port) if port else 80),
+                                             timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.rfile = self.sock.makefile("rb", buffering=1 << 16)
+
+    def close(self) -> None:
+        try:
+            self.rfile.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.sock.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
 _local = threading.local()
 
 
-def _conn(netloc: str, timeout: float) -> http.client.HTTPConnection:
+def _conn(netloc: str, timeout: float) -> _Conn:
     pool = getattr(_local, "pool", None)
     if pool is None:
         pool = _local.pool = {}
     c = pool.get(netloc)
     if c is None:
-        c = http.client.HTTPConnection(netloc, timeout=timeout)
+        c = _Conn(netloc, timeout)
         pool[netloc] = c
+    else:
+        c.sock.settimeout(timeout)
     return c
 
 
@@ -55,10 +83,72 @@ def _drop(netloc: str) -> None:
     if pool is not None:
         c = pool.pop(netloc, None)
         if c is not None:
+            c.close()
+
+
+class _Stale(Exception):
+    """Server closed a kept-alive connection between requests."""
+
+
+def _read_response(c: _Conn, method: str) -> tuple[Response, bool]:
+    """Parse one response; returns (response, keep_alive)."""
+    rf = c.rfile
+    line = rf.readline(8192)
+    if not line:
+        raise _Stale("connection closed")
+    parts = line.split(None, 2)
+    if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
+        raise OSError(f"malformed status line: {line[:80]!r}")
+    try:
+        status = int(parts[1])
+    except ValueError:
+        raise OSError(f"malformed status line: {line[:80]!r}") from None
+    version_11 = parts[0].endswith(b"1.1")
+    headers = Headers()
+    while True:
+        ln = rf.readline(8192)
+        if ln in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = ln.partition(b":")
+        headers[k.strip().lower().decode("latin1")] = \
+            v.strip().decode("latin1")
+    conn_tok = headers.get("connection", "").lower()
+    keep = (version_11 and conn_tok != "close") or conn_tok == "keep-alive"
+    if method == "HEAD" or status in (204, 304) or 100 <= status < 200:
+        return Response(status, headers, b""), keep
+    te = headers.get("transfer-encoding", "")
+    if "chunked" in te.lower():
+        chunks = []
+        while True:
+            size_line = rf.readline(8192)
             try:
-                c.close()
-            except Exception:  # noqa: BLE001
-                pass
+                size = int(size_line.split(b";")[0].strip(), 16)
+            except ValueError:
+                raise OSError(f"bad chunk size {size_line[:40]!r}") from None
+            if size == 0:
+                while True:  # trailers until blank line
+                    t = rf.readline(8192)
+                    if t in (b"\r\n", b"\n", b""):
+                        break
+                break
+            data = rf.read(size + 2)  # chunk + CRLF
+            if len(data) < size + 2:
+                raise OSError("truncated chunk")
+            chunks.append(data[:size])
+        return Response(status, headers, b"".join(chunks)), keep
+    cl = headers.get("content-length")
+    if cl is not None:
+        try:
+            n = int(cl)
+        except ValueError:
+            raise OSError(f"bad content-length {cl!r}") from None
+        body = rf.read(n) if n else b""
+        if len(body) < n:
+            raise OSError("truncated response body")
+        return Response(status, headers, body), keep
+    # no framing: read to EOF, connection is done
+    body = rf.read()
+    return Response(status, headers, body), False
 
 
 def request(method: str, url: str, body: bytes | None = None,
@@ -78,20 +168,30 @@ def request(method: str, url: str, body: bytes | None = None,
     if params:
         sep = "&" if "?" in path else "?"
         path = path + sep + urllib.parse.urlencode(params)
-    hdrs = headers or {}
+    body = body or b""
+    head = f"{method} {path} HTTP/1.1\r\nHost: {netloc}\r\n"
+    if headers:
+        for k, v in headers.items():
+            head += f"{k}: {v}\r\n"
+    if body or method in ("POST", "PUT"):
+        head += f"Content-Length: {len(body)}\r\n"
+    req_bytes = head.encode("latin1") + b"\r\n" + body
     for attempt in (0, 1):
         c = _conn(netloc, timeout)
+        fresh = attempt == 1
         try:
-            c.request(method, path, body=body, headers=hdrs)
-            r = c.getresponse()
-            content = r.read()
-            if r.will_close:
+            c.sock.sendall(req_bytes)
+            resp, keep = _read_response(c, method)
+            if not keep:
                 _drop(netloc)
-            return Response(r.status, r.headers, content)
-        except (http.client.HTTPException, ConnectionError, BrokenPipeError,
-                OSError):
+            return resp
+        except _Stale:
             _drop(netloc)
-            if attempt:
+            if fresh:
+                raise OSError(f"connection to {netloc} closed") from None
+        except (ConnectionError, BrokenPipeError, socket.timeout, OSError):
+            _drop(netloc)
+            if fresh:
                 raise
     raise AssertionError("unreachable")
 

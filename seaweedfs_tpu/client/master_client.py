@@ -18,6 +18,14 @@ from ..utils.rpc import MASTER_SERVICE, Stub
 log = logger("wdclient")
 
 
+class _HttpAssignRejected(Exception):
+    """Master answered the HTTP assign and refused it (authoritative)."""
+
+
+class _HttpNotLeader(Exception):
+    """A healthy follower answered; retry against the leader via gRPC."""
+
+
 class VidMap:
     def __init__(self):
         self.locations: dict[int, list[dict]] = {}
@@ -56,7 +64,8 @@ class VidMap:
 
 class MasterClient:
     def __init__(self, master_address: str, client_type: str = "client",
-                 client_address: str = "", grpc_port: int = 0):
+                 client_address: str = "", grpc_port: int = 0,
+                 http_address: str = ""):
         # comma-separated master quorum; leader discovered via hints
         # (reference masterclient.go:190 tryConnectToMaster round-robin)
         self.masters = [m for m in master_address.split(",") if m]
@@ -66,6 +75,10 @@ class MasterClient:
         self.client_type = client_type
         self.client_address = client_address or f"pyclient-{random.getrandbits(24):x}"
         self.grpc_port = grpc_port  # advertised service grpc port
+        # optional master HTTP API address: assigns ride the keep-alive
+        # /dir/assign fast path (~3x cheaper than a Python-grpcio unary)
+        self.http_address = http_address
+        self._http_assign_retry_at = 0.0
         self.vid_map = VidMap()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -173,6 +186,23 @@ class MasterClient:
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
                disk_type: str = "") -> pb.AssignResponse:
+        if self.http_address and time.monotonic() >= self._http_assign_retry_at:
+            try:
+                return self._assign_http(count, collection, replication, ttl,
+                                         disk_type)
+            except _HttpAssignRejected as e:
+                # the master answered and refused (grow failed, quota, …):
+                # authoritative — gRPC would say the same, and the HTTP
+                # endpoint is healthy, so no backoff and no retry
+                raise RuntimeError(f"assign: {e}") from None
+            except _HttpNotLeader:
+                pass  # healthy follower: let gRPC's leader-chasing run
+            except Exception as e:  # noqa: BLE001 - transport failure
+                # back off so a black-holed HTTP endpoint doesn't tax
+                # every assign with a connect timeout
+                self._http_assign_retry_at = time.monotonic() + 15.0
+                log.warning("http assign via %s failed (%s); using grpc "
+                            "for 15s", self.http_address, e)
         req = pb.AssignRequest(
             count=count, collection=collection, replication=replication,
             ttl=ttl, disk_type=disk_type)
@@ -213,6 +243,37 @@ class MasterClient:
             self.leader = addr
             return resp
         raise RuntimeError(f"assign: no reachable leader ({last_err})")
+
+    def _assign_http(self, count: int, collection: str, replication: str,
+                     ttl: str, disk_type: str = "") -> pb.AssignResponse:
+        """Keep-alive /dir/assign (reference master HTTP API
+        master_server_handlers.go:46 dirAssignHandler)."""
+        from . import http_util
+        params = {"count": count}
+        if collection:
+            params["collection"] = collection
+        if replication:
+            params["replication"] = replication
+        if ttl:
+            params["ttl"] = ttl
+        if disk_type:
+            params["disk_type"] = disk_type
+        r = http_util.get(f"http://{self.http_address}/dir/assign",
+                          params=params, timeout=5)
+        try:
+            body = r.json()
+        except ValueError:
+            raise OSError(f"non-JSON assign response ({r.status})") from None
+        err = body.get("error", "")
+        if r.status != 200 or err:
+            if err.startswith("not leader"):
+                raise _HttpNotLeader(err)
+            raise _HttpAssignRejected(err or f"HTTP {r.status}")
+        resp = pb.AssignResponse(fid=body["fid"], count=body.get("count", 1),
+                                 auth=body.get("auth", ""))
+        resp.location.url = body.get("url", "")
+        resp.location.public_url = body.get("publicUrl", "")
+        return resp
 
     def lookup(self, vid: int) -> list[dict]:
         cached = self.vid_map.get(vid)

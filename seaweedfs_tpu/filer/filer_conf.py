@@ -32,6 +32,10 @@ class PathRule:
     disk_type: str = ""
     fsync: bool = False
     volume_growth_count: int = 0
+    # set when an S3 PutBucketLifecycle created/claimed this rule's TTL;
+    # DeleteBucketLifecycle strips only marked rules, so TTLs an admin
+    # set via fs.configure under the bucket survive S3 lifecycle churn
+    from_lifecycle: bool = False
 
     @classmethod
     def from_dict(cls, d: dict) -> "PathRule":

@@ -84,9 +84,11 @@ class FilerServer:
         if entry is not None:
             self._maybe_reload_conf(filer_conf.CONF_DIR, None, entry)
         self._grpc = serve(f"{self.ip}:{self.grpc_port}", [self._build_service()])
+        self._http_ready = threading.Event()
         self._http_thread = threading.Thread(target=self._run_http, daemon=True,
                                              name=f"filer-http-{self.port}")
         self._http_thread.start()
+        self._http_ready.wait(10)  # don't log "up" before the port is bound
         if self.meta_aggregate:
             # peers learn this filer's real grpc port from the master
             # registration (KeepConnectedRequest.grpc_port), so a custom
@@ -308,7 +310,8 @@ class FilerServer:
             app.router.add_route("*", "/{path:.*}", handle)
 
         from ..utils.webapp import serve_web_app
-        serve_web_app(routes, self.ip, self.port, self._stop)
+        serve_web_app(routes, self.ip, self.port, self._stop,
+                      ready=self._http_ready)
 
     @staticmethod
     def _req_path(request) -> str:

@@ -27,7 +27,11 @@ from ..utils.rpc import MASTER_SERVICE, Stub
 log = logger("meta-aggregator")
 
 DISCOVER_INTERVAL_S = 2.0
-OFFSET_KEY_FMT = "meta.aggregator.offset.{peer}"
+# Keyed by peer address AND the peer's store signature: a peer wiped and
+# recreated at the same address announces a new signature, which resets
+# the resume point to 0 so its re-imported history replays (reference
+# meta_aggregator.go readFilerStoreSignature does the same).
+OFFSET_KEY_FMT = "meta.aggregator.offset.{peer}.{sig}"
 
 
 class MetaAggregator:
@@ -43,6 +47,10 @@ class MetaAggregator:
         # discovery tick and on batch thresholds)
         self._pending_offsets: dict[str, int] = {}
         self._offset_lock = threading.Lock()
+        # peer addr -> store signature (fills in when the tail dials)
+        self._peer_sig: dict[str, int] = {}
+        # peers whose offset is frozen behind a dead-lettered event
+        self.diverged_peers: set[str] = set()
         self._discover_thread: threading.Thread | None = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -108,7 +116,8 @@ class MetaAggregator:
 
     # -- per-peer tail ------------------------------------------------------
     def _offset_key(self, peer: str) -> bytes:
-        return OFFSET_KEY_FMT.format(peer=peer).encode()
+        sig = self._peer_sig.get(peer, 0)
+        return OFFSET_KEY_FMT.format(peer=peer, sig=sig).encode()
 
     def _sync_peer(self, peer: str, grpc_port: int = 0) -> None:
         try:
@@ -128,6 +137,8 @@ class MetaAggregator:
         fc = FilerClient(peer, grpc_address=grpc_addr,
                          client_name=f"aggr-{self.fs.url}")
         self.peer_signatures[fc.signature] = peer
+        self._peer_sig[peer] = fc.signature  # offset key is (peer, sig)
+        self.diverged_peers.discard(peer)  # fresh dial re-attempts the event
         key = self._offset_key(peer)
         raw = self.fs.filer.store.kv_get(key)
         since = struct.unpack("<q", raw)[0] if raw else 0
@@ -155,9 +166,17 @@ class MetaAggregator:
                     if self._stop.wait(0.2 * 2 ** attempt):
                         return
             if not applied:
-                log.error("DEAD-LETTER %s from %s: this filer's metadata "
-                          "may diverge", resp.directory, peer)
-            if resp.ts_ns:
+                # freeze the resume offset BEHIND this event: later events
+                # still apply (best effort) but the persisted offset stops
+                # here, so the next (re)dial replays and re-attempts it
+                # rather than making the divergence permanent silently.
+                from ..stats.metrics import FILER_AGGR_DEAD_LETTERS
+                FILER_AGGR_DEAD_LETTERS.inc(peer)
+                self.diverged_peers.add(peer)
+                log.error("DEAD-LETTER %s from %s: offset frozen at %d; "
+                          "tail will replay from there on redial",
+                          resp.directory, peer, last_ts)
+            if resp.ts_ns and peer not in self.diverged_peers:
                 last_ts = resp.ts_ns
                 pending += 1
                 with self._offset_lock:

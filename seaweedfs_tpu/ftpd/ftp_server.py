@@ -311,8 +311,14 @@ class _Session:
             self.send(550, "not a file")
             return
         self.send(150, "opening data connection")
-        data = self.fc.read_entry_bytes(e)
-        self._send_over_data(data)
+        conn = self._data_conn()
+        try:
+            # stream window-by-window: one RETR of a huge file must not
+            # materialize it in gateway memory
+            for part in self.fc.iter_entry_bytes(e):
+                conn.sendall(part)
+        finally:
+            conn.close()
         self.send(226, "transfer complete")
 
     def do_STOR(self, arg):
@@ -325,16 +331,20 @@ class _Session:
             return
         self.send(150, "ok to send data")
         conn = self._data_conn()
-        chunks = []
-        try:
+
+        def blocks():
             while True:
                 part = conn.recv(1 << 16)
                 if not part:
-                    break
-                chunks.append(part)
+                    return
+                yield part
+
+        try:
+            # spool through the chunked write path: at most one filer
+            # chunk of the upload is ever buffered in the gateway
+            self.fc.write_file_stream(self._real(vpath), blocks())
         finally:
             conn.close()
-        self.fc.write_file(self._real(vpath), b"".join(chunks))
         self.send(226, "transfer complete")
 
     def do_DELE(self, arg):

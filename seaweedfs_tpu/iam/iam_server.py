@@ -76,7 +76,9 @@ class IamApiServer:
         self._http_thread = threading.Thread(target=self._run_http,
                                              daemon=True,
                                              name=f"iam-{self.port}")
+        self._http_ready = threading.Event()
         self._http_thread.start()
+        self._http_ready.wait(10)  # port bound before start() returns
         log.info("iam api %s up", self.url)
         return self
 
@@ -178,7 +180,8 @@ class IamApiServer:
         from ..utils.webapp import serve_web_app
         serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
                                                        dispatch),
-                      self.ip, self.port, self._stop)
+                      self.ip, self.port, self._stop,
+                      ready=getattr(self, "_http_ready", None))
 
     # -- XML -----------------------------------------------------------------
     def _xml_ok(self, action: str, result: ET.Element | None) -> bytes:

@@ -307,7 +307,9 @@ class Topology:
         with self.lock:
             out = {}
             for sid, nids in self.ec_locations.get(vid, {}).items():
-                out[sid] = [self.nodes[n] for n in nids if n in self.nodes]
+                holders = [self.nodes[n] for n in nids if n in self.nodes]
+                if holders:  # fully-evacuated shard ids are not locations
+                    out[sid] = holders
             return out
 
     def next_volume_id(self) -> int:

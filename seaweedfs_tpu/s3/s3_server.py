@@ -95,7 +95,9 @@ class S3Gateway:
     def start(self) -> "S3Gateway":
         self._http_thread = threading.Thread(target=self._run_http, daemon=True,
                                              name=f"s3-http-{self.port}")
+        self._http_ready = threading.Event()
         self._http_thread.start()
+        self._http_ready.wait(10)  # port bound before start() returns
         log.info("s3 gateway %s up (auth %s)", self.url,
                  "on" if self.iam.enabled else "off")
         return self
@@ -140,7 +142,8 @@ class S3Gateway:
         from ..utils.webapp import serve_web_app
         serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
                                                        dispatch),
-                      self.ip, self.port, self._stop)
+                      self.ip, self.port, self._stop,
+                      ready=getattr(self, "_http_ready", None))
 
     # CORS (reference s3api_server.go cors.AllowAll-style middleware)
     def _cors_preflight(self, request):
@@ -444,9 +447,11 @@ class S3Gateway:
             import dataclasses
             existing = next((r for r in conf.rules
                              if r.location_prefix == lp), None)
-            conf.upsert(dataclasses.replace(existing, ttl=f"{days}d")
+            conf.upsert(dataclasses.replace(existing, ttl=f"{days}d",
+                                            from_lifecycle=True)
                         if existing is not None
-                        else PathRule(location_prefix=lp, ttl=f"{days}d"))
+                        else PathRule(location_prefix=lp, ttl=f"{days}d",
+                                      from_lifecycle=True))
             changed = True
         if changed:
             self._save_filer_conf(conf)
@@ -475,17 +480,19 @@ class S3Gateway:
         return _xml_response(root)
 
     def _strip_lifecycle_ttls(self, conf, bucket: str) -> bool:
-        """Remove the TTLs lifecycle PUTs own under the bucket; rules an
-        admin enriched with replication/collection/disk_type survive
-        (TTL-less). Returns whether anything changed."""
+        """Remove the TTLs lifecycle PUTs own under the bucket — only rules
+        carrying the from_lifecycle marker; TTLs an admin set via
+        fs.configure survive, and rules an admin enriched with
+        replication/collection/disk_type survive TTL-less. Returns whether
+        anything changed."""
         import dataclasses
         prefix = f"{BUCKETS_DIR}/{bucket}/"
         changed = False
         for r in list(conf.rules):
             if not (r.location_prefix.startswith(prefix)
-                    and r.ttl.endswith("d")):
+                    and r.from_lifecycle and r.ttl.endswith("d")):
                 continue
-            stripped = dataclasses.replace(r, ttl="")
+            stripped = dataclasses.replace(r, ttl="", from_lifecycle=False)
             if any(getattr(stripped, k) not in ("", False, 0)
                    for k in ("collection", "replication", "disk_type",
                              "fsync", "volume_growth_count")):

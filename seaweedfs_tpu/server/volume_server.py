@@ -59,6 +59,14 @@ class VolumeServer:
         self._stop = threading.Event()
         self._leave = threading.Event()  # volume.server.leave: stop heartbeats
         self._hb_wake = threading.Event()
+        # heartbeat flush bookkeeping: state seq bumps on every mutation
+        # trigger; the loop records which seq each SENT snapshot covered and
+        # advances _hb_acked_seq when the master's 1:1 response arrives, so
+        # flush_heartbeat() can wait for "master has processed my change"
+        self._hb_cond = threading.Condition()
+        self._hb_state_seq = 0
+        self._hb_acked_seq = -1
+        self._hb_inflight: "list[int]" = []
         self._grpc = None
         self._http_thread = None
         self._hb_thread = None
@@ -146,6 +154,10 @@ class VolumeServer:
                 self.store.close_idle_ec_handles()
             except Exception as e:  # noqa: BLE001
                 log.warning("ec housekeeping: %s", e)
+            # read the seq BEFORE snapshotting: any mutation that bumped
+            # the seq before this point is included in the snapshot, so
+            # acking snap_seq proves the master saw those mutations
+            snap_seq = self._hb_state_seq
             hb = self.store.collect_heartbeat()
             self._update_gauges(hb)
             msg = mpb.Heartbeat(
@@ -160,6 +172,8 @@ class VolumeServer:
                 msg.volumes.add(**v)
             for s in hb["ec_shards"]:
                 msg.ec_shards.add(**s)
+            with self._hb_cond:
+                self._hb_inflight.append(snap_seq)
             yield msg
             self._hb_wake.wait(timeout=self.pulse_seconds)
             self._hb_wake.clear()
@@ -172,6 +186,12 @@ class VolumeServer:
                     "SendHeartbeat", self._heartbeat_messages(),
                     mpb.Heartbeat, mpb.HeartbeatResponse)
                 for resp in stream:
+                    # master answers 1:1 AFTER ingesting each heartbeat:
+                    # the oldest in-flight snapshot is now master-visible
+                    with self._hb_cond:
+                        if self._hb_inflight:
+                            self._hb_acked_seq = self._hb_inflight.pop(0)
+                            self._hb_cond.notify_all()
                     if resp.volume_size_limit:
                         pass  # informational
                     if resp.leader and resp.leader != self.current_leader:
@@ -189,15 +209,46 @@ class VolumeServer:
                                            % len(self.masters))
                         self.current_leader = self.masters[self._master_rr]
                     time.sleep(min(self.pulse_seconds, 2.0))
+            finally:
+                with self._hb_cond:
+                    # unacked sends died with the stream; the next stream
+                    # re-sends full state, so waiters should not count them
+                    self._hb_inflight.clear()
+                    self._hb_cond.notify_all()
 
     def trigger_heartbeat(self) -> None:
+        with self._hb_cond:
+            self._hb_state_seq += 1
         self._hb_wake.set()
 
-    # -- HTTP data path (aiohttp) -------------------------------------------
+    def flush_heartbeat(self, timeout: float = 3.0) -> bool:
+        """Block until the master has ingested a heartbeat reflecting every
+        state change made before this call (or timeout). Admin RPCs that
+        mutate volume/EC registration call this so topology reads anywhere
+        in the cluster see the change once the RPC returns — closing the
+        assemble-send-ingest race the old fire-and-forget trigger left."""
+        if self._stop.is_set() or self._leave.is_set():
+            return False  # no heartbeat loop to ack (leave/decommission)
+        with self._hb_cond:
+            self._hb_state_seq += 1
+            target = self._hb_state_seq
+        self._hb_wake.set()
+        deadline = time.monotonic() + timeout
+        with self._hb_cond:
+            while self._hb_acked_seq < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set() \
+                        or self._leave.is_set():
+                    return False
+                self._hb_cond.wait(min(remaining, 0.25))
+        return True
+
+    # -- HTTP data path (utils/fastweb hand-rolled HTTP/1.1) ----------------
     def _run_http(self) -> None:
         import asyncio
 
-        from aiohttp import web
+        from ..utils import fastweb
+        from ..utils.fastweb import Redirect, json_response
 
         from ..stats import (VOLUME_REQUEST_COUNTER,
                              VOLUME_REQUEST_SECONDS)
@@ -205,7 +256,7 @@ class VolumeServer:
         _kind = {"POST": "post", "PUT": "put", "GET": "get",
                  "HEAD": "head", "DELETE": "delete"}
 
-        async def handle(request: web.Request):
+        async def handle(request: fastweb.Request):
             kind = _kind.get(request.method, "other")
             t0 = time.perf_counter()
             resp = None
@@ -219,18 +270,18 @@ class VolumeServer:
                     elif request.method == "DELETE":
                         resp = await self._handle_delete(request)
                     else:
-                        resp = web.json_response(
+                        resp = json_response(
                             {"error": "method not allowed"}, status=405)
                 except KeyError as e:
-                    resp = web.json_response({"error": str(e)}, status=404)
+                    resp = json_response({"error": str(e)}, status=404)
                 except PermissionError as e:
-                    resp = web.json_response({"error": str(e)}, status=403)
-                except web.HTTPException as e:
-                    status = e.status  # redirects count too
+                    resp = json_response({"error": str(e)}, status=403)
+                except Redirect as e:
+                    status = e.status
                     raise
                 except Exception as e:  # noqa: BLE001
                     log.error("http error: %s", e)
-                    resp = web.json_response({"error": str(e)}, status=500)
+                    resp = json_response({"error": str(e)}, status=500)
                 status = resp.status
                 return resp
             finally:
@@ -238,10 +289,12 @@ class VolumeServer:
                 VOLUME_REQUEST_SECONDS.observe(
                     kind, value=time.perf_counter() - t0)
 
-        async def status(request):
-            return web.json_response({"version": "swtpu", **self.store.status()})
+        def status(request):
+            return json_response({"version": "swtpu", **self.store.status()})
 
-        from ..stats.metrics import aiohttp_metrics_handler
+        def metrics(request):
+            from ..stats import REGISTRY
+            return fastweb.text_response(REGISTRY.gather())
 
         async def debug_profile(request):
             from ..utils import profiling
@@ -249,15 +302,14 @@ class VolumeServer:
             loop = asyncio.get_running_loop()
             text = await loop.run_in_executor(
                 None, profiling.cpu_profile, secs)
-            return web.Response(text=text, content_type="text/plain")
+            return fastweb.text_response(text)
 
-        async def debug_jax_profiler(request):
+        def debug_jax_profiler(request):
             from ..utils import profiling
             port = int(request.query.get("port", "9999"))
-            return web.Response(text=profiling.start_jax_profiler(port),
-                                content_type="text/plain")
+            return fastweb.text_response(profiling.start_jax_profiler(port))
 
-        async def status_ui(request):
+        def status_ui(request):
             # human status UI (reference weed/server/volume_server_ui)
             from ..utils.ui import render_page
             st = self.store.status()
@@ -284,37 +336,33 @@ class VolumeServer:
                 [("Volumes", ["id", "collection", "disk", "size", "files",
                               "deleted", "mode"], rows),
                  ("EC volumes", ["id", "collection", "shards"], ec_rows)])
-            return web.Response(text=page, content_type="text/html")
+            return fastweb.html_response(page)
 
-        def routes(app):
-            app.router.add_get("/status", status)
-            app.router.add_get("/ui", status_ui)
-            app.router.add_get("/metrics", aiohttp_metrics_handler)
-            # pprof-style triggers (reference -debug.port net/http/pprof)
-            app.router.add_get("/debug/profile", debug_profile)
-            app.router.add_get("/debug/jax-profiler", debug_jax_profiler)
-            app.router.add_route("*", "/{fid:.*}", handle)
+        app = fastweb.FastApp()
+        app.route("/status", status)
+        app.route("/ui", status_ui)
+        app.route("/metrics", metrics)
+        # pprof-style triggers (reference -debug.port net/http/pprof)
+        app.route("/debug/profile", debug_profile)
+        app.route("/debug/jax-profiler", debug_jax_profiler)
+        app.default(handle)
+        fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
+                               client_max_size=256 << 20, logger=log)
 
-        from ..utils.webapp import serve_web_app
-        serve_web_app(routes, self.ip, self.port, self._stop,
-                      client_max_size=256 << 20)
-
-    async def _read_body(self, request):
-        ct = request.content_type or ""
+    def _read_body(self, request):
+        ct = request.headers.get("Content-Type") or ""
         name = mime = b""
         gzipped = False
         if ct.startswith("multipart/"):
-            reader = await request.multipart()
-            async for part in reader:
-                data = await part.read(decode=False)
-                name = (part.filename or "").encode()
-                ptype = part.headers.get("Content-Type") or ""
-                if ptype and not ptype.startswith("multipart/"):
-                    mime = ptype.encode()
-                gzipped = part.headers.get("Content-Encoding") == "gzip"
-                return data, name, mime, gzipped
-            return b"", b"", b"", False
-        data = await request.read()
+            from ..utils.fastweb import parse_multipart_single
+            data, filename, ptype, part_headers = parse_multipart_single(
+                request.body, ct)
+            name = filename.encode()
+            if ptype and not ptype.startswith("multipart/"):
+                mime = ptype.encode()
+            gzipped = part_headers.get("Content-Encoding") == "gzip"
+            return data, name, mime, gzipped
+        data = request.body
         if ct and ct != "application/octet-stream":
             mime = ct.encode()
         gzipped = request.headers.get("Content-Encoding") == "gzip"
@@ -322,17 +370,17 @@ class VolumeServer:
         return data, name, mime, gzipped
 
     async def _handle_write(self, request):
-        from aiohttp import web
+        from ..utils.fastweb import json_response
 
-        fid = request.match_info["fid"]
+        fid = request.path.lstrip("/")
         if self.guard is not None:
             ok, why = self.guard.check_write(request.remote or "",
-                                             dict(request.query),
+                                             request.query,
                                              request.headers, fid)
             if not ok:
-                return web.json_response({"error": why}, status=401)
+                return json_response({"error": why}, status=401)
         vid, key, cookie = parse_file_id(fid)
-        data, name, mime, gzipped = await self._read_body(request)
+        data, name, mime, gzipped = self._read_body(request)
         is_replicate = request.query.get("type") == "replicate"
         n = Needle(id=key, cookie=cookie, data=data, name=name, mime=mime,
                    is_gzipped=gzipped,
@@ -340,9 +388,9 @@ class VolumeServer:
         self.store.write_needle(vid, n)
         if not is_replicate:
             await self._replicate(fid, data, name, mime, gzipped)
-        return web.json_response({"name": name.decode(errors="replace"),
-                                  "size": len(data),
-                                  "eTag": f"{n.checksum:x}"}, status=201)
+        return json_response({"name": name.decode(errors="replace"),
+                              "size": len(data),
+                              "eTag": f"{n.checksum:x}"}, status=201)
 
     async def _replicate(self, fid: str, data: bytes, name: bytes,
                          mime: bytes, gzipped: bool) -> None:
@@ -409,15 +457,15 @@ class VolumeServer:
         return []
 
     async def _handle_read(self, request):
-        from aiohttp import web
+        from ..utils.fastweb import Response, json_response
 
-        fid = request.match_info["fid"]
+        fid = request.path.lstrip("/")
         if self.guard is not None:
             ok, why = self.guard.check_read(request.remote or "",
-                                            dict(request.query),
+                                            request.query,
                                             request.headers, fid)
             if not ok:
-                return web.json_response({"error": why}, status=401)
+                return json_response({"error": why}, status=401)
         vid, key, cookie = parse_file_id(fid)
         try:
             n = self.store.read_needle(vid, key, cookie=cookie,
@@ -438,7 +486,7 @@ class VolumeServer:
         mode, do_resize = "", False
         if ext:
             from ..images import should_resize
-            w, h, mode, do_resize = should_resize(ext, dict(request.query))
+            w, h, mode, do_resize = should_resize(ext, request.query)
         gzip_ok = "gzip" in (request.headers.get("Accept-Encoding") or "")
         if n.is_gzipped and (do_resize or not gzip_ok):
             import gzip as _gz
@@ -452,44 +500,44 @@ class VolumeServer:
                 # plain read path serves stored bytes untouched
                 body = fix_jpeg_orientation(body)
             body = resized(ext, body, w, h, mode)
-        return web.Response(body=body, headers=headers,
-                            content_type=(n.mime.decode() if n.mime else
-                                          "application/octet-stream"))
+        return Response(body, headers=headers or None,
+                        content_type=(n.mime.decode() if n.mime else
+                                      "application/octet-stream"))
 
     async def _read_remote(self, request, fid: str, vid: int):
-        from aiohttp import web
+        from ..utils.fastweb import Redirect, Response, json_response
 
         if self.read_mode == "local":
-            return web.json_response({"error": f"volume {vid} not local"},
-                                     status=404)
+            return json_response({"error": f"volume {vid} not local"},
+                                 status=404)
         peers = [u for u in self._lookup_replicas(vid) if u != self.url]
         if not peers:
-            return web.json_response({"error": f"volume {vid} not found"},
-                                     status=404)
+            return json_response({"error": f"volume {vid} not found"},
+                                 status=404)
         # preserve the caller's query (jwt, resize params, …) on proxy/redirect
         qs = request.query_string
         suffix = f"?{qs}" if qs else ""
         if self.read_mode == "redirect":
-            raise web.HTTPMovedPermanently(f"http://{peers[0]}/{fid}{suffix}")
+            raise Redirect(f"http://{peers[0]}/{fid}{suffix}", status=301)
         import aiohttp
 
         async with aiohttp.ClientSession() as sess:
             async with sess.get(f"http://{peers[0]}/{fid}{suffix}") as r:
                 body = await r.read()
-                return web.Response(
-                    body=body, status=r.status,
+                return Response(
+                    body, status=r.status,
                     content_type=r.content_type or "application/octet-stream")
 
     async def _handle_delete(self, request):
-        from aiohttp import web
+        from ..utils.fastweb import json_response
 
-        fid = request.match_info["fid"]
+        fid = request.path.lstrip("/")
         if self.guard is not None:
             ok, why = self.guard.check_write(request.remote or "",
-                                             dict(request.query),
+                                             request.query,
                                              request.headers, fid)
             if not ok:
-                return web.json_response({"error": why}, status=401)
+                return json_response({"error": why}, status=401)
         vid, key, _ = parse_file_id(fid)
         is_replicate = request.query.get("type") == "replicate"
         v = self.store.find_volume(vid)
@@ -509,7 +557,7 @@ class VolumeServer:
                     for peer in peers:
                         await sess.delete(f"http://{peer}/{fid}?type=replicate"
                                           + self._peer_jwt_param(fid))
-        return web.json_response({"size": 1 if ok else 0}, status=202)
+        return json_response({"size": 1 if ok else 0}, status=202)
 
     # -- EC shard reader: remote fetch + degraded reconstruct ---------------
     def _fetch_remote_shard(self, vid: int, sid: int, offset: int,
@@ -655,27 +703,27 @@ class VolumeServer:
         def allocate(req, context):
             store.add_volume(req.volume_id, req.collection, req.replication,
                              req.ttl, req.disk_type or None)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.AllocateVolumeResponse()
 
         @svc.unary("VolumeDelete", vpb.VolumeDeleteRequest, vpb.VolumeDeleteResponse)
         def vol_delete(req, context):
             store.delete_volume(req.volume_id, req.only_empty)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeDeleteResponse()
 
         @svc.unary("VolumeMarkReadonly", vpb.VolumeMarkReadonlyRequest,
                    vpb.VolumeMarkReadonlyResponse)
         def mark_ro(req, context):
             store.mark_readonly(req.volume_id, True)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeMarkReadonlyResponse()
 
         @svc.unary("VolumeMarkWritable", vpb.VolumeMarkWritableRequest,
                    vpb.VolumeMarkWritableResponse)
         def mark_rw(req, context):
             store.mark_readonly(req.volume_id, False)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeMarkWritableResponse()
 
         @svc.unary("VolumeConfigure", vpb.VolumeConfigureRequest,
@@ -698,7 +746,7 @@ class VolumeServer:
                     v._dat.seek(0)
                     v._dat.write(v.super_block.to_bytes())
                     v._dat.flush()
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeConfigureResponse()
 
         @svc.unary("VolumeStatus", vpb.VolumeStatusRequest, vpb.VolumeStatusResponse)
@@ -715,7 +763,7 @@ class VolumeServer:
                    vpb.VolumeMountResponse)
         def volume_mount(req, context):
             store.mount_volume(req.volume_id, req.collection)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeMountResponse()
 
         @svc.unary("VolumeUnmount", vpb.VolumeUnmountRequest,
@@ -723,7 +771,7 @@ class VolumeServer:
         def volume_unmount(req, context):
             if not store.unmount_volume(req.volume_id):
                 context.abort(5, f"volume {req.volume_id} not found")
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeUnmountResponse()
 
         @svc.unary("VolumeServerLeave", vpb.VolumeServerLeaveRequest,
@@ -846,7 +894,7 @@ class VolumeServer:
             for loc in store.locations:
                 if loc.volumes.get(req.volume_id) is v:
                     loc.volumes[req.volume_id] = newv
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VacuumVolumeCommitResponse(volume_size=newv.content_size)
 
         @svc.unary("VacuumVolumeCleanup", vpb.VacuumVolumeCleanupRequest,
@@ -922,7 +970,7 @@ class VolumeServer:
                    vpb.VolumeEcShardsRebuildResponse)
         def ec_rebuild(req, context):
             rebuilt = store.rebuild_ec_shards(req.volume_id, req.collection)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
         @svc.unary("VolumeEcShardsCopy", vpb.VolumeEcShardsCopyRequest,
@@ -1010,7 +1058,7 @@ class VolumeServer:
                    vpb.VolumeEcShardsMountResponse)
         def ec_mount(req, context):
             store.mount_ec_shards(req.volume_id, req.collection)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeEcShardsMountResponse()
 
         @svc.unary("VolumeEcShardsUnmount", vpb.VolumeEcShardsUnmountRequest,
@@ -1018,7 +1066,7 @@ class VolumeServer:
         def ec_unmount(req, context):
             store.unmount_ec_shards(req.volume_id,
                                     list(req.shard_ids) or None)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeEcShardsUnmountResponse()
 
         @svc.unary("VolumeEcShardsDelete", vpb.VolumeEcShardsDeleteRequest,
@@ -1041,7 +1089,7 @@ class VolumeServer:
                     p = base + ec_files.shard_ext(s)
                     if os.path.exists(p):
                         os.remove(p)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeEcShardsDeleteResponse()
 
         # fork RPC: move = copy + source delete, driven from the target
@@ -1070,7 +1118,7 @@ class VolumeServer:
                          shard_ids=req.shard_ids),
                      vpb.VolumeEcShardsDeleteResponse)
             store.mount_ec_shards(req.volume_id, req.collection)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeEcShardsMoveResponse()
 
         @svc.unary_stream("VolumeEcShardRead", vpb.VolumeEcShardReadRequest,
@@ -1106,7 +1154,7 @@ class VolumeServer:
                    vpb.VolumeEcShardsToVolumeResponse)
         def ec_to_volume(req, context):
             store.ec_shards_to_volume(req.volume_id, req.collection)
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeEcShardsToVolumeResponse()
 
         @svc.unary("VolumeCopy", vpb.VolumeCopyRequest, vpb.VolumeCopyResponse)
@@ -1143,7 +1191,7 @@ class VolumeServer:
                         create_if_missing=False)
             with loc.lock:
                 loc.volumes[req.volume_id] = v
-            vs.trigger_heartbeat()
+            vs.flush_heartbeat()
             return vpb.VolumeCopyResponse(last_append_at_ns=v.last_append_at_ns)
 
         @svc.unary_stream("CopyFile", vpb.CopyFileRequest, vpb.CopyFileResponse)

@@ -715,10 +715,17 @@ def cmd_fs_merge_volumes(env: CommandEnv, args):
         src = usable[i]
         if opt.fromVolumeId and src != opt.fromVolumeId:
             continue
+        if src in plan.values():
+            # already chosen as a destination this sweep: draining it now
+            # would re-move chunks it is about to receive, and the
+            # projected-size math would undercount its incoming bytes
+            continue
         for j in range(i):  # into the fullest compatible candidate
             cand = usable[j]
             if opt.toVolumeId and cand != opt.toVolumeId:
                 continue
+            if cand in plan:
+                continue  # candidate is being drained as a source itself
             sv, cv = vols[src], vols[cand]
             if (sv.collection, sv.ttl, sv.replica_placement) != \
                     (cv.collection, cv.ttl, cv.replica_placement):

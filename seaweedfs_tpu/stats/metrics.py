@@ -234,6 +234,11 @@ EC_ENCODE_BYTES = _counter(
     "SeaweedFS_ec_encode_bytes_total", "bytes EC-encoded", ("coder",))
 EC_REBUILD_BYTES = _counter(
     "SeaweedFS_ec_rebuild_bytes_total", "bytes EC-rebuilt", ("coder",))
+# Mesh divergence: events a filer could not apply from a peer after
+# retries (operators should alarm on any non-zero rate).
+FILER_AGGR_DEAD_LETTERS = _counter(
+    "SeaweedFS_filer_aggregator_dead_letters",
+    "peer metadata events dropped after apply retries", ("peer",))
 
 
 async def aiohttp_metrics_handler(request):

@@ -15,7 +15,8 @@ from typing import Callable
 
 def serve_web_app(add_routes: Callable, ip: str, port: int,
                   stop: threading.Event,
-                  client_max_size: int = 1 << 30) -> None:
+                  client_max_size: int = 1 << 30,
+                  ready: threading.Event | None = None) -> None:
     from aiohttp import web
 
     async def main():
@@ -25,6 +26,8 @@ def serve_web_app(add_routes: Callable, ip: str, port: int,
         await runner.setup()
         site = web.TCPSite(runner, ip, port)
         await site.start()
+        if ready is not None:
+            ready.set()
         while not stop.is_set():
             await asyncio.sleep(0.2)
         await runner.cleanup()

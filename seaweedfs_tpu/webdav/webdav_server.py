@@ -53,7 +53,9 @@ class WebDavServer:
         self._http_thread = threading.Thread(target=self._run_http,
                                              daemon=True,
                                              name=f"webdav-{self.port}")
+        self._http_ready = threading.Event()
         self._http_thread.start()
+        self._http_ready.wait(10)  # port bound before start() returns
         log.info("webdav %s up (root %s)", self.url, self.root or "/")
         return self
 
@@ -106,7 +108,8 @@ class WebDavServer:
         from ..utils.webapp import serve_web_app
         serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
                                                        dispatch),
-                      self.ip, self.port, self._stop)
+                      self.ip, self.port, self._stop,
+                      ready=getattr(self, "_http_ready", None))
 
     async def _h_options(self, request):
         from aiohttp import web

@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# The suite is CPU-only by design; dropping the axon trigger BEFORE the
+# sitecustomize-registered plugin can dial out keeps test runs alive even
+# when the TPU tunnel is wedged (jax.devices() otherwise blocks forever
+# inside make_c_api_client regardless of JAX_PLATFORMS=cpu).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 

@@ -127,9 +127,14 @@ def test_mesh_offsets_resume(mesh, tmp_path):
     fa.write_file("/resume/y.txt", b"offset test")
     wait_until(lambda: fb.filer.find_entry("/resume", "y.txt") is not None,
                msg="y.txt on B")
-    key = f"meta.aggregator.offset.{fa.url}".encode()
+    # offsets are keyed (peer, peer-store-signature) so a wiped peer at
+    # the same address restarts from 0 instead of resuming a stale offset
+    key = (f"meta.aggregator.offset.{fa.url}.{fa.filer.signature}").encode()
     wait_until(lambda: fb.filer.store.kv_get(key) is not None,
                msg="offset recorded on B")
+    # a different signature must map to a different (unset) resume key
+    other = f"meta.aggregator.offset.{fa.url}.0".encode()
+    assert fb.filer.store.kv_get(other) is None
 
 
 def test_late_joiner_bootstraps(mesh):

@@ -19,14 +19,16 @@ def _fp():
 
 
 def _wait_for_leader(masters, timeout=10.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        leaders = [m for m in masters if m.is_leader and not m._stop.is_set()]
-        if len(leaders) == 1:
-            return leaders[0]
-        time.sleep(0.05)
-    raise AssertionError(
-        f"no single leader: {[(m.address, m.is_leader) for m in masters]}")
+    from conftest import wait_until
+    out = []
+
+    def one_leader():
+        out[:] = [m for m in masters if m.is_leader and not m._stop.is_set()]
+        return len(out) == 1
+
+    wait_until(one_leader, timeout=timeout,
+               msg=f"single leader among {[m.address for m in masters]}")
+    return out[0]
 
 
 @pytest.fixture()
@@ -48,10 +50,9 @@ def quorum(tmp_path):
 class TestElection:
     def test_single_leader_elected(self, quorum):
         leader = _wait_for_leader(quorum)
-        # followers know who the leader is
-        time.sleep(0.5)
-        for m in quorum:
-            assert m.leader_address == leader.address
+        from conftest import wait_until
+        wait_until(lambda: all(m.leader_address == leader.address
+                               for m in quorum), msg="followers learn leader")
 
     def test_leader_failover(self, quorum):
         leader = _wait_for_leader(quorum)
@@ -64,8 +65,10 @@ class TestElection:
         from seaweedfs_tpu.pb import master_pb2 as mpb
 
         leader = _wait_for_leader(quorum)
-        time.sleep(0.5)
+        from conftest import wait_until
         follower = next(m for m in quorum if m is not leader)
+        wait_until(lambda: follower.leader_address == leader.address,
+                   msg="follower learns leader")
         resp = follower.do_assign(mpb.AssignRequest(count=1))
         assert "not leader" in resp.error
         assert leader.address in resp.error
@@ -74,13 +77,9 @@ class TestElection:
         leader = _wait_for_leader(quorum)
         ok = leader.raft.propose({"max_volume_id": 41})
         assert ok
-        deadline = time.time() + 5
-        while time.time() < deadline:
-            if all(m.topo.max_volume_id >= 41 for m in quorum):
-                break
-            time.sleep(0.05)
-        for m in quorum:
-            assert m.topo.max_volume_id >= 41
+        from conftest import wait_until
+        wait_until(lambda: all(m.topo.max_volume_id >= 41 for m in quorum),
+                   timeout=5, msg="max_volume_id replicated")
 
     def test_raft_state_persists(self, tmp_path):
         from seaweedfs_tpu.master.raft import LogEntry, RaftNode
@@ -119,15 +118,16 @@ class TestFailoverEndToEnd:
         vs = VolumeServer(store, all_addrs, port=vport,
                           grpc_port=_fp(), pulse_seconds=0.3)
         vs.start()
-        deadline = time.time() + 10
-        while time.time() < deadline and len(leader.topo.nodes) < 1:
-            time.sleep(0.05)
-        while time.time() < deadline:
+        from conftest import wait_until
+
+        def vs_up():
             try:
-                requests.get(f"http://{vs.url}/status", timeout=1)
-                break
+                return requests.get(f"http://{vs.url}/status", timeout=1).ok
             except Exception:
-                time.sleep(0.05)
+                return False
+
+        wait_until(lambda: len(leader.topo.nodes) >= 1, msg="vs registered")
+        wait_until(vs_up, msg="vs http up")
         mc = MasterClient(all_addrs).start()
         mc.wait_connected()
         try:
@@ -139,9 +139,8 @@ class TestFailoverEndToEnd:
             new_leader = _wait_for_leader(survivors)
             # volume server re-registers with the new leader via the
             # heartbeat leader hint
-            deadline = time.time() + 15
-            while time.time() < deadline and len(new_leader.topo.nodes) < 1:
-                time.sleep(0.1)
+            wait_until(lambda: len(new_leader.topo.nodes) >= 1, timeout=15,
+                       msg="vs re-registered with new leader")
             assert len(new_leader.topo.nodes) == 1
 
             deadline = time.time() + 15
@@ -248,28 +247,26 @@ class TestMembership:
         joiner.start()
         try:
             assert leader.raft.add_server(addr)
-            deadline = time.time() + 10
-            while time.time() < deadline:
-                if set(joiner.raft.cluster_members) == \
-                        set(leader.raft.cluster_members) and \
-                        len(leader.raft.cluster_members) == 4:
-                    break
-                time.sleep(0.05)
+            from conftest import wait_until
+            wait_until(lambda: set(joiner.raft.cluster_members)
+                       == set(leader.raft.cluster_members)
+                       and len(leader.raft.cluster_members) == 4,
+                       msg="membership replicated to joiner")
             assert len(leader.raft.cluster_members) == 4
             assert set(joiner.raft.cluster_members) == \
                 set(leader.raft.cluster_members)
             # state replicates to the joiner
             assert leader.raft.propose({"max_volume_id": 77})
-            deadline = time.time() + 5
-            while time.time() < deadline and joiner.topo.max_volume_id < 77:
-                time.sleep(0.05)
-            assert joiner.topo.max_volume_id >= 77
+            wait_until(lambda: joiner.topo.max_volume_id >= 77, timeout=5,
+                       msg="state replicated to joiner")
         finally:
             joiner.stop()
 
     def test_remove_follower_quiesces_it(self, quorum):
         leader = _wait_for_leader(quorum)
-        time.sleep(0.3)
+        from conftest import wait_until
+        wait_until(lambda: all(m.leader_address == leader.address
+                               for m in quorum), msg="quorum settled")
         victim = next(m for m in quorum if m is not leader)
         assert leader.raft.remove_server(victim.address)
         assert victim.address not in leader.raft.cluster_members
@@ -277,9 +274,8 @@ class TestMembership:
         assert leader.raft.propose({"max_volume_id": 99})
         # the victim learns of its removal via the courtesy append and
         # stops campaigning instead of disrupting the survivors
-        deadline = time.time() + 5
-        while time.time() < deadline and victim.raft.peers:
-            time.sleep(0.05)
+        wait_until(lambda: not victim.raft.peers, timeout=5,
+                   msg="victim learns removal")
         assert victim.raft.peers == []
         # survivors refuse votes to the removed node (no term bumps)
         term_before = leader.raft.current_term

@@ -162,15 +162,16 @@ def two_filers(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
                       pulse_seconds=0.5)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
+    from conftest import wait_until
+
+    def vs_http_up():
         try:
-            requests.get(f"http://{vs.url}/status", timeout=1)
-            break
+            return requests.get(f"http://{vs.url}/status", timeout=1).ok
         except Exception:
-            time.sleep(0.05)
+            return False
+
+    wait_until(lambda: len(ms.topo.nodes) >= 1, msg="vs registered")
+    wait_until(vs_http_up, msg="vs http up")
     fa = FilerServer(ms.address, store_spec="memory", port=_fp(),
                      grpc_port=_fp(), chunk_size_mb=1)
     fa.start()
@@ -189,13 +190,10 @@ class TestFilerSync:
         fa, fb = two_filers
         sync = FilerSync(fa, fb, from_ns=time_ns_now()).start()
         fa.write_file("/sync/one.txt", b"replicate me")
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            e = fb.filer.find_entry("/sync", "one.txt")
-            if e is not None:
-                break
-            time.sleep(0.05)
-        assert e is not None
+        from conftest import wait_until
+        wait_until(lambda: fb.filer.find_entry("/sync", "one.txt") is not None,
+                   msg="entry replicated")
+        e = fb.filer.find_entry("/sync", "one.txt")
         assert fb.read_entry_bytes(e) == b"replicate me"
         sync.stop()
 
@@ -205,19 +203,17 @@ class TestFilerSync:
         s_ba = FilerSync(fb, fa, from_ns=time_ns_now()).start()
         fa.write_file("/bi/from-a.txt", b"AAA")
         fb.write_file("/bi/from-b.txt", b"BBB")
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            a_has = fa.filer.find_entry("/bi", "from-b.txt")
-            b_has = fb.filer.find_entry("/bi", "from-a.txt")
-            if a_has is not None and b_has is not None:
-                break
-            time.sleep(0.05)
-        assert a_has is not None and b_has is not None
+        from conftest import wait_until
+        wait_until(lambda: fa.filer.find_entry("/bi", "from-b.txt") is not None
+                   and fb.filer.find_entry("/bi", "from-a.txt") is not None,
+                   msg="both directions replicated")
+        a_has = fa.filer.find_entry("/bi", "from-b.txt")
+        b_has = fb.filer.find_entry("/bi", "from-a.txt")
         assert fa.read_entry_bytes(a_has) == b"BBB"
         assert fb.read_entry_bytes(b_has) == b"AAA"
         # loop guard: replicated writes come back stamped and are skipped
-        time.sleep(0.5)
-        assert s_ab.skipped >= 1 or s_ba.skipped >= 1
+        wait_until(lambda: s_ab.skipped >= 1 or s_ba.skipped >= 1,
+                   msg="loop guard skipped an echo")
         applied_before = (s_ab.applied, s_ba.applied)
         time.sleep(1.0)
         assert (s_ab.applied, s_ba.applied) == applied_before, \
@@ -229,15 +225,12 @@ class TestFilerSync:
         fa, fb = two_filers
         sync = FilerSync(fa, fb, from_ns=time_ns_now()).start()
         fa.write_file("/del/gone.txt", b"x")
-        deadline = time.time() + 10
-        while time.time() < deadline and \
-                fb.filer.find_entry("/del", "gone.txt") is None:
-            time.sleep(0.05)
+        from conftest import wait_until
+        wait_until(lambda: fb.filer.find_entry("/del", "gone.txt") is not None,
+                   msg="create replicated")
         fa.filer.delete_entry("/del", "gone.txt")
-        while time.time() < deadline and \
-                fb.filer.find_entry("/del", "gone.txt") is not None:
-            time.sleep(0.05)
-        assert fb.filer.find_entry("/del", "gone.txt") is None
+        wait_until(lambda: fb.filer.find_entry("/del", "gone.txt") is None,
+                   msg="delete replicated")
         sync.stop()
 
     def test_transient_failure_retried_not_skipped(self, two_filers):
@@ -258,14 +251,10 @@ class TestFilerSync:
         sync.replicator.replicate = flaky
         sync.start()
         fa.write_file("/retry/flaky.txt", b"eventually lands")
-        deadline = time.time() + 10
-        e = None
-        while time.time() < deadline:
-            e = fb.filer.find_entry("/retry", "flaky.txt")
-            if e is not None:
-                break
-            time.sleep(0.05)
-        assert e is not None, "event skipped instead of retried"
+        from conftest import wait_until
+        wait_until(lambda: fb.filer.find_entry("/retry", "flaky.txt")
+                   is not None, msg="event retried, not skipped")
+        e = fb.filer.find_entry("/retry", "flaky.txt")
         assert fb.read_entry_bytes(e) == b"eventually lands"
         assert fails["n"] == 0 and sync.applied >= 1
         assert sync.dead_lettered == 0

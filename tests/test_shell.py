@@ -9,6 +9,7 @@ import time
 
 import numpy as np
 import pytest
+from conftest import wait_until
 
 from seaweedfs_tpu.client import operation
 from seaweedfs_tpu.client.master_client import MasterClient
@@ -45,17 +46,17 @@ def cluster(tmp_path_factory):
                           grpc_port=free_port(), pulse_seconds=0.4)
         vs.start()
         servers.append(vs)
-    deadline = time.time() + 10
-    while time.time() < deadline and len(master.topo.nodes) < 3:
-        time.sleep(0.1)
     import requests
-    for vs in servers:
-        while time.time() < deadline:
-            try:
-                if requests.get(f"http://127.0.0.1:{vs.port}/status", timeout=1).ok:
-                    break
-            except Exception:
-                time.sleep(0.1)
+    wait_until(lambda: len(master.topo.nodes) >= 3, msg="3 servers registered")
+
+    def all_http_up():
+        try:
+            return all(requests.get(f"http://127.0.0.1:{vs.port}/status",
+                                    timeout=1).ok for vs in servers)
+        except Exception:
+            return False
+
+    wait_until(all_http_up, msg="all vs http up")
     mc = MasterClient(f"127.0.0.1:{mport}").start()
     out = io.StringIO()
     env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=out)
@@ -157,12 +158,14 @@ def test_volume_balance_and_fix_replication(cluster):
     from conftest import wait_until
     for i in range(6):
         operation.submit(mc, os.urandom(2000), collection=f"bal{i}")
-    wait_until(lambda: sum(1 for _ in master.topo.all_volume_ids()) >= 6
-               if hasattr(master.topo, "all_volume_ids") else True,
-               timeout=3, msg="volumes registered")
-    time.sleep(0.8)  # let sizes settle before balancing
+    def sizes_settled():
+        with master.topo.lock:
+            infos = [v for n in master.topo.all_nodes()
+                     for v in n.all_volumes()]
+        return len(infos) >= 6 and all(v.size > 0 for v in infos)
+
+    wait_until(sizes_settled, msg="volume sizes reach the master")
     sh(env, out, "volume.balance")
-    time.sleep(0.8)
     counts = []
     for vs in servers:
         counts.append(sum(len(l.volumes) for l in vs.store.locations))
@@ -194,17 +197,17 @@ def test_volume_tier_move(tmp_path):
             vs.start()
             servers.append(vs)
         import requests
-        deadline = time.time() + 10
-        while time.time() < deadline and len(master.topo.nodes) < 2:
-            time.sleep(0.05)
-        for vs in servers:
-            while time.time() < deadline:
-                try:
-                    if requests.get(f"http://127.0.0.1:{vs.port}/status",
-                                    timeout=1).ok:
-                        break
-                except Exception:
-                    time.sleep(0.05)
+        from conftest import wait_until as _wu
+        _wu(lambda: len(master.topo.nodes) >= 2, msg="2 servers registered")
+
+        def both_up():
+            try:
+                return all(requests.get(f"http://127.0.0.1:{vs.port}/status",
+                                        timeout=1).ok for vs in servers)
+            except Exception:
+                return False
+
+        _wu(both_up, msg="vs http up")
         mc = MasterClient(f"127.0.0.1:{mport}").start()
         try:
             res = operation.submit(mc, b"tiered payload")
@@ -221,13 +224,11 @@ def test_volume_tier_move(tmp_path):
             assert vid not in hdd_vs.store.locations[0].volumes
             # master learns the new holder on the next heartbeat; the
             # blob stays readable through the normal lookup path
-            deadline = time.time() + 10
-            while time.time() < deadline:
-                locs = master.topo.lookup(vid)
-                if locs and all(f"{ssd_vs.store.ip}:{ssd_vs.port}" ==
-                                loc.url for loc in locs):
-                    break
-                time.sleep(0.1)
+            from conftest import wait_until as _wu2
+            _wu2(lambda: (lambda locs: locs and all(
+                f"{ssd_vs.store.ip}:{ssd_vs.port}" == loc.url
+                for loc in locs))(master.topo.lookup(vid)),
+                msg="master learns the ssd holder")
             mc.refresh_lookup(vid)
             assert operation.read(mc, res.fid) == b"tiered payload"
         finally:

@@ -185,14 +185,13 @@ def test_collection_delete(env, stack):
     mc.start()
     mc.wait_connected()
     res = operation.submit(mc, b"col data", name="c.bin", collection="tmpcol")
-    time.sleep(1.0)  # let heartbeat register the collection volume
+    from conftest import wait_until
+    wait_until(lambda: "tmpcol" in stack["ms"].topo.collections(),
+               msg="collection volume registered")
     run_command(e, "lock")
     run_command(e, "collection.delete -collection tmpcol")
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        if "tmpcol" not in stack["ms"].topo.collections():
-            break
-        time.sleep(0.2)
+    wait_until(lambda: "tmpcol" not in stack["ms"].topo.collections(),
+               msg="collection dropped")
     assert "deleted collection" in out.getvalue()
 
 
@@ -253,7 +252,10 @@ def test_volume_server_evacuate_unreplicated(tmp_path_factory):
         e.mc.wait_connected()
         res = operation.submit(e.mc, b"evac payload", name="e.bin")
         assert operation.read(e.mc, res.fid) == b"evac payload"
-        time.sleep(1.2)  # let the holder heartbeat the volume to the master
+        from conftest import wait_until
+        evac_vid = int(res.fid.split(",")[0])
+        wait_until(lambda: ms.topo.lookup(evac_vid),
+                   msg="volume heartbeated to master")
         run_command(e, "lock")
         src = next(s for s in servers if s.store.status()["volumes"])
         run_command(e, f"volume.server.evacuate -node {src.url}")
@@ -490,7 +492,17 @@ def test_fs_merge_volumes(env, stack):
             "big.bin", big.fid, 40960))
         fs.filer.create_entry("/merge", _entry_with_chunk(
             "small.bin", small_fid, len(b"small chunk")))
-        time.sleep(1.2)  # heartbeat: sizes reach the master
+
+        def sizes_reported():
+            from conftest import wait_until  # noqa: F401 - scope helper
+            with ms.topo.lock:
+                sizes = {v.id: v.size for n in ms.topo.all_nodes()
+                         for v in n.all_volumes()}
+            return sizes.get(vid_big, 0) >= 40960 and \
+                sizes.get(vid_small, 0) > 0
+
+        from conftest import wait_until
+        wait_until(sizes_reported, msg="sizes reach the master")
         got = _sh(e, out, "fs.merge.volumes -dir /merge -collection mergecol")
         assert f"=> volume {vid_big}" in got, got
         got = _sh(e, out,
