@@ -201,6 +201,39 @@ def bench_cpu(out: dict, B: int, C: int, repeats: int) -> None:
 # End-to-end streaming encode from disk (verdict r2 ask #1)
 # ---------------------------------------------------------------------------
 
+def _make_volumes(base: str, n_vols: int, mb: int) -> "tuple[list, int]":
+    rng = np.random.default_rng(2)
+    chunk_bytes = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    jobs = []
+    for i in range(n_vols):
+        path = os.path.join(base, f"{i}.dat")
+        with open(path, "wb") as f:
+            for _ in range(mb):
+                f.write(chunk_bytes)
+        jobs.append((path, os.path.join(base, f"v{i}"), None))
+    return jobs, n_vols * mb * (1 << 20)
+
+
+def _write_probe_GBps(base: str) -> float:
+    """Median first-touch write bandwidth of this environment (tmpfs/disk
+    page-alloc rates on this virtualized host swing 0.4-2.6 GB/s between
+    identical runs — the e2e number has to be read against it)."""
+    src = np.frombuffer(os.urandom(64 << 20), dtype=np.uint8)
+    rates = []
+    for t in range(3):
+        p = os.path.join(base, f"probe{t}.bin")
+        fd = os.open(p, os.O_WRONLY | os.O_CREAT)
+        t0 = time.perf_counter()
+        for rep in range(4):
+            for off in range(0, src.nbytes, 1 << 20):
+                os.pwrite(fd, src[off:off + (1 << 20)].data,
+                          rep * src.nbytes + off)
+        rates.append(4 * src.nbytes / (time.perf_counter() - t0) / 1e9)
+        os.close(fd)
+        os.unlink(p)
+    return statistics.median(rates)
+
+
 def bench_e2e(out: dict, n_vols: int, mb: int, smoke: bool) -> None:
     from seaweedfs_tpu.ec import stream
     from seaweedfs_tpu.ec.locate import EcGeometry
@@ -209,19 +242,59 @@ def bench_e2e(out: dict, n_vols: int, mb: int, smoke: bool) -> None:
 
     geo = EcGeometry(d=D, p=P, large_block=1 << (22 if smoke else 26),
                      small_block=1 << 20)
+
+    # --- 1. host coder at scale from tmpfs (VERDICT r3 ask 2: >=100 vols,
+    # >=10 GB total, page-cache-warm source so disk is out of the picture)
+    shm_ok = os.path.isdir("/dev/shm")
+    tmpfs_base = "/dev/shm/swtpu_bench_e2e" if shm_ok else None
+    if tmpfs_base and native.available():
+        shutil.rmtree(tmpfs_base, ignore_errors=True)
+        os.makedirs(tmpfs_base)
+        try:
+            nv, vmb = (8, 16) if smoke else (104, 104)  # full: 10.8 GB input
+            jobs, total = _make_volumes(tmpfs_base, nv, vmb)
+            coder = native.NativeCoder(D, P)
+            # pass 1: sustained at >=10 GB — on this firecracker VM the
+            # guest must fault fresh frames from the host past ~2 GB of
+            # new allocations, collapsing ANY writer to ~0.3 GB/s (pure
+            # 10 GB pwrite probe: 0.27-0.34 GB/s); pass 2 reuses the
+            # freed frames and shows the pipeline nearer its own ceiling
+            for passno in ("sustained", "warm"):
+                stats: dict = {}
+                t0 = time.perf_counter()
+                stream.encode_volumes(jobs, geo, coder, stats=stats)
+                dt = time.perf_counter() - t0
+                key = ("ec_encode_e2e_tmpfs_GBps" if passno == "sustained"
+                       else "ec_encode_e2e_tmpfs_warm_GBps")
+                out[key] = round(total / dt / 1e9, 3)
+                out[key[:-5] + "_coder_s"] = round(stats.get("coder_s", 0), 2)
+                out[key[:-5] + "_write_s"] = round(stats.get("write_s", 0), 2)
+                out[key[:-5] + "_wall_s"] = round(dt, 2)
+                log(f"e2e encode from tmpfs ({passno}, {nv}x{vmb}MB): "
+                    f"{out[key]} GB/s ({dt:.1f}s; "
+                    f"coder {stats.get('coder_s', 0):.1f}s, "
+                    f"write {stats.get('write_s', 0):.1f}s)")
+                if passno == "sustained":
+                    from seaweedfs_tpu.ec import files as _ecf
+                    for _, out_base, _ in jobs:
+                        for i in range(D + P):
+                            fp = out_base + _ecf.shard_ext(i)
+                            if os.path.exists(fp):
+                                os.unlink(fp)
+            out["ec_encode_e2e_tmpfs_vols"] = nv
+            out["ec_encode_e2e_tmpfs_vol_mb"] = vmb
+            out["tmpfs_write_probe_GBps"] = round(
+                _write_probe_GBps(tmpfs_base), 2)
+            log(f"env write probe (64MB window): "
+                f"{out['tmpfs_write_probe_GBps']} GB/s")
+        finally:
+            shutil.rmtree(tmpfs_base, ignore_errors=True)
+
+    # --- 2. disk + device paths at the r3 scale (tunnel-throttled device:
+    # overlap efficiency is the meaningful number, not GB/s)
     tmp = tempfile.mkdtemp(prefix="swtpu_bench_")
     try:
-        rng = np.random.default_rng(2)
-        jobs = []
-        chunk_bytes = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
-        for i in range(n_vols):
-            path = os.path.join(tmp, f"{i}.dat")
-            with open(path, "wb") as f:
-                for _ in range(mb):
-                    f.write(chunk_bytes)
-            jobs.append((path, os.path.join(tmp, f"v{i}"), None))
-        total = n_vols * mb * (1 << 20)
-
+        jobs, total = _make_volumes(tmp, n_vols, mb)
         coders = []
         if native.available():
             coders.append(("host", native.NativeCoder(D, P)))
@@ -234,16 +307,32 @@ def bench_e2e(out: dict, n_vols: int, mb: int, smoke: bool) -> None:
             for i in range(n_vols):
                 jobs[i] = (jobs[i][0], os.path.join(tmp, f"{name}{i}"), None)
             np.asarray(coder.encode(warm))  # compile outside the timed region
+            if name == "device":
+                # per-batch device time (sync, warm) for the overlap metric
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    np.asarray(coder.encode(warm))
+                t_batch = (time.perf_counter() - t0) / 3
+            stats = {}
             t0 = time.perf_counter()
-            stream.encode_volumes(jobs, geo, coder)
+            stream.encode_volumes(jobs, geo, coder, stats=stats)
             dt = time.perf_counter() - t0
             key = f"ec_encode_e2e_{name}_GBps"
             out[key] = round(total / dt / 1e9, 3)
             log(f"e2e encode from disk ({name}, {n_vols}x{mb}MB): "
                 f"{out[key]} GB/s ({dt:.1f}s)")
+            if name == "device" and stats.get("batches"):
+                busy = stats["batches"] * t_batch
+                out["ec_encode_e2e_device_overlap"] = round(
+                    min(1.0, busy / stats["wall_s"]), 3)
+                out["ec_encode_e2e_device_batches"] = stats["batches"]
+                log(f"device overlap: {out['ec_encode_e2e_device_overlap']}"
+                    f" (est busy {busy:.1f}s / wall {stats['wall_s']:.1f}s)")
         # raw disk write rate of the same directory, for context: the e2e
         # pipeline writes (d+p)/d output bytes per input byte, so when
         # e2e_host ~= disk_rate * d/(d+p+d) the pipeline is disk-bound
+        rng = np.random.default_rng(2)
+        chunk_bytes = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
         probe = os.path.join(tmp, "probe.bin")
         t0 = time.perf_counter()
         with open(probe, "wb") as f:
@@ -257,8 +346,10 @@ def bench_e2e(out: dict, n_vols: int, mb: int, smoke: bool) -> None:
         out["ec_encode_e2e_vol_mb"] = mb
         out["ec_encode_e2e_note"] = (
             "device path crosses the axon network tunnel (~30 MB/s) in this "
-            "environment; host path shows the same pipeline (disk-bound on "
-            "this VM's ~200 MB/s disk)")
+            "environment, so its GB/s is tunnel-bound — the overlap metric "
+            "(device busy / wall) shows pipeline health; the tmpfs host run "
+            "shows the pipeline at its own ceiling, bounded by this VM's "
+            "volatile first-touch write rate (tmpfs_write_probe_GBps)")
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -76,7 +76,7 @@ class FilerClient:
         return urls
 
     def _fetch_blob(self, fid: str) -> bytes:
-        import requests
+        from . import http_util
 
         cached = self._blob_cache.get(fid)
         if cached is not None:
@@ -86,13 +86,13 @@ class FilerClient:
         for attempt in range(2):
             for url in self._lookup_fid(fid):
                 try:
-                    r = requests.get(f"http://{url}/{fid}", timeout=30)
-                    if r.status_code == 200:
+                    r = http_util.get(f"http://{url}/{fid}", timeout=30)
+                    if r.status == 200:
                         self._blob_cache[fid] = r.content
                         if len(self._blob_cache) > self._blob_cache_max:
                             self._blob_cache.popitem(last=False)
                         return r.content
-                    last = f"HTTP {r.status_code}"
+                    last = f"HTTP {r.status}"
                 except Exception as e:  # noqa: BLE001
                     last = e
             # stale cache: refresh once and retry

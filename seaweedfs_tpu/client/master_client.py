@@ -268,6 +268,14 @@ class MasterClient:
         if r.status != 200 or err:
             if err.startswith("not leader"):
                 raise _HttpNotLeader(err)
+            if r.status in (401, 403):
+                # the HTTP plane is guard-gated and this client carries no
+                # jwt — the gRPC plane may still be open/channel-authed, so
+                # stop using HTTP entirely rather than failing every assign
+                self.http_address = ""
+                log.warning("http assign endpoint requires auth (%s); "
+                            "falling back to grpc permanently", err)
+                raise _HttpNotLeader(err)
             raise _HttpAssignRejected(err or f"HTTP {r.status}")
         resp = pb.AssignResponse(fid=body["fid"], count=body.get("count", 1),
                                  auth=body.get("auth", ""))

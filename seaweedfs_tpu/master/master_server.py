@@ -176,10 +176,11 @@ class MasterServer:
         def guarded(path: str, handler):
             # The reference wraps master HTTP handlers in guard.WhiteList
             # only; JWT gating applies just to the mutating /dir/assign.
-            # /metrics stays open for scrapers.
+            # /metrics stays open for scrapers. Params are parsed once and
+            # handed to the handler (the assign hot path budget is ~100us).
             def h(req: fastweb.Request):
+                q = params_of(req)
                 if ms.guard is not None:
-                    q = params_of(req)
                     if path == "/dir/assign":
                         ok, why = ms.guard.check_write(req.remote, q,
                                                        req.headers)
@@ -187,7 +188,7 @@ class MasterServer:
                         ok, why = ms.guard.check_ip(req.remote)
                     if not ok:
                         return json_response({"error": why}, status=401)
-                return handler(req)
+                return handler(req, q)
             return h
 
         # Handler policy on the single-loop fastweb server: the hot/cheap
@@ -208,15 +209,14 @@ class MasterServer:
             from ..stats import REGISTRY
             return fastweb.text_response(REGISTRY.gather())
 
-        def dir_status(req):
+        def dir_status(req, q):
             # leader_address, not ms.address: a follower answering here
             # must hint at the real leader (empty mid-election)
             return json_response({"Topology": MessageToDict(ms.topology_info()),
                                   "Leader": ms.leader_address,
                                   "IsLeader": ms.is_leader})
 
-        def dir_lookup(req):
-            q = params_of(req)
+        def dir_lookup(req, q):
             vid = q.get("volumeId", "").split(",")[0]
             try:
                 nodes = ms.topo.lookup(int(vid))
@@ -230,14 +230,22 @@ class MasterServer:
                 "locations": [{"url": n.url, "publicUrl": n.public_url}
                               for n in nodes]})
 
-        def dir_assign(req):
-            q = params_of(req)
-            resp = ms.do_assign(pb.AssignRequest(
+        async def dir_assign(req, q):
+            areq = pb.AssignRequest(
                 count=int(q.get("count", 1)),
                 collection=q.get("collection", ""),
                 replication=q.get("replication", ""),
                 ttl=q.get("ttl", ""),
-                disk_type=q.get("disk_type", "")))
+                disk_type=q.get("disk_type", ""))
+            if ms.needs_growth(areq):
+                # growth does AllocateVolume RPCs + a raft commit —
+                # seconds, not microseconds: run it off-loop so other
+                # assigns/lookups/scrapes aren't head-of-line blocked
+                import asyncio
+                resp = await asyncio.get_running_loop().run_in_executor(
+                    None, ms.do_assign, areq)
+            else:
+                resp = ms.do_assign(areq)
             if resp.error:
                 return json_response({"error": resp.error}, status=406)
             return json_response({
@@ -246,13 +254,13 @@ class MasterServer:
                 "publicUrl": resp.location.public_url,
                 "auth": resp.auth})
 
-        def cluster_status(req):
+        def cluster_status(req, q):
             return json_response({
                 "IsLeader": ms.is_leader,
                 "Leader": ms.leader_address,
                 "Peers": [p for p in ms.peers if p != ms.address]})
 
-        def ui(req):
+        def ui(req, q):
             # human status UI (reference weed/server/master_ui)
             from ..utils.ui import render_page
             rows = []
@@ -279,12 +287,12 @@ class MasterServer:
                   ["node", "rack", "volumes", "ec volumes", "bytes"], rows)])
             return fastweb.html_response(page)
 
-        def debug_profile(req):
+        def debug_profile(req, q):
             # pprof-style CPU profile trigger (reference exposes
             # net/http/pprof on -debug.port, command/imports.go:4)
             from ..utils import profiling
             return fastweb.text_response(
-                profiling.cpu_profile(float(req.query.get("seconds", "5"))))
+                profiling.cpu_profile(float(q.get("seconds", "5"))))
 
         app = fastweb.FastApp()
         app.route("/metrics", metrics)
@@ -668,6 +676,19 @@ class MasterServer:
         from ..stats import MASTER_ASSIGN_COUNTER
         MASTER_ASSIGN_COUNTER.inc("error" if resp.error else "ok")
         return resp
+
+    def needs_growth(self, req: pb.AssignRequest) -> bool:
+        """True when this assign would have to grow a volume first (the
+        slow path: AllocateVolume RPCs + a raft commit). The master HTTP
+        handler uses this to keep no-growth assigns inline on the event
+        loop and offload growth to a thread."""
+        if not self.is_leader:
+            return False
+        layout = self.layouts.get(req.collection,
+                                  req.replication or self.default_replication,
+                                  req.ttl, req.disk_type or "hdd")
+        layout.ensure_correct_writables()
+        return layout.pick_for_write() is None
 
     def _do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
         if not self.is_leader:

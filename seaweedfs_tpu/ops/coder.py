@@ -24,6 +24,11 @@ from . import gf8
 
 
 class ErasureCoder(abc.ABC):
+    #: True when encode() returns an async handle that materializes on
+    #: np.asarray (device coders); the streaming pipeline double-buffers
+    #: those and takes a zero-copy synchronous fast path for the rest.
+    async_dispatch = False
+
     def __init__(self, d: int, p: int):
         if d <= 0 or p <= 0 or d + p > 256:
             raise ValueError(f"invalid RS geometry ({d},{p})")
@@ -70,6 +75,8 @@ class JaxCoder(ErasureCoder):
     hot path — unpack/matmul/pack pinned in VMEM; elsewhere (CPU tests,
     GPU) it falls back to the XLA einsum formulation (ops/rs_jax.py).
     """
+
+    async_dispatch = True
 
     def __init__(self, d: int, p: int, use_pallas: "bool | None" = None):
         super().__init__(d, p)

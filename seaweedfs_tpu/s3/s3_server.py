@@ -488,6 +488,11 @@ class S3Gateway:
         import dataclasses
         prefix = f"{BUCKETS_DIR}/{bucket}/"
         changed = False
+        # NOTE upgrade path: rules persisted by pre-marker builds carry no
+        # from_lifecycle flag and are treated as admin-owned — remove them
+        # once with `fs.configure -locationPrefix ... -ttl ""` if they came
+        # from an old lifecycle PUT. Guessing here would re-open the bug
+        # where DeleteBucketLifecycle strips TTLs an admin set.
         for r in list(conf.rules):
             if not (r.location_prefix.startswith(prefix)
                     and r.from_lifecycle and r.ttl.endswith("d")):

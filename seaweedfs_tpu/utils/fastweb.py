@@ -138,6 +138,7 @@ class _HttpProtocol(asyncio.Protocol):
         self._queue: deque = deque()
         self._worker: asyncio.Task | None = None
         self._closing = False
+        self._poison = None  # (status, msg) once unparseable bytes arrive
 
     # -- wire in -----------------------------------------------------------
     def connection_made(self, transport):
@@ -158,11 +159,20 @@ class _HttpProtocol(asyncio.Protocol):
         self._can_write.set()
 
     def data_received(self, data: bytes):
+        if self._poison is not None:
+            return  # already answering-then-closing; drop further bytes
         self.buf += data
         try:
             self._pump()
         except _BadRequest as e:
-            self._simple_error(400, str(e))
+            # valid requests may already be queued ahead of the malformed
+            # bytes; answer them in order first, THEN emit the 400+close
+            # (otherwise a completed write's response would be swallowed
+            # and the client would retry an applied mutation)
+            self._poison = (400, str(e))
+            self.buf.clear()
+            if self._worker is None or self._worker.done():
+                self._flush_poison()
 
     def connection_lost(self, exc):
         self._closing = True
@@ -311,6 +321,12 @@ class _HttpProtocol(asyncio.Protocol):
             self._send(req, resp)
             if not self._can_write.is_set():
                 await self._can_write.wait()
+        if self._poison is not None and not self._closing:
+            self._flush_poison()
+
+    def _flush_poison(self):
+        status, msg = self._poison
+        self._simple_error(status, msg)
 
     def _send(self, req: Request, resp: Response):
         if self.transport.is_closing():

@@ -65,3 +65,20 @@ def wait_until(cond, timeout: float = 10.0, interval: float = 0.05,
             pass
         _time.sleep(interval)
     raise AssertionError(f"timed out waiting for {msg}")
+
+
+def wait_http_up(url: str, timeout: float = 10.0):
+    """Block until an HTTP endpoint answers (daemon fixture readiness)."""
+    import requests as _rq
+
+    wait_until(lambda: _rq.get(url, timeout=1).ok, timeout=timeout,
+               msg=f"http up at {url}")
+
+
+def wait_cluster_up(master, servers, timeout: float = 10.0):
+    """Master sees every server registered AND each server answers HTTP —
+    the shared fixture-readiness gate (replaces per-file poll loops)."""
+    wait_until(lambda: len(master.topo.nodes) >= len(servers),
+               timeout=timeout, msg=f"{len(servers)} servers registered")
+    for vs in servers:
+        wait_http_up(f"http://{vs.url}/status", timeout=timeout)

@@ -50,21 +50,8 @@ def cluster(tmp_path_factory):
                           rack=f"rack{i % 2}")
         vs.start()
         servers.append(vs)
-    # wait for registration and HTTP readiness
-    import requests as _rq
-    deadline = time.time() + 10
-    while time.time() < deadline and len(master.topo.nodes) < 3:
-        time.sleep(0.1)
-    assert len(master.topo.nodes) == 3, "volume servers failed to register"
-    for vs in servers:
-        while time.time() < deadline:
-            try:
-                if _rq.get(f"http://127.0.0.1:{vs.port}/status", timeout=1).ok:
-                    break
-            except Exception:
-                time.sleep(0.1)
-        else:
-            pytest.fail(f"volume server {vs.port} HTTP not ready")
+    from conftest import wait_cluster_up
+    wait_cluster_up(master, servers)
     mc = MasterClient(f"127.0.0.1:{mport}").start()
     yield master, servers, mc
     mc.stop()
@@ -84,7 +71,6 @@ def test_write_read_delete_single(cluster):
     got = operation.read(mc, res.fid)
     assert got == payload
     assert operation.delete(mc, res.fid)
-    time.sleep(0.1)
     with pytest.raises((KeyError, RuntimeError)):
         operation.read(mc, res.fid)
 
@@ -95,7 +81,9 @@ def test_replicated_write(cluster):
     res = operation.submit(mc, payload, replication="001", collection="rep")
     # both replicas must hold the needle
     vid = int(res.fid.split(",")[0])
-    time.sleep(0.8)  # let heartbeats propagate volume stats
+    from conftest import wait_until
+    wait_until(lambda: len(master.topo.lookup(vid)) == 2,
+               msg="both replicas heartbeated")
     locs = master.topo.lookup(vid)
     assert len(locs) == 2, f"expected 2 replicas, got {[n.id for n in locs]}"
     from seaweedfs_tpu.storage.types import parse_file_id
@@ -179,15 +167,16 @@ def test_ec_encode_spread_and_degraded_read(cluster):
     # delete the original volume; reads must go through EC now
     src_stub.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
                   vpb.VolumeDeleteResponse)
-    time.sleep(0.8)  # heartbeats update master ec registry
-
-    assert vid in master.topo.ec_locations
+    from conftest import wait_until
+    wait_until(lambda: vid in master.topo.ec_locations,
+               msg="ec registry updated")
     for fid, data in list(blobs.items())[:10]:
         assert operation.read(mc, fid) == data, f"ec read {fid}"
 
     # degraded: kill shard 3's holder entirely
     others[0].stop()
-    time.sleep(1.0)
+    from conftest import wait_until as _wu
+    _wu(lambda: len(master.topo.nodes) == 2, msg="dead holder dropped")
     for fid, data in list(blobs.items())[10:16]:
         assert operation.read(mc, fid) == data, f"degraded ec read {fid}"
 

@@ -77,15 +77,8 @@ def test_remote_filer_sync(tmp_path):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
                       pulse_seconds=0.3)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://127.0.0.1:{vport}/status", timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
 
     def mkfiler(name):
         port = free_port_pair()
@@ -93,14 +86,9 @@ def test_remote_filer_sync(tmp_path):
                         grpc_port=port + 10000,
                         meta_log_path=str(tmp_path / f"{name}.metalog"))
         f.start()
-        dl = time.time() + 10
-        while time.time() < dl:
-            try:
-                if requests.get(f"http://{f.url}/__status__", timeout=1).ok:
-                    return f
-            except Exception:
-                time.sleep(0.1)
-        raise AssertionError("filer http not ready")
+        from conftest import wait_http_up
+        wait_http_up(f"http://{f.url}/__status__")
+        return f
 
     src, target = mkfiler("src"), mkfiler("tgt")
     sync = None
@@ -109,9 +97,9 @@ def test_remote_filer_sync(tmp_path):
         sync = FilerSync(src, tc, path_prefix="/synced").start()
         src.write_file("/synced/one.txt", b"payload-one")
         src.write_file("/synced/sub/two.txt", b"payload-two")
-        deadline = time.time() + 15
-        while time.time() < deadline and sync.applied < 2:
-            time.sleep(0.1)
+        from conftest import wait_until
+        wait_until(lambda: sync.applied >= 2, timeout=15,
+                   msg="sync applied both events")
         e = target.filer.find_entry("/synced", "one.txt")
         assert e is not None
         assert target.read_entry_bytes(e) == b"payload-one"

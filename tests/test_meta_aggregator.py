@@ -50,16 +50,8 @@ def mesh(tmp_path):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
                       pulse_seconds=0.3)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://127.0.0.1:{vport}/status",
-                            timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     filers = []
     for i in range(3):
         fport = free_port_pair()
@@ -171,7 +163,17 @@ def test_concurrent_update_no_chunk_loss(mesh):
     # near-simultaneous divergent updates on A and B
     fa.write_file("/race/f.bin", b"version from A " * 10)
     fb.write_file("/race/f.bin", b"version from B " * 10)
-    time.sleep(2.0)  # mesh settles (either version may win)
+
+    def converged():
+        seen = set()
+        for f in (fa, fb, fc):
+            e = f.filer.find_entry("/race", "f.bin")
+            if e is None or not e.chunks:
+                return False
+            seen.add(bytes(f.read_entry_bytes(e)))
+        return len(seen) == 1
+
+    wait_until(converged, msg="mesh settles on one version")
     for f in (fa, fb, fc):
         entry = f.filer.find_entry("/race", "f.bin")
         assert entry is not None and entry.chunks

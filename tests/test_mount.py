@@ -131,16 +131,8 @@ def wfs(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
                       pulse_seconds=0.5)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    import requests
-    while time.time() < deadline:
-        try:
-            requests.get(f"http://{vs.url}/status", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     fs = FilerServer(ms.address, store_spec="memory", port=fport,
                      grpc_port=_fp(), chunk_size_mb=1)
     fs.start()
@@ -262,14 +254,9 @@ class TestWeedFS:
         the metadata subscription."""
         wfs.readdir("/")  # prime the cache
         wfs.fs.write_file("/outside.txt", b"external change")
-        deadline = time.time() + 5
-        while time.time() < deadline:
-            try:
-                if wfs.getattr("/outside.txt")["st_size"] == 15:
-                    break
-            except FuseError:
-                pass
-            time.sleep(0.05)
+        from conftest import wait_until
+        wait_until(lambda: wfs.getattr("/outside.txt")["st_size"] == 15,
+                   timeout=5, msg="outside write visible through meta sub")
         assert wfs.getattr("/outside.txt")["st_size"] == 15
 
     def test_statfs(self, wfs):

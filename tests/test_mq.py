@@ -64,15 +64,8 @@ def broker_stack(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
                       pulse_seconds=0.5)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            requests.get(f"http://{vs.url}/status", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     fs = FilerServer(ms.address, store_spec="memory", port=fport,
                      grpc_port=_fp(), chunk_size_mb=1)
     fs.start()
@@ -368,15 +361,16 @@ def test_cluster_check_pings_filers_and_brokers(broker_stack):
     # brokers stopped by earlier tests drop off the cluster list when
     # their cancelled KeepConnected streams unwind (~1s); wait for the
     # list to settle to the one live broker before health-checking
-    deadline = time.time() + 10
-    while time.time() < deadline:
+    from conftest import wait_until
+
+    def broker_settled():
         nodes = Stub(ms.address, MASTER_SERVICE).call(
             "ListClusterNodes",
             mpb.ListClusterNodesRequest(client_type="broker"),
             mpb.ListClusterNodesResponse).cluster_nodes
-        if [n.address for n in nodes] == [broker_stack["broker"].address]:
-            break
-        time.sleep(0.2)
+        return [n.address for n in nodes] == [broker_stack["broker"].address]
+
+    wait_until(broker_settled, msg="one live broker")
     out = io.StringIO()
     env = CommandEnv(ms.address, out=out)
     run_command(env, "cluster.check")

@@ -86,15 +86,8 @@ class TestScrapeEndpoints:
         vs = VolumeServer(store, ms.address, port=vport,
                           grpc_port=_free_port(), pulse_seconds=0.5)
         vs.start()
-        deadline = time.time() + 10
-        while time.time() < deadline and len(ms.topo.nodes) < 1:
-            time.sleep(0.05)
-        while time.time() < deadline:
-            try:
-                requests.get(f"http://{vs.url}/status", timeout=1)
-                break
-            except Exception:
-                time.sleep(0.05)
+        from conftest import wait_cluster_up
+        wait_cluster_up(ms, [vs])
         yield ms, vs
         vs.stop()
         ms.stop()
@@ -147,11 +140,9 @@ class TestScrapeEndpoints:
 
         assert MASTER_RECEIVED_HEARTBEATS.value() >= 1
         vs.trigger_heartbeat()
-        deadline = time.time() + 5
-        while (time.time() < deadline
-               and VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") < 1):
-            time.sleep(0.1)
-        assert VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") >= 1
+        from conftest import wait_until
+        wait_until(lambda: VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") >= 1,
+                   timeout=5, msg="volume gauge updated")
 
 
 def test_status_ui_pages(tmp_path):
@@ -192,22 +183,10 @@ def test_status_ui_pages(tmp_path):
                      grpc_port=fport + 10000)
     fs.start()
     try:
-        deadline = time.time() + 10
-        while time.time() < deadline and len(ms.topo.nodes) < 1:
-            time.sleep(0.05)
-        while time.time() < deadline:
-            try:
-                if requests.get(f"http://{vs.url}/status", timeout=1).ok:
-                    break
-            except Exception:
-                time.sleep(0.05)
+        from conftest import wait_cluster_up, wait_http_up
+        wait_cluster_up(ms, [vs])
         fs.write_file("/ui-probe.txt", b"x")
-        while time.time() < deadline:
-            try:
-                requests.get(f"http://127.0.0.1:{hport}/", timeout=1)
-                break
-            except Exception:
-                time.sleep(0.05)
+        wait_http_up(f"http://127.0.0.1:{hport}/")
         r = requests.get(f"http://127.0.0.1:{hport}/", timeout=5)
         assert r.ok and "swtpu master" in r.text
         assert "Volume servers" in r.text
