@@ -272,6 +272,10 @@ def run_filer(argv):
     p.add_argument("-noPeerMeta", action="store_true",
                    help="disable the multi-filer metadata mesh (reference "
                         "filers aggregate peer metadata by default)")
+    p.add_argument("-chunkCacheMB", type=int, default=64,
+                   help="in-memory chunk-cache bound on the read path")
+    p.add_argument("-chunkCacheDir", default="",
+                   help="optional disk tier for the chunk cache")
     opt = p.parse_args(argv)
     store = opt.store
     if not store:
@@ -298,7 +302,9 @@ def run_filer(argv):
                 collection=opt.collection, replication=opt.replication,
                 chunk_size_mb=opt.maxMB,
                 encrypt_data=opt.encryptVolumeData,
-                meta_aggregate=not opt.noPeerMeta).start()
+                meta_aggregate=not opt.noPeerMeta,
+                chunk_cache_mb=opt.chunkCacheMB,
+                chunk_cache_dir=opt.chunkCacheDir or None).start()
     _wait_forever()
 
 
@@ -745,9 +751,14 @@ def run_mount(argv):
     p.add_argument("-chunkSizeLimitMB", type=int, default=4)
     p.add_argument("-concurrentWriters", type=int, default=8)
     p.add_argument("-allowOther", action="store_true")
+    p.add_argument("-cacheDir", default="",
+                   help="disk tier for the chunk cache (reference -cacheDir)")
+    p.add_argument("-cacheSizeMB", type=int, default=1024,
+                   help="disk chunk-cache bound (reference -cacheCapacityMB)")
     opt = p.parse_args(argv)
     fc = FilerClient(opt.filer, grpc_address=opt.filerGrpc,
-                     client_name="mount")
+                     client_name="mount", cache_dir=opt.cacheDir or None,
+                     cache_disk_mb=opt.cacheSizeMB)
     wfs = WeedFS(fc, chunk_size_mb=opt.chunkSizeLimitMB,
                  concurrency=opt.concurrentWriters)
     # local control socket for `shell mount.configure` (reference dials

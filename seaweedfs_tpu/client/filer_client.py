@@ -31,7 +31,8 @@ log = logger("filer-client")
 
 class FilerClient:
     def __init__(self, filer_address: str, grpc_address: str = "",
-                 client_name: str = "filer-client"):
+                 client_name: str = "filer-client", cache_mb: int = 32,
+                 cache_dir: "str | None" = None, cache_disk_mb: int = 1024):
         self.http_address = filer_address
         host, _, port = filer_address.rpartition(":")
         self.grpc_address = grpc_address or f"{host}:{int(port) + 10000}"
@@ -45,12 +46,17 @@ class FilerClient:
         self.replication = conf.replication
         self.signature = conf.signature  # the filer's identity (mesh)
         self._vid_cache: dict[str, tuple[list[str], float]] = {}
-        # tiny blob LRU: kernel reads arrive in <=128 KiB slices, each
-        # resolving a multi-MB chunk — caching the last few chunks turns
-        # ~32 refetches per chunk into one (reference uses chunk_cache)
-        from collections import OrderedDict
-        self._blob_cache: "OrderedDict[str, bytes]" = OrderedDict()
-        self._blob_cache_max = 8
+        # tiered chunk cache + prefetching reader: kernel reads arrive in
+        # <=128 KiB slices, each resolving a multi-MB chunk; sequential
+        # readers find chunk N+1 prefetched (reference util/chunk_cache +
+        # filer/reader_cache on the mount read path). cache_dir adds the
+        # bounded disk tier (mount -cacheDir).
+        from ..filer.chunk_cache import ChunkCache, ReaderCache
+        self.chunk_cache = ChunkCache(
+            mem_limit_bytes=cache_mb << 20, disk_dir=cache_dir,
+            disk_limit_bytes=cache_disk_mb << 20)
+        self.reader_cache = ReaderCache(self._fetch_blob_upstream,
+                                        self.chunk_cache)
         self.filer = _FilerFacade(self, conf.signature)
 
     # -- data path -----------------------------------------------------------
@@ -75,22 +81,15 @@ class FilerClient:
             self._vid_cache[vid] = (urls, now)
         return urls
 
-    def _fetch_blob(self, fid: str) -> bytes:
+    def _fetch_blob_upstream(self, fid: str) -> bytes:
         from . import http_util
 
-        cached = self._blob_cache.get(fid)
-        if cached is not None:
-            self._blob_cache.move_to_end(fid)
-            return cached
         last = None
         for attempt in range(2):
             for url in self._lookup_fid(fid):
                 try:
                     r = http_util.get(f"http://{url}/{fid}", timeout=30)
                     if r.status == 200:
-                        self._blob_cache[fid] = r.content
-                        if len(self._blob_cache) > self._blob_cache_max:
-                            self._blob_cache.popitem(last=False)
                         return r.content
                     last = f"HTTP {r.status}"
                 except Exception as e:  # noqa: BLE001
@@ -99,18 +98,20 @@ class FilerClient:
             self._vid_cache.pop(fid.split(",")[0], None)
         raise IOError(f"chunk {fid} unreadable: {last}")
 
+    def _fetch_blob(self, fid: str, upcoming: "list[str] | None" = None
+                    ) -> bytes:
+        return self.reader_cache.read(fid, upcoming)
+
+    def close(self) -> None:
+        """Release the prefetch pool (long-lived gateways call this on
+        shutdown; short-lived CLI verbs exit the process anyway)."""
+        self.reader_cache.close()
+
     def _fill_window(self, chunks, offset: int, size: int) -> bytes:
-        """Assemble [offset, offset+size) from resolved chunk views."""
-        buf = bytearray(size)
-        for v in read_views(chunks, offset, size):
-            blob = self._fetch_blob(v.file_id)
-            if v.cipher_key:
-                from ..security.cipher import decrypt
-                blob = decrypt(blob, v.cipher_key)
-            part = blob[v.chunk_offset:v.chunk_offset + v.size]
-            at = v.logical_offset - offset
-            buf[at:at + len(part)] = part
-        return bytes(buf)
+        """Assemble [offset, offset+size) with sequential-read prefetch
+        (one shared implementation with the filer server's read path)."""
+        from ..filer.chunk_cache import assemble_window
+        return assemble_window(chunks, offset, size, self._fetch_blob)
 
     def read_entry_bytes(self, entry: fpb.Entry, offset: int = 0,
                          size: int | None = None) -> bytes:

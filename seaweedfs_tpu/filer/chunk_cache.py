@@ -1,0 +1,279 @@
+"""Tiered chunk cache + prefetching reader cache for the read path.
+
+Reference: weed/util/chunk_cache/chunk_cache.go (mem tier over bounded
+on-disk tiers, consulted on every filer/mount/S3 chunk read) and
+weed/filer/reader_cache.go (bounded concurrent prefetch of upcoming chunks
+with single-flight downloads).
+
+Design here: one `ChunkCache` with a byte-bounded in-memory LRU and an
+optional byte-bounded disk tier (chunk files under a cache dir, LRU by
+access order, survives process restarts via a directory scan); one
+`ReaderCache` that serves fetch-through reads with single-flight dedup and
+prefetches the next chunks of a file onto a small thread pool. The filer
+HTTP read path, the S3 gateway (which reads through the filer), FUSE
+reads, and the remote FilerClient all share these types.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..utils.log import logger
+
+log = logger("chunk-cache")
+
+
+def assemble_window(chunks, offset: int, size: int, fetch) -> bytes:
+    """Assemble [offset, offset+size) of a chunked file.
+
+    `fetch(fid, upcoming)` returns the chunk's stored bytes (a ReaderCache
+    read; `upcoming` are prefetch hints). The one implementation behind
+    both the filer server's and the remote client's read paths: views in
+    this window hint at their successors, and when the window covers the
+    request tail the file's chunks beyond it are hinted so a sequential
+    reader's next request finds them warm."""
+    from ..security.cipher import decrypt
+    from .chunks import read_views
+
+    buf = bytearray(size)
+    views = list(read_views(chunks, offset, size))
+    beyond = [c.file_id for c in chunks if c.offset >= offset + size][:4]
+    for i, v in enumerate(views):
+        upcoming = [w.file_id for w in views[i + 1:i + 3]] or beyond
+        blob = fetch(v.file_id, upcoming)
+        if v.cipher_key:
+            blob = decrypt(blob, v.cipher_key)
+        part = blob[v.chunk_offset:v.chunk_offset + v.size]
+        at = v.logical_offset - offset
+        buf[at:at + len(part)] = part
+    return bytes(buf)
+
+
+class ChunkCache:
+    """fid -> chunk bytes, memory tier over an optional disk tier."""
+
+    def __init__(self, mem_limit_bytes: int = 64 << 20,
+                 disk_dir: str | None = None,
+                 disk_limit_bytes: int = 1 << 30,
+                 mem_chunk_max: int = 8 << 20):
+        self.mem_limit = mem_limit_bytes
+        self.mem_chunk_max = mem_chunk_max  # bigger chunks go disk-only
+        self._mem: "OrderedDict[str, bytes]" = OrderedDict()
+        self._mem_bytes = 0
+        self._lock = threading.Lock()
+        self.disk_dir = disk_dir
+        self.disk_limit = disk_limit_bytes
+        self._disk: "OrderedDict[str, int]" = OrderedDict()  # fid -> size
+        self._disk_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+            # adopt chunks left by a previous run (oldest first)
+            entries = []
+            for name in os.listdir(disk_dir):
+                p = os.path.join(disk_dir, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, name, st.st_size))
+            for _, name, size in sorted(entries):
+                self._disk[name] = size
+                self._disk_bytes += size
+
+    # fids contain ',' which is filesystem-safe; keep them as file names
+    def _disk_path(self, fid: str) -> str:
+        return os.path.join(self.disk_dir, fid.replace("/", "_"))
+
+    def get(self, fid: str) -> "bytes | None":
+        with self._lock:
+            data = self._mem.get(fid)
+            if data is not None:
+                self._mem.move_to_end(fid)
+                self.hits += 1
+                return data
+            on_disk = self.disk_dir is not None and fid in self._disk
+        if on_disk:
+            try:
+                with open(self._disk_path(fid), "rb") as f:
+                    data = f.read()
+            except OSError:
+                with self._lock:
+                    self._disk_bytes -= self._disk.pop(fid, 0)
+                return None
+            with self._lock:
+                if fid in self._disk:
+                    self._disk.move_to_end(fid)
+                self.hits += 1
+            self._put_mem(fid, data)  # promote
+            return data
+        with self._lock:
+            self.misses += 1
+        return None
+
+    def put(self, fid: str, data: bytes) -> None:
+        if len(data) <= self.mem_chunk_max:
+            self._put_mem(fid, data)
+        if self.disk_dir is not None and len(data) <= self.disk_limit:
+            self._put_disk(fid, data)
+
+    def _put_mem(self, fid: str, data: bytes) -> None:
+        if len(data) > self.mem_chunk_max:
+            return
+        with self._lock:
+            old = self._mem.pop(fid, None)
+            if old is not None:
+                self._mem_bytes -= len(old)
+            self._mem[fid] = data
+            self._mem_bytes += len(data)
+            while self._mem_bytes > self.mem_limit and self._mem:
+                _, evicted = self._mem.popitem(last=False)
+                self._mem_bytes -= len(evicted)
+
+    def _put_disk(self, fid: str, data: bytes) -> None:
+        path = self._disk_path(fid)
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except OSError as e:  # cache dir full/unwritable: degrade
+            log.warning("disk cache write %s: %s", fid, e)
+            return
+        victims = []
+        with self._lock:
+            self._disk_bytes -= self._disk.pop(os.path.basename(path), 0)
+            self._disk[os.path.basename(path)] = len(data)
+            self._disk_bytes += len(data)
+            while self._disk_bytes > self.disk_limit and len(self._disk) > 1:
+                name, size = self._disk.popitem(last=False)
+                self._disk_bytes -= size
+                victims.append(name)
+        for name in victims:
+            try:
+                os.unlink(os.path.join(self.disk_dir, name))
+            except OSError:
+                pass
+
+    def contains(self, fid: str) -> bool:
+        """Lock-only containment peek: no disk read, no stats mutation —
+        what the prefetcher consults before scheduling work."""
+        with self._lock:
+            return fid in self._mem or (self.disk_dir is not None
+                                        and fid in self._disk)
+
+    def put_mem(self, fid: str, data: bytes) -> None:
+        """Seed only the memory tier (write-path seeding must not double
+        local disk writes when a disk tier is configured)."""
+        self._put_mem(fid, data)
+
+    @property
+    def mem_bytes(self) -> int:
+        return self._mem_bytes
+
+    @property
+    def disk_bytes(self) -> int:
+        return self._disk_bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "mem_bytes": self._mem_bytes,
+                    "mem_chunks": len(self._mem),
+                    "disk_bytes": self._disk_bytes,
+                    "disk_chunks": len(self._disk)}
+
+
+class ReaderCache:
+    """Fetch-through reads with single-flight dedup and bounded prefetch.
+
+    `fetch(fid) -> bytes` is the upstream (volume-server GET). Readers call
+    `read(fid, upcoming=[...])`: the fid is served from cache or fetched
+    once (concurrent readers of the same fid share one download), and up to
+    `prefetch_depth` of the upcoming fids are scheduled onto the pool so a
+    sequential reader finds chunk N+1 already local when it gets there —
+    reference reader_cache.go MaybeCache/ReadChunkAt.
+    """
+
+    def __init__(self, fetch, cache: ChunkCache,
+                 prefetch_depth: int = 2, workers: int = 2):
+        self.fetch = fetch
+        self.cache = cache
+        self.prefetch_depth = prefetch_depth
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="chunk-prefetch")
+        self._inflight: dict[str, Future] = {}
+        self._lock = threading.Lock()
+
+    def read(self, fid: str, upcoming: "list[str] | None" = None) -> bytes:
+        data = self.cache.get(fid)
+        if data is None:
+            data = self._fetch_once(fid)
+        if upcoming:
+            for nxt in upcoming[: self.prefetch_depth]:
+                self._maybe_prefetch(nxt)
+        return data
+
+    def _fetch_once(self, fid: str) -> bytes:
+        with self._lock:
+            fut = self._inflight.get(fid)
+            if fut is None:
+                fut = Future()
+                self._inflight[fid] = fut
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            try:
+                return fut.result()
+            except Exception:  # noqa: BLE001
+                # the flight owner (possibly a prefetch) failed — retry
+                # on our own rather than inheriting its error
+                return self._fetch_direct(fid)
+        try:
+            data = self.fetch(fid)
+            self.cache.put(fid, data)
+            fut.set_result(data)
+            return data
+        except BaseException as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            with self._lock:
+                self._inflight.pop(fid, None)
+
+    def _fetch_direct(self, fid: str) -> bytes:
+        data = self.fetch(fid)
+        self.cache.put(fid, data)
+        return data
+
+    def _maybe_prefetch(self, fid: str) -> None:
+        if self.cache.contains(fid):
+            return
+        with self._lock:
+            if fid in self._inflight:
+                return
+            fut = Future()
+            self._inflight[fid] = fut
+
+        def run():
+            try:
+                data = self.fetch(fid)
+                self.cache.put(fid, data)
+                fut.set_result(data)
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+                # a failed prefetch must not poison later reads
+                fut.exception()
+            finally:
+                with self._lock:
+                    self._inflight.pop(fid, None)
+
+        self._pool.submit(run)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

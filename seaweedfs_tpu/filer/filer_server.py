@@ -40,7 +40,10 @@ class FilerServer:
                  collection: str = "", replication: str = "",
                  chunk_size_mb: int = DEFAULT_CHUNK_MB,
                  encrypt_data: bool = False,
-                 meta_aggregate: bool = False):
+                 meta_aggregate: bool = False,
+                 chunk_cache_mb: int = 64,
+                 chunk_cache_dir: "str | None" = None,
+                 chunk_cache_disk_mb: int = 1024):
         self.ip, self.port = ip, port
         self.grpc_port = grpc_port or port + 10000
         self.collection, self.replication = collection, replication
@@ -66,6 +69,16 @@ class FilerServer:
         from . import filer_conf
         self.conf = filer_conf.FilerConf()
         self.filer.mutation_hooks.append(self._maybe_reload_conf)
+        # tiered chunk cache + prefetching reader shared by HTTP, S3 (it
+        # reads through this filer), and FUSE reads (reference
+        # util/chunk_cache + filer/reader_cache behind every read)
+        from .chunk_cache import ChunkCache, ReaderCache
+        self.chunk_cache = ChunkCache(
+            mem_limit_bytes=chunk_cache_mb << 20,
+            disk_dir=chunk_cache_dir,
+            disk_limit_bytes=chunk_cache_disk_mb << 20)
+        self.reader_cache = ReaderCache(self._fetch_blob_upstream,
+                                        self.chunk_cache)
         self._stop = threading.Event()
         self._grpc = None
         self._http_thread = None
@@ -107,6 +120,7 @@ class FilerServer:
             self.aggregator.stop()
         if self._grpc:
             self._grpc.stop(grace=0.5)
+        self.reader_cache.close()  # drop prefetch workers
         self.mc.stop()
         self.filer.close()
 
@@ -168,6 +182,10 @@ class FilerServer:
         target = a.location.public_url or a.location.url
         res = operation.upload(f"{target}/{a.fid}", data,
                                gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
+        # freshly written chunks are the likeliest next reads — seed the
+        # MEM tier with exactly what a volume-server GET would return
+        # (never the disk tier: that would double local writes on ingest)
+        self.chunk_cache.put_mem(a.fid, data)
         # size stays LOGICAL (plaintext) — interval math never sees the
         # nonce/tag overhead
         return fpb.FileChunk(file_id=a.fid,
@@ -177,8 +195,12 @@ class FilerServer:
                              e_tag=res.get("eTag", ""),
                              cipher_key=cipher_key)
 
-    def _fetch_blob(self, fid: str) -> bytes:
+    def _fetch_blob_upstream(self, fid: str) -> bytes:
         return operation.read(self.mc, fid)
+
+    def _fetch_blob(self, fid: str, upcoming: "list[str] | None" = None
+                    ) -> bytes:
+        return self.reader_cache.read(fid, upcoming)
 
     def read_entry_bytes(self, entry: fpb.Entry, offset: int = 0,
                          size: int | None = None) -> bytes:
@@ -195,16 +217,8 @@ class FilerServer:
         if size is None:
             size = fsize - offset
         size = max(0, min(size, fsize - offset))
-        buf = bytearray(size)
-        for v in read_views(chunks, offset, size):
-            blob = self._fetch_blob(v.file_id)
-            if v.cipher_key:
-                from ..security.cipher import decrypt
-                blob = decrypt(blob, v.cipher_key)
-            part = blob[v.chunk_offset:v.chunk_offset + v.size]
-            at = v.logical_offset - offset
-            buf[at:at + len(part)] = part
-        return bytes(buf)
+        from .chunk_cache import assemble_window
+        return assemble_window(chunks, offset, size, self._fetch_blob)
 
     def write_file(self, path: str, data: bytes, mime: str = "",
                    ttl_sec: int = 0, mode: int = 0o644,
@@ -276,7 +290,8 @@ class FilerServer:
 
         async def status(request):
             return web.json_response({"version": "swtpu-filer",
-                                      "master": self.mc.leader})
+                                      "master": self.mc.leader,
+                                      "chunk_cache": self.chunk_cache.stats()})
 
         from ..stats.metrics import aiohttp_metrics_handler
 

@@ -121,92 +121,19 @@ class MemoryStore(FilerStore):
         self._kv[key] = value
 
 
-class SqliteStore(FilerStore):
-    """Durable stdlib-sqlite backend (reference abstract_sql + sqlite dirs)."""
+# mid-module import: sql_store needs FilerStore (defined above); doing it
+# here keeps `from .store import SqliteStore` working for existing callers
+from .sql_store import AbstractSqlStore, SqliteDialect  # noqa: E402
 
-    name = "sqlite"
+
+class SqliteStore(AbstractSqlStore):
+    """Durable stdlib-sqlite backend — the always-on dialect of the shared
+    SQL layer (reference abstract_sql + sqlite dirs); mysql/postgres
+    dialects live beside it in sql_store.py."""
 
     def __init__(self, path: str):
         self._path = path
-        self._local = threading.local()
-        self._init_schema()
-
-    def _conn(self) -> sqlite3.Connection:
-        c = getattr(self._local, "conn", None)
-        if c is None:
-            c = sqlite3.connect(self._path, timeout=30)
-            c.execute("PRAGMA journal_mode=WAL")
-            c.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = c
-        return c
-
-    def _init_schema(self):
-        c = self._conn()
-        c.execute("""CREATE TABLE IF NOT EXISTS filemeta(
-            directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB,
-            PRIMARY KEY(directory, name))""")
-        c.execute("CREATE TABLE IF NOT EXISTS kv(k BLOB PRIMARY KEY, v BLOB)")
-        c.commit()
-
-    def insert_entry(self, directory, entry):
-        c = self._conn()
-        c.execute("INSERT OR REPLACE INTO filemeta VALUES(?,?,?)",
-                  (directory, entry.name, entry.SerializeToString()))
-        c.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, directory, name):
-        row = self._conn().execute(
-            "SELECT meta FROM filemeta WHERE directory=? AND name=?",
-            (directory, name)).fetchone()
-        if row is None:
-            return None
-        e = fpb.Entry()
-        e.ParseFromString(row[0])
-        return e
-
-    def delete_entry(self, directory, name):
-        c = self._conn()
-        c.execute("DELETE FROM filemeta WHERE directory=? AND name=?",
-                  (directory, name))
-        c.commit()
-
-    def delete_folder_children(self, directory):
-        c = self._conn()
-        c.execute("DELETE FROM filemeta WHERE directory=?", (directory,))
-        c.commit()
-
-    def list_entries(self, directory, start_from="", inclusive=False,
-                     limit=2**31, prefix=""):
-        op = ">=" if inclusive else ">"
-        q = f"SELECT meta FROM filemeta WHERE directory=? AND name {op} ?"
-        args: list = [directory, start_from]
-        if prefix:
-            q += " AND name GLOB ?"
-            args.append(prefix.replace("[", "[[]").replace("*", "[*]")
-                        .replace("?", "[?]") + "*")
-        q += " ORDER BY name LIMIT ?"
-        args.append(min(limit, 2**31 - 1))
-        for (blob,) in self._conn().execute(q, args):
-            e = fpb.Entry()
-            e.ParseFromString(blob)
-            yield e
-
-    def kv_get(self, key):
-        row = self._conn().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row else None
-
-    def kv_put(self, key, value):
-        c = self._conn()
-        c.execute("INSERT OR REPLACE INTO kv VALUES(?,?)", (key, value))
-        c.commit()
-
-    def close(self):
-        c = getattr(self._local, "conn", None)
-        if c is not None:
-            c.close()
-            self._local.conn = None
+        super().__init__(SqliteDialect(path))
 
 
 class LogDbStore(MemoryStore):
@@ -305,7 +232,8 @@ class LogDbStore(MemoryStore):
 
 
 def open_store(spec: str) -> FilerStore:
-    """spec: 'memory', 'sqlite:/path/db.sqlite', 'logdb:/path/filer.log'."""
+    """spec: 'memory', 'sqlite:/path/db.sqlite', 'logdb:/path/filer.log',
+    'lsm:/dir', 'redis:host:port', 'mysql:k=v ...', 'postgres:<dsn>'."""
     kind, _, arg = spec.partition(":")
     if kind == "memory":
         return MemoryStore()
@@ -317,8 +245,21 @@ def open_store(spec: str) -> FilerStore:
         # "leveldb" accepted for reference-flag familiarity: LsmStore is
         # the from-scratch leveldb analogue
         return LsmStore(arg or "filer-lsm")
+    if kind == "redis":
+        from .redis_store import RedisStore
+        return RedisStore(arg.lstrip("/") or "127.0.0.1:6379")
+    if kind == "mysql":
+        from .sql_store import AbstractSqlStore, MysqlDialect
+        kw = dict(kv.split("=", 1) for kv in arg.split() if "=" in kv)
+        if "port" in kw:
+            kw["port"] = int(kw["port"])
+        return AbstractSqlStore(MysqlDialect(**kw))
+    if kind == "postgres":
+        from .sql_store import AbstractSqlStore, PostgresDialect
+        return AbstractSqlStore(PostgresDialect(arg or "dbname=seaweedfs"))
     raise ValueError(f"unknown filer store {spec!r} (supported: memory, "
-                     f"sqlite:<path>, logdb:<path>, lsm:<dir>)")
+                     f"sqlite:<path>, logdb:<path>, lsm:<dir>, "
+                     f"redis:<host:port>, mysql:<k=v ...>, postgres:<dsn>)")
 
 
 class LsmStore(FilerStore):

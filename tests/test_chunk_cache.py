@@ -1,0 +1,170 @@
+"""Tiered chunk cache + prefetching reader cache (reference
+util/chunk_cache/chunk_cache.go, filer/reader_cache.go)."""
+
+import threading
+import time
+
+from seaweedfs_tpu.filer.chunk_cache import ChunkCache, ReaderCache
+
+
+def test_mem_lru_bounded():
+    c = ChunkCache(mem_limit_bytes=10_000, mem_chunk_max=4_000)
+    for i in range(10):
+        c.put(f"1,{i:x}", bytes([i]) * 3_000)
+    assert c.mem_bytes <= 10_000
+    # newest survive, oldest evicted
+    assert c.get("1,9") is not None
+    assert c.get("1,0") is None
+
+
+def test_mem_oversize_chunks_skip_mem():
+    c = ChunkCache(mem_limit_bytes=100 << 20, mem_chunk_max=1_000)
+    c.put("1,a", b"x" * 5_000)
+    assert c.mem_bytes == 0  # too big for the mem tier, no disk tier
+
+
+def test_disk_tier_roundtrip_and_restart(tmp_path):
+    d = str(tmp_path / "cache")
+    c = ChunkCache(mem_limit_bytes=1_000, disk_dir=d,
+                   disk_limit_bytes=100_000, mem_chunk_max=500)
+    payload = b"y" * 10_000  # too big for mem, lands on disk
+    c.put("2,abc", payload)
+    assert c.get("2,abc") == payload
+    # a new instance adopts the on-disk population
+    c2 = ChunkCache(mem_limit_bytes=1_000, disk_dir=d,
+                    disk_limit_bytes=100_000)
+    assert c2.get("2,abc") == payload
+
+
+def test_disk_tier_eviction_bounded(tmp_path):
+    d = str(tmp_path / "cache")
+    c = ChunkCache(mem_limit_bytes=500, disk_dir=d,
+                   disk_limit_bytes=25_000, mem_chunk_max=100)
+    for i in range(10):
+        c.put(f"3,{i:x}", bytes([i]) * 8_000)
+    assert c.disk_bytes <= 25_000
+    import os
+    on_disk = os.listdir(d)
+    assert 1 <= len(on_disk) <= 3
+
+
+def test_reader_cache_single_flight():
+    calls = []
+    started = threading.Event()
+    release = threading.Event()
+
+    def fetch(fid):
+        calls.append(fid)
+        started.set()
+        release.wait(5)
+        return b"data-" + fid.encode()
+
+    rc = ReaderCache(fetch, ChunkCache(mem_limit_bytes=1 << 20))
+    results = []
+    ts = [threading.Thread(target=lambda: results.append(rc.read("4,a")))
+          for _ in range(4)]
+    for t in ts:
+        t.start()
+    started.wait(5)
+    release.set()
+    for t in ts:
+        t.join(5)
+    assert results == [b"data-4,a"] * 4
+    assert calls == ["4,a"]  # one upstream fetch for four readers
+
+
+def test_reader_cache_prefetches_upcoming():
+    calls = []
+
+    def fetch(fid):
+        calls.append(fid)
+        return fid.encode()
+
+    rc = ReaderCache(fetch, ChunkCache(mem_limit_bytes=1 << 20))
+    rc.read("5,a", upcoming=["5,b", "5,c", "5,d"])  # depth=2 prefetched
+    deadline = time.time() + 5
+    while time.time() < deadline and len(calls) < 3:
+        time.sleep(0.01)
+    assert set(calls) == {"5,a", "5,b", "5,c"}
+    calls.clear()
+    assert rc.read("5,b") == b"5,b"  # served from cache
+    assert calls == []
+
+
+def test_reader_cache_failed_prefetch_recovers():
+    fail = {"on": True}
+
+    def fetch(fid):
+        if fail["on"]:
+            raise IOError("volume down")
+        return b"ok"
+
+    rc = ReaderCache(fetch, ChunkCache(mem_limit_bytes=1 << 20))
+    rc._maybe_prefetch("6,x")
+    deadline = time.time() + 5
+    while time.time() < deadline and "6,x" in rc._inflight:
+        time.sleep(0.01)
+    fail["on"] = False
+    assert rc.read("6,x") == b"ok"  # failed prefetch didn't poison reads
+
+
+def test_filer_read_path_hits_cache(tmp_path):
+    """Integration: second read of a chunked file does zero upstream
+    fetches; cache stats are surfaced."""
+    import socket
+
+    from seaweedfs_tpu.ec.locate import EcGeometry
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+    from conftest import free_port_pair, wait_cluster_up
+
+    def fp():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    ms = MasterServer(port=fp(), volume_size_limit_mb=64, pulse_seconds=0.3)
+    ms.start()
+    vport = fp()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path / "v"), max_volume_count=8)],
+                  ec_geometry=EcGeometry(), coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=fp(),
+                      pulse_seconds=0.3)
+    vs.start()
+    wait_cluster_up(ms, [vs])
+    fport = free_port_pair()
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=fport + 10000, chunk_size_mb=1).start()
+    try:
+        payload = bytes(range(256)) * 4096 * 3  # 3 MB -> 3 chunks
+        fs.write_file("/cache/big.bin", payload)
+
+        upstream = []
+        orig = fs._fetch_blob_upstream
+        fs.reader_cache.fetch = lambda fid: (upstream.append(fid),
+                                             orig(fid))[1]
+        e = fs.filer.find_entry("/cache", "big.bin")
+        assert fs.read_entry_bytes(e) == payload
+        # write seeded the cache, so even the FIRST read is all hits
+        assert upstream == []
+        st = fs.chunk_cache.stats()
+        assert st["hits"] >= 3
+        # evict everything, then a cold read fetches each chunk once
+        fs.chunk_cache._mem.clear()
+        fs.chunk_cache._mem_bytes = 0
+        assert fs.read_entry_bytes(e) == payload
+        assert sorted(set(upstream)) == sorted(
+            c.file_id for c in e.chunks)
+        n_cold = len(upstream)
+        assert fs.read_entry_bytes(e) == payload  # warm again
+        assert len(upstream) == n_cold
+    finally:
+        fs.stop()
+        vs.stop()
+        ms.stop()

@@ -20,7 +20,94 @@ def _entry(name: str, size: int = 0, directory_flag: bool = False) -> fpb.Entry:
     return e
 
 
-@pytest.fixture(params=["memory", "sqlite", "logdb", "lsm", "lsm-tiny"])
+class _FakePgDbapi:
+    """In-process DB-API double that understands exactly the statements
+    PostgresDialect emits — the abstract_sql layer's logic runs end to end
+    through a non-sqlite dialect without a postgres server (the statements
+    are matched semantically, not executed as SQL text)."""
+
+    def __init__(self):
+        self.filemeta: dict[tuple[str, str], bytes] = {}
+        self.kv: dict[bytes, bytes] = {}
+        self._rows: list = []
+
+    # connection surface
+    def cursor(self):
+        return self
+
+    def commit(self):
+        pass
+
+    def close(self):
+        pass
+
+    # cursor surface
+    def execute(self, sql, params=()):
+        from seaweedfs_tpu.filer.sql_store import PostgresDialect as D
+        d = D()
+        self._rows = []
+        if sql in d.CREATE_TABLES:
+            return
+        if sql == d.sql(d.UPSERT_ENTRY):
+            self.filemeta[(params[0], params[1])] = params[2]
+        elif sql == d.sql(d.UPSERT_KV):
+            self.kv[bytes(params[0])] = params[1]
+        elif sql == d.sql(d.FIND_ENTRY):
+            blob = self.filemeta.get((params[0], params[1]))
+            self._rows = [] if blob is None else [(blob,)]
+        elif sql == d.sql(d.DELETE_ENTRY):
+            self.filemeta.pop((params[0], params[1]), None)
+        elif sql == d.sql(d.DELETE_CHILDREN):
+            for k in [k for k in self.filemeta if k[0] == params[0]]:
+                del self.filemeta[k]
+        elif sql == d.sql(d.GET_KV):
+            v = self.kv.get(bytes(params[0]))
+            self._rows = [] if v is None else [(v,)]
+        elif sql.startswith("SELECT meta FROM filemeta WHERE directory="):
+            # the LIST statement family (op and prefix clause vary)
+            directory, start_from = params[0], params[1]
+            inclusive = " name >= " in sql
+            like = None
+            if "LIKE" in sql:
+                like = params[2]
+                limit = params[3]
+            else:
+                limit = params[2]
+            names = sorted(n for (dd, n) in self.filemeta if dd == directory)
+            out = []
+            for n in names:
+                if start_from and (n < start_from
+                                   or (not inclusive and n == start_from)):
+                    continue
+                if like is not None:
+                    prefix = (like[:-1].replace("\\%", "%")
+                              .replace("\\_", "_").replace("\\\\", "\\"))
+                    if not n.startswith(prefix):
+                        continue
+                out.append((self.filemeta[(directory, n)],))
+                if len(out) >= limit:
+                    break
+            self._rows = out
+        else:
+            raise AssertionError(f"unexpected SQL for pg dialect: {sql}")
+
+    def fetchone(self):
+        return self._rows[0] if self._rows else None
+
+    def fetchall(self):
+        return list(self._rows)
+
+
+@pytest.fixture(scope="module")
+def mini_redis():
+    from seaweedfs_tpu.utils.mini_redis import MiniRedis
+    srv = MiniRedis().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(params=["memory", "sqlite", "logdb", "lsm", "lsm-tiny",
+                        "redis", "pg-dialect"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryStore()
@@ -30,6 +117,24 @@ def store(request, tmp_path):
         s = LogDbStore(str(tmp_path / "filer.logdb"))
     elif request.param == "lsm":
         s = LsmStore(str(tmp_path / "filer-lsm"))
+    elif request.param == "redis":
+        srv = request.getfixturevalue("mini_redis")
+        from seaweedfs_tpu.filer.redis_store import RedisStore
+        s = RedisStore(srv.address)
+        s._cmd(b"FLUSHALL")  # isolate from earlier parametrizations
+    elif request.param == "pg-dialect":
+        from seaweedfs_tpu.filer.sql_store import (AbstractSqlStore,
+                                                   PostgresDialect)
+        db = _FakePgDbapi()
+
+        class _Dialect(PostgresDialect):
+            def __init__(self):
+                super().__init__("dbname=fake")
+
+            def connect(self):
+                return db
+
+        s = AbstractSqlStore(_Dialect())
     else:
         # memtable_limit=2 forces SST flushes + compactions mid-suite so
         # the conformance contract exercises the on-disk merge paths
@@ -104,7 +209,13 @@ class TestFilerStoreConformance:
         if isinstance(store, MemoryStore) and not isinstance(store, LogDbStore):
             pytest.skip("memory store is ephemeral by design")
         store.close()
-        if isinstance(store, LogDbStore):
+        from seaweedfs_tpu.filer.redis_store import RedisStore
+        if isinstance(store, RedisStore):
+            # persistence lives server-side: a fresh CLIENT sees the data
+            re = RedisStore(store.address)
+        elif store.name == "postgres":
+            pytest.skip("fake pg dbapi is process-local by design")
+        elif isinstance(store, LogDbStore):
             re = LogDbStore(str(tmp_path / "filer.logdb"))
         elif isinstance(store, LsmStore):
             re = LsmStore(store.dir)
@@ -117,7 +228,7 @@ class TestFilerStoreConformance:
             re.close()
 
 
-def test_open_store_specs(tmp_path):
+def test_open_store_specs(tmp_path, mini_redis):
     assert isinstance(open_store("memory"), MemoryStore)
     s = open_store(f"sqlite:{tmp_path}/x.db")
     assert isinstance(s, SqliteStore)
@@ -125,8 +236,38 @@ def test_open_store_specs(tmp_path):
     s = open_store(f"logdb:{tmp_path}/y.logdb")
     assert isinstance(s, LogDbStore)
     s.close()
+    from seaweedfs_tpu.filer.redis_store import RedisStore
+    s = open_store(f"redis:{mini_redis.address}")
+    assert isinstance(s, RedisStore)
+    s.close()
     with pytest.raises(ValueError):
         open_store("cassandra:nope")
+
+
+def test_gated_sql_dialects_fail_helpfully():
+    """mysql/postgres dialects exist with the reference DSN surface but
+    need drivers this image doesn't ship — the error must say so."""
+    with pytest.raises(RuntimeError, match="pymysql"):
+        open_store("mysql:host=127.0.0.1 user=root")
+    with pytest.raises(RuntimeError, match="psycopg2"):
+        open_store("postgres:dbname=weed")
+
+
+def test_filer_on_redis_store(mini_redis, tmp_path):
+    """A whole Filer rides the redis-protocol backend (reference filers
+    run on redis2 the same way)."""
+    from seaweedfs_tpu.filer.filer import Filer
+
+    f = Filer(open_store(f"redis:{mini_redis.address}"),
+              str(tmp_path / "meta.log"))
+    e = _entry("hello.txt", 5)
+    f.create_entry("/redis-dir", e)
+    got = f.find_entry("/redis-dir", "hello.txt")
+    assert got is not None and got.attributes.file_size == 5
+    names = [x.name for x in f.store.list_entries("/redis-dir")]
+    assert names == ["hello.txt"]
+    f.delete_entry("/redis-dir", "hello.txt")
+    assert f.find_entry("/redis-dir", "hello.txt") is None
 
 
 class TestLsmInternals:
