@@ -899,6 +899,12 @@ def _open_sink(spec: str):
         host, _, bucket = rest.partition("/")
         ak, _, sk = cred.partition(":")
         return S3Sink(f"{scheme}://{host}", bucket, ak, sk)
+    if kind == "azure":
+        from .remote.azure import AzureSink, parse_azure_spec
+        return AzureSink(parse_azure_spec(arg))
+    if kind == "gcs-json":
+        from .remote.gcs import GcsSink, parse_gcs_spec
+        return GcsSink(parse_gcs_spec(arg))
     raise ValueError(f"unknown sink spec {spec!r}")
 
 

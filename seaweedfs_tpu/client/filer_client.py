@@ -21,7 +21,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..filer.chunks import read_views, total_size
+from ..filer.chunks import total_size
 from ..pb import filer_pb2 as fpb
 from ..utils.log import logger
 from ..utils.rpc import FILER_SERVICE, Stub

@@ -60,7 +60,13 @@ class ChunkCache:
                  disk_limit_bytes: int = 1 << 30,
                  mem_chunk_max: int = 8 << 20):
         self.mem_limit = mem_limit_bytes
-        self.mem_chunk_max = mem_chunk_max  # bigger chunks go disk-only
+        # bigger chunks go disk-only — but with NO disk tier they must
+        # still be mem-cacheable (up to half the budget), or a >8MB
+        # chunk_size config would re-fetch a full chunk per 128KiB
+        # kernel read slice
+        if disk_dir is None:
+            mem_chunk_max = max(mem_chunk_max, mem_limit_bytes // 2)
+        self.mem_chunk_max = mem_chunk_max
         self._mem: "OrderedDict[str, bytes]" = OrderedDict()
         self._mem_bytes = 0
         self._lock = threading.Lock()

@@ -22,7 +22,7 @@ from ..pb import filer_pb2 as fpb
 from ..utils.log import logger
 from ..utils.rpc import FILER_SERVICE, RpcService, serve
 from .chunks import etag as chunk_etag
-from .chunks import maybe_manifestize, read_views, total_size
+from .chunks import maybe_manifestize, total_size
 from .filer import Filer, join_path, split_path
 from .store import open_store
 

@@ -127,6 +127,10 @@ class RedisStore(FilerStore):
                      limit=2**31, prefix="") -> Iterator[fpb.Entry]:
         lo = b"-" if not start_from else \
             (b"[" if inclusive else b"(") + start_from.encode()
+        if prefix and prefix > start_from:
+            # seek straight to the prefix region instead of paging the
+            # whole directory from the start
+            lo = b"[" + prefix.encode()
         n = 0
         batch = 1024
         while n < limit:

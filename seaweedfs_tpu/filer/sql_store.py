@@ -42,10 +42,11 @@ class SqlDialect:
     FIND_ENTRY = "SELECT meta FROM filemeta WHERE directory={p} AND name={p}"
     DELETE_ENTRY = "DELETE FROM filemeta WHERE directory={p} AND name={p}"
     DELETE_CHILDREN = "DELETE FROM filemeta WHERE directory={p}"
-    # LIKE + explicit ESCAPE is portable across sqlite/mysql/postgres
+    # LIKE + ESCAPE '|' is portable across sqlite/mysql/postgres (a
+    # backslash escape char would itself be string-escaped by MySQL)
     LIST = ("SELECT meta FROM filemeta WHERE directory={p} AND name {op} {p}"
             "{prefix_clause} ORDER BY name LIMIT {p}")
-    LIST_PREFIX_CLAUSE = " AND name LIKE {p} ESCAPE '\\'"
+    LIST_PREFIX_CLAUSE = " AND name LIKE {p} ESCAPE '|'"
     GET_KV = "SELECT v FROM kv WHERE k={p}"
 
     def connect(self):
@@ -65,6 +66,9 @@ class SqliteDialect(SqlDialect):
         c = sqlite3.connect(self.path, timeout=30)
         c.execute("PRAGMA journal_mode=WAL")
         c.execute("PRAGMA synchronous=NORMAL")
+        # LIKE defaults to case-insensitive in sqlite; prefix listings
+        # must be byte-exact (the python-side re-filter is the backstop)
+        c.execute("PRAGMA case_sensitive_like=ON")
         return c
 
 
@@ -73,9 +77,12 @@ class MysqlDialect(SqlDialect):
 
     name = "mysql"
     placeholder = "%s"
+    # VARBINARY keys: byte-length (not chars x4 under utf8mb4), so the
+    # composite PK fits InnoDB's 3072-byte index cap, and comparisons/
+    # LIKE are binary-exact like every other backend
     CREATE_TABLES = (
         """CREATE TABLE IF NOT EXISTS filemeta(
-            directory VARCHAR(512) NOT NULL, name VARCHAR(512) NOT NULL,
+            directory VARBINARY(760) NOT NULL, name VARBINARY(760) NOT NULL,
             meta LONGBLOB, PRIMARY KEY(directory, name))""",
         """CREATE TABLE IF NOT EXISTS kv(
             k VARBINARY(512) PRIMARY KEY, v LONGBLOB)""",
@@ -132,8 +139,8 @@ class PostgresDialect(SqlDialect):
 
 
 def _escape_like(prefix: str) -> str:
-    return (prefix.replace("\\", "\\\\").replace("%", "\\%")
-            .replace("_", "\\_"))
+    return (prefix.replace("|", "||").replace("%", "|%")
+            .replace("_", "|_"))
 
 
 class AbstractSqlStore(FilerStore):
@@ -204,6 +211,8 @@ class AbstractSqlStore(FilerStore):
         for (blob,) in cur.fetchall():
             e = fpb.Entry()
             e.ParseFromString(bytes(blob))
+            if prefix and not e.name.startswith(prefix):
+                continue  # backstop for collation-insensitive LIKE
             yield e
 
     def kv_get(self, key):

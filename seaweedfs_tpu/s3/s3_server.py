@@ -785,12 +785,15 @@ class S3Gateway:
             if k.startswith("x-amz-meta-"):
                 headers[k] = v.decode()
         rng = request.http_range
+        has_range = rng.start is not None or rng.stop is not None
         offset = rng.start or 0
         if offset < 0:
             offset, stop = max(0, fsize + offset), fsize
         else:
             stop = min(rng.stop if rng.stop is not None else fsize, fsize)
-        if offset > 0 and offset >= fsize:
+        if (offset > 0 and offset >= fsize) or (has_range and fsize == 0):
+            # any Range on an empty object is unsatisfiable (s3tests
+            # test_ranged_request_empty_object expects 416)
             raise S3Error("InvalidRange",
                           "The requested range is not satisfiable", 416)
         status = 200 if (offset == 0 and stop >= fsize) else 206

@@ -279,6 +279,16 @@ def open_remote(spec: str) -> RemoteStorageClient:
             base, _, bucket = url.rpartition("/")
         ak, _, sk = cred.partition(":")
         return S3Remote(base, bucket, ak, sk)
+    if kind == "azure":
+        # native Blob REST + SharedKey (not the s3-compat path):
+        # 'azure:https://{acct}.blob.core.windows.net/container?acct:key'
+        from ..remote.azure import parse_azure_spec
+        return parse_azure_spec(arg)
+    if kind == "gcs-json":
+        # native GCS JSON API with a bearer token (HMAC users can keep
+        # the s3-compat 'gcs:' spec above)
+        from ..remote.gcs import parse_gcs_spec
+        return parse_gcs_spec(arg)
     raise ValueError(f"unknown remote backend {spec!r}")
 
 

@@ -17,10 +17,20 @@ def test_mem_lru_bounded():
     assert c.get("1,0") is None
 
 
-def test_mem_oversize_chunks_skip_mem():
+def test_mem_oversize_chunks_skip_mem_when_disk_tier(tmp_path):
+    # WITH a disk tier, oversize chunks go disk-only (mem stays hot-small)
+    c = ChunkCache(mem_limit_bytes=100 << 20, mem_chunk_max=1_000,
+                   disk_dir=str(tmp_path / "d"))
+    c.put("1,a", b"x" * 5_000)
+    assert c.mem_bytes == 0 and c.disk_bytes == 5_000
+
+
+def test_mem_accepts_big_chunks_without_disk_tier():
+    # with NO disk tier the mem cap floors at half the budget, so large
+    # chunk_size configs still get caching (r4 review finding)
     c = ChunkCache(mem_limit_bytes=100 << 20, mem_chunk_max=1_000)
     c.put("1,a", b"x" * 5_000)
-    assert c.mem_bytes == 0  # too big for the mem tier, no disk tier
+    assert c.mem_bytes == 5_000
 
 
 def test_disk_tier_roundtrip_and_restart(tmp_path):
