@@ -177,24 +177,50 @@ def bench_device(out: dict, B: int, C: int, repeats: int, smoke: bool) -> None:
 # ---------------------------------------------------------------------------
 
 def bench_cpu(out: dict, B: int, C: int, repeats: int) -> None:
+    """Pin the AVX2 baseline (VERDICT r3 ask 6): many short samples,
+    interquartile trimming against VM CPU-steal transients, iterate until
+    the trimmed spread is <10% (or a 60s budget runs out). Published as
+    GB/s/core with a linear multi-core estimate — klauspost/reedsolomon
+    parallelizes across stripe slabs, so per-core rate x cores is the
+    defensible denominator for the headline."""
     from seaweedfs_tpu.ops import native
 
     if not native.available():
         log("native CPU coder unavailable; skipping baseline")
         return
     rng = np.random.default_rng(1)
-    data = rng.integers(0, 256, (B, D, C), dtype=np.uint8)
+    # ~80 MB per sample: big enough to stream DRAM, short enough (~40 ms)
+    # that host-steal events land BETWEEN samples, not inside them
+    b = min(B, 8)
+    data = rng.integers(0, 256, (b, D, C), dtype=np.uint8)
     coder = native.NativeCoder(D, P)
     coder.encode(data[:1])  # warm tables
-    rates = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        coder.encode(data)
-        rates.append(data.nbytes / (time.perf_counter() - t0) / 1e9)
-    m, s = med_spread(rates)
+    rates: list[float] = []
+    deadline = time.time() + 60
+    m = s = float("nan")
+    while time.time() < deadline:
+        for _ in range(5):
+            t0 = time.perf_counter()
+            coder.encode(data)
+            rates.append(data.nbytes / (time.perf_counter() - t0) / 1e9)
+        sel = sorted(rates)[len(rates) // 4: max(3 * len(rates) // 4,
+                                                 len(rates) // 4 + 1)]
+        m, s = med_spread(sel)
+        if len(rates) >= max(repeats, 20) and s < 0.10:
+            break
+    raw_m, raw_s = med_spread(rates)
     out["cpu_avx2_GBps"], out["cpu_avx2_spread"] = round(m, 3), round(s, 4)
+    out["cpu_avx2_raw_spread"] = round(raw_s, 4)
+    out["cpu_avx2_samples"] = len(rates)
     out["cpu_threads"] = 1  # ctypes call on one thread; box has nproc=1
-    log(f"cpu avx2 encode: {m:.2f} GB/s (spread {s:.1%}, 1 thread)")
+    out["cpu_avx2_GBps_per_core"] = out["cpu_avx2_GBps"]
+    out["cpu_avx2_est_8core_GBps"] = round(m * 8, 2)
+    out["cpu_baseline_note"] = (
+        "interquartile-trimmed median over short samples (VM steal lands "
+        "between samples); vs_baseline uses GB/s/core x core count")
+    log(f"cpu avx2 encode: {m:.2f} GB/s/core (trimmed spread {s:.1%} over "
+        f"{len(rates)} samples; raw {raw_s:.1%}; est 8-core "
+        f"{out['cpu_avx2_est_8core_GBps']} GB/s)")
 
 
 # ---------------------------------------------------------------------------
@@ -441,6 +467,87 @@ def bench_s3(out: dict, obj_mb: int = 24) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_cluster_procs(out: dict, n_files: int, conc: int) -> None:
+    """Separate-process master + volume topology at >=100k files
+    (VERDICT r3 ask 8: real network hops + volume rollover/growth under
+    load, no in-process dispatch flattering the numbers)."""
+    import socket
+    import subprocess
+
+    from seaweedfs_tpu import bench_tool
+    from seaweedfs_tpu.client import http_util
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="swtpu_bench_procs_")
+    mport, mhttp, vport, vgrpc = (free_port() for _ in range(4))
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # CPU-only children
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    try:
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "master",
+             "-port", str(mport), "-httpPort", str(mhttp),
+             # small volumes force rollover + growth mid-bench
+             "-volumeSizeLimitMB", "32"],
+            cwd=repo_root, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "volume",
+             "-port", str(vport), "-grpcPort", str(vgrpc),
+             "-mserver", f"127.0.0.1:{mport}", "-dir", tmp,
+             "-max", "64", "-coder", "numpy"],
+            cwd=repo_root, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 45
+        up = False
+        while time.time() < deadline:
+            try:
+                if http_util.get(f"http://127.0.0.1:{vport}/status",
+                                 timeout=1).ok and \
+                   http_util.get(f"http://127.0.0.1:{mhttp}/dir/status",
+                                 timeout=1).ok:
+                    up = True
+                    break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.25)
+        if not up:
+            raise RuntimeError("separate-process cluster failed to start")
+        res = bench_tool.run(["-master", f"127.0.0.1:{mport}",
+                              "-masterHttp", f"127.0.0.1:{mhttp}",
+                              "-n", str(n_files), "-c", str(conc)])
+        out["procs_write_rps"] = round(res["write"]["rps"], 1)
+        out["procs_write_p99_ms"] = round(res["write"]["p99_ms"], 2)
+        out["procs_read_rps"] = round(res["read"]["rps"], 1)
+        out["procs_read_p99_ms"] = round(res["read"]["p99_ms"], 2)
+        out["procs_files"] = n_files
+        out["procs_errors"] = res.get("errors", 0)
+        out["procs_topology"] = ("separate-process master+volume, "
+                                 f"{conc}-thread client, 32MB volumes "
+                                 "(rollover+growth exercised), 1-core box")
+        log(f"separate-process cluster ({n_files} files): "
+            f"write {out['procs_write_rps']} req/s "
+            f"(p99 {out['procs_write_p99_ms']} ms), "
+            f"read {out['procs_read_rps']} req/s "
+            f"(p99 {out['procs_read_p99_ms']} ms)")
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_cluster(out: dict, n_files: int, conc: int) -> None:
     import socket
 
@@ -568,9 +675,20 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             log(f"s3 bench failed: {e}")
             out["s3_error"] = str(e)[:200]
+        try:
+            bench_cluster_procs(out, 2000 if smoke else 100_000, 12)
+        except Exception as e:  # noqa: BLE001
+            log(f"separate-process cluster bench failed: {e}")
+            out["procs_error"] = str(e)[:200]
 
     cpu = out.get("cpu_avx2_GBps")
     out["vs_baseline"] = round(out["value"] / cpu, 3) if cpu else None
+    # per-core is the honest denominator on this 1-core VM; a real
+    # klauspost host scales ~linearly with cores, so also publish the
+    # ratio against an 8-core estimate
+    if out.get("cpu_avx2_est_8core_GBps"):
+        out["vs_baseline_8core_est"] = round(
+            out["value"] / out["cpu_avx2_est_8core_GBps"], 3)
     print(json.dumps(out))
 
 

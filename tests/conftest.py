@@ -68,11 +68,13 @@ def wait_until(cond, timeout: float = 10.0, interval: float = 0.05,
 
 
 def wait_http_up(url: str, timeout: float = 10.0):
-    """Block until an HTTP endpoint answers (daemon fixture readiness)."""
+    """Block until an HTTP endpoint answers AT ALL (daemon readiness —
+    a 4xx from an auth-gated root still means the server is up; any
+    response proves the listener is live)."""
     import requests as _rq
 
-    wait_until(lambda: _rq.get(url, timeout=1).ok, timeout=timeout,
-               msg=f"http up at {url}")
+    wait_until(lambda: _rq.get(url, timeout=1) is not None,
+               timeout=timeout, msg=f"http up at {url}")
 
 
 def wait_cluster_up(master, servers, timeout: float = 10.0):

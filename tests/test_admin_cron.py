@@ -111,7 +111,14 @@ def test_cron_rebuilds_lost_shards_without_operator(cluster):
     env = CommandEnv(f"127.0.0.1:{master.port}", mc=mc, out=io.StringIO())
     wait_until(lambda: mc.volume_list().topology_info is not None,
                msg="topology")
-    time.sleep(1.0)  # let heartbeats settle volume sizes
+
+    def sizes_settled():
+        with master.topo.lock:
+            infos = [v for n in master.topo.all_nodes()
+                     for v in n.all_volumes() if v.collection == "cron"]
+        return bool(infos) and all(v.size > 0 for v in infos)
+
+    wait_until(sizes_settled, msg="volume sizes settle")
     run_command(env, "lock")
     run_command(env, "ec.encode -collection cron -fullPercent 0")
     run_command(env, "unlock")
@@ -173,7 +180,14 @@ def test_cron_ec_encodes_full_volumes(cluster):
         payloads[res.fid] = data
     vid = int(next(iter(payloads)).split(",")[0])
     wait_until(lambda: master.topo.lookup(vid), msg="volume registered")
-    time.sleep(0.7)  # one pulse: sizes settle
+
+    def size_settled():
+        with master.topo.lock:
+            infos = [v for n in master.topo.all_nodes()
+                     for v in n.all_volumes() if v.id == vid]
+        return bool(infos) and all(v.size > 0 for v in infos)
+
+    wait_until(size_settled, msg="volume size settles")
 
     master.admin_cron.trigger()
 

@@ -307,15 +307,8 @@ def filer_server(cluster, tmp_path_factory):  # noqa: F811
                      chunk_size_mb=1)
     fs.start()
     import requests
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://{fs.url}/__status__", timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.1)
-    else:
-        pytest.fail("filer HTTP not ready")
+    from conftest import wait_http_up
+    wait_http_up(f"http://{fs.url}/__status__")
     yield fs
     fs.stop()
 
@@ -519,13 +512,8 @@ def test_fs_configure_shell_command(cluster, tmp_path):
                      meta_log_path=str(tmp_path / "meta.log"))
     fs.start()
     import requests
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://{fs.url}/__status__", timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_http_up
+    wait_http_up(f"http://{fs.url}/__status__")
     try:
         out = iomod.StringIO()
         env = CommandEnv(f"127.0.0.1:{master.port}", mc=mc, out=out)
@@ -585,13 +573,8 @@ def test_encrypted_chunks_at_rest(cluster, tmp_path):
                      chunk_size_mb=1, encrypt_data=True)
     fs.start()
     try:
-        deadline = time.time() + 10
-        while time.time() < deadline:
-            try:
-                if requests.get(f"http://{fs.url}/__status__", timeout=1).ok:
-                    break
-            except Exception:
-                time.sleep(0.1)
+        from conftest import wait_http_up
+        wait_http_up(f"http://{fs.url}/__status__")
         secret = b"TOP-SECRET-PAYLOAD-" * 120_000  # ~2.3 MB, multi-chunk
         r = requests.post(f"http://{fs.url}/enc/secret.bin", data=secret,
                           timeout=30)

@@ -42,16 +42,8 @@ def ftp_stack(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
                       pulse_seconds=0.3)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://127.0.0.1:{vport}/status",
-                            timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     fport = free_port_pair()
     fs = FilerServer(ms.address, store_spec="memory", port=fport,
                      grpc_port=fport + 10000, chunk_size_mb=1)

@@ -51,27 +51,15 @@ def stack(tmp_path):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
                       pulse_seconds=0.3)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://127.0.0.1:{vport}/status",
-                            timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     port = free_port_pair()
     fs = FilerServer(ms.address, store_spec="memory", port=port,
                      grpc_port=port + 10000,
                      meta_log_path=str(tmp_path / "meta.log"))
     fs.start()
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://{fs.url}/__status__", timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_http_up
+    wait_http_up(f"http://{fs.url}/__status__")
     yield ms, vs, fs
     fs.stop()
     vs.stop()

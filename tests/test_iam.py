@@ -36,15 +36,8 @@ def stack(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
                       pulse_seconds=0.5)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            requests.get(f"http://{vs.url}/status", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     fs = FilerServer(ms.address, store_spec="memory", port=fport,
                      grpc_port=_fp(), chunk_size_mb=1)
     fs.start()
@@ -59,13 +52,9 @@ def stack(tmp_path_factory):
     iam.start()
     # seeding from the live gateway identities must keep admin working
     assert any(i["name"] == "admin" for i in iam.config["identities"])
+    from conftest import wait_http_up
     for url in (f"http://127.0.0.1:{iamport}/", f"http://127.0.0.1:{s3port}/"):
-        while time.time() < deadline:
-            try:
-                requests.get(url, timeout=1)
-                break
-            except Exception:
-                time.sleep(0.05)
+        wait_http_up(url)
     yield {"iam_url": f"http://127.0.0.1:{iamport}",
            "s3_url": f"http://127.0.0.1:{s3port}",
            "iam": iam, "s3": s3, "fs": fs}

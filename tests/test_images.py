@@ -87,15 +87,8 @@ class TestReadPathResize:
                           pulse_seconds=0.5)
         vs.start()
         try:
-            deadline = time.time() + 10
-            while time.time() < deadline and len(ms.topo.nodes) < 1:
-                time.sleep(0.05)
-            while time.time() < deadline:
-                try:
-                    requests.get(f"http://{vs.url}/status", timeout=1)
-                    break
-                except Exception:
-                    time.sleep(0.05)
+            from conftest import wait_cluster_up
+            wait_cluster_up(ms, [vs])
             mc = MasterClient(ms.address).start()
             mc.wait_connected()
             res = operation.submit(mc, _png(80, 40), name="pic.png",

@@ -109,16 +109,8 @@ def live_cluster(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=fp(),
                       pulse_seconds=0.5)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    import requests
-    while time.time() < deadline:
-        try:
-            requests.get(f"http://{vs.url}/status", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     mc = MasterClient(ms.address).start()
     mc.wait_connected()
     yield {"ms": ms, "vs": vs, "mc": mc}

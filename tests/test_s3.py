@@ -103,13 +103,8 @@ def s3(filer_server):  # noqa: F811
 
     gw = S3Gateway(filer_server, port=free_port()).start()
     base = f"http://{gw.url}"
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        try:
-            requests.get(base, timeout=1)
-            break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_http_up
+    wait_http_up(base)
     yield gw, base
     gw.stop()
 
@@ -340,13 +335,8 @@ def s3_auth(filer_server):  # noqa: F811
 
     gw = S3Gateway(filer_server, port=free_port(), iam_config=IAM_CONFIG).start()
     base = f"http://{gw.url}"
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        try:
-            requests.get(base, timeout=1)
-            break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_http_up
+    wait_http_up(base)
     yield gw, base
     gw.stop()
 
@@ -513,13 +503,8 @@ def test_circuit_breaker_gateway_503(filer_server):
     gw = S3Gateway(filer_server, port=free_port(),
                    circuit_breaker={"global": {"Write": 0}}).start()
     base = f"http://{gw.url}"
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        try:
-            requests.get(base, timeout=1)
-            break
-        except Exception:
-            time.sleep(0.1)
+    from conftest import wait_http_up
+    wait_http_up(base)
     try:
         r = requests.put(f"{base}/throttled", timeout=10)
         assert r.status_code == 503

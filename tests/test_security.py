@@ -148,16 +148,8 @@ class TestJwtCluster:
                           guard=Guard(signing_key="cluster-key"))
         vs.start()
         try:
-            deadline = time.time() + 10
-            while time.time() < deadline and len(ms.topo.nodes) < 1:
-                time.sleep(0.05)
-            import requests
-            while time.time() < deadline:
-                try:
-                    requests.get(f"http://{vs.url}/status", timeout=1)
-                    break
-                except Exception:
-                    time.sleep(0.05)
+            from conftest import wait_cluster_up
+            wait_cluster_up(ms, [vs])
             yield ms, vs
         finally:
             vs.stop()

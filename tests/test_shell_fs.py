@@ -50,16 +50,8 @@ def stack(tmp_path_factory):
                           pulse_seconds=0.5, rack="r0")
         vs.start()
         servers.append(vs)
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 2:
-        time.sleep(0.05)
-    for vs in servers:
-        while time.time() < deadline:
-            try:
-                requests.get(f"http://{vs.url}/status", timeout=1)
-                break
-            except Exception:
-                time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, servers)
     fs = FilerServer(ms.address, store_spec="memory", port=fport,
                      grpc_port=fport + 10000, chunk_size_mb=1)
     fs.start()
@@ -154,16 +146,19 @@ def test_cluster_ps(env):
 def test_volume_configure_replication(env, stack):
     e, out = env
     # find a volume id
-    vid = None
-    deadline = time.time() + 5
-    while time.time() < deadline and vid is None:
+    from conftest import wait_until
+    found = []
+
+    def find_vid():
         for vs in stack["servers"]:
-            st = vs.store.status()
-            if st["volumes"]:
-                vid = next(iter(
-                    vs.store.locations[0].volumes.keys()))
-        time.sleep(0.1)
-    assert vid is not None
+            if vs.store.status()["volumes"]:
+                found.append(next(iter(
+                    vs.store.locations[0].volumes.keys())))
+                return True
+        return False
+
+    wait_until(find_vid, timeout=5, msg="a volume exists")
+    vid = found[0]
     run_command(e, "lock")
     run_command(e, f"volume.configure.replication -volumeId {vid} "
                    "-replication 000")
@@ -236,16 +231,8 @@ def test_volume_server_evacuate_unreplicated(tmp_path_factory):
                               pulse_seconds=0.5, rack=f"r{i}")
             vs.start()
             servers.append(vs)
-        deadline = time.time() + 10
-        while time.time() < deadline and len(ms.topo.nodes) < 2:
-            time.sleep(0.05)
-        for vs in servers:
-            while time.time() < deadline:
-                try:
-                    requests.get(f"http://{vs.url}/status", timeout=1)
-                    break
-                except Exception:
-                    time.sleep(0.05)
+        from conftest import wait_cluster_up
+        wait_cluster_up(ms, servers)
         out = io.StringIO()
         e = CommandEnv(ms.address, out=out)
         e.mc.start()

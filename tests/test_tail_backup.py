@@ -94,16 +94,8 @@ def cluster(tmp_path):
     vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
                       grpc_port=free_port(), pulse_seconds=0.3)
     vs.start()
-    deadline = time.time() + 15
-    while time.time() < deadline and len(master.topo.nodes) < 1:
-        time.sleep(0.05)
-    import requests
-    while time.time() < deadline:
-        try:
-            if requests.get(f"http://127.0.0.1:{vs.port}/status", timeout=1).ok:
-                break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(master, [vs], timeout=15)
     mc = MasterClient(f"127.0.0.1:{mport}").start()
     mc.wait_connected()
     yield master, vs, store, mc
@@ -196,9 +188,9 @@ def test_tail_receiver_catches_up_replica(cluster, tmp_path):
                        grpc_port=free_port(), pulse_seconds=0.3)
     vs2.start()
     try:
-        deadline = time.time() + 10
-        while time.time() < deadline and len(master.topo.nodes) < 2:
-            time.sleep(0.05)
+        from conftest import wait_until
+        wait_until(lambda: len(master.topo.nodes) >= 2,
+                   msg="second server registered")
         # allocate the empty replica volume on server 2, then tail-pull
         stub2 = Stub(f"127.0.0.1:{vs2.grpc_port}", VOLUME_SERVICE)
         stub2.call("AllocateVolume",

@@ -36,25 +36,16 @@ def dav(tmp_path_factory):
     vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
                       pulse_seconds=0.5)
     vs.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(ms.topo.nodes) < 1:
-        time.sleep(0.05)
-    while time.time() < deadline:
-        try:
-            requests.get(f"http://{vs.url}/status", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
     fs = FilerServer(ms.address, store_spec="memory", port=fport,
                      grpc_port=_fp(), chunk_size_mb=1)
     fs.start()
     wd = WebDavServer(fs, port=wport).start()
-    while time.time() < deadline:
-        try:
-            requests.request("OPTIONS", f"http://{wd.url}/", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.05)
+    from conftest import wait_until
+    wait_until(lambda: requests.request(
+        "OPTIONS", f"http://{wd.url}/", timeout=1).status_code < 600,
+        msg="webdav up")
     yield f"http://{wd.url}"
     wd.stop()
     fs.stop()
