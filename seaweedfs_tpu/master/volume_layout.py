@@ -56,7 +56,13 @@ class VolumeLayout:
                 locs = self.topo.lookup(vid)
                 if len(locs) < self._copy_count:
                     self.writable.discard(vid)
-                infos = [v for n in locs for v in n.all_volumes() if v.id == vid]
+                # iterate node volume dicts under the TOPOLOGY lock:
+                # heartbeat ingest mutates disk.volumes concurrently
+                # ("dictionary changed size during iteration" — caught by
+                # tests/stress assign-storm)
+                with self.topo.lock:
+                    infos = [v for n in locs for v in n.all_volumes()
+                             if v.id == vid]
                 if any(v.size >= self.topo.volume_size_limit or v.read_only
                        for v in infos):
                     self.writable.discard(vid)

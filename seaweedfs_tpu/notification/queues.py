@@ -109,7 +109,12 @@ def open_queue(spec: str) -> MessageQueue:
         ns, _, topic = rest.partition("/")
         return MqQueue(addr, namespace=ns or "notifications",
                        topic=topic or "filer")
-    if kind in ("kafka", "aws_sqs", "gcp_pub_sub", "gocdk_pub_sub"):
+    if kind == "kafka":
+        # the real Kafka wire protocol, no SDK: 'kafka:host:port/topic'
+        from .kafka import KafkaQueue
+        addr, _, topic = arg.partition("/")
+        return KafkaQueue(addr, topic=topic or "seaweedfs_filer")
+    if kind in ("aws_sqs", "gcp_pub_sub", "gocdk_pub_sub"):
         raise RuntimeError(
             f"notification backend {kind!r} requires its broker SDK, "
             "which is not in this image (reference gates these behind "
