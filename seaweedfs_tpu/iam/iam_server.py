@@ -102,6 +102,19 @@ class IamApiServer:
         self.config = {"identities": list(seen.values())}
 
     # -- persistence ---------------------------------------------------------
+    # The stored document is iam_pb.S3ApiConfiguration proto-JSON
+    # (reference weed/pb/iam.proto serialized at /etc/iam/identity.json):
+    # round-tripping through the message enforces the schema on load AND
+    # save, so a malformed field fails loudly instead of flowing into the
+    # auth path.
+    @staticmethod
+    def _to_proto(config: dict):
+        from google.protobuf import json_format
+
+        from ..pb import iam_pb2 as ipb
+        return json_format.ParseDict(config, ipb.S3ApiConfiguration(),
+                                     ignore_unknown_fields=True)
+
     def _load_persisted(self) -> None:
         if self.fs is None:
             return
@@ -111,7 +124,15 @@ class IamApiServer:
             entry = self.fs.filer.find_entry(d, n)
             if entry is not None:
                 data = self.fs.read_entry_bytes(entry)
-                self.config = json.loads(data)
+                doc = json.loads(data)
+                self._to_proto(doc)  # schema gate: malformed fails loudly
+                # keep the RAW dict: proto round-trips drop empty repeated
+                # fields and extension keys (policy_document)
+                for ident in doc.get("identities", []):
+                    ident.setdefault("credentials", [])
+                    ident.setdefault("actions", [])
+                doc.setdefault("identities", [])
+                self.config = doc
                 self.iam.load(self.config)
         except Exception as e:  # noqa: BLE001
             log.warning("iam config load: %s", e)
@@ -121,6 +142,7 @@ class IamApiServer:
         if self.fs is None:
             return
         try:
+            self._to_proto(self.config)  # schema gate before writing
             self.fs.write_file(CONFIG_PATH,
                                json.dumps(self.config, indent=2).encode(),
                                mime="application/json")

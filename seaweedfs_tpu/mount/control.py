@@ -3,18 +3,20 @@
 Reference: `weed shell mount.configure` dials the mount process over a
 unix socket derived from the mount directory
 (command_mount_configure.go: /tmp/seaweedfs-mount-<hash>.sock) and calls
-the mount_pb Configure RPC (CollectionCapacity quota). Same shape here
-with newline-delimited JSON instead of gRPC — the socket only ever
-carries one tiny local RPC.
+the mount_pb Configure RPC (CollectionCapacity quota). Same wire shape
+here: one length-prefixed mount_pb.ConfigureRequest per connection,
+answered by a length-prefixed ConfigureResponse (pb/mount.proto).
 """
 
 from __future__ import annotations
 
 import hashlib
-import json
 import os
 import socket
+import struct
 import threading
+
+from ..pb import mount_pb2 as mpb
 
 
 def mount_socket_path(mount_dir: str) -> str:
@@ -24,9 +26,27 @@ def mount_socket_path(mount_dir: str) -> str:
     return f"/tmp/swtpu-mount-{h}.sock"
 
 
+def _send_msg(conn: socket.socket, msg) -> None:
+    raw = msg.SerializeToString()
+    conn.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_msg(rf, cls):
+    hdr = rf.read(4)
+    if len(hdr) < 4:
+        raise ConnectionError("control peer closed")
+    (n,) = struct.unpack(">I", hdr)
+    raw = rf.read(n)
+    if len(raw) < n:
+        raise ConnectionError("truncated control message")
+    msg = cls()
+    msg.ParseFromString(raw)
+    return msg
+
+
 def serve_mount_control(wfs, sock_path: str):
-    """Listen for {"collection_capacity": N} lines; apply to the live
-    WeedFS. Returns a stop() closure."""
+    """Answer ConfigureRequest messages against the live WeedFS.
+    Returns a stop() closure."""
     try:
         os.unlink(sock_path)
     except FileNotFoundError:
@@ -43,18 +63,18 @@ def serve_mount_control(wfs, sock_path: str):
             except OSError:
                 return
             with conn:
+                resp = mpb.ConfigureResponse()
                 try:
                     conn.settimeout(5.0)  # a silent client must not wedge
-                    line = conn.makefile("rb").readline()
-                    req = json.loads(line or b"{}")
-                    if "collection_capacity" in req:
-                        wfs.configure(req["collection_capacity"])
-                    resp = {"ok": True,
-                            "collection_capacity": wfs.collection_capacity}
+                    req = _recv_msg(conn.makefile("rb"),
+                                    mpb.ConfigureRequest)
+                    # apply unconditionally: capacity 0 CLEARS a quota
+                    wfs.configure(req.collection_capacity)
+                    resp.collection_capacity = wfs.collection_capacity
                 except Exception as e:  # noqa: BLE001
-                    resp = {"ok": False, "error": str(e)}
+                    resp.error = str(e)
                 try:
-                    conn.sendall(json.dumps(resp).encode() + b"\n")
+                    _send_msg(conn, resp)
                 except OSError:
                     pass
 
@@ -82,8 +102,13 @@ def configure_mount(mount_dir: str, collection_capacity: int) -> dict:
     c.settimeout(5.0)
     try:
         c.connect(path)
-        c.sendall(json.dumps(
-            {"collection_capacity": collection_capacity}).encode() + b"\n")
-        return json.loads(c.makefile("rb").readline() or b"{}")
+        _send_msg(c, mpb.ConfigureRequest(
+            collection_capacity=collection_capacity))
+        resp = _recv_msg(c.makefile("rb"), mpb.ConfigureResponse)
+        out = {"ok": not resp.error,
+               "collection_capacity": resp.collection_capacity}
+        if resp.error:
+            out["error"] = resp.error
+        return out
     finally:
         c.close()

@@ -30,18 +30,38 @@ REMOTE_KEY = b"remote"  # extended map key (bytes per proto)
 
 
 def _load_mappings(fs) -> dict:
+    """Mappings persist as remote_pb.RemoteStorageMapping proto-JSON
+    (reference stores remote.proto messages under /etc/remote the same
+    way); legacy plain-JSON files from earlier rounds still load."""
+    from google.protobuf import json_format
+
+    from ..pb import remote_pb2 as rpb
     d, n = split_path(MOUNT_CONF)
     entry = fs.filer.find_entry(d, n)
     if entry is None:
         return {}
     try:
-        return json.loads(fs.read_entry_bytes(entry))
+        raw = fs.read_entry_bytes(entry)
+        doc = json.loads(raw)
+        if "mappings" in doc:
+            msg = json_format.ParseDict(doc, rpb.RemoteStorageMapping())
+            return {dir_: {"spec": m.spec, "prefix": m.prefix}
+                    for dir_, m in msg.mappings.items()}
+        return doc  # legacy flat dict
     except Exception:  # noqa: BLE001
         return {}
 
 
 def _save_mappings(fs, mappings: dict) -> None:
-    fs.write_file(MOUNT_CONF, json.dumps(mappings, indent=2).encode(),
+    from google.protobuf import json_format
+
+    from ..pb import remote_pb2 as rpb
+    msg = rpb.RemoteStorageMapping()
+    for dir_, m in mappings.items():
+        msg.mappings[dir_].spec = m.get("spec", "")
+        msg.mappings[dir_].prefix = m.get("prefix", "")
+    fs.write_file(MOUNT_CONF,
+                  json_format.MessageToJson(msg, indent=2).encode(),
                   mime="application/json")
 
 

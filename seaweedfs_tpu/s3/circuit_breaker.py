@@ -37,12 +37,30 @@ class CircuitBreaker:
     def load(self, config: "dict | None") -> None:
         """(Re)apply a config — hot-reloaded from the filer at
         /etc/s3/circuit_breaker.json (reference s3api_circuit_breaker.go
-        subscribes to the same path). In-flight counters survive."""
+        subscribes to the same path; the document is
+        s3_pb.S3CircuitBreakerConfig, pb/s3.proto). In-flight counters
+        survive. Both shapes load: the proto form
+        {global:{actions:{...}}} and the terse {global:{Action:N}}."""
         config = config or {}
+
+        def limits(section: dict) -> dict:
+            if "actions" in section or "enabled" in section:
+                # proto S3CircuitBreakerOptions shape — validate it
+                from google.protobuf import json_format
+
+                from ..pb import s3_pb2 as spb
+                opts = json_format.ParseDict(section,
+                                             spb.S3CircuitBreakerOptions(),
+                                             ignore_unknown_fields=True)
+                if "enabled" in section and not opts.enabled:
+                    return {}  # kept on disk but switched off
+                return dict(opts.actions)
+            return dict(section)
+
         with self._lock:
-            self.global_limits = dict(config.get("global", {}))
+            self.global_limits = limits(config.get("global") or {})
             self.bucket_limits = {
-                b: dict(v) for b, v in (config.get("buckets") or {}).items()}
+                b: limits(v) for b, v in (config.get("buckets") or {}).items()}
             self.enabled = bool(self.global_limits or self.bucket_limits)
 
     @contextmanager
