@@ -44,7 +44,11 @@ def _load_mappings(fs) -> dict:
         raw = fs.read_entry_bytes(entry)
         doc = json.loads(raw)
         if "mappings" in doc:
-            msg = json_format.ParseDict(doc, rpb.RemoteStorageMapping())
+            # tolerate unknown fields: a hand edit or newer schema must
+            # not make load return {} (a later save would then wipe
+            # every other mount mapping)
+            msg = json_format.ParseDict(doc, rpb.RemoteStorageMapping(),
+                                        ignore_unknown_fields=True)
             return {dir_: {"spec": m.spec, "prefix": m.prefix}
                     for dir_, m in msg.mappings.items()}
         return doc  # legacy flat dict

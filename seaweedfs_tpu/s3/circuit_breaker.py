@@ -45,16 +45,29 @@ class CircuitBreaker:
 
         def limits(section: dict) -> dict:
             if "actions" in section or "enabled" in section:
-                # proto S3CircuitBreakerOptions shape — validate it
+                # proto S3CircuitBreakerOptions shape — validate it.
+                # `enabled` semantics: an EXPLICIT false disables; an
+                # absent key counts as on (divergence from strict proto3
+                # omission noted: our shell always writes explicit keys,
+                # and silently enforcing a disabled config is the worse
+                # failure mode of the two).
                 from google.protobuf import json_format
 
                 from ..pb import s3_pb2 as spb
                 opts = json_format.ParseDict(section,
                                              spb.S3CircuitBreakerOptions(),
                                              ignore_unknown_fields=True)
-                if "enabled" in section and not opts.enabled:
+                if section.get("enabled") is False:
                     return {}  # kept on disk but switched off
-                return dict(opts.actions)
+                merged = dict(opts.actions)
+                # terse top-level action keys overlay (the shell's
+                # s3.circuitbreaker writes Action:N at section level;
+                # dropping them silently would ignore operator edits)
+                for k, v in section.items():
+                    if k not in ("enabled", "actions") and \
+                            isinstance(v, (int, float)):
+                        merged[k] = int(v)
+                return merged
             return dict(section)
 
         with self._lock:
