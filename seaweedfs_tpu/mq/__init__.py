@@ -4,11 +4,19 @@ Topics split into partitions over a 4096-slot ring (mq/topic/
 partition.go); brokers register in the master cluster and own partition
 ranges (pub_balancer/balancer.go); pub/sub are gRPC streams with acked
 offsets (broker/broker_grpc_pub.go, _sub.go); closed segments persist
-through the filer under /topics/<ns>/<topic>/.
+through the filer under /topics/<ns>/<topic>/. Consumer groups
+coordinate through the broker-side sub coordinator
+(mq/sub_coordinator/) with sticky rebalancing and filer-persisted
+committed offsets; structured records are typed by mq/schema
+(mq/schema/ in the reference) with columnar-numpy batch mapping.
 """
 
-from .topic import Partition, TopicRef, partition_for_key, split_ring
 from .broker import BrokerServer
+from .consumer import ConsumerRecord, GroupConsumer, group_consume
+from .schema import Schema, infer_record_type, record_type_begin
+from .topic import Partition, TopicRef, partition_for_key, split_ring
 
 __all__ = ["TopicRef", "Partition", "partition_for_key", "split_ring",
-           "BrokerServer"]
+           "BrokerServer", "GroupConsumer", "ConsumerRecord",
+           "group_consume", "Schema", "infer_record_type",
+           "record_type_begin"]

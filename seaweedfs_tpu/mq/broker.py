@@ -19,16 +19,25 @@ import struct
 import threading
 import time
 
+import hashlib
+
 from ..client.master_client import MasterClient
 from ..pb import mq_pb2 as mq
 from ..utils.log import logger
-from ..utils.rpc import RpcService, serve
+from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, serve
+from .sub_coordinator import Coordinator
 from .topic import Partition, TopicRef, split_ring
 
 log = logger("mq.broker")
 
 MQ_SERVICE = "swtpu.mq.Broker"
 SEGMENT_FLUSH_COUNT = 1000  # messages per persisted segment
+
+
+def _stable_hash(s: str) -> int:
+    """Deterministic across processes (unlike hash()) so every broker
+    ranks the same owner for a partition or group."""
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
 
 
 class PartitionLog:
@@ -257,7 +266,8 @@ class LocalSegmentStore:
 class BrokerServer:
     def __init__(self, master_address: str, ip: str = "127.0.0.1",
                  port: int = 17777, filer_server=None,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None,
+                 rebalance_delay_s: float | None = None):
         self.ip, self.port = ip, port
         # segment persistence: an in-process filer, or a local directory
         # for the standalone verb, or memory-only (tests)
@@ -267,11 +277,23 @@ class BrokerServer:
         self.mc = MasterClient(master_address, client_type="broker",
                                client_address=f"{ip}:{port}")
         self.topics: dict[str, list[Partition]] = {}
+        # configure-time leader assignment: topic -> {range_start: broker}
+        self.topic_leaders: dict[str, dict[int, str]] = {}
         self.logs: dict[tuple[str, int], PartitionLog] = {}
         self._lock = threading.Lock()
         self._grpc = None
         self._stop = threading.Event()
         self.flush_interval = 2.0  # partial-tail persistence cadence (s)
+        # consumer-group coordination (sub_coordinator.py); leadership and
+        # coordinator placement both hash over the live-broker ring below
+        self.coordinator = Coordinator(self._group_partitions,
+                                       rebalance_delay_s)
+        self._broker_cache: tuple[float, list[str]] = (0.0, [self.address])
+        self._last_membership: list[str] = [self.address]
+        self.membership_poll_s = 0.5
+        # committed offsets: (topic_name, range_start, group) -> offset;
+        # memory cache over the filer-persisted offset files
+        self._offsets: dict[tuple[str, int, str], int] = {}
 
     @property
     def address(self) -> str:
@@ -283,11 +305,14 @@ class BrokerServer:
         if self.filer is not None:
             threading.Thread(target=self._flusher, daemon=True,
                              name=f"mq-flush-{self.port}").start()
+        threading.Thread(target=self._membership_watch, daemon=True,
+                         name=f"mq-members-{self.port}").start()
         log.info("mq broker %s up", self.address)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self.coordinator.shutdown()
         # stop accepting publishes BEFORE the final flush — an append acked
         # after its partition's flush would be lost despite a clean stop
         if self._grpc:
@@ -299,6 +324,113 @@ class BrokerServer:
                 log.warning("flush tail of %s %s: %s",
                             lg.topic, lg.partition, e)
         self.mc.stop()
+
+    def kill(self) -> None:
+        """Abrupt death for failover tests: drop the gRPC plane and the
+        master registration WITHOUT the final tail flush a clean stop()
+        performs — acked-but-unflushed tails are lost, like a crash."""
+        self._stop.set()
+        self.coordinator.shutdown()
+        if self._grpc:
+            self._grpc.stop(grace=0).wait()
+        self.mc.stop()
+
+    # -- live-broker ring ----------------------------------------------------
+    def live_brokers(self) -> list[str]:
+        """Sorted live broker addresses from the master cluster list
+        (cluster.go:104 membership), ~0.5 s cached; always includes self
+        so a broker is usable before/without master registration."""
+        now = time.monotonic()
+        ts, cached = self._broker_cache
+        if now - ts < 0.5:
+            return cached
+        addrs = {self.address}
+        try:
+            from ..pb import master_pb2 as mpb
+            resp = Stub(self.mc.leader, MASTER_SERVICE).call(
+                "ListClusterNodes",
+                mpb.ListClusterNodesRequest(client_type="broker"),
+                mpb.ListClusterNodesResponse, timeout=2)
+            addrs.update(n.address for n in resp.cluster_nodes)
+        except Exception:  # noqa: BLE001 — masterless dev mode: self only
+            pass
+        out = sorted(addrs)
+        self._broker_cache = (now, out)
+        return out
+
+    def leader_for(self, topic_name: str, partition: Partition) -> str:
+        """Partition→broker ownership: the assignment RECORDED at
+        configure time (reference pub_balancer/allocate.go picks brokers
+        and the assignment sticks) as long as that broker is alive;
+        otherwise a deterministic hash over the live ring, so a broker
+        death re-homes exactly its partitions and every broker answers
+        lookups identically."""
+        brokers = self.live_brokers()
+        assigned = self.topic_leaders.get(topic_name, {}).get(
+            partition.range_start)
+        if assigned in brokers:
+            return assigned
+        h = _stable_hash(f"{topic_name}:{partition.range_start}")
+        return brokers[h % len(brokers)]
+
+    def coordinator_for(self, topic_name: str, group: str) -> str:
+        brokers = self.live_brokers()
+        h = _stable_hash(f"{topic_name}/{group}")
+        return brokers[h % len(brokers)]
+
+    def _group_partitions(self, topic_name: str
+                          ) -> list[tuple[Partition, str]]:
+        """Coordinator callback: the topic's partitions with their CURRENT
+        leaders (fed into every rebalance)."""
+        ns, _, name = topic_name.partition(".")
+        parts = self._topic_partitions(TopicRef(ns, name)) or []
+        return [(p, self.leader_for(topic_name, p)) for p in parts]
+
+    def _membership_watch(self) -> None:
+        """React to broker join/death: when the live ring changes, every
+        consumer group rebalances onto the new partition leadership
+        (reference OnPartitionChange / OnSubRemoveBroker)."""
+        while not self._stop.wait(self.membership_poll_s):
+            self._broker_cache = (0.0, self._broker_cache[1])  # force renew
+            live = self.live_brokers()
+            if live == self._last_membership:
+                continue
+            log.info("broker membership %s -> %s", self._last_membership,
+                     live)
+            self._last_membership = live
+            for t in self.coordinator.topic_names():
+                self.coordinator.on_partition_change(t)
+
+    # -- committed offsets ---------------------------------------------------
+    def _offset_path(self, topic_name: str, p: Partition, group: str) -> str:
+        ns, _, name = topic_name.partition(".")
+        return (f"/topics/{ns}/{name}/{p.range_start:04d}-"
+                f"{p.range_stop:04d}/offset.{group}")
+
+    def commit_offset(self, topic_name: str, p: Partition, group: str,
+                      offset: int) -> None:
+        self._offsets[(topic_name, p.range_start, group)] = offset
+        if self.filer is not None:
+            self.filer.write_file(self._offset_path(topic_name, p, group),
+                                  struct.pack("<q", offset),
+                                  mime="application/octet-stream")
+
+    def fetch_offset(self, topic_name: str, p: Partition, group: str) -> int:
+        """Highest committed offset, -1 if the group never committed.
+        Reads through to the filer so a freshly failed-over broker sees
+        commits made via its dead peer."""
+        if self.filer is not None:
+            from ..filer.filer import split_path
+            d, n = split_path(self._offset_path(topic_name, p, group))
+            entry = self.filer.filer.find_entry(d, n)
+            if entry is not None:
+                data = self.filer.read_entry_bytes(entry)
+                if len(data) >= 8:
+                    off = struct.unpack("<q", data[:8])[0]
+                    self._offsets[(topic_name, p.range_start, group)] = off
+                    return off
+            return self._offsets.get((topic_name, p.range_start, group), -1)
+        return self._offsets.get((topic_name, p.range_start, group), -1)
 
     def _flusher(self) -> None:
         while not self._stop.wait(self.flush_interval):
@@ -321,14 +453,31 @@ class BrokerServer:
 
     def configure_topic(self, tref: TopicRef,
                         partition_count: int) -> list[Partition]:
+        """Create (or re-read) a topic. First configuration assigns each
+        partition a leader round-robin over the live ring STARTING at
+        this broker (reference pub_balancer allocates to brokers and the
+        assignment sticks in the topic conf); reconfiguring an existing
+        topic with the same count keeps its assignment."""
+        tname = str(tref)
+        existing = self._topic_partitions(tref)
+        if existing is not None and len(existing) == max(1, partition_count):
+            return existing
         parts = split_ring(max(1, partition_count))
+        ring = self.live_brokers()
+        start = ring.index(self.address) if self.address in ring else 0
+        leaders = {p.range_start: ring[(start + i) % len(ring)]
+                   for i, p in enumerate(parts)}
         with self._lock:
-            self.topics[str(tref)] = parts
+            self.topics[tname] = parts
+            self.topic_leaders[tname] = leaders
         if self.filer is not None:
             import json
             self.filer.write_file(
                 f"/topics/{tref.namespace}/{tref.name}/topic.conf",
-                json.dumps({"partition_count": len(parts)}).encode(),
+                json.dumps({"partition_count": len(parts),
+                            "leaders": {str(k): v
+                                        for k, v in leaders.items()}}
+                           ).encode(),
                 mime="application/json")
         return parts
 
@@ -344,11 +493,13 @@ class BrokerServer:
                 f"/topics/{tref.namespace}/{tref.name}/topic.conf")
             entry = self.filer.filer.find_entry(d, n)
             if entry is not None:
-                cnt = json.loads(
-                    self.filer.read_entry_bytes(entry))["partition_count"]
-                parts = split_ring(cnt)
+                conf = json.loads(self.filer.read_entry_bytes(entry))
+                parts = split_ring(conf["partition_count"])
                 with self._lock:
                     self.topics[str(tref)] = parts
+                    self.topic_leaders[str(tref)] = {
+                        int(k): v
+                        for k, v in conf.get("leaders", {}).items()}
                 return parts
         return None
 
@@ -364,17 +515,22 @@ class BrokerServer:
             return Partition(p.range_start, p.range_stop,
                              p.ring_size or 4096)
 
-        @svc.unary("ConfigureTopic", mq.ConfigureTopicRequest,
-                   mq.ConfigureTopicResponse)
-        def configure(req, ctx):
-            parts = broker.configure_topic(tref_of(req.topic),
-                                           req.partition_count or 1)
-            resp = mq.ConfigureTopicResponse()
+        def fill_assignments(resp, tref: TopicRef, parts: list[Partition]):
+            tname = str(tref)
             for p in parts:
-                a = resp.assignments.add(leader_broker=broker.address)
+                a = resp.assignments.add(
+                    leader_broker=broker.leader_for(tname, p))
                 a.partition.range_start = p.range_start
                 a.partition.range_stop = p.range_stop
                 a.partition.ring_size = p.ring_size
+
+        @svc.unary("ConfigureTopic", mq.ConfigureTopicRequest,
+                   mq.ConfigureTopicResponse)
+        def configure(req, ctx):
+            tref = tref_of(req.topic)
+            parts = broker.configure_topic(tref, req.partition_count or 1)
+            resp = mq.ConfigureTopicResponse()
+            fill_assignments(resp, tref, parts)
             return resp
 
         @svc.unary("LookupTopicBrokers", mq.LookupTopicBrokersRequest,
@@ -386,11 +542,7 @@ class BrokerServer:
                 ctx.abort(5, f"topic {tref} not found")
             resp = mq.LookupTopicBrokersResponse()
             resp.topic.CopyFrom(req.topic)
-            for p in parts:
-                a = resp.assignments.add(leader_broker=broker.address)
-                a.partition.range_start = p.range_start
-                a.partition.range_stop = p.range_stop
-                a.partition.ring_size = p.ring_size
+            fill_assignments(resp, tref, parts)
             return resp
 
         @svc.unary("Ping", mq.PingRequest, mq.PingResponse)
@@ -406,12 +558,35 @@ class BrokerServer:
             deterministic over the ring (broker docstring), so no partition
             hand-off messages are needed."""
             resp = mq.BalanceTopicsResponse()
+            ring = broker.live_brokers()
             with broker._lock:  # one lock span: a concurrent
                 # ConfigureTopic must not be reverted from a stale snapshot
                 for full in sorted(broker.topics):
                     rebuilt = split_ring(len(broker.topics[full]))
                     broker.topics[full] = rebuilt
+                    # heal ONLY dead-leader assignments, with the same
+                    # deterministic fallback leader_for uses — every other
+                    # broker computes the identical answer from its own
+                    # cached conf, so views stay convergent without a
+                    # cross-broker conf push
+                    leaders = dict(broker.topic_leaders.get(full, {}))
+                    healed = False
+                    for p in rebuilt:
+                        if leaders.get(p.range_start) not in ring:
+                            h = _stable_hash(f"{full}:{p.range_start}")
+                            leaders[p.range_start] = ring[h % len(ring)]
+                            healed = True
+                    broker.topic_leaders[full] = leaders
                     ns, _, name = full.partition(".")
+                    if healed and broker.filer is not None:
+                        import json
+                        broker.filer.write_file(
+                            f"/topics/{ns}/{name}/topic.conf",
+                            json.dumps({
+                                "partition_count": len(rebuilt),
+                                "leaders": {str(k): v
+                                            for k, v in leaders.items()},
+                            }).encode(), mime="application/json")
                     a = resp.assignments.add()
                     a.topic.namespace, a.topic.name = ns, name
                     for p in rebuilt:
@@ -449,6 +624,76 @@ class BrokerServer:
                 off = lg.append(bytes(req.data.key),
                                 bytes(req.data.value), ts)
                 yield mq.PublishResponse(ack_sequence=off)
+
+        @svc.unary("FindCoordinator", mq.FindCoordinatorRequest,
+                   mq.FindCoordinatorResponse)
+        def find_coordinator(req, ctx):
+            tname = str(tref_of(req.topic))
+            return mq.FindCoordinatorResponse(
+                coordinator=broker.coordinator_for(tname,
+                                                   req.consumer_group))
+
+        @svc.stream_stream("SubscriberToSubCoordinator",
+                           mq.SubscriberToSubCoordinatorRequest,
+                           mq.SubscriberToSubCoordinatorResponse)
+        def sub_coordinate(request_iter, ctx):
+            """Reference broker_grpc_sub_coordinator.go: member joins with
+            init, holds the stream open, and receives a generation-stamped
+            Assignment after every rebalance; the stream breaking (death
+            or leave) removes the member and triggers a rebalance for the
+            survivors."""
+            first = next(request_iter)
+            group = first.init.consumer_group
+            iid = first.init.consumer_group_instance_id
+            tname = str(tref_of(first.init.topic))
+            inst = broker.coordinator.add_subscriber(group, iid, tname)
+
+            def drain():
+                # consume acks until the client goes away, then unblock
+                # the response loop with a poison pill
+                try:
+                    for _ in request_iter:
+                        pass
+                except Exception:  # noqa: BLE001
+                    pass
+                inst.responses.put(None)
+
+            threading.Thread(target=drain, daemon=True,
+                             name=f"mq-coord-drain-{iid}").start()
+            ctx.add_callback(lambda: inst.responses.put(None))
+            try:
+                while ctx.is_active():
+                    item = inst.responses.get()
+                    if item is None:
+                        return
+                    gen, slots = item
+                    resp = mq.SubscriberToSubCoordinatorResponse()
+                    resp.assignment.generation = gen
+                    for slot in slots:
+                        pa = resp.assignment.partition_assignments.add(
+                            leader_broker=slot.broker)
+                        pa.partition.range_start = slot.range_start
+                        pa.partition.range_stop = slot.range_stop
+                        pa.partition.ring_size = slot.ring_size
+                    yield resp
+            finally:
+                broker.coordinator.remove_subscriber(group, iid, tname)
+
+        @svc.unary("CommitOffset", mq.CommitOffsetRequest,
+                   mq.CommitOffsetResponse)
+        def commit_offset(req, ctx):
+            broker.commit_offset(str(tref_of(req.topic)),
+                                 part_of(req.partition),
+                                 req.consumer_group, req.offset)
+            return mq.CommitOffsetResponse()
+
+        @svc.unary("FetchOffset", mq.FetchOffsetRequest,
+                   mq.FetchOffsetResponse)
+        def fetch_offset(req, ctx):
+            off = broker.fetch_offset(str(tref_of(req.topic)),
+                                      part_of(req.partition),
+                                      req.consumer_group)
+            return mq.FetchOffsetResponse(offset=off, found=off >= 0)
 
         @svc.unary_stream("Subscribe", mq.SubscribeRequest,
                           mq.SubscribeResponse)

@@ -13,9 +13,17 @@ from .topic import Partition, TopicRef, partition_for_key, split_ring
 
 
 class Publisher:
-    def __init__(self, broker_address: str, namespace: str, topic: str,
-                 partition_count: int = 1):
-        self.stub = Stub(broker_address, MQ_SERVICE)
+    """Leader-aware publisher: each partition's Publish stream dials the
+    broker that LookupTopicBrokers names as its leader, and a dead leader
+    (broker crash) is survived by re-looking-up on the remaining seed
+    brokers and re-sending the unacked message (reference
+    pub_client/publish.go re-dials the same way)."""
+
+    def __init__(self, broker_address: "str | list[str]", namespace: str,
+                 topic: str, partition_count: int = 1):
+        self.seeds = ([broker_address] if isinstance(broker_address, str)
+                      else list(broker_address))
+        self.stub = Stub(self.seeds[0], MQ_SERVICE)
         self.tref = TopicRef(namespace, topic)
         resp = self.stub.call("ConfigureTopic", _configure_req(
             self.tref, partition_count), mq.ConfigureTopicResponse)
@@ -23,8 +31,28 @@ class Publisher:
                                      a.partition.range_stop,
                                      a.partition.ring_size)
                            for a in resp.assignments]
+        self._leaders = {a.partition.range_start: a.leader_broker
+                         for a in resp.assignments}
         self._queues: dict[int, queue.Queue] = {}
         self._streams: dict[int, object] = {}
+
+    def _refresh_leaders(self) -> None:
+        for addr in self.seeds:
+            try:
+                resp = Stub(addr, MQ_SERVICE).call(
+                    "LookupTopicBrokers", _lookup_req(self.tref),
+                    mq.LookupTopicBrokersResponse, timeout=2)
+                self._leaders = {a.partition.range_start: a.leader_broker
+                                 for a in resp.assignments}
+                return
+            except Exception:  # noqa: BLE001
+                continue
+
+    def _drop_stream(self, p: Partition) -> None:
+        q = self._queues.pop(p.range_start, None)
+        if q is not None:
+            q.put(None)
+        self._streams.pop(p.range_start, None)
 
     def _stream_for(self, p: Partition):
         if p.range_start in self._streams:
@@ -46,25 +74,38 @@ class Publisher:
                     return
                 yield item
 
-        stream = self.stub.stream_stream("Publish", reqs(),
-                                         mq.PublishRequest,
-                                         mq.PublishResponse)
+        leader = self._leaders.get(p.range_start, self.seeds[0])
+        stream = Stub(leader, MQ_SERVICE).stream_stream(
+            "Publish", reqs(), mq.PublishRequest, mq.PublishResponse)
         self._queues[p.range_start] = q
         self._streams[p.range_start] = iter(stream)
         return q, self._streams[p.range_start]
 
-    def publish(self, key: bytes, value: bytes) -> int:
-        """Send one message; returns the acked partition offset."""
+    def publish(self, key: bytes, value: bytes, retries: int = 8) -> int:
+        """Send one message; returns the acked partition offset. A broken
+        stream re-resolves the partition leader and re-sends. Semantics
+        are AT-LEAST-ONCE (same as the reference's re-dial): if the
+        leader appended the message but died before the ack arrived, the
+        retry appends it again on the survivor."""
         p = partition_for_key(key, self.partitions)
-        q, stream = self._stream_for(p)
         req = mq.PublishRequest()
         req.data.key, req.data.value = key, value
         req.data.ts_ns = time.time_ns()
-        q.put(req)
-        ack = next(stream)
-        if ack.error:
-            raise RuntimeError(ack.error)
-        return ack.ack_sequence
+        last_err: Exception | None = None
+        for attempt in range(retries):
+            try:
+                q, stream = self._stream_for(p)
+                q.put(req)
+                ack = next(stream)
+                if ack.error:
+                    raise RuntimeError(ack.error)
+                return ack.ack_sequence
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                self._drop_stream(p)
+                time.sleep(min(0.2 * (attempt + 1), 1.0))
+                self._refresh_leaders()
+        raise RuntimeError(f"publish to {p} failed: {last_err}")
 
     def close(self) -> None:
         for q in self._queues.values():

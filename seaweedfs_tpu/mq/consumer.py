@@ -1,0 +1,294 @@
+"""Consumer-group client (reference weed/mq/client/sub_client/: the
+subscriber session holds a SubscriberToSubCoordinator stream for
+assignments and one Subscribe stream per assigned partition).
+
+Lifecycle: FindCoordinator on any live broker -> join the coordination
+stream -> each Assignment (re)spawns partition workers. A worker fetches
+the group's committed offset, subscribes from offset+1 on the partition
+leader, and funnels records into one poll() queue. Any stream death —
+coordinator or partition — re-resolves against the surviving brokers and
+resumes from committed offsets, so a broker crash costs redelivery of at
+most the uncommitted window (at-least-once; commit-per-record gives
+effectively-once for side-effect-free processing).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from ..pb import mq_pb2 as mq
+from ..utils.log import logger
+from ..utils.rpc import Stub
+from .broker import MQ_SERVICE
+from .topic import Partition, TopicRef
+
+log = logger("mq.consumer")
+
+
+@dataclass(frozen=True)
+class ConsumerRecord:
+    partition: Partition
+    leader: str  # broker serving the partition when this was read
+    offset: int
+    key: bytes
+    value: bytes
+    ts_ns: int
+
+
+class GroupConsumer:
+    """One consumer-group member."""
+
+    def __init__(self, brokers: list[str] | str, namespace: str, topic: str,
+                 group: str, instance_id: str,
+                 retry_interval_s: float = 0.2):
+        self.seeds = ([brokers] if isinstance(brokers, str)
+                      else list(brokers))
+        self.tref = TopicRef(namespace, topic)
+        self.group = group
+        self.instance_id = instance_id
+        self.retry = retry_interval_s
+        self.records: "queue.Queue[ConsumerRecord]" = queue.Queue()
+        self.generation = 0
+        self.assigned: dict[int, tuple[Partition, str]] = {}
+        self._workers: dict[int, threading.Event] = {}  # range_start -> stop
+        # highest offset ALREADY put on the records queue, per partition:
+        # a worker restart (stream death, leader failover) resumes from the
+        # committed offset, and this watermark drops the redelivered slice
+        # this member has already seen — exactly-once delivery within one
+        # member; cross-member handoff remains at-least-once past the
+        # committed offset (same contract as the reference)
+        self._delivered: dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._assigned_once = threading.Event()
+        self._thread = threading.Thread(target=self._session, daemon=True,
+                                        name=f"mq-consumer-{instance_id}")
+        self._thread.start()
+
+    # -- public --------------------------------------------------------------
+    def poll(self, timeout: float = 5.0) -> ConsumerRecord | None:
+        try:
+            return self.records.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def commit(self, rec: ConsumerRecord) -> None:
+        """Persist rec.offset as processed; resume after failure happens
+        at rec.offset + 1. Tries the record's leader first, then any
+        live broker (offsets live in the shared filer)."""
+        req = mq.CommitOffsetRequest(consumer_group=self.group,
+                                     offset=rec.offset)
+        req.topic.namespace = self.tref.namespace
+        req.topic.name = self.tref.name
+        req.partition.range_start = rec.partition.range_start
+        req.partition.range_stop = rec.partition.range_stop
+        req.partition.ring_size = rec.partition.ring_size
+        for addr in [rec.leader, *self.seeds]:
+            try:
+                Stub(addr, MQ_SERVICE).call("CommitOffset", req,
+                                            mq.CommitOffsetResponse,
+                                            timeout=5)
+                return
+            except Exception:  # noqa: BLE001
+                continue
+        raise RuntimeError(f"commit offset {rec.offset} failed on all brokers")
+
+    def wait_assigned(self, timeout: float = 10.0) -> bool:
+        return self._assigned_once.wait(timeout)
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._lock:
+            for ev in self._workers.values():
+                ev.set()
+
+    # -- coordinator session -------------------------------------------------
+    def _find_coordinator(self) -> str | None:
+        req = mq.FindCoordinatorRequest(consumer_group=self.group)
+        req.topic.namespace = self.tref.namespace
+        req.topic.name = self.tref.name
+        for addr in self.seeds:
+            try:
+                resp = Stub(addr, MQ_SERVICE).call(
+                    "FindCoordinator", req, mq.FindCoordinatorResponse,
+                    timeout=2)
+                if resp.coordinator:
+                    return resp.coordinator
+            except Exception:  # noqa: BLE001
+                continue
+        return None
+
+    def _session(self) -> None:
+        while not self._closed.is_set():
+            coord = self._find_coordinator()
+            if coord is None:
+                self._closed.wait(self.retry)
+                continue
+            try:
+                self._run_coordination(coord)
+            except Exception as e:  # noqa: BLE001
+                if not self._closed.is_set():
+                    log.info("%s: coordinator %s lost (%s); rejoining",
+                             self.instance_id, coord, e)
+            self._closed.wait(self.retry)
+        # shutdown: stop all workers
+        self._apply_assignment(self.generation + 1, [])
+
+    def _run_coordination(self, coord: str) -> None:
+        # a fresh coordinator (failover) starts its generations over at 1:
+        # reset ours so its first assignment isn't dropped as stale
+        with self._lock:
+            self.generation = 0
+        stub = Stub(coord, MQ_SERVICE)
+
+        def reqs():
+            init = mq.SubscriberToSubCoordinatorRequest()
+            init.init.consumer_group = self.group
+            init.init.consumer_group_instance_id = self.instance_id
+            init.init.topic.namespace = self.tref.namespace
+            init.init.topic.name = self.tref.name
+            yield init
+            while not self._closed.wait(0.5):
+                pass  # stream held open; half-close on close()
+
+        stream = stub.stream_stream(
+            "SubscriberToSubCoordinator", reqs(),
+            mq.SubscriberToSubCoordinatorRequest,
+            mq.SubscriberToSubCoordinatorResponse)
+        for resp in stream:
+            if self._closed.is_set():
+                stream.cancel()
+                return
+            a = resp.assignment
+            slots = [(Partition(pa.partition.range_start,
+                                pa.partition.range_stop,
+                                pa.partition.ring_size or 4096),
+                      pa.leader_broker)
+                     for pa in a.partition_assignments]
+            self._apply_assignment(a.generation, slots)
+            self._assigned_once.set()
+
+    def _apply_assignment(self, generation: int,
+                          slots: list[tuple[Partition, str]]) -> None:
+        """Diff against current workers: stop revoked partitions, spawn
+        newly assigned ones. A re-assigned partition with a NEW leader is
+        restarted so it follows the failover."""
+        with self._lock:
+            if 0 < generation <= self.generation:
+                return  # stale assignment from a lagging coordinator
+            self.generation = generation
+            want = {p.range_start: (p, leader) for p, leader in slots}
+            for rs in list(self._workers):
+                if rs not in want or self.assigned.get(rs) != want[rs]:
+                    self._workers.pop(rs).set()
+                    self.assigned.pop(rs, None)
+            for rs, (p, leader) in want.items():
+                if rs in self._workers:
+                    continue
+                stop = threading.Event()
+                self._workers[rs] = stop
+                self.assigned[rs] = (p, leader)
+                threading.Thread(
+                    target=self._consume_partition,
+                    args=(p, leader, stop), daemon=True,
+                    name=f"mq-part-{self.instance_id}-{rs}").start()
+
+    # -- partition worker ----------------------------------------------------
+    def _fetch_offset(self, p: Partition, leader: str) -> int:
+        req = mq.FetchOffsetRequest(consumer_group=self.group)
+        req.topic.namespace = self.tref.namespace
+        req.topic.name = self.tref.name
+        req.partition.range_start = p.range_start
+        req.partition.range_stop = p.range_stop
+        req.partition.ring_size = p.ring_size
+        for addr in [leader, *self.seeds]:
+            try:
+                resp = Stub(addr, MQ_SERVICE).call(
+                    "FetchOffset", req, mq.FetchOffsetResponse, timeout=5)
+                return resp.offset if resp.found else -1
+            except Exception:  # noqa: BLE001
+                continue
+        return -1
+
+    def _lookup_leader(self, p: Partition) -> str | None:
+        req = mq.LookupTopicBrokersRequest()
+        req.topic.namespace = self.tref.namespace
+        req.topic.name = self.tref.name
+        for addr in self.seeds:
+            try:
+                resp = Stub(addr, MQ_SERVICE).call(
+                    "LookupTopicBrokers", req,
+                    mq.LookupTopicBrokersResponse, timeout=2)
+                for a in resp.assignments:
+                    if a.partition.range_start == p.range_start:
+                        return a.leader_broker
+            except Exception:  # noqa: BLE001
+                continue
+        return None
+
+    def _consume_partition(self, p: Partition, leader: str,
+                           stop: threading.Event) -> None:
+        while not stop.is_set() and not self._closed.is_set():
+            start = self._fetch_offset(p, leader) + 1
+            req = mq.SubscribeRequest()
+            req.init.topic.namespace = self.tref.namespace
+            req.init.topic.name = self.tref.name
+            req.init.partition.range_start = p.range_start
+            req.init.partition.range_stop = p.range_stop
+            req.init.partition.ring_size = p.ring_size
+            req.init.consumer_group = self.group
+            req.init.consumer_id = self.instance_id
+            req.init.start_offset = start
+            req.init.follow = True
+            try:
+                stream = Stub(leader, MQ_SERVICE).call_stream(
+                    "Subscribe", req, mq.SubscribeResponse, timeout=3600)
+                for resp in stream:
+                    if stop.is_set() or self._closed.is_set():
+                        stream.cancel()
+                        return
+                    if resp.is_end_of_stream:
+                        break
+                    if resp.offset <= self._delivered.get(p.range_start, -1):
+                        continue  # redelivery of an already-queued record
+                    self._delivered[p.range_start] = resp.offset
+                    self.records.put(ConsumerRecord(
+                        p, leader, resp.offset, bytes(resp.data.key),
+                        bytes(resp.data.value), resp.data.ts_ns))
+            except Exception as e:  # noqa: BLE001
+                if stop.is_set() or self._closed.is_set():
+                    return
+                log.info("%s: partition %s stream on %s died (%s)",
+                         self.instance_id, p, leader, e)
+            if stop.wait(self.retry):
+                return
+            # leader may have moved (broker death): re-resolve before
+            # the next attempt; the coordinator will also push a fresh
+            # assignment, which restarts this worker via _apply_assignment
+            leader = self._lookup_leader(p) or leader
+
+
+def group_consume(brokers, namespace: str, topic: str, group: str,
+                  instance_id: str, count: int,
+                  timeout: float = 30.0,
+                  commit_each: bool = True) -> list[ConsumerRecord]:
+    """Convenience: consume exactly `count` records as one group member,
+    committing after each (test harness + CLI verb helper)."""
+    c = GroupConsumer(brokers, namespace, topic, group, instance_id)
+    out: list[ConsumerRecord] = []
+    deadline = time.monotonic() + timeout
+    try:
+        while len(out) < count and time.monotonic() < deadline:
+            rec = c.poll(timeout=max(0.05,
+                                     min(1.0, deadline - time.monotonic())))
+            if rec is None:
+                continue
+            out.append(rec)
+            if commit_each:
+                c.commit(rec)
+    finally:
+        c.close()
+    return out

@@ -1,0 +1,324 @@
+"""Consumer-group coordination + schema layer (reference
+weed/mq/sub_coordinator/: coordinator.go, consumer_group.go,
+partition_consumer_mapping.go; weed/mq/schema/: schema.go,
+struct_to_schema.go).
+
+The failover test is the round-4 verdict's done-criterion: a multi-broker
+cluster loses a broker mid-stream and the consumer group rebalances and
+resumes from committed offsets with no loss and no duplication.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.mq.sub_coordinator import PartitionSlot, balance_sticky
+
+
+def _fp():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _slots(n, broker="b1"):
+    step = 4096 // n
+    return [PartitionSlot(i * step, 4096 if i == n - 1 else (i + 1) * step,
+                          4096, broker) for i in range(n)]
+
+
+class TestStickyBalance:
+    """partition_consumer_mapping_test.go semantics + the steal pass."""
+
+    def test_initial_even_split(self):
+        out = balance_sticky(_slots(4), ["c1", "c2"], None)
+        loads = {}
+        for s in out:
+            assert s.assigned_instance_id in ("c1", "c2")
+            loads[s.assigned_instance_id] = \
+                loads.get(s.assigned_instance_id, 0) + 1
+        assert loads == {"c1": 2, "c2": 2}
+
+    def test_member_loss_is_sticky_for_survivors(self):
+        prev = balance_sticky(_slots(4), ["c1", "c2"], None)
+        kept = {(s.range_start): s.assigned_instance_id for s in prev
+                if s.assigned_instance_id == "c1"}
+        out = balance_sticky(_slots(4), ["c1"], prev)
+        # c1 keeps exactly the partitions it had; c2's are re-homed to it
+        for s in out:
+            assert s.assigned_instance_id == "c1"
+        for rs, who in kept.items():
+            assert next(s for s in out
+                        if s.range_start == rs).assigned_instance_id == who
+
+    def test_member_add_steals_minimally(self):
+        prev = balance_sticky(_slots(4), ["c1", "c2"], None)
+        out = balance_sticky(_slots(4), ["c1", "c2", "c3"], prev)
+        loads = {}
+        moved = 0
+        prev_by_rs = {s.range_start: s.assigned_instance_id for s in prev}
+        for s in out:
+            loads[s.assigned_instance_id] = \
+                loads.get(s.assigned_instance_id, 0) + 1
+            if prev_by_rs[s.range_start] != s.assigned_instance_id:
+                moved += 1
+        assert sorted(loads.values()) == [1, 1, 2]  # balanced to ±1
+        assert moved == 1  # minimal movement (reference leaves c3 idle)
+
+    def test_more_members_than_partitions(self):
+        out = balance_sticky(_slots(2), ["c1", "c2", "c3"], None)
+        assigned = [s.assigned_instance_id for s in out]
+        assert all(assigned)
+        assert len(set(assigned)) == 2  # one member idle, no double-assign
+
+    def test_empty_inputs(self):
+        assert balance_sticky([], ["c1"], None) == []
+        assert balance_sticky(_slots(2), [], None) == []
+
+
+@pytest.fixture()
+def two_brokers(tmp_path):
+    """Master + filer + TWO brokers sharing the filer (segments and
+    committed offsets live there, so either broker can take over any
+    partition)."""
+    from conftest import wait_cluster_up
+
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.mq import BrokerServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    mport, vport, fport = _fp(), _fp(), _fp()
+    ms = MasterServer(port=mport, volume_size_limit_mb=64, pulse_seconds=0.5)
+    ms.start()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path / "v"), max_volume_count=8)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=_fp(),
+                      pulse_seconds=0.5)
+    vs.start()
+    wait_cluster_up(ms, [vs])
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=_fp(), chunk_size_mb=1)
+    fs.start()
+    # hold both sockets open while allocating so the two brokers can't
+    # land on the same ephemeral port
+    s1, s2 = socket.socket(), socket.socket()
+    s1.bind(("127.0.0.1", 0))
+    s2.bind(("127.0.0.1", 0))
+    bports = [s.getsockname()[1] for s in (s1, s2)]
+    s1.close()
+    s2.close()
+    brokers = [BrokerServer(ms.address, port=p, filer_server=fs,
+                            rebalance_delay_s=0.2) for p in bports]
+    for b in brokers:
+        b.membership_poll_s = 0.2
+        b.start()
+    # both brokers registered before any leadership decisions
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all(len(b.live_brokers()) == 2 for b in brokers):
+            break
+        brokers[0]._broker_cache = (0.0, [])
+        brokers[1]._broker_cache = (0.0, [])
+        time.sleep(0.1)
+    assert all(len(b.live_brokers()) == 2 for b in brokers)
+    yield {"ms": ms, "fs": fs, "brokers": brokers}
+    for b in brokers:
+        if not b._stop.is_set():
+            b.stop()
+    fs.stop()
+    vs.stop()
+    ms.stop()
+
+
+def _drain(consumers, want: int, commit: bool = True, timeout: float = 30.0,
+           seen=None):
+    """Round-robin poll members until `want` NEW (partition, offset) pairs
+    arrive; returns {(range_start, offset): value}."""
+    got = {}
+    seen = seen if seen is not None else set()
+    deadline = time.monotonic() + timeout
+    while len(got) < want and time.monotonic() < deadline:
+        for c in consumers:
+            rec = c.poll(timeout=0.2)
+            if rec is None:
+                continue
+            key = (rec.partition.range_start, rec.offset)
+            assert key not in seen, f"duplicate delivery {key}"
+            seen.add(key)
+            got[key] = rec.value
+            if commit:
+                c.commit(rec)
+    return got
+
+
+class TestGroupConsume:
+    def test_two_members_split_partitions_and_rebalance(self, two_brokers):
+        from seaweedfs_tpu.mq.client import Publisher
+        from seaweedfs_tpu.mq.consumer import GroupConsumer
+
+        addrs = [b.address for b in two_brokers["brokers"]]
+        pub = Publisher(addrs, "grp", "orders", partition_count=4)
+        for i in range(40):
+            pub.publish(f"k{i}".encode(), f"v{i}".encode())
+
+        c1 = GroupConsumer(addrs, "grp", "orders", "workers", "w1")
+        c2 = GroupConsumer(addrs, "grp", "orders", "workers", "w2")
+        assert c1.wait_assigned(10) and c2.wait_assigned(10)
+        # coordination settles: 4 partitions split 2/2
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (
+                len(c1.assigned) == len(c2.assigned) == 2):
+            time.sleep(0.1)
+        assert len(c1.assigned) == 2 and len(c2.assigned) == 2
+        # the two members cover all four partitions with no overlap
+        assert set(c1.assigned).isdisjoint(c2.assigned)
+        assert len(set(c1.assigned) | set(c2.assigned)) == 4
+
+        seen = set()
+        got = _drain([c1, c2], 40, seen=seen)
+        assert sorted(got.values()) == sorted(
+            f"v{i}".encode() for i in range(40))
+
+        # member leaves -> survivor owns all 4 and keeps consuming
+        c2.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(c1.assigned) != 4:
+            time.sleep(0.1)
+        assert len(c1.assigned) == 4
+        for i in range(40, 60):
+            pub.publish(f"k{i}".encode(), f"v{i}".encode())
+        got2 = _drain([c1], 20, seen=seen)
+        assert sorted(got2.values()) == sorted(
+            f"v{i}".encode() for i in range(40, 60))
+        pub.close()
+        c1.close()
+
+    def test_broker_killed_mid_stream_group_resumes(self, two_brokers):
+        """The verdict's done-criterion. Kill (not stop) one broker while
+        a group is consuming: partition leadership re-homes onto the
+        survivor, the coordinator re-forms there, and consumption resumes
+        from committed offsets — zero loss (all published values arrive)
+        and zero duplication (asserted per (partition, offset) and per
+        value)."""
+        from seaweedfs_tpu.mq.client import Publisher
+        from seaweedfs_tpu.mq.consumer import GroupConsumer
+
+        b1, b2 = two_brokers["brokers"]
+        addrs = [b1.address, b2.address]
+        pub = Publisher(addrs, "grp", "events", partition_count=4)
+        for i in range(100):
+            pub.publish(f"k{i}".encode(), f"v{i}".encode())
+        # deterministic crash boundary: everything acked so far is on the
+        # shared filer (a real crash loses at most flush_interval's tail)
+        for b in (b1, b2):
+            for lg in list(b.logs.values()):
+                lg.flush_tail()
+
+        c1 = GroupConsumer(addrs, "grp", "events", "readers", "r1")
+        c2 = GroupConsumer(addrs, "grp", "events", "readers", "r2")
+        assert c1.wait_assigned(10) and c2.wait_assigned(10)
+        seen = set()
+        got = _drain([c1, c2], 60, seen=seen)  # partial consumption...
+        b1.kill()  # ...then the crash
+        got.update(_drain([c1, c2], 40, seen=seen))
+        assert sorted(got.values()) == sorted(
+            f"v{i}".encode() for i in range(100))
+
+        # the survivor keeps serving new publishes to re-homed partitions
+        for i in range(100, 150):
+            pub.publish(f"k{i}".encode(), f"v{i}".encode())
+        got3 = _drain([c1, c2], 50, seen=seen)
+        assert sorted(got3.values()) == sorted(
+            f"v{i}".encode() for i in range(100, 150))
+        # every partition's leader is now the survivor
+        for p, leader in b2._group_partitions("grp.events"):
+            assert leader == b2.address
+        pub.close()
+        c1.close()
+        c2.close()
+
+
+class TestSchema:
+    def test_infer_encode_decode_roundtrip(self):
+        from seaweedfs_tpu.mq.schema import Schema
+
+        rec = {"user": "ada", "score": 3.5, "visits": 7,
+               "tags": ["a", "b"], "blob": b"\x00\x01",
+               "meta": {"ok": True, "rank": 2}}
+        s = Schema.infer(rec)
+        out = s.decode(s.encode(rec))
+        assert out == rec
+
+    def test_schema_bytes_roundtrip_and_validation(self):
+        from seaweedfs_tpu.mq.schema import Schema
+
+        s = Schema.infer({"a": 1, "b": "x"})
+        s2 = Schema.from_bytes(s.schema_bytes())
+        assert s2.record_type == s.record_type
+        with pytest.raises(KeyError):
+            s2.encode({"a": 1})  # missing field
+        with pytest.raises(KeyError):
+            s2.encode({"a": 1, "b": "x", "c": 9})  # extra field
+
+    def test_builder_matches_inference(self):
+        from seaweedfs_tpu.mq.schema import (Schema, TypeInt32, TypeString,
+                                             record_type_begin)
+
+        built = (record_type_begin()
+                 .with_field("a", TypeInt32)
+                 .with_field("b", TypeString)
+                 .build())
+        assert built == Schema.infer({"a": 1, "b": "x"}).record_type
+
+    def test_dataclass_inference(self):
+        import dataclasses
+
+        from seaweedfs_tpu.mq.schema import Schema
+
+        @dataclasses.dataclass
+        class Event:
+            name: str
+            count: int
+
+        e = Event("boot", 3)
+        s = Schema.infer(e)
+        assert s.decode(s.encode(e)) == {"name": "boot", "count": 3}
+
+    def test_columnar_roundtrip(self):
+        import numpy as np
+
+        from seaweedfs_tpu.mq.schema import Schema
+
+        recs = [{"t": float(i), "n": i, "pos": {"x": i * 2, "y": i * 3},
+                 "samples": [i, i + 1]} for i in range(5)]
+        s = Schema.infer(recs[0])
+        cols = s.to_columnar(recs)
+        # nested record flattens to dotted parquet-style column paths
+        assert set(cols) >= {"t", "n", "pos.x", "pos.y",
+                             "samples.offsets", "samples.values"}
+        assert cols["t"].dtype == np.float64
+        assert cols["samples.offsets"].tolist() == [0, 2, 4, 6, 8, 10]
+        back = s.from_columnar(cols)
+        assert back == recs
+
+    def test_schema_over_the_wire(self, two_brokers):
+        """Typed records ride DataMessage.value as RecordValue bytes; the
+        subscriber decodes with the shared schema."""
+        from seaweedfs_tpu.mq.client import Publisher, subscribe
+        from seaweedfs_tpu.mq.schema import Schema
+
+        addrs = [b.address for b in two_brokers["brokers"]]
+        s = Schema.infer({"name": "x", "qty": 1})
+        pub = Publisher(addrs, "typed", "stock")
+        for i in range(5):
+            pub.publish(b"k", s.encode({"name": f"it{i}", "qty": i}))
+        pub.close()
+        lead = pub._leaders.get(0, addrs[0])
+        got = [s.decode(v) for _, _, v in
+               subscribe(lead, "typed", "stock", start_offset=0)]
+        assert got == [{"name": f"it{i}", "qty": i} for i in range(5)]
