@@ -271,10 +271,11 @@ class _FilerFacade:
             raise IOError(resp.error)
 
     def update_entry(self, directory: str, entry: fpb.Entry,
-                     **_kw) -> None:
+                     touch_mtime: bool = True, **_kw) -> None:
         self.fc.stub.call("UpdateEntry",
                           fpb.UpdateEntryRequest(directory=directory,
-                                                 entry=entry),
+                                                 entry=entry,
+                                                 keep_mtime=not touch_mtime),
                           fpb.UpdateEntryResponse)
 
     def list_entries(self, directory: str, start_from: str = "",
@@ -297,6 +298,21 @@ class _FilerFacade:
                               new_directory=new_dir,
                               new_name=new_name or old_name),
                           fpb.AtomicRenameEntryResponse)
+
+    def link(self, old_dir: str, old_name: str, new_dir: str,
+             new_name: str) -> None:
+        resp = self.fc.stub.call("LinkEntry",
+                                 fpb.LinkEntryRequest(
+                                     old_directory=old_dir,
+                                     old_name=old_name,
+                                     new_directory=new_dir,
+                                     new_name=new_name),
+                                 fpb.LinkEntryResponse)
+        if resp.error:
+            tag, _, msg = resp.error.partition(":")
+            exc = {"EISDIR": IsADirectoryError,
+                   "EEXIST": FileExistsError}.get(tag, FileNotFoundError)
+            raise exc(msg or resp.error)
 
     def delete_entry(self, directory: str, name: str,
                      is_delete_data: bool = True,

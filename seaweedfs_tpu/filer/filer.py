@@ -102,11 +102,15 @@ class Filer:
     def update_entry(self, directory: str, entry: fpb.Entry,
                      from_other_cluster: bool = False,
                      signatures: list[int] | None = None,
-                     gc_chunks: bool = True) -> None:
+                     gc_chunks: bool = True,
+                     touch_mtime: bool = True) -> None:
+        """touch_mtime=False is for metadata-only updates (xattr, chmod):
+        POSIX says those change ctime, not mtime."""
         old = self.store.find_entry(directory, entry.name)
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
-        entry.attributes.mtime = int(time.time())
+        if touch_mtime:
+            entry.attributes.mtime = int(time.time())
         if old.hard_link_id:
             # write-through: EVERY link sees the new content; the counter
             # stays authoritative in the shared record
@@ -214,13 +218,23 @@ class Filer:
                 raise FileNotFoundError(join_path(old_dir, old_name))
             if src.is_directory:
                 raise IsADirectoryError(join_path(old_dir, old_name))
+            if self.store.find_entry(new_dir, new_name) is not None:
+                # never clobber: an overwrite here would orphan the old
+                # entry's chunks (and strand a hardlink set's counter)
+                raise FileExistsError(join_path(new_dir, new_name))
             if not src.hard_link_id:
                 # first link: move the metadata into the shared record
+                src_before = fpb.Entry()
+                src_before.CopyFrom(src)
                 src.hard_link_id = _os.urandom(16)
                 src.hard_link_counter = 1
                 self.store.kv_put(self._hardlink_key(src.hard_link_id),
                                   src.SerializeToString())
                 self.store.update_entry(old_dir, src)
+                # announce the source's mutation: peer mounts must learn
+                # it became a hardlink pointer or their caches serve the
+                # pre-link record forever
+                self._notify(old_dir, src_before, src)
             meta = fpb.Entry()
             meta.ParseFromString(
                 self.store.kv_get(self._hardlink_key(src.hard_link_id)))

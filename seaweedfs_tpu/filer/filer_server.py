@@ -458,7 +458,8 @@ class FilerServer:
                    fpb.UpdateEntryResponse)
         def update(req, ctx):
             f.update_entry(req.directory, req.entry,
-                           from_other_cluster=req.is_from_other_cluster)
+                           from_other_cluster=req.is_from_other_cluster,
+                           touch_mtime=not req.keep_mtime)
             return fpb.UpdateEntryResponse()
 
         @svc.unary("AppendToEntry", fpb.AppendToEntryRequest,
@@ -487,6 +488,21 @@ class FilerServer:
             f.rename(req.old_directory, req.old_name,
                      req.new_directory, req.new_name)
             return fpb.AtomicRenameEntryResponse()
+
+        @svc.unary("LinkEntry", fpb.LinkEntryRequest, fpb.LinkEntryResponse)
+        def link(req, ctx):
+            # errno-tagged error strings so the remote client can surface
+            # the right POSIX error instead of collapsing all to ENOENT
+            try:
+                f.link(req.old_directory, req.old_name,
+                       req.new_directory, req.new_name)
+                return fpb.LinkEntryResponse()
+            except FileNotFoundError as e:
+                return fpb.LinkEntryResponse(error=f"ENOENT:{e}")
+            except IsADirectoryError as e:
+                return fpb.LinkEntryResponse(error=f"EISDIR:{e}")
+            except FileExistsError as e:
+                return fpb.LinkEntryResponse(error=f"EEXIST:{e}")
 
         @svc.unary("AssignVolume", fpb.AssignVolumeRequest,
                    fpb.AssignVolumeResponse)

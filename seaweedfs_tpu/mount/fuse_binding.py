@@ -84,8 +84,9 @@ fuse_fill_dir_t = ctypes.CFUNCTYPE(
 
 _GETATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
                             ctypes.POINTER(c_stat))
+# buf is c_void_p: a c_char_p arg would arrive as an immutable bytes copy
 _READLINK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
-                             ctypes.c_char_p, ctypes.c_size_t)
+                             ctypes.c_void_p, ctypes.c_size_t)
 _GETDIR = ctypes.c_void_p
 _MKNOD = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
                           ctypes.c_uint64)
@@ -127,6 +128,16 @@ _FGETATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
                              ctypes.POINTER(c_stat), fuse_file_info_p)
 _UTIMENS = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
                             ctypes.POINTER(c_timespec * 2))
+# xattr family (libfuse 2.9 signatures; value buffers as c_void_p so the
+# get/list destinations stay writable)
+_SETXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int)
+_GETXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                             ctypes.c_void_p, ctypes.c_size_t)
+_LISTXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_void_p, ctypes.c_size_t)
+_REMOVEXATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_char_p)
 
 
 class fuse_operations(ctypes.Structure):
@@ -153,10 +164,10 @@ class fuse_operations(ctypes.Structure):
         ("flush", _FLUSH),
         ("release", _RELEASE),
         ("fsync", _FSYNC),
-        ("setxattr", ctypes.c_void_p),
-        ("getxattr", ctypes.c_void_p),
-        ("listxattr", ctypes.c_void_p),
-        ("removexattr", ctypes.c_void_p),
+        ("setxattr", _SETXATTR),
+        ("getxattr", _GETXATTR),
+        ("listxattr", _LISTXATTR),
+        ("removexattr", _REMOVEXATTR),
         ("opendir", ctypes.c_void_p),
         ("readdir", _READDIR),
         ("releasedir", ctypes.c_void_p),
@@ -312,6 +323,52 @@ def fuse_loop(handlers, mountpoint: str, fsname: str = "swtpu",
         handlers.getattr(path.decode())  # existence check
 
     @guard
+    def op_symlink(target, linkpath):
+        handlers.symlink(target.decode(), linkpath.decode())
+
+    @guard
+    def op_readlink(path, buf, size):
+        target = handlers.readlink(path.decode()).encode()
+        # NUL-terminated, truncated to the kernel's buffer
+        data = target[:max(0, size - 1)] + b"\x00"
+        ctypes.memmove(buf, data, len(data))
+
+    @guard
+    def op_link(old, new):
+        handlers.link(old.decode(), new.decode())
+
+    @guard
+    def op_setxattr(path, name, value, size, flags):
+        data = ctypes.string_at(value, size) if size else b""
+        handlers.setxattr(path.decode(), name.decode(), data, flags)
+
+    @guard
+    def op_getxattr(path, name, buf, size):
+        data = handlers.getxattr(path.decode(), name.decode())
+        if size == 0:
+            return len(data)  # size probe
+        if len(data) > size:
+            return -errno_mod.ERANGE
+        ctypes.memmove(buf, data, len(data))
+        return len(data)
+
+    @guard
+    def op_listxattr(path, buf, size):
+        names = handlers.listxattr(path.decode())
+        blob = b"".join(n.encode() + b"\x00" for n in names)
+        if size == 0:
+            return len(blob)
+        if len(blob) > size:
+            return -errno_mod.ERANGE
+        if blob:
+            ctypes.memmove(buf, blob, len(blob))
+        return len(blob)
+
+    @guard
+    def op_removexattr(path, name):
+        handlers.removexattr(path.decode(), name.decode())
+
+    @guard
     def op_chmod(path, mode):
         pass  # permissions are advisory in the filer model
 
@@ -341,6 +398,13 @@ def fuse_loop(handlers, mountpoint: str, fsname: str = "swtpu",
     ops.release = _RELEASE(op_release)
     ops.fsync = _FSYNC(op_fsync)
     ops.statfs = _STATFS(op_statfs)
+    ops.symlink = _SYMLINK(op_symlink)
+    ops.readlink = _READLINK(op_readlink)
+    ops.link = _LINK(op_link)
+    ops.setxattr = _SETXATTR(op_setxattr)
+    ops.getxattr = _GETXATTR(op_getxattr)
+    ops.listxattr = _LISTXATTR(op_listxattr)
+    ops.removexattr = _REMOVEXATTR(op_removexattr)
     ops.access = _ACCESS(op_access)
     ops.chmod = _CHMOD(op_chmod)
     ops.chown = _CHOWN(op_chown)
@@ -349,7 +413,9 @@ def fuse_loop(handlers, mountpoint: str, fsname: str = "swtpu",
     args = [b"swtpu-mount", mountpoint.encode()]
     if foreground:
         args.append(b"-f")
-    opts = [f"fsname={fsname}", "big_writes", "max_read=131072"]
+    # use_ino: report the handlers' st_ino (hardlink sets share one inode
+    # number) instead of kernel-assigned per-path inodes
+    opts = [f"fsname={fsname}", "big_writes", "max_read=131072", "use_ino"]
     if allow_other:
         opts.append("allow_other")
     args += [b"-o", ",".join(opts).encode()]

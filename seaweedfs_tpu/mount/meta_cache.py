@@ -80,10 +80,14 @@ class MetaCache:
         path = self._join(directory, name)
         with self._lock:
             hit = self._entries.get(path)
-            if hit is not None:
+            if hit is not None and not hit.hard_link_id:
                 e = fpb.Entry()
                 e.CopyFrom(hit)
                 return e
+            # hardlinked entries read through: their truth lives in the
+            # shared record, which updates through OTHER names this
+            # cache never sees events for (reference keys hardlinks by
+            # hard_link_id for the same reason, weedfs_link.go:17)
         entry = self.fs.filer.find_entry(directory, name)
         if entry is not None:
             with self._lock:

@@ -56,6 +56,10 @@ class WeedFS:
         self._handles: dict[int, FileHandle] = {}
         self._next_fh = 2
         self._lock = threading.Lock()
+        # serializes whole-entry read-modify-writes (flush vs setxattr vs
+        # truncate): the loser of an unserialized RMW would overwrite the
+        # winner's chunk list or extended map
+        self._entry_mu = threading.Lock()
         # mount.configure quota (reference mount_pb ConfigureRequest
         # CollectionCapacity): 0 = unlimited; reported via statfs
         self.collection_capacity = 0
@@ -80,14 +84,29 @@ class WeedFS:
     def _attr(self, path: str, entry: fpb.Entry) -> dict:
         a = entry.attributes
         mode = a.file_mode & 0o7777
-        mode |= stat_mod.S_IFDIR if entry.is_directory else stat_mod.S_IFREG
-        size = (0 if entry.is_directory
-                else max(a.file_size, total_size(entry.chunks)))
-        return {"st_ino": self.inodes.lookup(path), "st_mode": mode,
+        if entry.is_directory:
+            mode |= stat_mod.S_IFDIR
+        elif a.symlink_target:
+            mode |= stat_mod.S_IFLNK
+        else:
+            mode |= stat_mod.S_IFREG
+        if a.symlink_target:
+            size = len(a.symlink_target)
+        else:
+            size = (0 if entry.is_directory
+                    else max(a.file_size, total_size(entry.chunks)))
+        if entry.hard_link_id:
+            # all names of a hardlink set share one inode number
+            # (weedfs_link.go:17 "use the hardlink id as inode") so
+            # os.path.samefile and `find -samefile` work across names
+            ino = int.from_bytes(bytes(entry.hard_link_id)[:8], "big") or 1
+        else:
+            ino = self.inodes.lookup(path)
+        return {"st_ino": ino, "st_mode": mode,
                 "st_size": size, "st_mtime": a.mtime or 0,
                 "st_ctime": a.crtime or a.mtime or 0,
                 "st_uid": a.uid, "st_gid": a.gid,
-                "st_nlink": 1}
+                "st_nlink": max(1, entry.hard_link_counter)}
 
     # -- FUSE ops ------------------------------------------------------------
     def lookup(self, parent_path: str, name: str) -> dict:
@@ -144,6 +163,110 @@ class WeedFS:
         self.meta.invalidate(od, on)
         self.meta.invalidate(nd, nn)
         self.inodes.move_path(old, new)
+
+    # -- symlinks (reference weedfs_symlink.go) ------------------------------
+    def symlink(self, target: str, path: str) -> dict:
+        """`ln -s target path`: a zero-chunk entry whose attributes carry
+        the target (weedfs_symlink.go:33 stores SymlinkTarget the same
+        way)."""
+        d, n = self._split(path)
+        if self.meta.find(d, n) is not None:
+            raise FuseError(17, path)  # EEXIST
+        e = fpb.Entry(name=n)
+        e.attributes.file_mode = 0o777
+        e.attributes.symlink_target = target
+        e.attributes.mtime = e.attributes.crtime = int(time.time())
+        self.fs.filer.create_entry(d, e)
+        self.meta.invalidate(d, n)
+        return self.getattr(path)
+
+    def readlink(self, path: str) -> str:
+        entry = self._entry(path)
+        if not entry.attributes.symlink_target:
+            raise FuseError(22, path)  # EINVAL — not a symlink
+        return entry.attributes.symlink_target
+
+    # -- hardlinks (reference weedfs_link.go; shared record in the filer) ----
+    def link(self, old: str, new: str) -> dict:
+        od, on = self._split(old)
+        nd, nn = self._split(new)
+        if self.meta.find(nd, nn) is not None:
+            raise FuseError(17, new)
+        src = self.meta.find(od, on)
+        if src is None:
+            raise FuseError(2, old)
+        if src.is_directory:
+            raise FuseError(31, old)  # EMLINK — no dir hardlinks
+        try:
+            self.fs.filer.link(od, on, nd, nn)
+        except FileNotFoundError:
+            raise FuseError(2, old) from None
+        except FileExistsError:
+            raise FuseError(17, new) from None
+        except IsADirectoryError:
+            raise FuseError(31, old) from None
+        self.meta.invalidate(od, on)
+        self.meta.invalidate(nd, nn)
+        return self.getattr(new)
+
+    # -- extended attributes (reference weedfs_xattr.go; stored in
+    # Entry.extended under the same "xattr-" key prefix the filer uses) ------
+    XATTR_PREFIX = "xattr-"
+    MAX_XATTR_NAME = 255
+    MAX_XATTR_VALUE = 65536
+
+    def _xattr_update(self, path: str, mutate) -> None:
+        d, n = self._split(path)
+        with self._entry_mu:
+            entry = self.fs.filer.find_entry(d, n)
+            if entry is None:
+                raise FuseError(2, path)
+            updated = fpb.Entry()
+            updated.CopyFrom(entry)
+            mutate(updated)
+            # POSIX: xattr changes touch ctime only, never mtime
+            self.fs.filer.update_entry(d, updated, gc_chunks=False,
+                                       touch_mtime=False)
+        self.meta.invalidate(d, n)
+
+    def setxattr(self, path: str, name: str, value: bytes,
+                 flags: int = 0) -> None:
+        if not name or len(name) > self.MAX_XATTR_NAME:
+            raise FuseError(22 if not name else 34)  # EINVAL / ERANGE
+        if len(value) > self.MAX_XATTR_VALUE:
+            raise FuseError(7)  # E2BIG
+        key = self.XATTR_PREFIX + name
+
+        def mutate(e: fpb.Entry) -> None:
+            if flags & 1 and key in e.extended:  # XATTR_CREATE
+                raise FuseError(17, name)
+            if flags & 2 and key not in e.extended:  # XATTR_REPLACE
+                raise FuseError(61, name)  # ENODATA/ENOATTR
+            e.extended[key] = value
+
+        self._xattr_update(path, mutate)
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        entry = self._entry(path)
+        key = self.XATTR_PREFIX + name
+        if key not in entry.extended:
+            raise FuseError(61, name)  # ENODATA/ENOATTR
+        return bytes(entry.extended[key])
+
+    def listxattr(self, path: str) -> list[str]:
+        entry = self._entry(path)
+        return sorted(k[len(self.XATTR_PREFIX):] for k in entry.extended
+                      if k.startswith(self.XATTR_PREFIX))
+
+    def removexattr(self, path: str, name: str) -> None:
+        key = self.XATTR_PREFIX + name
+
+        def mutate(e: fpb.Entry) -> None:
+            if key not in e.extended:
+                raise FuseError(61, name)
+            del e.extended[key]
+
+        self._xattr_update(path, mutate)
 
     # -- open files ----------------------------------------------------------
     def create(self, path: str, mode: int = 0o644) -> int:
@@ -209,16 +332,17 @@ class WeedFS:
         h = self._handle(fh)
         if not h.dirty.dirty:
             return
-        new_chunks = h.dirty.flush()
+        new_chunks = h.dirty.flush()  # uploads happen OUTSIDE the mutex
         d, n = self._split(h.path)
-        entry = self.fs.filer.find_entry(d, n) or h.entry
-        updated = fpb.Entry()
-        updated.CopyFrom(entry)
-        updated.chunks.extend(new_chunks)
-        updated.attributes.file_size = max(
-            h.size, total_size(updated.chunks))
-        updated.attributes.mtime = int(time.time())
-        self.fs.filer.update_entry(d, updated)
+        with self._entry_mu:
+            entry = self.fs.filer.find_entry(d, n) or h.entry
+            updated = fpb.Entry()
+            updated.CopyFrom(entry)
+            updated.chunks.extend(new_chunks)
+            updated.attributes.file_size = max(
+                h.size, total_size(updated.chunks))
+            updated.attributes.mtime = int(time.time())
+            self.fs.filer.update_entry(d, updated)
         h.entry = updated
         h.dirty.commit()  # entry now holds the chunks; drop overlay copies
         self.meta.invalidate(d, n)
@@ -244,20 +368,21 @@ class WeedFS:
             if h.path == path and h.dirty.dirty:
                 self.flush(h.fh)
         d, n = self._split(path)
-        entry = self.fs.filer.find_entry(d, n)
-        if entry is None:
-            raise FuseError(2, path)
-        kept = [c for c in entry.chunks if c.offset < length]
-        updated = fpb.Entry()
-        updated.CopyFrom(entry)
-        del updated.chunks[:]
-        for c in kept:
-            nc = updated.chunks.add()
-            nc.CopyFrom(c)
-            if nc.offset + nc.size > length:
-                nc.size = length - nc.offset
-        updated.attributes.file_size = length
-        self.fs.filer.update_entry(d, updated)
+        with self._entry_mu:
+            entry = self.fs.filer.find_entry(d, n)
+            if entry is None:
+                raise FuseError(2, path)
+            kept = [c for c in entry.chunks if c.offset < length]
+            updated = fpb.Entry()
+            updated.CopyFrom(entry)
+            del updated.chunks[:]
+            for c in kept:
+                nc = updated.chunks.add()
+                nc.CopyFrom(c)
+                if nc.offset + nc.size > length:
+                    nc.size = length - nc.offset
+            updated.attributes.file_size = length
+            self.fs.filer.update_entry(d, updated)
         self.meta.invalidate(d, n)
         for h in self._handles.values():
             if h.path == path:

@@ -264,6 +264,119 @@ class TestWeedFS:
         assert st["f_bsize"] > 0 and st["f_blocks"] > 0
 
 
+class TestSymlinkXattrLink:
+    """Reference weedfs_symlink.go / weedfs_xattr.go / weedfs_link.go."""
+
+    def test_symlink_readlink(self, wfs):
+        fh = wfs.create("/sx/orig.txt")
+        wfs.write(fh, 0, b"payload")
+        wfs.flush(fh)
+        wfs.release(fh)
+        attr = wfs.symlink("/sx/orig.txt", "/sx/alias")
+        assert attr["st_mode"] & 0o170000 == 0o120000  # S_IFLNK
+        assert attr["st_size"] == len("/sx/orig.txt")
+        assert wfs.readlink("/sx/alias") == "/sx/orig.txt"
+        with pytest.raises(OSError):
+            wfs.readlink("/sx/orig.txt")  # EINVAL: not a symlink
+        # dangling symlink is legal; target never has to exist
+        wfs.symlink("/nowhere", "/sx/dangling")
+        assert wfs.readlink("/sx/dangling") == "/nowhere"
+
+    def test_hardlink_shares_content_and_counts(self, wfs):
+        fh = wfs.create("/hl/a.txt")
+        wfs.write(fh, 0, b"shared bytes")
+        wfs.flush(fh)
+        wfs.release(fh)
+        attr = wfs.link("/hl/a.txt", "/hl/b.txt")
+        assert attr["st_mode"] & 0o170000 == 0o100000  # regular file
+        assert wfs.getattr("/hl/a.txt")["st_nlink"] == 2
+        assert wfs.getattr("/hl/b.txt")["st_nlink"] == 2
+        fh = wfs.open("/hl/b.txt")
+        assert wfs.read(fh, 0, 12) == b"shared bytes"
+        wfs.release(fh)
+        # write through one name, read through the other
+        fh = wfs.open("/hl/b.txt")
+        wfs.write(fh, 0, b"SHARED")
+        wfs.flush(fh)
+        wfs.release(fh)
+        fh = wfs.open("/hl/a.txt")
+        assert wfs.read(fh, 0, 12) == b"SHARED bytes"
+        wfs.release(fh)
+        # unlink one name: the other keeps the bytes, nlink drops
+        wfs.unlink("/hl/a.txt")
+        assert wfs.getattr("/hl/b.txt")["st_nlink"] == 1
+        fh = wfs.open("/hl/b.txt")
+        assert wfs.read(fh, 0, 12) == b"SHARED bytes"
+        wfs.release(fh)
+
+    def test_link_errors(self, wfs):
+        with pytest.raises(OSError):
+            wfs.link("/hl/missing", "/hl/x")
+        wfs.mkdir("/hl/dir")
+        with pytest.raises(OSError):
+            wfs.link("/hl/dir", "/hl/dirlink")  # no directory hardlinks
+
+    def test_xattr_crud(self, wfs):
+        fh = wfs.create("/xa/f.txt")
+        wfs.release(fh)
+        wfs.setxattr("/xa/f.txt", "user.color", b"blue")
+        wfs.setxattr("/xa/f.txt", "user.shape", b"round")
+        assert wfs.getxattr("/xa/f.txt", "user.color") == b"blue"
+        assert wfs.listxattr("/xa/f.txt") == ["user.color", "user.shape"]
+        wfs.setxattr("/xa/f.txt", "user.color", b"red")  # overwrite
+        assert wfs.getxattr("/xa/f.txt", "user.color") == b"red"
+        wfs.removexattr("/xa/f.txt", "user.shape")
+        assert wfs.listxattr("/xa/f.txt") == ["user.color"]
+        with pytest.raises(OSError):
+            wfs.getxattr("/xa/f.txt", "user.shape")  # ENODATA
+        with pytest.raises(OSError):
+            wfs.removexattr("/xa/f.txt", "user.gone")
+
+    def test_xattr_flags(self, wfs):
+        fh = wfs.create("/xa/g.txt")
+        wfs.release(fh)
+        wfs.setxattr("/xa/g.txt", "user.k", b"v", flags=1)  # XATTR_CREATE
+        with pytest.raises(OSError):
+            wfs.setxattr("/xa/g.txt", "user.k", b"v2", flags=1)  # EEXIST
+        wfs.setxattr("/xa/g.txt", "user.k", b"v2", flags=2)  # XATTR_REPLACE
+        assert wfs.getxattr("/xa/g.txt", "user.k") == b"v2"
+        with pytest.raises(OSError):
+            wfs.setxattr("/xa/g.txt", "user.new", b"v", flags=2)  # ENODATA
+
+    def test_xattr_survives_content_writes(self, wfs):
+        fh = wfs.create("/xa/h.txt")
+        wfs.write(fh, 0, b"v1")
+        wfs.flush(fh)
+        wfs.release(fh)
+        wfs.setxattr("/xa/h.txt", "user.tag", b"keep")
+        fh = wfs.open("/xa/h.txt")
+        wfs.write(fh, 0, b"v2")
+        wfs.flush(fh)
+        wfs.release(fh)
+        assert wfs.getxattr("/xa/h.txt", "user.tag") == b"keep"
+
+    def test_xattr_on_directory(self, wfs):
+        wfs.mkdir("/xa/d")
+        wfs.setxattr("/xa/d", "user.role", b"archive")
+        assert wfs.getxattr("/xa/d", "user.role") == b"archive"
+
+    def test_xattr_does_not_touch_mtime(self, wfs):
+        fh = wfs.create("/xa/mt.txt")
+        wfs.write(fh, 0, b"x")
+        wfs.flush(fh)
+        wfs.release(fh)
+        before = wfs.getattr("/xa/mt.txt")["st_mtime"]
+        wfs.setxattr("/xa/mt.txt", "user.t", b"v")
+        assert wfs.getattr("/xa/mt.txt")["st_mtime"] == before
+
+    def test_link_refuses_to_clobber(self, wfs):
+        for p in ("/hl/c1.txt", "/hl/c2.txt"):
+            fh = wfs.create(p)
+            wfs.release(fh)
+        with pytest.raises(OSError):
+            wfs.link("/hl/c1.txt", "/hl/c2.txt")  # EEXIST
+
+
 class TestMountControl:
     """mount.configure control socket (reference command_mount_configure.go
     + mount_pb Configure)."""
