@@ -24,6 +24,7 @@ from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.types import TTL, parse_file_id
 from ..storage.vacuum import commit_compact, compact
+from ..utils import failpoints
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 
@@ -172,6 +173,10 @@ class VolumeServer:
                 msg.volumes.add(**v)
             for s in hb["ec_shards"]:
                 msg.ec_shards.add(**s)
+            # failpoint: a raised error tears the heartbeat stream (the
+            # master sees the disconnect and unregisters); delay models a
+            # stalled node feeding the failure detector
+            failpoints.check("volume.heartbeat")
             with self._hb_cond:
                 self._hb_inflight.append(snap_seq)
             yield msg
@@ -309,6 +314,21 @@ class VolumeServer:
             port = int(request.query.get("port", "9999"))
             return fastweb.text_response(profiling.start_jax_profiler(port))
 
+        def debug_failpoints(request):
+            """GET: list armed failpoints; ?name=X&spec=Y arms/updates one
+            at runtime (operator-driven chaos drills). A bare ?name=X
+            without spec is a read — it must not disarm mid-drill."""
+            name = request.query.get("name")
+            spec = request.query.get("spec")
+            if name and spec is not None:
+                try:
+                    failpoints.configure(name, spec)
+                except ValueError as e:
+                    return fastweb.text_response(f"bad spec: {e}",
+                                                 status=400)
+            return json_response({"armed": failpoints.active(),
+                                  "fired": failpoints.fired_counts()})
+
         def status_ui(request):
             # human status UI (reference weed/server/volume_server_ui)
             from ..utils.ui import render_page
@@ -345,6 +365,7 @@ class VolumeServer:
         # pprof-style triggers (reference -debug.port net/http/pprof)
         app.route("/debug/profile", debug_profile)
         app.route("/debug/jax-profiler", debug_jax_profiler)
+        app.route("/debug/failpoints", debug_failpoints)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
                                client_max_size=256 << 20, logger=log)
@@ -413,6 +434,9 @@ class VolumeServer:
             headers["Content-Encoding"] = "gzip"
         async with aiohttp.ClientSession(auto_decompress=False) as sess:
             for peer in peers:
+                # failpoint: a dead replica peer without killing a real
+                # process — drives the write-path failure handling
+                failpoints.check("replicate.peer")
                 url = f"http://{peer}/{fid}?type=replicate"
                 if name:
                     url += "&" + urllib.parse.urlencode(
@@ -562,6 +586,13 @@ class VolumeServer:
     # -- EC shard reader: remote fetch + degraded reconstruct ---------------
     def _fetch_remote_shard(self, vid: int, sid: int, offset: int,
                             length: int, holders: "list[str]") -> bytes | None:
+        try:
+            # failpoint: shard fetch failure -> the caller's degraded
+            # reconstruct-from-d-others path, without destroying a shard
+            failpoints.check("ec.shard.read")
+        except failpoints.FailpointError as e:
+            log.warning("ec shard %d.%d read failpoint: %s", vid, sid, e)
+            return None
         for addr in holders:
             try:
                 stub = Stub(addr, VOLUME_SERVICE)

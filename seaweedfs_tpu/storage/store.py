@@ -16,6 +16,7 @@ from ..ec.encoder import decode_volume, encode_volume, rebuild_shards
 from ..ec.locate import EcGeometry
 from ..ec.volume import EcVolume
 from ..ops.coder import ErasureCoder, get_coder
+from ..utils import failpoints
 from ..utils.log import logger
 from . import types as t
 from .disk_location import DiskLocation
@@ -164,6 +165,7 @@ class Store:
 
     def read_needle(self, vid: int, needle_id: int, cookie: int | None = None,
                     shard_reader=None) -> Needle:
+        failpoints.check("store.read")  # delay = slow disk; error = bad disk
         v = self.find_volume(vid)
         if v is not None:
             return v.read_needle(needle_id, cookie=cookie)

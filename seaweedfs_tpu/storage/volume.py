@@ -12,6 +12,7 @@ import os
 import threading
 
 from . import types as t
+from ..utils import failpoints
 from ..utils.log import logger
 from .needle import Needle, record_size_from_header
 from .needle_map import NeedleMap, idx_entries_numpy
@@ -319,7 +320,10 @@ class Volume:
             if off + len(rec) > t.MAX_VOLUME_SIZE:
                 raise OSError(f"volume {self.id} exceeds max size")
             self._dat.seek(off)
-            self._dat.write(rec)
+            # failpoint: persist only a prefix while the in-memory state
+            # believes the full record landed — a crash mid-write; the
+            # reopen-time _check_integrity heal is driven by this
+            self._dat.write(failpoints.torn("volume.write.torn", rec))
             self._append_offset = off + len(rec)
             self.nm.put(n.id, off, self._body_size(rec))
             self.last_append_at_ns = n.append_at_ns

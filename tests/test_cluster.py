@@ -97,6 +97,24 @@ def test_replicated_write(cluster):
     assert held == 2
 
 
+def test_replicated_write_fails_when_peer_injected_dead(cluster):
+    """replicate.peer failpoint: the write-path fan-out surfaces a dead
+    replica as a failed write (no silent single-copy acks), and writes
+    succeed again once the fault clears — reference store_replicate.go:25
+    fails the whole write when any replica fails."""
+    from seaweedfs_tpu.utils import failpoints
+    master, servers, mc = cluster
+    payload = os.urandom(500)
+    with failpoints.inject("replicate.peer", "error:peer-down"):
+        with pytest.raises(Exception):
+            operation.submit(mc, payload, replication="001",
+                             collection="repfault")
+    assert failpoints.fired("replicate.peer") >= 1
+    res = operation.submit(mc, payload, replication="001",
+                           collection="repfault")
+    assert operation.read(mc, res.fid) == payload
+
+
 def test_many_files_roundtrip(cluster):
     master, servers, mc = cluster
     rng = np.random.default_rng(0)
@@ -172,6 +190,16 @@ def test_ec_encode_spread_and_degraded_read(cluster):
                msg="ec registry updated")
     for fid, data in list(blobs.items())[:10]:
         assert operation.read(mc, fid) == data, f"ec read {fid}"
+
+    # degraded via FAILPOINT: one transient shard-fetch failure forces the
+    # reconstruct-from-d-others path without destroying anything
+    # (tests/test_failpoints.py has the facility; SURVEY §5 fault injection)
+    from seaweedfs_tpu.utils import failpoints
+    with failpoints.inject("ec.shard.read", "times:1:error:injected"):
+        for fid, data in list(blobs.items())[16:20]:
+            assert operation.read(mc, fid) == data, \
+                f"ec read with injected shard fault {fid}"
+    assert failpoints.fired("ec.shard.read") >= 1
 
     # degraded: kill shard 3's holder entirely
     others[0].stop()
