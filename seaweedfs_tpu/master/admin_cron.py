@@ -31,6 +31,12 @@ DEFAULT_SCRIPTS = [
     "volume.balance",
     "volume.fix.replication",
     "volume.vacuum",
+    # periodic bit-rot detection through the device-batched CRC kernel
+    # (volume.scrub, storage/scrub.py — BASELINE config 4 in operations).
+    # Budgeted: each sweep scans up to 2 min per server from a rotating
+    # cursor, so full coverage accrues across sweeps without a
+    # whole-disk scan competing with live traffic every 17 minutes
+    "volume.scrub -timeBudget 120",
 ]
 DEFAULT_INTERVAL_S = 17 * 60  # master_server.go:278 sleep_minutes default
 

@@ -743,6 +743,52 @@ class VolumeServer:
             vs.flush_heartbeat()
             return vpb.VolumeDeleteResponse()
 
+        @svc.unary("VolumeScrub", vpb.VolumeScrubRequest,
+                   vpb.VolumeScrubResponse)
+        def volume_scrub(req, context):
+            """Stream live needles through the batched CRC kernel
+            (storage/scrub.py); device='auto' uses the accelerator when
+            jax initializes, else the host loop. One failing volume never
+            loses the other volumes' results; a time budget + rotating
+            cursor lets the admin cron cover large servers across sweeps."""
+            from ..storage.scrub import scrub_volume
+            if req.volume_id:
+                v = store.find_volume(req.volume_id)
+                if v is None:
+                    context.abort(5, f"volume {req.volume_id} not found")
+                vols = [v]
+            else:
+                vols = []
+                for loc in store.locations:
+                    with loc.lock:
+                        vols.extend(loc.volumes.values())
+                vols.sort(key=lambda v: v.id)
+                # rotate: start after the last volume a budgeted sweep
+                # finished with, so coverage advances sweep over sweep
+                cursor = getattr(vs, "_scrub_cursor", 0)
+                vols = ([v for v in vols if v.id > cursor]
+                        + [v for v in vols if v.id <= cursor])
+            resp = vpb.VolumeScrubResponse()
+            deadline = (time.monotonic() + req.time_budget_s
+                        if req.time_budget_s else None)
+            for v in vols:
+                try:
+                    r = scrub_volume(v, device=req.device or "auto")
+                    resp.results.add(volume_id=r.volume_id,
+                                     scanned=r.scanned,
+                                     corrupt_needle_ids=r.corrupt,
+                                     bytes_checked=r.bytes_checked,
+                                     elapsed_s=r.elapsed_s, mode=r.mode,
+                                     error=r.error)
+                except Exception as e:  # noqa: BLE001 — isolate per volume
+                    resp.results.add(volume_id=v.id, mode="error",
+                                     error=str(e))
+                if not req.volume_id:
+                    vs._scrub_cursor = v.id
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+            return resp
+
         @svc.unary("VolumeMarkReadonly", vpb.VolumeMarkReadonlyRequest,
                    vpb.VolumeMarkReadonlyResponse)
         def mark_ro(req, context):

@@ -178,6 +178,10 @@ def cmd_fs_verify(env: CommandEnv, args):
 
     p = _fs_parser("fs.verify")
     p.add_argument("path", nargs="?", default="/")
+    p.add_argument("-scrub", action="store_true",
+                   help="additionally CRC-verify every volume's needles "
+                        "through the device-batched kernel (volume.scrub)")
+    p.add_argument("-device", choices=["auto", "on", "off"], default="auto")
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
     ok = bad = 0
@@ -202,6 +206,11 @@ def cmd_fs_verify(env: CommandEnv, args):
                 bad += 1
                 env.println(f"BROKEN {path} chunk {c.file_id}: {ex}")
     env.println(f"verified {ok} chunks ok, {bad} broken")
+    if opt.scrub:
+        # HTTP reachability above proves the chunks serve; the scrub pass
+        # proves the BYTES on disk still match their CRCs (bit rot)
+        from .volume_commands import cmd_volume_scrub
+        cmd_volume_scrub(env, ["-device", opt.device])
 
 
 @command("volume.fsck", "cross-check filer chunk refs against volume needles")

@@ -61,6 +61,70 @@ def cmd_volume_list(env: CommandEnv, args):
                                     f"col={s.collection!r} shards={bits}")
 
 
+@command("volume.scrub", "CRC-verify live needles (device-batched kernel)")
+def cmd_volume_scrub(env: CommandEnv, args):
+    """BASELINE config 4 as an operational surface: every volume server
+    streams its .dat needles through the batched CRC kernel
+    (storage/scrub.py; device when jax initializes, host loop otherwise)
+    and reports corrupt needles + needles/s. Exceeds the reference —
+    command_volume_fsck.go:81 walks needles but never hardware-verifies
+    CRCs."""
+    import argparse
+
+    from ..pb import volume_server_pb2 as vpb
+
+    p = argparse.ArgumentParser(prog="volume.scrub")
+    p.add_argument("-volumeId", type=int, default=0,
+                   help="scrub one volume (default: all)")
+    p.add_argument("-device", choices=["auto", "on", "off"], default="auto")
+    p.add_argument("-timeBudget", type=float, default=0,
+                   help="per-server seconds; servers keep a rotating "
+                        "cursor so budgeted sweeps cover everything "
+                        "across runs (admin cron uses this)")
+    opt = p.parse_args(args)
+    if opt.volumeId:
+        # only the holders have the volume; fanning out to every server
+        # would print spurious not-found failures
+        servers = _volume_holders(env, opt.volumeId)
+    else:
+        servers = env.collect_volume_servers()
+    total = corrupt = troubled = 0
+    t_sum = 0.0
+    for srv in servers:
+        try:
+            resp = _vs_stub(env, srv["id"], srv["grpc_port"]).call(
+                "VolumeScrub",
+                vpb.VolumeScrubRequest(volume_id=opt.volumeId,
+                                       device=opt.device,
+                                       time_budget_s=opt.timeBudget),
+                vpb.VolumeScrubResponse, timeout=600)
+        except Exception as e:  # noqa: BLE001
+            env.println(f"{srv['id']}: scrub failed: {e}")
+            troubled += 1
+            continue
+        for r in resp.results:
+            rate = r.scanned / r.elapsed_s if r.elapsed_s else 0.0
+            env.println(
+                f"{srv['id']} volume {r.volume_id}: {r.scanned} needles "
+                f"({r.bytes_checked >> 20} MB) in {r.elapsed_s:.2f}s "
+                f"[{r.mode}] {rate:,.0f} needles/s"
+                + (f" CORRUPT: {[hex(n) for n in r.corrupt_needle_ids]}"
+                   if r.corrupt_needle_ids else "")
+                + (f" ERROR: {r.error}" if r.error else ""))
+            total += r.scanned
+            corrupt += len(r.corrupt_needle_ids)
+            troubled += 1 if (r.error and r.mode != "skipped-tiered") else 0
+            t_sum += r.elapsed_s
+    env.println(f"scrubbed {total} needles, {corrupt} corrupt"
+                + (f", {total / t_sum:,.0f} needles/s overall"
+                   if t_sum else ""))
+    if corrupt or troubled:
+        # RuntimeError, not SystemExit: the admin cron catches Exception
+        # to survive failing scripts, and SystemExit would kill its thread
+        raise RuntimeError(
+            f"{corrupt} corrupt needles, {troubled} troubled volumes/servers")
+
+
 @command("cluster.check", "ping every node and report health")
 def cmd_cluster_check(env: CommandEnv, args):
     ok = 0
@@ -505,6 +569,11 @@ def cmd_volume_check_disk(env: CommandEnv, args):
                    help="limit to one volume (default: all)")
     p.add_argument("-fix", action="store_true",
                    help="copy missing needles to lagging replicas")
+    p.add_argument("-scrub", action="store_true",
+                   help="also CRC-verify each replica's needles through "
+                        "the device-batched kernel before diffing")
+    p.add_argument("-device", choices=["auto", "on", "off"], default="auto",
+                   help="scrub backend (with -scrub)")
     opt = p.parse_args(args)
     env.confirm_is_locked()
     # group volume -> holders
@@ -520,6 +589,38 @@ def cmd_volume_check_disk(env: CommandEnv, args):
     for vid, hs in sorted(holders.items()):
         if len(hs) < 2:
             continue
+        if opt.scrub:
+            # CRC pass first: a bit-rotted replica is EXCLUDED from the
+            # diff so it can never be the donor that "repairs" healthy
+            # replicas with corrupt bytes
+            healthy = []
+            for h in hs:
+                ok = True
+                try:
+                    resp = _vs_stub(env, h["id"], h["grpc_port"]).call(
+                        "VolumeScrub",
+                        vpb.VolumeScrubRequest(volume_id=vid,
+                                               device=opt.device),
+                        vpb.VolumeScrubResponse, timeout=600)
+                    for r in resp.results:
+                        if r.corrupt_needle_ids or r.error:
+                            ok = False
+                            env.println(
+                                f"volume {vid} on {h['id']}: excluded "
+                                f"from diff — corrupt "
+                                f"{[hex(n) for n in r.corrupt_needle_ids]}"
+                                f"{' ' + r.error if r.error else ''}")
+                except Exception as e:  # noqa: BLE001
+                    ok = False
+                    env.println(f"volume {vid} on {h['id']}: scrub: {e}")
+                if ok:
+                    healthy.append(h)
+            if len(healthy) < 2:
+                if len(healthy) < len(hs):
+                    env.println(f"volume {vid}: <2 healthy replicas, "
+                                "skipping diff (repair corruption first)")
+                continue
+            hs = healthy
         needle_sets = []
         for h in hs:
             stub = _vs_stub(env, h["id"], h["grpc_port"])
