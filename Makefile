@@ -1,0 +1,20 @@
+# seaweedfs_tpu delivery loop
+
+.PHONY: test stress bench smoke protos
+
+test:
+	python -m pytest tests/ -q
+
+# race/stress harness with artifact (tests/stress/run_stress.py);
+# bounded ~60s total at 6 s/scenario on an idle box
+stress:
+	python tests/stress/run_stress.py STRESS_r05.json 6
+
+bench:
+	python bench.py
+
+smoke:
+	python bench.py --smoke
+
+protos:
+	python -m seaweedfs_tpu.pb.build

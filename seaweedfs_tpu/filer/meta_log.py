@@ -16,6 +16,9 @@ import time
 from collections import deque
 
 from ..pb import filer_pb2 as fpb
+from ..utils.log import logger
+
+log = logger("meta-log")
 
 _HDR = struct.Struct("<QI")  # ts_ns, blob length
 
@@ -30,6 +33,13 @@ class MetaLog:
         self._tail: deque[tuple[int, bytes]] = deque(maxlen=tail_window)
         self._cond = threading.Condition()
         self._last_ts = 0
+        # highest ts ever evicted from the bounded tail: a live subscriber
+        # whose `last` is behind this has a GAP the deque can no longer
+        # serve and must re-read the persisted log (subscribe())
+        self._evicted_ts = 0
+        # bumped by purge(): the file was rewritten, so subscribers'
+        # incremental read cursors into it are invalid
+        self._purge_gen = 0
 
     def append(self, directory: str, ev: fpb.EventNotification) -> int:
         resp = fpb.SubscribeMetadataResponse(directory=directory,
@@ -43,6 +53,8 @@ class MetaLog:
                 self._f.write(_HDR.pack(ts, len(blob)))
                 self._f.write(blob)
                 self._f.flush()
+            if len(self._tail) == self._tail.maxlen:
+                self._evicted_ts = self._tail[0][0]
             self._tail.append((ts, blob))
             self._cond.notify_all()
         return ts
@@ -81,15 +93,22 @@ class MetaLog:
             if self._f:
                 self._f.close()
             os.replace(tmp, self._path)
+            self._purge_gen += 1
             if self._f:
                 self._f = open(self._path, "ab")
             return dropped
 
-    def _read_persisted(self, since_ns: int) -> list[tuple[int, bytes]]:
+    def _read_persisted(self, since_ns: int, start_pos: int = 0
+                        ) -> tuple[list[tuple[int, bytes]], int]:
+        """Events with ts > since_ns from byte `start_pos` on; returns
+        (events, end_pos) so lagging subscribers re-scan incrementally
+        instead of the whole file per poll."""
         if not self._path or not os.path.exists(self._path):
-            return []
+            return [], start_pos
         out = []
         with open(self._path, "rb") as f:
+            f.seek(start_pos)
+            pos = start_pos
             while True:
                 hdr = f.read(_HDR.size)
                 if len(hdr) < _HDR.size:
@@ -98,9 +117,10 @@ class MetaLog:
                 blob = f.read(ln)
                 if len(blob) < ln:
                     break  # torn tail
+                pos = f.tell()
                 if ts > since_ns:
                     out.append((ts, blob))
-        return out
+        return out, pos
 
     def subscribe(self, since_ns: int, stop: threading.Event,
                   poll_s: float = 0.2):
@@ -112,18 +132,43 @@ class MetaLog:
         if self._path is None or (oldest_tail is not None and last + 1 >= oldest_tail):
             backlog = [(t, b) for t, b in list(self._tail) if t > last]
         else:  # tail window may have dropped (or never seen) older events
-            backlog = self._read_persisted(last)
+            backlog, _ = self._read_persisted(last)
         for ts, blob in backlog:
             resp = fpb.SubscribeMetadataResponse()
             resp.ParseFromString(blob)
             yield resp
             last = ts
+        warned_gap = False
+        file_pos = 0  # incremental gap-read cursor into the persisted log
+        file_gen = self._purge_gen
         while not stop.is_set():
             with self._cond:
                 fresh = [(t, b) for t, b in list(self._tail) if t > last]
-                if not fresh:
+                if not fresh and last >= self._evicted_ts:
                     self._cond.wait(timeout=poll_s)
                     fresh = [(t, b) for t, b in list(self._tail) if t > last]
+                # recompute AFTER any wait: a burst larger than the tail
+                # window during the wait must not be silently skipped
+                gap = last < self._evicted_ts
+            if gap:
+                # a burst overflowed the bounded tail while this
+                # subscriber lagged: the deque can no longer serve the
+                # backlog. Re-read the persisted log (appends flush
+                # before entering the tail, so it is complete up to now),
+                # resuming from the last scan's file offset — a purge
+                # rewrites the file, so its generation resets the cursor.
+                if self._path is not None:
+                    if file_gen != self._purge_gen:
+                        file_pos, file_gen = 0, self._purge_gen
+                    fresh, file_pos = self._read_persisted(
+                        last, start_pos=file_pos)
+                elif not warned_gap:
+                    warned_gap = True
+                    log.warning(
+                        "meta tail window overflowed a memory-only log: "
+                        "a lagging subscriber lost events before %d "
+                        "(persist the log or raise tail_window)",
+                        self._evicted_ts)
             for ts, blob in fresh:
                 # re-check per event: a stopped subscriber must not keep
                 # consuming (a "stopped" FilerSync would still replicate)

@@ -70,10 +70,24 @@ class GroupConsumer:
 
     # -- public --------------------------------------------------------------
     def poll(self, timeout: float = 5.0) -> ConsumerRecord | None:
-        try:
-            return self.records.get(timeout=timeout)
-        except queue.Empty:
-            return None
+        """Next record from any assigned partition. Records whose
+        partition has been revoked since they were queued are dropped —
+        a revoked partition's uncommitted tail belongs to its NEW owner,
+        and delivering it here after the owner re-reads it would be a
+        guaranteed duplicate (the remaining cross-member window is the
+        in-flight record the app is processing at revoke time:
+        at-least-once, same contract as the reference / Kafka sans EOS)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                rec = self.records.get(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except queue.Empty:
+                return None
+            with self._lock:
+                if rec.partition.range_start in self._workers:
+                    return rec
+            # revoked while queued: drop and keep polling
 
     def commit(self, rec: ConsumerRecord) -> None:
         """Persist rec.offset as processed; resume after failure happens
@@ -185,6 +199,17 @@ class GroupConsumer:
                 if rs not in want or self.assigned.get(rs) != want[rs]:
                     self._workers.pop(rs).set()
                     self.assigned.pop(rs, None)
+                    if rs not in want:
+                        # truly revoked (not a leader-change restart):
+                        # purge its queued records NOW — if the partition
+                        # later returns, stale first-ownership records
+                        # would pass poll's membership check while the
+                        # fresh worker re-reads the same offsets (double
+                        # delivery) — and reset the watermark, since
+                        # suppressing offsets we queued but never
+                        # processed would turn the purge into loss
+                        self._purge_queued(rs)
+                        self._delivered.pop(rs, None)
             for rs, (p, leader) in want.items():
                 if rs in self._workers:
                     continue
@@ -195,6 +220,20 @@ class GroupConsumer:
                     target=self._consume_partition,
                     args=(p, leader, stop), daemon=True,
                     name=f"mq-part-{self.instance_id}-{rs}").start()
+
+    def _purge_queued(self, range_start: int) -> None:
+        """Drop a revoked partition's not-yet-polled records, preserving
+        the order of everything else."""
+        keep: list[ConsumerRecord] = []
+        while True:
+            try:
+                rec = self.records.get_nowait()
+            except queue.Empty:
+                break
+            if rec.partition.range_start != range_start:
+                keep.append(rec)
+        for rec in keep:
+            self.records.put(rec)
 
     # -- partition worker ----------------------------------------------------
     def _fetch_offset(self, p: Partition, leader: str) -> int:
@@ -252,12 +291,22 @@ class GroupConsumer:
                         return
                     if resp.is_end_of_stream:
                         break
-                    if resp.offset <= self._delivered.get(p.range_start, -1):
-                        continue  # redelivery of an already-queued record
-                    self._delivered[p.range_start] = resp.offset
-                    self.records.put(ConsumerRecord(
-                        p, leader, resp.offset, bytes(resp.data.key),
-                        bytes(resp.data.value), resp.data.ts_ns))
+                    # watermark + enqueue under the consumer lock, fenced
+                    # on THIS worker still owning the partition: a revoke
+                    # (purge + watermark reset, _apply_assignment) cannot
+                    # be undone by an in-flight record, and a purge can
+                    # never interleave with a concurrent put
+                    with self._lock:
+                        if stop.is_set() or \
+                                self._workers.get(p.range_start) is not stop:
+                            return
+                        if resp.offset <= self._delivered.get(
+                                p.range_start, -1):
+                            continue  # redelivery already queued
+                        self._delivered[p.range_start] = resp.offset
+                        self.records.put(ConsumerRecord(
+                            p, leader, resp.offset, bytes(resp.data.key),
+                            bytes(resp.data.value), resp.data.ts_ns))
             except Exception as e:  # noqa: BLE001
                 if stop.is_set() or self._closed.is_set():
                     return
