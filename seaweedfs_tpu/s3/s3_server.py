@@ -363,11 +363,13 @@ class S3Gateway:
                                         request=request)
             return self.put_object(bucket, key, body,
                                    request.content_type or "",
-                                   acl=self._canned_acl(request))
+                                   acl=self._canned_acl(request),
+                                   meta=_user_meta(request.headers))
         if m == "POST":
             if "uploads" in q:
                 return self.initiate_multipart(
-                    bucket, key, acl=self._canned_acl(request))
+                    bucket, key, acl=self._canned_acl(request),
+                    meta=_user_meta(request.headers))
             if "uploadId" in q:
                 return self.complete_multipart(bucket, key, q["uploadId"], body)
         if m in ("GET", "HEAD"):
@@ -611,9 +613,12 @@ class S3Gateway:
         acl = self._validate_canned(fields.get("acl"))
         entry = self.fs.write_file(self._object_path(bucket, key), file_bytes,
                                    mime=fields.get("Content-Type", ""))
+        attrs = {k.lower(): v.encode() for k, v in fields.items()
+                 if k.lower().startswith("x-amz-meta-")}
         if acl:
-            d, _n = split_path(self._object_path(bucket, key))
-            self._store_acl(d, entry, acl)
+            attrs["acl"] = acl.encode()
+        d, _n = split_path(self._object_path(bucket, key))
+        self._merge_extended(d, entry, attrs)
         try:
             status = int(fields.get("success_action_status", "204"))
         except ValueError:
@@ -642,11 +647,23 @@ class S3Gateway:
         return BUCKETS_DIR, bucket, self.fs.filer.find_entry(
             BUCKETS_DIR, bucket)
 
-    def _store_acl(self, d: str, e: fpb.Entry, canned: str) -> None:
+    def _merge_extended(self, d: str, e: fpb.Entry,
+                        attrs: "dict[str, bytes]") -> None:
+        """Merge extended attributes (acl, x-amz-meta-*, tags) in ONE
+        metadata-only update: no mtime bump (Last-Modified must not move
+        for an ACL/metadata change) and no chunk GC."""
+        if not attrs:
+            return
         upd = fpb.Entry()
         upd.CopyFrom(e)
-        upd.extended["acl"] = canned.encode()
-        self.fs.filer.update_entry(d, upd)
+        for k, v in attrs.items():
+            upd.extended[k] = v
+        self.fs.filer.update_entry(d, upd, gc_chunks=False,
+                                   touch_mtime=False)
+        e.CopyFrom(upd)
+
+    def _store_acl(self, d: str, e: fpb.Entry, canned: str) -> None:
+        self._merge_extended(d, e, {"acl": canned.encode()})
 
     def put_acl(self, bucket, key, request, body):
         """Canned ACLs via the x-amz-acl header (reference
@@ -703,28 +720,31 @@ class S3Gateway:
             grant("FULL_CONTROL", user_id="bucket-owner")
         return _xml_response(root)
 
-    def put_object(self, bucket, key, body, mime, acl: str | None = None):
+    def put_object(self, bucket, key, body, mime, acl: str | None = None,
+                   meta: "dict[str, str] | None" = None):
         from aiohttp import web
 
         self._require_bucket(bucket)
         self._check_quota(bucket)
+        attrs = {k.lower(): v.encode() for k, v in (meta or {}).items()}
+        if acl:
+            attrs["acl"] = acl.encode()
         if key.endswith("/"):  # directory object
             d, n = split_path(self._object_path(bucket, key))
             e = fpb.Entry(name=n, is_directory=True)
             e.attributes.file_mode = 0o40755
-            if acl:
-                e.extended["acl"] = acl.encode()
+            for k, v in attrs.items():
+                e.extended[k] = v
             existing = self.fs.filer.find_entry(d, n)
             if existing is None:
                 self.fs.filer.create_entry(d, e)
-            elif acl:
-                self._store_acl(d, existing, acl)
+            else:
+                self._merge_extended(d, existing, attrs)
             return web.Response(status=200, headers={"ETag": '"d41d8cd98f00b204e9800998ecf8427e"'})
         entry = self.fs.write_file(self._object_path(bucket, key), body,
                                    mime=mime)
-        if acl:
-            d, _n = split_path(self._object_path(bucket, key))
-            self._store_acl(d, entry, acl)
+        d, _n = split_path(self._object_path(bucket, key))
+        self._merge_extended(d, entry, attrs)
         return web.Response(status=200,
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
 
@@ -750,13 +770,45 @@ class S3Gateway:
                     request=None):
         self._check_quota(bucket)
         self._require_bucket(bucket)
-        _sb, _sk, entry = self._resolve_copy_source(src, request)
+        sb, sk, entry = self._resolve_copy_source(src, request)
+        hdrs = request.headers if request is not None else {}
+        directive = (hdrs.get("x-amz-metadata-directive") or "COPY").upper()
+        if directive not in ("COPY", "REPLACE"):
+            raise S3Error("InvalidArgument",
+                          "Unknown metadata directive.", 400)
+        if sb == bucket and sk == key and directive == "COPY":
+            # s3tests test_object_copy_to_itself: illegal without
+            # changing metadata (REPLACE)
+            raise S3Error(
+                "InvalidRequest",
+                "This copy request is illegal because it is trying to "
+                "copy an object to itself without changing the object's "
+                "metadata, storage class, website redirect location or "
+                "encryption attributes.", 400)
+        # x-amz-copy-source-if-* (s3tests test_copy_object_ifmatch_good /
+        # ifnonematch_failed / ...): all failures answer 412
+        cond = _check_preconditions(hdrs, _entry_etag(entry),
+                                    entry.attributes.mtime,
+                                    prefix="x-amz-copy-source-")
+        if cond is not None:
+            raise S3Error("PreconditionFailed",
+                          "At least one of the pre-conditions you "
+                          "specified did not hold", 412)
         data = self.fs.read_entry_bytes(entry)
-        new = self.fs.write_file(self._object_path(bucket, key), data,
-                                 mime=entry.attributes.mime)
+        if directive == "REPLACE":
+            mime = (hdrs.get("Content-Type") or hdrs.get("content-type")
+                    or entry.attributes.mime)
+            attrs = {k: v.encode() for k, v in _user_meta(hdrs).items()}
+        else:  # COPY: source metadata AND tags travel with the object
+            mime = entry.attributes.mime
+            attrs = {k: bytes(v) for k, v in entry.extended.items()
+                     if k.startswith(("x-amz-meta-", TAG_PREFIX))}
         if acl:
-            dd, _n = split_path(self._object_path(bucket, key))
-            self._store_acl(dd, new, acl)
+            attrs["acl"] = acl.encode()
+        new = self.fs.write_file(self._object_path(bucket, key), data,
+                                 mime=mime)
+        dd, _n = split_path(self._object_path(bucket, key))
+        self._merge_extended(dd, new, attrs)
         root = ET.Element("CopyObjectResult")
         ET.SubElement(root, "ETag").text = f'"{new.attributes.md5.hex()}"'
         ET.SubElement(root, "LastModified").text = _iso(new.attributes.mtime)
@@ -769,14 +821,29 @@ class S3Gateway:
         d, n = split_path(self._object_path(bucket, key))
         entry = self.fs.filer.find_entry(d, n)
         if entry is not None and entry.is_directory and key.endswith("/"):
+            dir_headers = {"ETag": '"d41d8cd98f00b204e9800998ecf8427e"',
+                           "Content-Type": "application/octet-stream"}
+            for k, v in entry.extended.items():
+                if k.startswith("x-amz-meta-"):
+                    dir_headers[k] = v.decode()
             return web.Response(  # directory object: empty body
-                status=200, headers={
-                    "ETag": '"d41d8cd98f00b204e9800998ecf8427e"',
-                    "Content-Type": "application/octet-stream"})
+                status=200, headers=dir_headers)
         if entry is None or entry.is_directory:
             raise ErrNoSuchKey(key)
         fsize = entry.attributes.file_size or total_size(entry.chunks)
         etag = _entry_etag(entry)
+        # conditional GET/HEAD (s3tests test_get_object_ifmatch_* /
+        # ifnonematch / ifmodifiedsince / ifunmodifiedsince)
+        cond = _check_preconditions(request.headers, etag,
+                                    entry.attributes.mtime)
+        if cond == 304:
+            return web.Response(status=304, headers={
+                "ETag": f'"{etag}"',
+                "Last-Modified": _http_date(entry.attributes.mtime)})
+        if cond == 412:
+            raise S3Error("PreconditionFailed",
+                          "At least one of the pre-conditions you "
+                          "specified did not hold", 412)
         headers = {"ETag": f'"{etag}"', "Accept-Ranges": "bytes",
                    "Last-Modified": _http_date(entry.attributes.mtime),
                    "Content-Type": entry.attributes.mime or
@@ -875,16 +942,48 @@ class S3Gateway:
         max_keys = int(q.get("max-keys", "1000"))
         v2 = q.get("list-type") == "2"
         marker = q.get("continuation-token", "") if v2 else q.get("marker", "")
-        if v2 and q.get("start-after", "") > marker:
-            marker = q["start-after"]
+        if v2 and not marker:
+            # a continuation token always wins over start-after (s3tests
+            # test_bucket_listv2_both_continuationtoken_startafter)
+            marker = q.get("start-after", "")
         base = self._bucket_dir(bucket)
 
         contents: list[tuple[str, fpb.Entry]] = []
         prefixes: list[str] = []
         truncated = False
+        if max_keys <= 0:
+            # s3tests test_bucket_listv2_maxkeys_zero: empty result,
+            # NOT truncated
+            return self._list_response(bucket, q, prefix, delimiter, 0,
+                                       v2, [], [], False)
         if delimiter and delimiter != "/":
-            raise S3Error("NotImplemented",
-                          "Only '/' delimiter is supported.", 501)
+            # generic delimiter (s3tests test_bucket_listv2_delimiter_alt):
+            # flatten the recursive walk, roll keys up at the first
+            # delimiter occurrence after the prefix
+            seen_p: set[str] = set()
+            # marker pruning is safe: a rollup is a prefix of its key, so
+            # any key <= marker would be dropped by the checks below anyway
+            for key, e in self._walk_keys(base, "", marker, prefix):
+                idx = key.find(delimiter, len(prefix))
+                rollup = key[:idx + len(delimiter)] if idx >= 0 else None
+                if rollup is not None:
+                    if rollup in seen_p or rollup <= marker:
+                        continue
+                    if len(contents) + len(prefixes) >= max_keys:
+                        truncated = True
+                        break
+                    seen_p.add(rollup)
+                    prefixes.append(rollup)
+                else:
+                    if key <= marker:
+                        continue
+                    if len(contents) + len(prefixes) >= max_keys:
+                        truncated = True
+                        break
+                    contents.append((key, e))
+            return self._list_response(bucket, q, prefix, delimiter,
+                                       max_keys, v2, contents, prefixes,
+                                       truncated)
         if delimiter:
             # list the dir named by the prefix; subdirs become CommonPrefixes
             pdir, pname = prefix.rpartition("/")[0], prefix.rpartition("/")[2]
@@ -912,7 +1011,11 @@ class S3Gateway:
                     truncated = True
                     break
                 contents.append((key, e))
+        return self._list_response(bucket, q, prefix, delimiter, max_keys,
+                                   v2, contents, prefixes, truncated)
 
+    def _list_response(self, bucket, q, prefix, delimiter, max_keys, v2,
+                       contents, prefixes, truncated):
         root = ET.Element("ListBucketResult",
                           xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
         ET.SubElement(root, "Name").text = bucket
@@ -947,7 +1050,8 @@ class S3Gateway:
     def _upload_dir(self, bucket: str, upload_id: str) -> str:
         return f"{self._bucket_dir(bucket)}/{UPLOADS_DIR}/{upload_id}"
 
-    def initiate_multipart(self, bucket, key, acl: str | None = None):
+    def initiate_multipart(self, bucket, key, acl: str | None = None,
+                           meta: "dict[str, str] | None" = None):
         self._require_bucket(bucket)
         upload_id = uuid.uuid4().hex
         d, n = split_path(self._upload_dir(bucket, upload_id))
@@ -955,6 +1059,11 @@ class S3Gateway:
         e.extended["key"] = key.encode()
         if acl:
             e.extended["acl"] = acl.encode()
+        # x-amz-meta-* from CreateMultipartUpload rides the upload dir and
+        # lands on the final object at complete time (boto3's transfer
+        # manager sends metadata here, never on the parts)
+        for k, v in (meta or {}).items():
+            e.extended[k.lower()] = v.encode()
         self.fs.filer.create_entry(d, e)
         root = ET.Element("InitiateMultipartUploadResult")
         ET.SubElement(root, "Bucket").text = bucket
@@ -1026,10 +1135,20 @@ class S3Gateway:
         updir = self._upload_dir(bucket, upload_id)
         req = ET.fromstring(body) if body else None
         wanted: list[int] | None = None
+        wanted_etags: dict[int, str] = {}
         if req is not None:
             ns = _ns(req)
-            wanted = [int(p.findtext(f"{ns}PartNumber") or "0")
-                      for p in req.findall(f"{ns}Part")]
+            wanted = []
+            for p in req.findall(f"{ns}Part"):
+                num = int(p.findtext(f"{ns}PartNumber") or "0")
+                wanted.append(num)
+                et = (p.findtext(f"{ns}ETag") or "").strip().strip('"')
+                if et:
+                    wanted_etags[num] = et
+            if not wanted:
+                # s3tests test_multipart_upload_empty
+                raise S3Error("MalformedXML",
+                              "You must specify at least one part.", 400)
         parts = {int(e.name.split(".")[0]): e
                  for e in self.fs.filer.list_entries(updir)
                  if e.name.endswith(".part")}
@@ -1040,6 +1159,14 @@ class S3Gateway:
         if any(p not in parts for p in order):
             raise S3Error("InvalidPart", "One or more of the specified parts "
                           "could not be found.", 400)
+        for num, et in wanted_etags.items():
+            # s3tests test_multipart_upload_incorrect_etag
+            if parts[num].attributes.md5.hex() != et:
+                raise S3Error(
+                    "InvalidPart", "One or more of the specified parts "
+                    "could not be found. The part may not have been "
+                    "uploaded, or the specified entity tag may not match "
+                    "the part's entity tag.", 400)
         # zero-copy concat: rebase each part's chunks onto the final offset
         final = fpb.Entry()
         offset = 0
@@ -1060,6 +1187,10 @@ class S3Gateway:
         final.extended["s3-etag"] = etag.encode()
         if upload.extended.get("acl"):
             final.extended["acl"] = upload.extended["acl"]
+        for k, v in upload.extended.items():
+            # user metadata staged at initiate time lands on the object
+            if k.startswith("x-amz-meta-"):
+                final.extended[k] = v
         self.fs.filer.create_entry(d, final)
         # drop staging metadata but never the chunks (now owned by `final`)
         pdir, pname = split_path(updir)
@@ -1076,6 +1207,8 @@ class S3Gateway:
         from aiohttp import web
 
         self._require_bucket(bucket)
+        # s3tests test_abort_multipart_upload_not_found: unknown id -> 404
+        self._find_upload(bucket, upload_id)
         d, n = split_path(self._upload_dir(bucket, upload_id))
         self.fs.filer.delete_entry(d, n, is_delete_data=True,
                                    is_recursive=True)
@@ -1160,6 +1293,55 @@ class S3Gateway:
 
 
 # -- helpers -----------------------------------------------------------------
+
+def _user_meta(headers) -> "dict[str, str]":
+    """x-amz-meta-* user metadata from request headers (case folded)."""
+    return {k.lower(): v for k, v in headers.items()
+            if k.lower().startswith("x-amz-meta-")}
+
+
+def _parse_http_date(value: str) -> "int | None":
+    import email.utils
+    try:
+        return int(email.utils.parsedate_to_datetime(value).timestamp())
+    except (TypeError, ValueError):
+        return None
+
+
+def _check_preconditions(headers, etag: str, mtime: int,
+                         prefix: str = "") -> "int | None":
+    """RFC 7232 / S3 conditional semantics -> None (proceed), 304, or 412.
+
+    prefix='' evaluates GET/HEAD If-* headers; 'x-amz-copy-source-if-'
+    style prefixes evaluate CopyObject's source conditions (which answer
+    412 instead of 304 for the not-modified cases, per S3)."""
+    def h(name):
+        # aiohttp headers are case-insensitive; internal callers pass {}.
+        # Present-but-empty must stay distinct from absent.
+        return headers.get(prefix + name)
+
+    def etag_matches(spec: str) -> bool:
+        cands = [c.strip().strip('"') for c in spec.split(",")]
+        return "*" in spec or etag in cands
+
+    if_match = h("if-match")
+    if if_match is not None and not etag_matches(if_match):
+        return 412
+    if_unmod = h("if-unmodified-since")
+    if if_unmod is not None and if_match is None:
+        ts = _parse_http_date(if_unmod)
+        if ts is not None and mtime > ts:
+            return 412
+    if_none = h("if-none-match")
+    if if_none is not None and etag_matches(if_none):
+        return 412 if prefix else 304
+    if_mod = h("if-modified-since")
+    if if_mod is not None and if_none is None:
+        ts = _parse_http_date(if_mod)
+        if ts is not None and mtime <= ts:
+            return 412 if prefix else 304
+    return None
+
 
 def _entry_etag(e: fpb.Entry) -> str:
     s3etag = e.extended.get("s3-etag")
