@@ -248,6 +248,9 @@ def open_store(spec: str) -> FilerStore:
     if kind == "redis":
         from .redis_store import RedisStore
         return RedisStore(arg.lstrip("/") or "127.0.0.1:6379")
+    if kind in ("mongo", "mongodb"):
+        from .mongo_store import MongoStore
+        return MongoStore(arg.lstrip("/") or "127.0.0.1:27017")
     if kind == "mysql":
         from .sql_store import AbstractSqlStore, MysqlDialect
         kw = dict(kv.split("=", 1) for kv in arg.split() if "=" in kv)
@@ -259,7 +262,8 @@ def open_store(spec: str) -> FilerStore:
         return AbstractSqlStore(PostgresDialect(arg or "dbname=seaweedfs"))
     raise ValueError(f"unknown filer store {spec!r} (supported: memory, "
                      f"sqlite:<path>, logdb:<path>, lsm:<dir>, "
-                     f"redis:<host:port>, mysql:<k=v ...>, postgres:<dsn>)")
+                     f"redis:<host:port>, mongo:<host:port>, "
+                     f"mysql:<k=v ...>, postgres:<dsn>)")
 
 
 class _Sst:
