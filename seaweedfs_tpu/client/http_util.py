@@ -41,7 +41,7 @@ class Response:
 
 
 class _Conn:
-    __slots__ = ("sock", "rfile")
+    __slots__ = ("sock", "rfile", "used")
 
     def __init__(self, netloc: str, timeout: float):
         host, _, port = netloc.rpartition(":")
@@ -50,6 +50,7 @@ class _Conn:
                                              timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb", buffering=1 << 16)
+        self.used = 0  # requests served; >0 = reused pool connection
 
     def close(self) -> None:
         try:
@@ -91,7 +92,17 @@ class _Stale(Exception):
 
 
 def _read_response(c: _Conn, method: str) -> tuple[Response, bool]:
-    """Parse one response; returns (response, keep_alive)."""
+    """Parse one response; returns (response, keep_alive). 1xx interim
+    responses (e.g. 100 Continue) are consumed and the NEXT response is
+    returned — surfacing an interim as final would leave the real
+    response unread on the kept-alive socket, desynchronizing the pool."""
+    while True:
+        resp, keep = _read_one_response(c, method)
+        if not 100 <= resp.status < 200:
+            return resp, keep
+
+
+def _read_one_response(c: _Conn, method: str) -> tuple[Response, bool]:
     rf = c.rfile
     line = rf.readline(8192)
     if not line:
@@ -157,7 +168,11 @@ def request(method: str, url: str, body: bytes | None = None,
     """One HTTP round-trip on the calling thread's persistent connection.
 
     A stale keep-alive connection (server closed it between requests) gets
-    one transparent reconnect+retry; real errors propagate.
+    one transparent reconnect+retry. The blind retry on other socket
+    errors is restricted to idempotent methods: a slow-but-alive server
+    may have already EXECUTED a POST/PUT whose response timed out, and
+    re-sending would duplicate the mutation (duplicate assigns leak file
+    keys) — those errors surface to the caller immediately.
     """
     if "://" in url:
         _, rest = url.split("://", 1)
@@ -176,22 +191,37 @@ def request(method: str, url: str, body: bytes | None = None,
     if body or method in ("POST", "PUT"):
         head += f"Content-Length: {len(body)}\r\n"
     req_bytes = head.encode("latin1") + b"\r\n" + body
+    idempotent = method in ("GET", "HEAD", "DELETE", "OPTIONS")
     for attempt in (0, 1):
         c = _conn(netloc, timeout)
         fresh = attempt == 1
+        sent = False
+        reused = c.used > 0
+        c.used += 1
         try:
             c.sock.sendall(req_bytes)
+            sent = True
             resp, keep = _read_response(c, method)
             if not keep:
                 _drop(netloc)
             return resp
         except _Stale:
+            # On a REUSED connection this is the idle keep-alive close
+            # race (the server closed before seeing the request): any
+            # method retries safely. On a FRESH connection the server
+            # accepted the request and closed without a response — a
+            # mutation may have executed, so the idempotency guard
+            # applies just like any other read-phase failure.
             _drop(netloc)
-            if fresh:
+            if fresh or (not reused and sent and not idempotent):
                 raise OSError(f"connection to {netloc} closed") from None
         except (ConnectionError, BrokenPipeError, socket.timeout, OSError):
             _drop(netloc)
-            if fresh:
+            # send-phase failure: the request never went out whole, any
+            # method retries. Read-phase failure after a full send: the
+            # server may have EXECUTED the mutation — only idempotent
+            # methods retry blindly.
+            if fresh or (sent and not idempotent):
                 raise
     raise AssertionError("unreachable")
 

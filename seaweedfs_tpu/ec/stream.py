@@ -232,6 +232,7 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
                    coder: ErasureCoder, chunk: int = DEFAULT_CHUNK,
                    batch: int = DEFAULT_BATCH, depth: int = DEFAULT_DEPTH,
                    stats: "dict | None" = None,
+                   null_sink: bool = False,
                    ) -> "dict[str, list[str]]":
     """Encode many volumes through one shared device stream.
 
@@ -253,13 +254,17 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
     """
     assert coder.d == geo.d and coder.p == geo.p
     chunk = fit_chunk(geo, chunk)
+    if null_sink and coder.async_dispatch:
+        raise ValueError("null_sink is a sync-coder measurement mode")
     if not coder.async_dispatch:
-        return _encode_volumes_sync(jobs, geo, coder, chunk, batch, stats)
+        return _encode_volumes_sync(jobs, geo, coder, chunk, batch, stats,
+                                    null_sink=null_sink)
     return _encode_volumes_async(jobs, geo, coder, chunk, batch, depth, stats)
 
 
 def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,
-                         chunk: int, batch: int, stats: "dict | None"
+                         chunk: int, batch: int, stats: "dict | None",
+                         null_sink: bool = False,
                          ) -> "dict[str, list[str]]":
     """Zero-copy streaming encode for synchronous host coders.
 
@@ -287,7 +292,8 @@ def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,
         if plan.dat_size == 0:
             plan.finish()
             continue
-        fds = [os.open(path, os.O_WRONLY) for path in out[dat_path]]
+        fds = ([] if null_sink else
+               [os.open(path, os.O_WRONLY) for path in out[dat_path]])
         try:
             for view, base, rows, nch in plan.regions:
                 contiguous = nch == 1 and view.base is not None
@@ -308,17 +314,18 @@ def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,
                     t0 = _time.perf_counter()
                     parity = np.asarray(coder.encode(inp))
                     coder_s += _time.perf_counter() - t0
-                    shard_off = base + r0 * chunk
-                    t0 = _time.perf_counter()
-                    for b in range(k):
-                        off = shard_off + b * chunk
-                        src = inp[b]
-                        for i in range(d):
-                            os.pwrite(fds[i], src[i].data, off)
-                        prow = parity[b]
-                        for j in range(p):
-                            os.pwrite(fds[d + j], prow[j].data, off)
-                    write_s += _time.perf_counter() - t0
+                    if not null_sink:  # measurement mode: discard shards
+                        shard_off = base + r0 * chunk
+                        t0 = _time.perf_counter()
+                        for b in range(k):
+                            off = shard_off + b * chunk
+                            src = inp[b]
+                            for i in range(d):
+                                os.pwrite(fds[i], src[i].data, off)
+                            prow = parity[b]
+                            for j in range(p):
+                                os.pwrite(fds[d + j], prow[j].data, off)
+                        write_s += _time.perf_counter() - t0
                     r0 += k
             EC_ENCODE_BYTES.inc(type(coder).__name__, amount=plan.dat_size)
         finally:
@@ -382,12 +389,16 @@ def _encode_volumes_async(jobs, geo: EcGeometry, coder: ErasureCoder,
     fill_s = dispatch_s = 0.0
     batches = 0
     drain_block = [0.0]
+    dispatch_ts: list = []  # per-batch submit time (FIFO pipe)
+    done_ts: list = []      # per-batch drain-return time
     orig_drain_one = pipe.drain_one
 
     def timed_drain_one():
         t0 = _time.perf_counter()
         orig_drain_one()
-        drain_block[0] += _time.perf_counter() - t0
+        t1 = _time.perf_counter()
+        drain_block[0] += t1 - t0
+        done_ts.append(t1)
     pipe.drain_one = timed_drain_one
 
     while pump():
@@ -417,6 +428,7 @@ def _encode_volumes_async(jobs, geo: EcGeometry, coder: ErasureCoder,
         t0 = _time.perf_counter()
         fut = coder.encode(buf)
         dispatch_s += _time.perf_counter() - t0
+        dispatch_ts.append(t0)
         pipe.submit(fut, runs, drain)
         batches += 1
     pipe.flush()
@@ -425,5 +437,10 @@ def _encode_volumes_async(jobs, geo: EcGeometry, coder: ErasureCoder,
                      batch_bytes=batch * geo.d * chunk,
                      wall_s=_time.perf_counter() - t_wall0,
                      fill_s=fill_s, dispatch_s=dispatch_s,
-                     drain_block_s=drain_block[0])
+                     drain_block_s=drain_block[0],
+                     # MEASURED per-batch spans (dispatch -> blocking
+                     # drain return, FIFO-paired): their interval union
+                     # is the device-occupancy window, replacing the old
+                     # estimated per-batch-time multiplication
+                     dispatch_ts=dispatch_ts, done_ts=done_ts)
     return out

@@ -82,6 +82,14 @@ class ChunkCache:
             entries = []
             for name in os.listdir(disk_dir):
                 p = os.path.join(disk_dir, name)
+                if name.endswith(".tmp"):
+                    # crash mid-_put_disk: a phantom that could never be
+                    # hit would pin disk budget until LRU-evicted
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                    continue
                 try:
                     st = os.stat(p)
                 except OSError:

@@ -208,12 +208,19 @@ class AbstractSqlStore(FilerStore):
         cur = self._conn().cursor()
         cur.execute(self.dialect.sql(self.dialect.LIST, op=op,
                                      prefix_clause=clause), params)
-        for (blob,) in cur.fetchall():
-            e = fpb.Entry()
-            e.ParseFromString(bytes(blob))
-            if prefix and not e.name.startswith(prefix):
-                continue  # backstop for collation-insensitive LIKE
-            yield e
+        # stream rows from the cursor: fetchall() would materialize an
+        # entire huge directory in memory (the SqliteStore this layer
+        # replaced was O(batch))
+        while True:
+            rows = cur.fetchmany(256)
+            if not rows:
+                return
+            for (blob,) in rows:
+                e = fpb.Entry()
+                e.ParseFromString(bytes(blob))
+                if prefix and not e.name.startswith(prefix):
+                    continue  # backstop for collation-insensitive LIKE
+                yield e
 
     def kv_get(self, key):
         cur = self._conn().cursor()

@@ -245,7 +245,15 @@ class MasterServer:
                 resp = await asyncio.get_running_loop().run_in_executor(
                     None, ms.do_assign, areq)
             else:
-                resp = ms.do_assign(areq)
+                # inline fast path NEVER grows: a concurrent assign may
+                # have filled the last writable between the check above
+                # and here (TOCTOU) — the sentinel re-dispatches that
+                # loser to the executor instead of blocking the loop
+                resp = ms.do_assign(areq, allow_growth=False)
+                if resp.error == ms.NEEDS_GROWTH:
+                    import asyncio
+                    resp = await asyncio.get_running_loop().run_in_executor(
+                        None, ms.do_assign, areq)
             if resp.error:
                 return json_response({"error": resp.error}, status=406)
             return json_response({
@@ -671,10 +679,14 @@ class MasterServer:
         return node
 
     # -- assign --------------------------------------------------------------
-    def do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
-        resp = self._do_assign(req)
-        from ..stats import MASTER_ASSIGN_COUNTER
-        MASTER_ASSIGN_COUNTER.inc("error" if resp.error else "ok")
+    NEEDS_GROWTH = "__needs_growth__"  # internal redispatch sentinel
+
+    def do_assign(self, req: pb.AssignRequest,
+                  allow_growth: bool = True) -> pb.AssignResponse:
+        resp = self._do_assign(req, allow_growth=allow_growth)
+        if resp.error != self.NEEDS_GROWTH:
+            from ..stats import MASTER_ASSIGN_COUNTER
+            MASTER_ASSIGN_COUNTER.inc("error" if resp.error else "ok")
         return resp
 
     def needs_growth(self, req: pb.AssignRequest) -> bool:
@@ -690,7 +702,8 @@ class MasterServer:
         layout.ensure_correct_writables()
         return layout.pick_for_write() is None
 
-    def _do_assign(self, req: pb.AssignRequest) -> pb.AssignResponse:
+    def _do_assign(self, req: pb.AssignRequest,
+                   allow_growth: bool = True) -> pb.AssignResponse:
         if not self.is_leader:
             hint = self.leader_address
             return pb.AssignResponse(
@@ -702,6 +715,10 @@ class MasterServer:
         layout.ensure_correct_writables()
         vid = layout.pick_for_write()
         if vid is None:
+            if not allow_growth:
+                # caller (the inline event-loop path) must re-dispatch to
+                # a thread: growth is seconds, not microseconds
+                return pb.AssignResponse(error=self.NEEDS_GROWTH)
             try:
                 self.growth.grow(GrowRequest(
                     collection=req.collection, replication=replication,

@@ -95,7 +95,12 @@ class _FakePgDbapi:
         return self._rows[0] if self._rows else None
 
     def fetchall(self):
-        return list(self._rows)
+        rows, self._rows = list(self._rows), []
+        return rows
+
+    def fetchmany(self, size=1):
+        out, self._rows = self._rows[:size], self._rows[size:]
+        return out
 
 
 @pytest.fixture(scope="module")
