@@ -233,7 +233,8 @@ class LogDbStore(MemoryStore):
 
 def open_store(spec: str) -> FilerStore:
     """spec: 'memory', 'sqlite:/path/db.sqlite', 'logdb:/path/filer.log',
-    'lsm:/dir', 'redis:host:port', 'mysql:k=v ...', 'postgres:<dsn>'."""
+    'lsm:/dir', 'redis:host:port', 'mongo:host:port', 'etcd:host:port',
+    'mysql:k=v ...', 'postgres:<dsn>'."""
     kind, _, arg = spec.partition(":")
     if kind == "memory":
         return MemoryStore()
@@ -251,6 +252,9 @@ def open_store(spec: str) -> FilerStore:
     if kind in ("mongo", "mongodb"):
         from .mongo_store import MongoStore
         return MongoStore(arg.lstrip("/") or "127.0.0.1:27017")
+    if kind == "etcd":
+        from .etcd_store import EtcdStore
+        return EtcdStore(arg.lstrip("/") or "127.0.0.1:2379")
     if kind == "mysql":
         from .sql_store import AbstractSqlStore, MysqlDialect
         kw = dict(kv.split("=", 1) for kv in arg.split() if "=" in kv)
@@ -262,7 +266,7 @@ def open_store(spec: str) -> FilerStore:
         return AbstractSqlStore(PostgresDialect(arg or "dbname=seaweedfs"))
     raise ValueError(f"unknown filer store {spec!r} (supported: memory, "
                      f"sqlite:<path>, logdb:<path>, lsm:<dir>, "
-                     f"redis:<host:port>, mongo:<host:port>, "
+                     f"redis:<host:port>, mongo:<host:port>, etcd:<host:port>, "
                      f"mysql:<k=v ...>, postgres:<dsn>)")
 
 

@@ -120,8 +120,16 @@ def mini_mongo():
     srv.stop()
 
 
+@pytest.fixture(scope="module")
+def mini_etcd():
+    from seaweedfs_tpu.utils.mini_etcd import MiniEtcd
+    srv = MiniEtcd().start()
+    yield srv
+    srv.stop()
+
+
 @pytest.fixture(params=["memory", "sqlite", "logdb", "lsm", "lsm-tiny",
-                        "redis", "mongo", "pg-dialect"])
+                        "redis", "mongo", "etcd", "pg-dialect"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryStore()
@@ -130,6 +138,11 @@ def store(request, tmp_path):
         from seaweedfs_tpu.filer.mongo_store import MongoStore
         s = MongoStore(srv.address)
         srv.collections.clear()  # isolate from earlier parametrizations
+    elif request.param == "etcd":
+        srv = request.getfixturevalue("mini_etcd")
+        from seaweedfs_tpu.filer.etcd_store import EtcdStore
+        s = EtcdStore(srv.address)
+        srv.clear()  # isolate from earlier parametrizations
     elif request.param == "sqlite":
         s = SqliteStore(str(tmp_path / "filer.db"))
     elif request.param == "logdb":
@@ -228,6 +241,7 @@ class TestFilerStoreConformance:
         if isinstance(store, MemoryStore) and not isinstance(store, LogDbStore):
             pytest.skip("memory store is ephemeral by design")
         store.close()
+        from seaweedfs_tpu.filer.etcd_store import EtcdStore
         from seaweedfs_tpu.filer.mongo_store import MongoStore
         from seaweedfs_tpu.filer.redis_store import RedisStore
         if isinstance(store, RedisStore):
@@ -235,6 +249,8 @@ class TestFilerStoreConformance:
             re = RedisStore(store.address)
         elif isinstance(store, MongoStore):
             re = MongoStore(store.address)
+        elif isinstance(store, EtcdStore):
+            re = EtcdStore(store.address)
         elif store.name == "postgres":
             pytest.skip("fake pg dbapi is process-local by design")
         elif isinstance(store, LogDbStore):
@@ -270,6 +286,29 @@ def test_open_store_spec_mongo(mini_mongo):
     from seaweedfs_tpu.filer.mongo_store import MongoStore
     s = open_store(f"mongo:{mini_mongo.address}")
     assert isinstance(s, MongoStore)
+    s.close()
+
+
+def test_open_store_spec_etcd(mini_etcd):
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+    s = open_store(f"etcd:{mini_etcd.address}")
+    assert isinstance(s, EtcdStore)
+    s.close()
+
+
+def test_etcd_range_paging(mini_etcd):
+    """Listings page through bounded Ranges using `more` + next-key
+    continuation (the real etcd flow), not one unbounded Range."""
+    from seaweedfs_tpu.filer.etcd_store import EtcdStore
+    mini_etcd.clear()
+    s = EtcdStore(mini_etcd.address)
+    for i in range(1300):  # > the 512-per-Range page size
+        s.insert_entry("/page", _entry(f"e{i:05d}", i))
+    before = mini_etcd.requests
+    names = [e.name for e in s.list_entries("/page")]
+    assert names == [f"e{i:05d}" for i in range(1300)]
+    # the listing itself paged: >=3 Range RPCs for 1300 keys at 512/page
+    assert mini_etcd.requests - before >= 3
     s.close()
 
 
