@@ -370,15 +370,26 @@ def fuse_loop(handlers, mountpoint: str, fsname: str = "swtpu",
 
     @guard
     def op_chmod(path, mode):
-        pass  # permissions are advisory in the filer model
+        handlers.chmod(path.decode(), mode)
 
     @guard
     def op_chown(path, uid, gid):
-        pass
+        handlers.chown(path.decode(), uid, gid)
 
     @guard
     def op_utimens(path, times):
-        pass
+        if not times:
+            handlers.utimens(path.decode(), None, None)
+            return
+        ts = times.contents
+        def val(t):  # UTIME_NOW(2^30-1)/UTIME_OMIT(2^30-2) in tv_nsec
+            if t.tv_nsec == (1 << 30) - 2:
+                return None
+            if t.tv_nsec == (1 << 30) - 1:
+                import time as _t
+                return _t.time()
+            return t.tv_sec + t.tv_nsec / 1e9
+        handlers.utimens(path.decode(), val(ts[0]), val(ts[1]))
 
     ops = fuse_operations()
     ops.getattr = _GETATTR(op_getattr)

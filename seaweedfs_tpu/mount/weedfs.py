@@ -164,6 +164,48 @@ class WeedFS:
         self.meta.invalidate(nd, nn)
         self.inodes.move_path(old, new)
 
+    # -- setattr family (reference weedfs_attr.go: chmod/chown/utimens
+    # persist through the filer like any metadata change) -------------------
+    def _update_entry_meta(self, path: str, mutate) -> None:
+        """Shared metadata-only read-modify-write (setattr + xattr): one
+        lock, one gc-free mtime-preserving update, one invalidation."""
+        d, n = self._split(path)
+        with self._entry_mu:
+            entry = self.fs.filer.find_entry(d, n)
+            if entry is None:
+                raise FuseError(2, path)
+            updated = fpb.Entry()
+            updated.CopyFrom(entry)
+            mutate(updated)
+            self.fs.filer.update_entry(d, updated, gc_chunks=False,
+                                       touch_mtime=False)
+        self.meta.invalidate(d, n)
+
+    _setattr = _update_entry_meta
+
+    def chmod(self, path: str, mode: int) -> None:
+        def mutate(e: fpb.Entry) -> None:
+            e.attributes.file_mode = (e.attributes.file_mode & ~0o7777) | \
+                (mode & 0o7777)
+        self._setattr(path, mutate)
+
+    def chown(self, path: str, uid: int, gid: int) -> None:
+        def mutate(e: fpb.Entry) -> None:
+            # -1 means "leave unchanged" (chown(2) semantics); the FUSE
+            # layer passes 0xFFFFFFFF for it
+            if uid not in (0xFFFFFFFF, -1):
+                e.attributes.uid = uid
+            if gid not in (0xFFFFFFFF, -1):
+                e.attributes.gid = gid
+        self._setattr(path, mutate)
+
+    def utimens(self, path: str, atime: float | None,
+                mtime: float | None) -> None:
+        def mutate(e: fpb.Entry) -> None:
+            if mtime is not None:
+                e.attributes.mtime = int(mtime)
+        self._setattr(path, mutate)
+
     # -- symlinks (reference weedfs_symlink.go) ------------------------------
     def symlink(self, target: str, path: str) -> dict:
         """`ln -s target path`: a zero-chunk entry whose attributes carry
@@ -215,19 +257,9 @@ class WeedFS:
     MAX_XATTR_NAME = 255
     MAX_XATTR_VALUE = 65536
 
-    def _xattr_update(self, path: str, mutate) -> None:
-        d, n = self._split(path)
-        with self._entry_mu:
-            entry = self.fs.filer.find_entry(d, n)
-            if entry is None:
-                raise FuseError(2, path)
-            updated = fpb.Entry()
-            updated.CopyFrom(entry)
-            mutate(updated)
-            # POSIX: xattr changes touch ctime only, never mtime
-            self.fs.filer.update_entry(d, updated, gc_chunks=False,
-                                       touch_mtime=False)
-        self.meta.invalidate(d, n)
+    # POSIX: xattr changes touch ctime only, never mtime — which is what
+    # the shared metadata-only RMW already guarantees
+    _xattr_update = _update_entry_meta
 
     def setxattr(self, path: str, name: str, value: bytes,
                  flags: int = 0) -> None:
@@ -491,5 +523,36 @@ def mount(weedfs: WeedFS, mountpoint: str):  # pragma: no cover - needs fusepy
 
         def statfs(self, path):
             return weedfs.statfs()
+
+        def symlink(self, target, source):
+            weedfs.symlink(source, target)  # fusepy arg order
+
+        def readlink(self, path):
+            return weedfs.readlink(path)
+
+        def link(self, target, source):
+            weedfs.link(source, target)
+
+        def chmod(self, path, mode):
+            weedfs.chmod(path, mode)
+
+        def chown(self, path, uid, gid):
+            weedfs.chown(path, uid, gid)
+
+        def utimens(self, path, times=None):
+            if times:
+                weedfs.utimens(path, times[0], times[1])
+
+        def setxattr(self, path, name, value, options, position=0):
+            weedfs.setxattr(path, name, value, options)
+
+        def getxattr(self, path, name, position=0):
+            return weedfs.getxattr(path, name)
+
+        def listxattr(self, path):
+            return weedfs.listxattr(path)
+
+        def removexattr(self, path, name):
+            weedfs.removexattr(path, name)
 
     return fuse.FUSE(_Ops(), mountpoint, foreground=True)

@@ -679,6 +679,41 @@ class BrokerServer:
             finally:
                 broker.coordinator.remove_subscriber(group, iid, tname)
 
+        @svc.unary("DescribeConsumerGroups",
+                   mq.DescribeConsumerGroupsRequest,
+                   mq.DescribeConsumerGroupsResponse)
+        def describe_groups(req, ctx):
+            """Groups coordinated by THIS broker for the topic, with
+            member assignments and committed offsets (the shell fans out
+            to every live broker and merges)."""
+            tname = str(tref_of(req.topic))
+            resp = mq.DescribeConsumerGroupsResponse()
+            coord = broker.coordinator
+            with coord._lock:
+                snap = [(g, cg.generation, dict(cg.instances),
+                         list(cg.mapping))
+                        for (t, g), cg in coord.groups.items()
+                        if t == tname]
+            for gname, gen, instances, mapping in snap:
+                g = resp.groups.add(name=gname, generation=gen)
+                by_inst: dict[str, list] = {i: [] for i in instances}
+                for slot in mapping:
+                    by_inst.setdefault(slot.assigned_instance_id,
+                                       []).append(slot)
+                for iid in sorted(instances):
+                    m = g.members.add(instance_id=iid)
+                    for slot in by_inst.get(iid, []):
+                        m.partitions.add(range_start=slot.range_start,
+                                         range_stop=slot.range_stop,
+                                         ring_size=slot.ring_size)
+                for p, _leader in broker._group_partitions(tname):
+                    off = broker.fetch_offset(tname, p, gname)
+                    po = g.offsets.add(committed=off)
+                    po.partition.range_start = p.range_start
+                    po.partition.range_stop = p.range_stop
+                    po.partition.ring_size = p.ring_size
+            return resp
+
         @svc.unary("CommitOffset", mq.CommitOffsetRequest,
                    mq.CommitOffsetResponse)
         def commit_offset(req, ctx):

@@ -142,11 +142,10 @@ def repl(env: CommandEnv) -> None:
 
 
 
-def discover_cluster_node(env: "CommandEnv", client_type: str
-                          ) -> "tuple[str, int]":
-    """Oldest live node of a type from the master cluster list
-    (reference cluster.go:104): ('', 0) if none. Shared by filer and
-    broker discovery so fixes (grpc ports, retries) land once."""
+def list_cluster_nodes(env: "CommandEnv", client_type: str) -> list:
+    """Live nodes of a type from the master cluster list (cluster.go:104),
+    oldest first; [] on any error. THE single ListClusterNodes call site
+    for shell helpers so fixes (grpc ports, retries) land once."""
     from ..pb import master_pb2 as mpb
     from ..utils.rpc import MASTER_SERVICE
     try:
@@ -154,7 +153,16 @@ def discover_cluster_node(env: "CommandEnv", client_type: str
             "ListClusterNodes",
             mpb.ListClusterNodesRequest(client_type=client_type),
             mpb.ListClusterNodesResponse)
-        nodes = sorted(resp.cluster_nodes, key=lambda n: n.created_at_ns)
+        return sorted(resp.cluster_nodes, key=lambda n: n.created_at_ns)
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def discover_cluster_node(env: "CommandEnv", client_type: str
+                          ) -> "tuple[str, int]":
+    """Oldest live node of a type: ('', 0) if none."""
+    try:
+        nodes = list_cluster_nodes(env, client_type)
         if nodes:
             return nodes[0].address, nodes[0].grpc_port
     except Exception:  # noqa: BLE001

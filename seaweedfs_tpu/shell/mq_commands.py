@@ -34,6 +34,12 @@ def _find_broker(env: CommandEnv) -> str:
     return discover_cluster_node(env, "broker")[0]
 
 
+def _all_broker_addrs(env: CommandEnv) -> "list[str]":
+    """Every live broker from the master cluster list."""
+    from .commands import list_cluster_nodes
+    return sorted(n.address for n in list_cluster_nodes(env, "broker"))
+
+
 def _mq_parser(prog: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog=prog)
     p.add_argument("-broker", default="")
@@ -67,6 +73,34 @@ def cmd_mq_topic_desc(env: CommandEnv, args):
         env.println(f"partition [{a.partition.range_start},"
                     f"{a.partition.range_stop}) -> {a.leader_broker}")
     env.println(f"{len(resp.assignments)} partitions")
+    # consumer groups: every live broker reports the groups ITS
+    # coordinator manages (sub_coordinator.py); merge across brokers
+    total_groups = 0
+    for addr in (_all_broker_addrs(env)
+                 or [_broker_addr(env, opt.broker)]):
+        if not addr:
+            continue
+        try:
+            gresp = Stub(addr, MQ_SERVICE).call(
+                "DescribeConsumerGroups",
+                mq.DescribeConsumerGroupsRequest(
+                    topic=mq.Topic(namespace=ns, name=name)),
+                mq.DescribeConsumerGroupsResponse, timeout=5)
+        except Exception:  # noqa: BLE001 — dead broker mid-listing
+            continue
+        for g in gresp.groups:
+            total_groups += 1
+            env.println(f"group {g.name!r} gen {g.generation} "
+                        f"(coordinator {addr}):")
+            for m in g.members:
+                parts = [f"[{p.range_start},{p.range_stop})"
+                         for p in m.partitions]
+                env.println(f"  member {m.instance_id}: "
+                            f"{' '.join(parts) or '(idle)'}")
+            for po in g.offsets:
+                env.println(f"  committed [{po.partition.range_start},"
+                            f"{po.partition.range_stop}): {po.committed}")
+    env.println(f"{total_groups} consumer groups")
 
 
 @command("mq.topic.configure", "-topic ns/name -partitions N: create or "

@@ -430,3 +430,34 @@ class TestMountControl:
         st = WeedFS.statfs(wfs)
         assert st["f_blocks"] == 64
         assert st["f_bfree"] == 64
+
+
+class TestSetattrFamily:
+    """chmod/chown/utimens persist through the filer (weedfs_attr.go)."""
+
+    def test_chmod_persists(self, wfs):
+        fh = wfs.create("/sa/f1.txt", mode=0o644)
+        wfs.release(fh)
+        wfs.chmod("/sa/f1.txt", 0o600)
+        assert wfs.getattr("/sa/f1.txt")["st_mode"] & 0o7777 == 0o600
+
+    def test_chown_persists_and_minus_one_skips(self, wfs):
+        fh = wfs.create("/sa/f2.txt")
+        wfs.release(fh)
+        wfs.chown("/sa/f2.txt", 1000, 2000)
+        a = wfs.getattr("/sa/f2.txt")
+        assert (a["st_uid"], a["st_gid"]) == (1000, 2000)
+        wfs.chown("/sa/f2.txt", 0xFFFFFFFF, 3000)  # uid unchanged
+        a = wfs.getattr("/sa/f2.txt")
+        assert (a["st_uid"], a["st_gid"]) == (1000, 3000)
+
+    def test_utimens_sets_mtime(self, wfs):
+        fh = wfs.create("/sa/f3.txt")
+        wfs.release(fh)
+        wfs.utimens("/sa/f3.txt", None, 1234567890.5)
+        assert wfs.getattr("/sa/f3.txt")["st_mtime"] == 1234567890
+
+    def test_setattr_missing_file(self, wfs):
+        import pytest as _pytest
+        with _pytest.raises(OSError):
+            wfs.chmod("/sa/ghost", 0o600)

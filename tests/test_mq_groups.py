@@ -322,3 +322,42 @@ class TestSchema:
         got = [s.decode(v) for _, _, v in
                subscribe(lead, "typed", "stock", start_offset=0)]
         assert got == [{"name": f"it{i}", "qty": i} for i in range(5)]
+
+
+def test_describe_consumer_groups(two_brokers):
+    """mq.topic.desc visibility: DescribeConsumerGroups reports members,
+    assignments, generation, and committed offsets from the coordinator."""
+    from seaweedfs_tpu.mq.client import Publisher
+    from seaweedfs_tpu.mq.consumer import GroupConsumer
+    from seaweedfs_tpu.pb import mq_pb2 as mq
+    from seaweedfs_tpu.utils.rpc import Stub
+
+    brokers = two_brokers["brokers"]
+    addrs = [b.address for b in brokers]
+    pub = Publisher(addrs, "vis", "events", partition_count=4)
+    for i in range(8):
+        pub.publish(f"k{i}".encode(), f"v{i}".encode())
+    c1 = GroupConsumer(addrs, "vis", "events", "viewers", "v1")
+    assert c1.wait_assigned(10)
+    seen = set()
+    _drain([c1], 8, seen=seen)
+
+    merged = []
+    for addr in addrs:
+        resp = Stub(addr, "swtpu.mq.Broker").call(
+            "DescribeConsumerGroups",
+            mq.DescribeConsumerGroupsRequest(
+                topic=mq.Topic(namespace="vis", name="events")),
+            mq.DescribeConsumerGroupsResponse, timeout=5)
+        merged.extend(resp.groups)
+    assert len(merged) == 1  # exactly one coordinator owns the group
+    g = merged[0]
+    assert g.name == "viewers" and g.generation >= 1
+    assert [m.instance_id for m in g.members] == ["v1"]
+    assert sum(len(m.partitions) for m in g.members) == 4
+    # per-record commits: every partition's committed offset accounts for
+    # all 8 records between them
+    assert sum(po.committed + 1 for po in g.offsets
+               if po.committed >= 0) == 8
+    pub.close()
+    c1.close()
