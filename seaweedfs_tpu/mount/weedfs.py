@@ -9,6 +9,14 @@ weedfs_rename.go.
 
 File handles keep per-open state (ChunkedDirtyPages). Reads merge the
 stored chunk views with unflushed dirty ranges for read-your-writes.
+
+Op-table coverage vs the reference mount: weedfs_symlink.go,
+weedfs_xattr.go, weedfs_link.go, weedfs_attr.go (chmod/chown/utimens)
+are all implemented. weedfs_file_copy_range.go and weedfs_file_lseek.go
+(copy_file_range, SEEK_HOLE/SEEK_DATA) have NO slots in the libfuse 2.9
+ABI this binding targets (fuse_operations ends at fallocate; both are
+fuse3 additions), so the kernel transparently falls back to read/write
+copies and data-only seeks — correct results, without the offload.
 """
 
 from __future__ import annotations
