@@ -307,28 +307,41 @@ def bench_e2e(out: dict, n_vols: int, mb: int, smoke: bool) -> None:
                             fp = out_base + _ecf.shard_ext(i)
                             if os.path.exists(fp):
                                 os.unlink(fp)
-            # NULL-SINK pass: the full read+stripe+encode pipeline with
+            # NULL-SINK passes: the full read+stripe+encode pipeline with
             # shard writes discarded — the pipeline's own ceiling, with
-            # the VM first-touch write wall out of the picture entirely
-            stats = {}
-            t0 = time.perf_counter()
-            stream.encode_volumes(jobs, geo, coder, stats=stats,
-                                  null_sink=True)
-            dt = time.perf_counter() - t0
+            # the VM first-touch write wall out of the picture entirely.
+            # Three passes, median + best: this virtualized host's page
+            # fault service rate swings 2-4x between identical runs, and
+            # a capability ceiling should not be charged for host steal
+            rates, coder_rates = [], []
+            for _ in range(3):
+                stats = {}
+                t0 = time.perf_counter()
+                stream.encode_volumes(jobs, geo, coder, stats=stats,
+                                      null_sink=True)
+                dt = time.perf_counter() - t0
+                rates.append(total / dt / 1e9)
+                if stats.get("coder_s"):
+                    coder_rates.append(total / stats["coder_s"] / 1e9)
             out["ec_encode_e2e_tmpfs_nullsink_GBps"] = round(
-                total / dt / 1e9, 3)
+                statistics.median(rates), 3)
+            out["ec_encode_e2e_tmpfs_nullsink_best_GBps"] = round(
+                max(rates), 3)
             # FIRST-CLASS coder-only rate (VERDICT r4 ask 1), measured in
-            # the null-sink run: the write passes' coder_s is polluted by
+            # the null-sink runs: the write passes' coder_s is polluted by
             # dirty-shard-page writeback stealing cycles inside the coder
-            # spans, so the clean run is the honest in-coder number
-            if stats.get("coder_s"):
+            # spans, so the clean runs are the honest in-coder number
+            if coder_rates:
                 out["ec_encode_e2e_tmpfs_coder_GBps"] = round(
-                    total / stats["coder_s"] / 1e9, 3)
-            log(f"e2e encode null-sink ({nv}x{vmb}MB): "
-                f"{out['ec_encode_e2e_tmpfs_nullsink_GBps']} GB/s wall, "
-                f"coder-only "
-                f"{out.get('ec_encode_e2e_tmpfs_coder_GBps')} GB/s "
-                f"({dt:.1f}s, coder {stats.get('coder_s', 0):.1f}s)")
+                    statistics.median(coder_rates), 3)
+                out["ec_encode_e2e_tmpfs_coder_best_GBps"] = round(
+                    max(coder_rates), 3)
+            log(f"e2e encode null-sink ({nv}x{vmb}MB, 3 passes): "
+                f"median {out['ec_encode_e2e_tmpfs_nullsink_GBps']} / "
+                f"best {out['ec_encode_e2e_tmpfs_nullsink_best_GBps']} GB/s"
+                f" wall; coder-only median "
+                f"{out.get('ec_encode_e2e_tmpfs_coder_GBps')} / best "
+                f"{out.get('ec_encode_e2e_tmpfs_coder_best_GBps')} GB/s")
             out["ec_encode_e2e_tmpfs_vols"] = nv
             out["ec_encode_e2e_tmpfs_vol_mb"] = vmb
             out["tmpfs_write_probe_GBps"] = round(
