@@ -851,6 +851,20 @@ class S3Gateway:
         for k, v in entry.extended.items():
             if k.startswith("x-amz-meta-"):
                 headers[k] = v.decode()
+        # response header overrides (s3tests test_object_response_headers:
+        # GetObject response-* query params rewrite the reply headers)
+        for qparam, hname in (("response-content-type", "Content-Type"),
+                              ("response-content-language",
+                               "Content-Language"),
+                              ("response-expires", "Expires"),
+                              ("response-cache-control", "Cache-Control"),
+                              ("response-content-disposition",
+                               "Content-Disposition"),
+                              ("response-content-encoding",
+                               "Content-Encoding")):
+            v = request.query.get(qparam)
+            if v:
+                headers[hname] = v
         rng = request.http_range
         has_range = rng.start is not None or rng.stop is not None
         offset = rng.start or 0
@@ -1016,18 +1030,27 @@ class S3Gateway:
 
     def _list_response(self, bucket, q, prefix, delimiter, max_keys, v2,
                        contents, prefixes, truncated):
+        # s3tests test_bucket_listv2_encoding_basic: encoding-type=url
+        # percent-encodes keys/prefixes in the XML
+        url_encode = q.get("encoding-type") == "url"
+
+        def enc(s: str) -> str:
+            return urllib.parse.quote(s, safe="/") if url_encode else s
+
         root = ET.Element("ListBucketResult",
                           xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
         ET.SubElement(root, "Name").text = bucket
-        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Prefix").text = enc(prefix)
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
         ET.SubElement(root, "IsTruncated").text = "true" if truncated else "false"
+        if url_encode:
+            ET.SubElement(root, "EncodingType").text = "url"
         if delimiter:
-            ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "Delimiter").text = enc(delimiter)
         last = ""
         for key, e in contents:
             c = ET.SubElement(root, "Contents")
-            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "Key").text = enc(key)
             ET.SubElement(c, "LastModified").text = _iso(e.attributes.mtime)
             ET.SubElement(c, "ETag").text = f'"{_entry_etag(e)}"'
             ET.SubElement(c, "Size").text = str(e.attributes.file_size)
@@ -1035,15 +1058,21 @@ class S3Gateway:
             last = max(last, key)
         for p in prefixes:
             cp = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(cp, "Prefix").text = p
+            ET.SubElement(cp, "Prefix").text = enc(p)
             last = max(last, p)
         if v2:
             ET.SubElement(root, "KeyCount").text = \
                 str(len(contents) + len(prefixes))
             if truncated:
+                # v2 tokens are OPAQUE: SDKs echo them back verbatim
+                # without decoding, and list_objects consumes the raw
+                # key — so no encoding here even under encoding-type=url
                 ET.SubElement(root, "NextContinuationToken").text = last
         elif truncated:
-            ET.SubElement(root, "NextMarker").text = last
+            # v1 NextMarker is a key-valued element: clients DECODE it
+            # under encoding-type=url before resending, so encode it like
+            # Key/Prefix or the resumed listing skips keys
+            ET.SubElement(root, "NextMarker").text = enc(last)
         return _xml_response(root)
 
     # -- multipart -----------------------------------------------------------

@@ -828,3 +828,39 @@ def test_ranged_request_single_byte(bucket):  # noqa: F811
     assert r.status_code == 206
     assert r.content == b"4"
     assert r.headers["Content-Range"] == "bytes 4-4/10"
+
+
+def test_object_response_headers(bucket):  # noqa: F811
+    # s3tests: test_object_response_headers — response-* query params
+    # override the reply headers
+    base, b = bucket
+    _put(base, b, "rh.bin", b"x", {"Content-Type": "text/plain"})
+    r = requests.get(
+        f"{base}/{b}/rh.bin"
+        "?response-content-type=application/weird"
+        "&response-content-disposition=attachment%3B%20filename%3Dd.bin"
+        "&response-cache-control=no-cache", timeout=10)
+    assert r.status_code == 200
+    assert r.headers["Content-Type"] == "application/weird"
+    assert r.headers["Content-Disposition"] == "attachment; filename=d.bin"
+    assert r.headers["Cache-Control"] == "no-cache"
+    # without overrides the stored type serves
+    r = requests.get(f"{base}/{b}/rh.bin", timeout=10)
+    assert r.headers["Content-Type"] == "text/plain"
+
+
+def test_bucket_listv2_encoding_url(bucket):  # noqa: F811
+    # s3tests: test_bucket_listv2_encoding_basic
+    base, b = bucket
+    for k in ("foo+1/bar", "foo/bar/xyzzy", "quux ab/thud", "asdf+b"):
+        _put(base, b, urllib.parse.quote(k, safe=""), b"v")
+    r = requests.get(f"{base}/{b}?list-type=2&encoding-type=url&delimiter=%2F",
+                     timeout=10)
+    root = _xml(r)
+    assert _tag(root, "EncodingType") == "url"
+    keys = [e.text for e in root.findall(".//Contents/Key")]
+    # '+' and ' ' are percent-encoded in the listing
+    assert "asdf%2Bb" in keys
+    prefixes = [e.text for e in root.findall(".//CommonPrefixes/Prefix")]
+    assert "foo%2B1/" in prefixes
+    assert "quux%20ab/" in prefixes
