@@ -279,6 +279,8 @@ class BrokerServer:
         self.topics: dict[str, list[Partition]] = {}
         # configure-time leader assignment: topic -> {range_start: broker}
         self.topic_leaders: dict[str, dict[int, str]] = {}
+        # topic -> serialized RecordType (mq_schema.proto); b"" = schemaless
+        self.topic_schemas: dict[str, bytes] = {}
         self.logs: dict[tuple[str, int], PartitionLog] = {}
         self._lock = threading.Lock()
         self._grpc = None
@@ -451,16 +453,22 @@ class BrokerServer:
                 self.logs[key] = lg
             return lg
 
-    def configure_topic(self, tref: TopicRef,
-                        partition_count: int) -> list[Partition]:
+    def configure_topic(self, tref: TopicRef, partition_count: int,
+                        record_type: bytes = b"") -> list[Partition]:
         """Create (or re-read) a topic. First configuration assigns each
         partition a leader round-robin over the live ring STARTING at
         this broker (reference pub_balancer allocates to brokers and the
         assignment sticks in the topic conf); reconfiguring an existing
-        topic with the same count keeps its assignment."""
+        topic with the same count keeps its assignment. `record_type` is
+        the serialized schema (mq_schema.proto RecordType) persisted with
+        the topic conf — reference ConfigureTopicRequest.record_type."""
         tname = str(tref)
         existing = self._topic_partitions(tref)
         if existing is not None and len(existing) == max(1, partition_count):
+            if record_type and self.topic_schemas.get(tname) != record_type:
+                with self._lock:
+                    self.topic_schemas[tname] = record_type
+                self._persist_topic_conf(tref)
             return existing
         parts = split_ring(max(1, partition_count))
         ring = self.live_brokers()
@@ -470,16 +478,50 @@ class BrokerServer:
         with self._lock:
             self.topics[tname] = parts
             self.topic_leaders[tname] = leaders
-        if self.filer is not None:
-            import json
-            self.filer.write_file(
-                f"/topics/{tref.namespace}/{tref.name}/topic.conf",
-                json.dumps({"partition_count": len(parts),
-                            "leaders": {str(k): v
-                                        for k, v in leaders.items()}}
-                           ).encode(),
-                mime="application/json")
+            if record_type:
+                self.topic_schemas[tname] = record_type
+        self._persist_topic_conf(tref)
         return parts
+
+    def _topic_schema(self, tref: TopicRef) -> bytes:
+        """Read-through schema lookup: a broker that cached the topic
+        BEFORE another broker registered a schema must still see it (the
+        conf lives in the shared filer)."""
+        tname = str(tref)
+        schema = self.topic_schemas.get(tname, b"")
+        if not schema and self.filer is not None:
+            import base64
+            import json
+
+            from ..filer.filer import split_path
+            d, n = split_path(
+                f"/topics/{tref.namespace}/{tref.name}/topic.conf")
+            entry = self.filer.filer.find_entry(d, n)
+            if entry is not None:
+                conf = json.loads(self.filer.read_entry_bytes(entry))
+                if conf.get("record_type_b64"):
+                    schema = base64.b64decode(conf["record_type_b64"])
+                    with self._lock:
+                        self.topic_schemas[tname] = schema
+        return schema
+
+    def _persist_topic_conf(self, tref: TopicRef) -> None:
+        if self.filer is None:
+            return
+        import base64
+        import json
+        tname = str(tref)
+        with self._lock:
+            parts = self.topics.get(tname, [])
+            leaders = dict(self.topic_leaders.get(tname, {}))
+            schema = self.topic_schemas.get(tname, b"")
+        conf = {"partition_count": len(parts),
+                "leaders": {str(k): v for k, v in leaders.items()}}
+        if schema:
+            conf["record_type_b64"] = base64.b64encode(schema).decode()
+        self.filer.write_file(
+            f"/topics/{tref.namespace}/{tref.name}/topic.conf",
+            json.dumps(conf).encode(), mime="application/json")
 
     def _topic_partitions(self, tref: TopicRef) -> list[Partition] | None:
         parts = self.topics.get(str(tref))
@@ -493,6 +535,7 @@ class BrokerServer:
                 f"/topics/{tref.namespace}/{tref.name}/topic.conf")
             entry = self.filer.filer.find_entry(d, n)
             if entry is not None:
+                import base64
                 conf = json.loads(self.filer.read_entry_bytes(entry))
                 parts = split_ring(conf["partition_count"])
                 with self._lock:
@@ -500,6 +543,9 @@ class BrokerServer:
                     self.topic_leaders[str(tref)] = {
                         int(k): v
                         for k, v in conf.get("leaders", {}).items()}
+                    if conf.get("record_type_b64"):
+                        self.topic_schemas[str(tref)] = base64.b64decode(
+                            conf["record_type_b64"])
                 return parts
         return None
 
@@ -528,8 +574,26 @@ class BrokerServer:
                    mq.ConfigureTopicResponse)
         def configure(req, ctx):
             tref = tref_of(req.topic)
-            parts = broker.configure_topic(tref, req.partition_count or 1)
+            parts = broker.configure_topic(tref, req.partition_count or 1,
+                                           bytes(req.record_type))
             resp = mq.ConfigureTopicResponse()
+            fill_assignments(resp, tref, parts)
+            return resp
+
+        @svc.unary("GetTopicConfiguration",
+                   mq.GetTopicConfigurationRequest,
+                   mq.GetTopicConfigurationResponse)
+        def get_topic_configuration(req, ctx):
+            """Reference GetTopicConfiguration: partitions + the topic's
+            registered schema (subscribers fetch it to decode records)."""
+            tref = tref_of(req.topic)
+            parts = broker._topic_partitions(tref)
+            if parts is None:
+                ctx.abort(5, f"topic {tref} not found")
+            resp = mq.GetTopicConfigurationResponse(
+                partition_count=len(parts),
+                record_type=broker._topic_schema(tref))
+            resp.topic.CopyFrom(req.topic)
             fill_assignments(resp, tref, parts)
             return resp
 

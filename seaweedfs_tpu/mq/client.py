@@ -20,13 +20,16 @@ class Publisher:
     pub_client/publish.go re-dials the same way)."""
 
     def __init__(self, broker_address: "str | list[str]", namespace: str,
-                 topic: str, partition_count: int = 1):
+                 topic: str, partition_count: int = 1, schema=None):
         self.seeds = ([broker_address] if isinstance(broker_address, str)
                       else list(broker_address))
         self.stub = Stub(self.seeds[0], MQ_SERVICE)
         self.tref = TopicRef(namespace, topic)
+        self.schema = schema  # mq.schema.Schema: typed-record publishing
         resp = self.stub.call("ConfigureTopic", _configure_req(
-            self.tref, partition_count), mq.ConfigureTopicResponse)
+            self.tref, partition_count,
+            schema.schema_bytes() if schema is not None else b""),
+            mq.ConfigureTopicResponse)
         self.partitions = [Partition(a.partition.range_start,
                                      a.partition.range_stop,
                                      a.partition.ring_size)
@@ -107,16 +110,38 @@ class Publisher:
                 self._refresh_leaders()
         raise RuntimeError(f"publish to {p} failed: {last_err}")
 
+    def publish_record(self, key: bytes, record) -> int:
+        """Typed publish: encode `record` (dict/dataclass) with the
+        topic's registered schema."""
+        if self.schema is None:
+            raise ValueError("publisher has no schema (pass schema=)")
+        return self.publish(key, self.schema.encode(record))
+
     def close(self) -> None:
         for q in self._queues.values():
             q.put(None)
 
 
-def _configure_req(tref: TopicRef, n: int) -> mq.ConfigureTopicRequest:
-    req = mq.ConfigureTopicRequest(partition_count=n)
+def _configure_req(tref: TopicRef, n: int,
+                   record_type: bytes = b"") -> mq.ConfigureTopicRequest:
+    req = mq.ConfigureTopicRequest(partition_count=n,
+                                   record_type=record_type)
     req.topic.namespace = tref.namespace
     req.topic.name = tref.name
     return req
+
+
+def topic_schema(broker_address: str, namespace: str, topic: str):
+    """Fetch a topic's registered schema (GetTopicConfiguration); None
+    for schemaless topics. Subscribers decode records with it."""
+    from .schema import Schema
+    req = mq.GetTopicConfigurationRequest()
+    req.topic.namespace = namespace
+    req.topic.name = topic
+    resp = Stub(broker_address, MQ_SERVICE).call(
+        "GetTopicConfiguration", req, mq.GetTopicConfigurationResponse)
+    return Schema.from_bytes(bytes(resp.record_type)) \
+        if resp.record_type else None
 
 
 def subscribe(broker_address: str, namespace: str, topic: str,

@@ -361,3 +361,49 @@ def test_describe_consumer_groups(two_brokers):
                if po.committed >= 0) == 8
     pub.close()
     c1.close()
+
+
+def test_topic_schema_registration_roundtrip(two_brokers):
+    """ConfigureTopic carries the record schema; GetTopicConfiguration
+    serves it back so any subscriber can decode typed records (reference
+    ConfigureTopicRequest.record_type / GetTopicConfiguration)."""
+    from seaweedfs_tpu.mq.client import Publisher, subscribe, topic_schema
+    from seaweedfs_tpu.mq.schema import Schema
+
+    brokers = two_brokers["brokers"]
+    addrs = [b.address for b in brokers]
+    schema = Schema.infer({"device": "d0", "temp": 0.0, "n": 0})
+    pub = Publisher(addrs, "typed2", "metrics", partition_count=2,
+                    schema=schema)
+    for i in range(6):
+        pub.publish_record(f"d{i % 2}".encode(),
+                           {"device": f"d{i % 2}", "temp": i * 1.5, "n": i})
+    pub.close()
+
+    # a fresh consumer learns the schema from the broker — EITHER broker,
+    # the conf is shared through the filer
+    fetched = topic_schema(addrs[1], "typed2", "metrics")
+    assert fetched is not None
+    assert fetched.record_type == schema.record_type
+    got = []
+    for p in pub.partitions:
+        lead = pub._leaders.get(p.range_start, addrs[0])
+        for _, _, v in subscribe(lead, "typed2", "metrics",
+                                 start_offset=0, partition=p):
+            got.append(fetched.decode(v))
+    assert sorted(r["n"] for r in got) == list(range(6))
+    # schemaless topics answer None
+    pub2 = Publisher(addrs, "typed2", "raw")
+    pub2.close()
+    assert topic_schema(addrs[0], "typed2", "raw") is None
+
+    # read-through: broker B cached the topic BEFORE the schema was
+    # registered through broker A — B must still serve it (shared conf)
+    pub3 = Publisher(addrs[0], "typed2", "late")  # created schemaless
+    assert topic_schema(addrs[1], "typed2", "late") is None  # B caches
+    late_schema = Schema.infer({"x": 1})
+    pub4 = Publisher(addrs[0], "typed2", "late", schema=late_schema)
+    got = topic_schema(addrs[1], "typed2", "late")
+    assert got is not None and got.record_type == late_schema.record_type
+    pub3.close()
+    pub4.close()
