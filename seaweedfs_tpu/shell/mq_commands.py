@@ -73,6 +73,22 @@ def cmd_mq_topic_desc(env: CommandEnv, args):
         env.println(f"partition [{a.partition.range_start},"
                     f"{a.partition.range_stop}) -> {a.leader_broker}")
     env.println(f"{len(resp.assignments)} partitions")
+    # registered record schema (ConfigureTopic record_type)
+    try:
+        gc = stub.call("GetTopicConfiguration",
+                       mq.GetTopicConfigurationRequest(
+                           topic=mq.Topic(namespace=ns, name=name)),
+                       mq.GetTopicConfigurationResponse, timeout=5)
+        if gc.record_type:
+            from ..mq.schema import Schema
+            sch = Schema.from_bytes(bytes(gc.record_type))
+            fields = ", ".join(
+                f.name for f in sch.record_type.fields)
+            env.println(f"schema: {{{fields}}}")
+        else:
+            env.println("schema: (none)")
+    except Exception:  # noqa: BLE001 — older broker without the RPC
+        pass
     # consumer groups: every live broker reports the groups ITS
     # coordinator manages (sub_coordinator.py); merge across brokers
     total_groups = 0
