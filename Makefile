@@ -1,6 +1,6 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress bench smoke protos
+.PHONY: test stress chaos bench smoke protos
 
 test:
 	python -m pytest tests/ -q
@@ -9,6 +9,12 @@ test:
 # bounded ~60s total at 6 s/scenario on an idle box
 stress:
 	python tests/stress/run_stress.py STRESS_r05.json 6
+
+# randomized fault schedules against a live mini-cluster (opt-in gate
+# like stress); bounded time, failing runs print their seed — replay with
+# SWTPU_CHAOS_SEED=<seed> make chaos
+chaos:
+	SWTPU_CHAOS=1 python -m pytest tests/chaos -q
 
 bench:
 	python bench.py

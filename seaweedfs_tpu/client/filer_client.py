@@ -82,15 +82,23 @@ class FilerClient:
         return urls
 
     def _fetch_blob_upstream(self, fid: str) -> bytes:
+        from ..utils import failpoints, retry
         from . import http_util
 
+        failpoints.check("filer.blob.read")
         last = None
         for attempt in range(2):
-            for url in self._lookup_fid(fid):
+            # known-dead holders (open breakers) go last; http_util
+            # itself retries transient blips with jittered backoff. The
+            # last candidate attempts even through an open breaker.
+            ordered = retry.order_by_breaker(self._lookup_fid(fid))
+            for i, url in enumerate(ordered):
                 try:
-                    r = http_util.get(f"http://{url}/{fid}", timeout=30)
+                    r = http_util.get(f"http://{url}/{fid}", timeout=30,
+                                      fail_fast_open=i < len(ordered) - 1)
                     if r.status == 200:
-                        return r.content
+                        return failpoints.corrupt("filer.blob.read.data",
+                                                  r.content)
                     last = f"HTTP {r.status}"
                 except Exception as e:  # noqa: BLE001
                     last = e

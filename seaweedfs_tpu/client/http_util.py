@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 import urllib.parse
 import uuid
 
 
+from ..utils import failpoints, retry
 from ..utils.fastweb import Headers  # shared case-insensitive header dict
 
 
@@ -164,15 +166,24 @@ def _read_one_response(c: _Conn, method: str) -> tuple[Response, bool]:
 
 def request(method: str, url: str, body: bytes | None = None,
             headers: dict | None = None, params: dict | None = None,
-            timeout: float = 60.0) -> Response:
-    """One HTTP round-trip on the calling thread's persistent connection.
+            timeout: float = 60.0, max_attempts: int | None = None,
+            policy: "retry.RetryPolicy | None" = None,
+            fail_fast_open: bool = False) -> Response:
+    """One logical HTTP round-trip with the shared fault-tolerance
+    envelope (utils/retry.py): per-peer circuit breaker, bounded
+    attempts with full-jitter exponential backoff, an overall deadline
+    on top of the per-attempt socket `timeout`, and the process retry
+    budget.
 
-    A stale keep-alive connection (server closed it between requests) gets
-    one transparent reconnect+retry. The blind retry on other socket
-    errors is restricted to idempotent methods: a slow-but-alive server
-    may have already EXECUTED a POST/PUT whose response timed out, and
-    re-sending would duplicate the mutation (duplicate assigns leak file
-    keys) — those errors surface to the caller immediately.
+    A stale keep-alive connection (server closed it between requests)
+    gets one transparent immediate reconnect — that's a liveness race,
+    not peer trouble, so it costs neither backoff nor breaker credit.
+    The blind retry on other socket errors is restricted to idempotent
+    methods and to failures BEFORE the request was fully sent: a
+    slow-but-alive server may have already EXECUTED a POST/PUT whose
+    response timed out, and re-sending would duplicate the mutation
+    (duplicate assigns leak file keys) — those errors surface to the
+    caller immediately.
     """
     if "://" in url:
         _, rest = url.split("://", 1)
@@ -192,43 +203,89 @@ def request(method: str, url: str, body: bytes | None = None,
         head += f"Content-Length: {len(body)}\r\n"
     req_bytes = head.encode("latin1") + b"\r\n" + body
     idempotent = method in ("GET", "HEAD", "DELETE", "OPTIONS")
-    for attempt in (0, 1):
-        c = _conn(netloc, timeout)
-        fresh = attempt == 1
+    pol = policy or retry.DEFAULT_POLICY
+    attempts = max_attempts or pol.max_attempts
+    deadline = time.monotonic() + pol.deadline
+    br = retry.breaker(netloc)
+    attempt = 0
+    stale_retried = False
+    last_err: Exception | None = None
+    while True:
+        attempt += 1
+        if not br.allow() and fail_fast_open:
+            # `fail_fast_open` is for replica-iterating callers that still
+            # hold ANOTHER candidate: they move on instead of burning a
+            # connect timeout here. The default attempts anyway — an open
+            # breaker must cost latency, never availability, when this
+            # netloc is the only way to serve the request.
+            raise retry.BreakerOpenError(netloc, br.remaining_cooldown())
         sent = False
-        reused = c.used > 0
-        c.used += 1
+        reused = False
         try:
+            # flaky-wire site: a fault here is pre-send, safe for any
+            # method to retry (chaos schedules arm it with pct:P)
+            failpoints.check("http.request")
+            c = _conn(netloc, timeout)
+            reused = c.used > 0
+            c.used += 1
             c.sock.sendall(req_bytes)
             sent = True
             resp, keep = _read_response(c, method)
             if not keep:
                 _drop(netloc)
+            br.record_success()
+            retry.BUDGET.deposit()
             return resp
         except _Stale:
+            _drop(netloc)
             # On a REUSED connection this is the idle keep-alive close
             # race (the server closed before seeing the request): any
-            # method retries safely. On a FRESH connection the server
-            # accepted the request and closed without a response — a
-            # mutation may have executed, so the idempotency guard
-            # applies just like any other read-phase failure.
+            # method retries immediately and for free. On a FRESH
+            # connection the server accepted the request and closed
+            # without a response — a mutation may have executed, so the
+            # idempotency guard applies like any read-phase failure.
+            if reused and not stale_retried:
+                stale_retried = True
+                attempt -= 1  # the free reconnect, not a real retry
+                continue
+            last_err = OSError(f"connection to {netloc} closed")
+            br.record_failure()
+            if sent and not reused and not idempotent:
+                raise last_err from None
+        except failpoints.FailpointError as e:
+            last_err = e
+            br.record_failure()
+        except (ConnectionError, BrokenPipeError, socket.timeout, OSError) as e:
             _drop(netloc)
-            if fresh or (not reused and sent and not idempotent):
-                raise OSError(f"connection to {netloc} closed") from None
-        except (ConnectionError, BrokenPipeError, socket.timeout, OSError):
-            _drop(netloc)
+            br.record_failure()
+            last_err = e
             # send-phase failure: the request never went out whole, any
             # method retries. Read-phase failure after a full send: the
             # server may have EXECUTED the mutation — only idempotent
             # methods retry blindly.
-            if fresh or (sent and not idempotent):
+            if sent and not idempotent:
                 raise
-    raise AssertionError("unreachable")
+        if attempt >= attempts:
+            raise last_err
+        delay = pol.backoff(attempt)
+        if time.monotonic() + delay > deadline:
+            raise last_err  # the envelope is spent: fail now, not later
+        if not retry.BUDGET.withdraw():
+            raise last_err
+        try:
+            from ..stats import RETRY_ATTEMPTS
+            RETRY_ATTEMPTS.inc(f"http.{method}")
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(delay)
 
 
 def get(url: str, params: dict | None = None, timeout: float = 60.0,
-        headers: dict | None = None) -> Response:
-    return request("GET", url, params=params, timeout=timeout, headers=headers)
+        headers: dict | None = None, max_attempts: int | None = None,
+        fail_fast_open: bool = False) -> Response:
+    return request("GET", url, params=params, timeout=timeout,
+                   headers=headers, max_attempts=max_attempts,
+                   fail_fast_open=fail_fast_open)
 
 
 def post(url: str, body: bytes = b"", headers: dict | None = None,

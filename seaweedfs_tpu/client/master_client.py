@@ -12,6 +12,7 @@ import time
 
 from ..pb import master_pb2 as pb
 from ..storage.types import parse_file_id
+from ..utils import retry
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, Stub
 
@@ -172,20 +173,65 @@ class MasterClient:
 
     def _call_any(self, method: str, req, resp_cls, timeout: float = 10.0):
         """Unary call with quorum fallback: try the known leader, then
-        the rest of the master list (reads work against any master)."""
+        the rest of the master list (reads work against any master).
+        Candidates are ordered healthy-first by their circuit breakers,
+        and one jittered second sweep covers an election-in-progress blip
+        instead of failing the whole operation on the first pass."""
         last_err: Exception | None = None
-        for addr in [self.leader] + [m for m in self.masters
-                                     if m != self.leader]:
+        pol = retry.DEFAULT_POLICY
+        deadline = time.monotonic() + pol.deadline
+
+        def try_addr(addr: str):
+            nonlocal last_err
+            br = retry.breaker(addr)
             try:
-                return Stub(addr, MASTER_SERVICE).call(
+                resp = Stub(addr, MASTER_SERVICE).call(
                     method, req, resp_cls, timeout=timeout)
             except Exception as e:  # noqa: BLE001
+                br.record_failure()
                 last_err = e
+                return None
+            br.record_success()
+            retry.BUDGET.deposit()
+            return resp
+
+        for sweep in range(2):
+            candidates = retry.order_by_breaker(
+                [self.leader] + [m for m in self.masters
+                                 if m != self.leader])
+            skipped = []
+            for addr in candidates:
+                if not retry.breaker(addr).allow():
+                    skipped.append(addr)  # cooling: healthy peers first
+                    continue
+                resp = try_addr(addr)
+                if resp is not None:
+                    return resp
+            for addr in skipped:
+                # every healthy candidate failed: the cooling peers are
+                # the last resort — an open breaker must cost latency,
+                # never availability
+                resp = try_addr(addr)
+                if resp is not None:
+                    return resp
+            delay = pol.backoff(sweep + 1)
+            if (sweep == 0 and time.monotonic() + delay <= deadline
+                    and retry.BUDGET.withdraw()):
+                from ..stats import RETRY_ATTEMPTS
+                RETRY_ATTEMPTS.inc(f"master.{method}")
+                time.sleep(delay)
+                continue
+            break
         raise RuntimeError(f"{method}: no reachable master ({last_err})")
 
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
-               disk_type: str = "") -> pb.AssignResponse:
+               disk_type: str = "",
+               deadline: float | None = None) -> pb.AssignResponse:
+        """`deadline` (time.monotonic() value) lets an ENCLOSING retry
+        envelope (submit, filer _save_blob) bound this call's quorum
+        sweeps too, so nested envelopes share one wall-clock budget
+        instead of stacking multiplicatively."""
         if self.http_address and time.monotonic() >= self._http_assign_retry_at:
             try:
                 return self._assign_http(count, collection, replication, ttl,
@@ -208,40 +254,61 @@ class MasterClient:
             ttl=ttl, disk_type=disk_type)
         # leader hints can be stale right after a failover — fall back
         # through the whole quorum rather than pinning a dead address
-        # (reference masterclient round-robin + leader redirect)
-        candidates = [self.leader] + [m for m in self.masters
-                                      if m != self.leader]
+        # (reference masterclient round-robin + leader redirect), ordered
+        # healthy-first by breaker, and re-swept with jittered backoff so
+        # an election in progress delays the assign instead of failing it
+        pol = retry.WRITE_POLICY
+        stop_at = (deadline if deadline is not None
+                   else time.monotonic() + pol.deadline)
         last_err: Exception | None = None
-        for addr in candidates:
-            try:
-                resp = Stub(addr, MASTER_SERVICE).call(
-                    "Assign", req, pb.AssignResponse, timeout=10)
-            except Exception as e:  # noqa: BLE001
-                last_err = e
-                continue
-            if resp.error.startswith("not leader"):
-                if "; leader is " not in resp.error:
-                    last_err = RuntimeError(resp.error)
-                    continue  # election in progress: try next candidate
-                hint = resp.error.rsplit(" ", 1)[-1]
+        for sweep in range(1, pol.max_attempts + 1):
+            candidates = retry.order_by_breaker(
+                [self.leader] + [m for m in self.masters
+                                 if m != self.leader])
+            for addr in candidates:
+                br = retry.breaker(addr)
                 try:
-                    resp = Stub(hint, MASTER_SERVICE).call(
+                    resp = Stub(addr, MASTER_SERVICE).call(
                         "Assign", req, pb.AssignResponse, timeout=10)
                 except Exception as e:  # noqa: BLE001
+                    br.record_failure()
                     last_err = e
-                    continue  # hint dead: try next candidate
+                    continue
+                br.record_success()
                 if resp.error.startswith("not leader"):
-                    last_err = RuntimeError(resp.error)
-                    continue  # stale hint: try next candidate
+                    if "; leader is " not in resp.error:
+                        last_err = RuntimeError(resp.error)
+                        continue  # election in progress: try next candidate
+                    hint = resp.error.rsplit(" ", 1)[-1]
+                    hint_br = retry.breaker(hint)
+                    try:
+                        resp = Stub(hint, MASTER_SERVICE).call(
+                            "Assign", req, pb.AssignResponse, timeout=10)
+                    except Exception as e:  # noqa: BLE001
+                        hint_br.record_failure()
+                        last_err = e
+                        continue  # hint dead: try next candidate
+                    hint_br.record_success()
+                    if resp.error.startswith("not leader"):
+                        last_err = RuntimeError(resp.error)
+                        continue  # stale hint: try next candidate
+                    if resp.error:
+                        # the real leader answered with a genuine failure
+                        raise RuntimeError(f"assign: {resp.error}")
+                    self.leader = hint
+                    return resp
                 if resp.error:
-                    # the real leader answered with a genuine failure
                     raise RuntimeError(f"assign: {resp.error}")
-                self.leader = hint
+                self.leader = addr
                 return resp
-            if resp.error:
-                raise RuntimeError(f"assign: {resp.error}")
-            self.leader = addr
-            return resp
+            delay = pol.backoff(sweep)
+            if (sweep >= pol.max_attempts
+                    or time.monotonic() + delay > stop_at
+                    or not retry.BUDGET.withdraw()):
+                break
+            from ..stats import RETRY_ATTEMPTS
+            RETRY_ATTEMPTS.inc("master.Assign")
+            time.sleep(delay)
         raise RuntimeError(f"assign: no reachable leader ({last_err})")
 
     def _assign_http(self, count: int, collection: str, replication: str,

@@ -9,9 +9,11 @@ client CPU per call, which dominated the small-file data plane).
 from __future__ import annotations
 
 import gzip as _gzip
+import time
 from dataclasses import dataclass
 
 from ..storage.types import parse_file_id
+from ..utils import failpoints, retry
 from . import http_util
 from .master_client import MasterClient
 
@@ -30,6 +32,7 @@ def upload(url: str, data: bytes, name: str = "", mime: str = "",
            jwt: str = "") -> dict:
     """PUT one blob to a volume server (reference upload_content.go:151).
     `jwt` is the single-fid write token the master minted on Assign."""
+    failpoints.check("client.upload")
     body = data
     gzipped = False
     compressible = (mime.startswith("text/") or name.endswith((".txt", ".json",
@@ -64,21 +67,33 @@ def upload(url: str, data: bytes, name: str = "", mime: str = "",
 def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
            collection: str = "", replication: str = "", ttl: str = "",
            retries: int = 3) -> UploadResult:
-    """Assign a fid then upload (reference submit.go:58)."""
-    last_err: Exception | None = None
-    for _ in range(retries):
-        try:
-            a = mc.assign(collection=collection, replication=replication, ttl=ttl)
-            target = a.location.public_url or a.location.url
-            res = upload(f"{target}/{a.fid}", data, name=name, mime=mime,
-                         ttl=ttl, jwt=a.auth)
-            return UploadResult(fid=a.fid, url=target,
-                                size=res.get("size", len(data)),
-                                e_tag=res.get("eTag", ""),
-                                name=res.get("name", name))
-        except Exception as e:  # noqa: BLE001
-            last_err = e
-    raise RuntimeError(f"submit failed after {retries} tries: {last_err}")
+    """Assign a fid then upload (reference submit.go:58). Each retry
+    gets a FRESH assign (the previous target may be the dead node), with
+    full-jitter backoff and an overall deadline via the shared
+    fault-tolerance envelope (utils/retry.py)."""
+
+    stop_at = time.monotonic() + retry.WRITE_POLICY.deadline
+
+    def attempt() -> UploadResult:
+        # the enclosing envelope's wall clock bounds the inner assign
+        # sweeps too — nested envelopes share one budget
+        a = mc.assign(collection=collection, replication=replication,
+                      ttl=ttl, deadline=stop_at)
+        target = a.location.public_url or a.location.url
+        res = upload(f"{target}/{a.fid}", data, name=name, mime=mime,
+                     ttl=ttl, jwt=a.auth)
+        return UploadResult(fid=a.fid, url=target,
+                            size=res.get("size", len(data)),
+                            e_tag=res.get("eTag", ""),
+                            name=res.get("name", name))
+
+    try:
+        return retry.retry_call(
+            attempt, op="client.submit",
+            policy=retry.WRITE_POLICY.with_(max_attempts=retries))
+    except Exception as e:
+        raise RuntimeError(f"submit failed after {retries} tries: {e}") \
+            from e
 
 
 def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
@@ -88,11 +103,17 @@ def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
     (volume moved/evacuated), so one refreshed-lookup retry pass runs before
     giving up (LookupFileIdWithFallback masterclient.go:59).
     Pass `jwt` (a read-key token) when the cluster read-gates volumes."""
+    failpoints.check("client.read")
     vid, _, _ = parse_file_id(fid)
     last_err: Exception | None = None
     params = {"jwt": jwt} if jwt else None
     all_404 = False
     urls: list[str] = []
+
+    def _netloc(u: str) -> str:
+        rest = u.split("://", 1)[-1]
+        return rest.split("/", 1)[0]
+
     for attempt in range(2):
         saw_404 = saw_other_err = False
         try:
@@ -100,9 +121,16 @@ def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
         except KeyError as e:
             last_err = e
             urls = []
-        for url in urls:
+        # replicas with open breakers go last: a known-dead holder should
+        # cost us nothing while a healthy replica can serve the read
+        # (http_util records the per-peer outcomes). Only the LAST
+        # candidate attempts through an open breaker — earlier ones fail
+        # fast and move on, but the read always keeps one real attempt.
+        ordered = retry.order_by_breaker(urls, key=_netloc)
+        for i, url in enumerate(ordered):
             try:
-                r = http_util.get(url, params=params)
+                r = http_util.get(url, params=params,
+                                  fail_fast_open=i < len(ordered) - 1)
                 # a volume server in read_mode=redirect answers 301/302
                 # with the holder's URL (volume_server _read_remote)
                 hops = 0
@@ -118,6 +146,12 @@ def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
                 if r.status >= 300:
                     raise RuntimeError(f"HTTP {r.status} from {url}")
                 return r.content
+            except retry.BreakerOpenError as e:
+                # a SKIP, not evidence about the file: the healthy
+                # replicas' 404s stay authoritative (a circuit-open
+                # holder diverging from its replica set is the smaller
+                # risk than 5xx-ing definitively-deleted files forever)
+                last_err = e
             except Exception as e:  # noqa: BLE001
                 saw_other_err = True
                 last_err = e

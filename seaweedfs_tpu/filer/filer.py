@@ -13,6 +13,7 @@ import time
 from typing import Callable, Iterator
 
 from ..pb import filer_pb2 as fpb
+from ..utils import failpoints
 from ..utils.log import logger
 from .chunks import resolve_manifests, separate_manifest_chunks, total_size
 from .meta_log import MetaLog
@@ -63,6 +64,7 @@ class Filer:
         uses: chunks are shared cluster-wide, and GC-ing the replaced
         version's chunks on EVERY mesh filer would delete both sides of
         a concurrent update (the origin filer already GCs once)."""
+        failpoints.check("filer.create_entry")
         if not entry.attributes.crtime:
             entry.attributes.crtime = int(time.time())
         if not entry.attributes.mtime:
@@ -106,6 +108,7 @@ class Filer:
                      touch_mtime: bool = True) -> None:
         """touch_mtime=False is for metadata-only updates (xattr, chmod):
         POSIX says those change ctime, not mtime."""
+        failpoints.check("filer.update_entry")
         old = self.store.find_entry(directory, entry.name)
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
@@ -266,6 +269,7 @@ class Filer:
                      is_delete_data: bool = True, is_recursive: bool = False,
                      from_other_cluster: bool = False,
                      signatures: list[int] | None = None) -> None:
+        failpoints.check("filer.delete_entry")
         entry = self.store.find_entry(directory, name)
         if entry is None:
             return
@@ -310,6 +314,7 @@ class Filer:
     # -- rename (reference filer_rename.go / AtomicRenameEntry) -------------
     def rename(self, old_dir: str, old_name: str, new_dir: str,
                new_name: str) -> None:
+        failpoints.check("filer.rename")
         entry = self.store.find_entry(old_dir, old_name)
         if entry is None:
             raise FileNotFoundError(join_path(old_dir, old_name))

@@ -170,18 +170,33 @@ class FilerServer:
     # -- chunk IO helpers ----------------------------------------------------
     def _save_blob(self, data: bytes, ttl: str = "",
                    path: str = "") -> fpb.FileChunk:
+        from ..utils import failpoints, retry
         collection, replication, rule_ttl, disk = self._storage_rule(path)
         cipher_key = b""
         logical = len(data)
         if self.encrypt_data:
             from ..security.cipher import encrypt
             data, cipher_key = encrypt(data)
-        a = self.mc.assign(collection=collection,
-                           replication=replication, ttl=ttl or rule_ttl,
-                           disk_type=disk)
-        target = a.location.public_url or a.location.url
-        res = operation.upload(f"{target}/{a.fid}", data,
-                               gzip_if_worthwhile=False, ttl=ttl, jwt=a.auth)
+        failpoints.check("filer.blob.write")
+        import time as _time
+        stop_at = _time.monotonic() + retry.WRITE_POLICY.deadline
+
+        def assign_and_upload():
+            # a failed upload retries with a FRESH assign: the first
+            # target may be the transiently-dead node (filer→volume hop);
+            # the enclosing envelope's wall clock bounds the assign
+            # sweeps too, so nested envelopes share one budget
+            a = self.mc.assign(collection=collection,
+                               replication=replication, ttl=ttl or rule_ttl,
+                               disk_type=disk, deadline=stop_at)
+            target = a.location.public_url or a.location.url
+            res = operation.upload(f"{target}/{a.fid}", data,
+                                   gzip_if_worthwhile=False, ttl=ttl,
+                                   jwt=a.auth)
+            return a, res
+
+        a, res = retry.retry_call(assign_and_upload, op="filer.blob.write",
+                                  policy=retry.WRITE_POLICY)
         # freshly written chunks are the likeliest next reads — seed the
         # MEM tier with exactly what a volume-server GET would return
         # (never the disk tier: that would double local writes on ingest)
@@ -196,7 +211,12 @@ class FilerServer:
                              cipher_key=cipher_key)
 
     def _fetch_blob_upstream(self, fid: str) -> bytes:
-        return operation.read(self.mc, fid)
+        from ..utils import failpoints
+        failpoints.check("filer.blob.read")
+        # operation.read carries the retry/breaker envelope; the corrupt
+        # site models a bad wire so CRC-style invariants can be drilled
+        return failpoints.corrupt("filer.blob.read.data",
+                                  operation.read(self.mc, fid))
 
     def _fetch_blob(self, fid: str, upcoming: "list[str] | None" = None
                     ) -> bytes:

@@ -162,13 +162,19 @@ class MetaLog:
                         file_pos, file_gen = 0, self._purge_gen
                     fresh, file_pos = self._read_persisted(
                         last, start_pos=file_pos)
-                elif not warned_gap:
-                    warned_gap = True
-                    log.warning(
-                        "meta tail window overflowed a memory-only log: "
-                        "a lagging subscriber lost events before %d "
-                        "(persist the log or raise tail_window)",
-                        self._evicted_ts)
+                else:
+                    if not warned_gap:
+                        warned_gap = True
+                        log.warning(
+                            "meta tail window overflowed a memory-only "
+                            "log: a lagging subscriber lost events "
+                            "before %d (persist the log or raise "
+                            "tail_window)", self._evicted_ts)
+                    # the lost events are unrecoverable: advance past the
+                    # gap or this loop spins at 100% CPU re-detecting it
+                    # (the cv wait above only engages once last catches up
+                    # to the evicted watermark)
+                    last = max(last, self._evicted_ts)
             for ts, blob in fresh:
                 # re-check per event: a stopped subscriber must not keep
                 # consuming (a "stopped" FilerSync would still replicate)

@@ -16,6 +16,7 @@ import time
 
 from ..pb import master_pb2 as pb
 from ..storage.types import TTL, ReplicaPlacement, file_id
+from ..utils import failpoints
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 from .sequencer import MemorySequencer, SnowflakeSequencer
@@ -426,6 +427,7 @@ class MasterServer:
 
         @svc.unary("LookupVolume", pb.LookupVolumeRequest, pb.LookupVolumeResponse)
         def lookup(req, context):
+            failpoints.check("master.lookup")
             resp = pb.LookupVolumeResponse()
             for vf in req.volume_or_file_ids:
                 entry = resp.volume_id_locations.add(volume_or_file_id=vf)
@@ -466,6 +468,7 @@ class MasterServer:
         @svc.unary("LookupEcVolume", pb.LookupEcVolumeRequest,
                    pb.LookupEcVolumeResponse)
         def lookup_ec(req, context):
+            failpoints.check("master.lookup.ec")
             resp = pb.LookupEcVolumeResponse(volume_id=req.volume_id)
             for sid, nodes in sorted(ms.topo.lookup_ec(req.volume_id).items()):
                 e = resp.shard_id_locations.add(shard_id=sid)
@@ -683,6 +686,9 @@ class MasterServer:
 
     def do_assign(self, req: pb.AssignRequest,
                   allow_growth: bool = True) -> pb.AssignResponse:
+        # error = master transiently refusing assigns (clients must retry
+        # through the envelope); delay = overloaded leader
+        failpoints.check("master.assign")
         resp = self._do_assign(req, allow_growth=allow_growth)
         if resp.error != self.NEEDS_GROWTH:
             from ..stats import MASTER_ASSIGN_COUNTER

@@ -36,8 +36,11 @@ SEGMENT_FLUSH_COUNT = 1000  # messages per persisted segment
 
 def _stable_hash(s: str) -> int:
     """Deterministic across processes (unlike hash()) so every broker
-    ranks the same owner for a partition or group."""
-    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+    ranks the same owner for a partition or group. blake2b, not md5:
+    md5 raises on FIPS-enforcing builds (usedforsecurity defaults True)
+    and this is placement hashing, not cryptography."""
+    return int.from_bytes(
+        hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
 
 
 class PartitionLog:
@@ -623,6 +626,7 @@ class BrokerServer:
             hand-off messages are needed."""
             resp = mq.BalanceTopicsResponse()
             ring = broker.live_brokers()
+            healed_topics: "list[TopicRef]" = []
             with broker._lock:  # one lock span: a concurrent
                 # ConfigureTopic must not be reverted from a stale snapshot
                 for full in sorted(broker.topics):
@@ -642,21 +646,19 @@ class BrokerServer:
                             healed = True
                     broker.topic_leaders[full] = leaders
                     ns, _, name = full.partition(".")
-                    if healed and broker.filer is not None:
-                        import json
-                        broker.filer.write_file(
-                            f"/topics/{ns}/{name}/topic.conf",
-                            json.dumps({
-                                "partition_count": len(rebuilt),
-                                "leaders": {str(k): v
-                                            for k, v in leaders.items()},
-                            }).encode(), mime="application/json")
+                    if healed:
+                        healed_topics.append(TopicRef(ns, name))
                     a = resp.assignments.add()
                     a.topic.namespace, a.topic.name = ns, name
                     for p in rebuilt:
                         a.partitions.add(range_start=p.range_start,
                                          range_stop=p.range_stop,
                                          ring_size=p.ring_size)
+            # persist OUTSIDE broker._lock (it re-acquires it), via the
+            # one conf writer — a hand-rolled dict here silently dropped
+            # record_type_b64, so a healed topic lost its registered schema
+            for tref in healed_topics:
+                broker._persist_topic_conf(tref)
             return resp
 
         @svc.unary("ListTopics", mq.ListTopicsRequest, mq.ListTopicsResponse)

@@ -18,6 +18,7 @@ import os
 from typing import Callable
 
 from ..pb import filer_pb2 as fpb
+from ..utils import failpoints
 from ..utils.log import logger
 
 log = logger("replication.sink")
@@ -96,6 +97,9 @@ class FilerSink(ReplicationSink):
                      read_data: DataReader,
                      signatures: list[int] | None = None) -> None:
         from ..filer.filer import split_path
+        # failpoint: destination-cluster hiccup — the replicator's
+        # per-event retry/dead-letter path is driven from here
+        failpoints.check("replication.sink.create")
         target = self._path(path)
         if entry.is_directory:
             d, n = split_path(target)
@@ -113,6 +117,7 @@ class FilerSink(ReplicationSink):
     def update_entry(self, path: str, entry: fpb.Entry,
                      read_data: DataReader,
                      signatures: list[int] | None = None) -> None:
+        failpoints.check("replication.sink.update")
         # write_file overwrites in place; no need to delete first
         if entry.is_directory:
             return
@@ -122,6 +127,7 @@ class FilerSink(ReplicationSink):
 
     def delete_entry(self, path: str, is_directory: bool) -> None:
         from ..filer.filer import split_path
+        failpoints.check("replication.sink.delete")
         d, n = split_path(self._path(path))
         try:
             self.fs.filer.delete_entry(d, n, is_recursive=is_directory,

@@ -24,7 +24,7 @@ from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.types import TTL, parse_file_id
 from ..storage.vacuum import commit_compact, compact
-from ..utils import failpoints
+from ..utils import failpoints, retry
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 
@@ -71,6 +71,7 @@ class VolumeServer:
         self._grpc = None
         self._http_thread = None
         self._hb_thread = None
+        self._hb_active_stream = None
         self._http_runner = None
         # EC shard-location cache (tiers, store_ec.go:256-267) + the
         # degraded-read fan-out pool (store_ec.go:367 goroutine fan-out)
@@ -108,6 +109,18 @@ class VolumeServer:
             return
         self._stop.set()
         self._hb_wake.set()
+        # tear the live heartbeat stream so the blocked thread unblocks
+        # NOW, then join it — otherwise it outlives the test/daemon and
+        # spams "I/O operation on closed file" retrying against a closed
+        # store and torn-down logging
+        stream = self._hb_active_stream
+        if stream is not None:
+            try:
+                stream.cancel()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         if self._grpc:
             self._grpc.stop(grace=0.5)
         self._ec_read_pool.shutdown(wait=False, cancel_futures=True)
@@ -190,6 +203,12 @@ class VolumeServer:
                 stream = stub.stream_stream(
                     "SendHeartbeat", self._heartbeat_messages(),
                     mpb.Heartbeat, mpb.HeartbeatResponse)
+                # kept for stop(): cancelling unblocks this thread so the
+                # join in stop() returns promptly
+                self._hb_active_stream = stream
+                if self._stop.is_set():
+                    stream.cancel()
+                    return
                 for resp in stream:
                     # master answers 1:1 AFTER ingesting each heartbeat:
                     # the oldest in-flight snapshot is now master-visible
@@ -213,7 +232,9 @@ class VolumeServer:
                         self._master_rr = ((self._master_rr + 1)
                                            % len(self.masters))
                         self.current_leader = self.masters[self._master_rr]
-                    time.sleep(min(self.pulse_seconds, 2.0))
+                    # interruptible wait: a stop() during the retry pause
+                    # must not leave a zombie heartbeat thread behind
+                    self._stop.wait(min(self.pulse_seconds, 2.0))
             finally:
                 with self._hb_cond:
                     # unacked sends died with the stream; the next stream
@@ -427,24 +448,74 @@ class VolumeServer:
         peers = [u for u in self._lookup_replicas_cached(vid) if u != self.url]
         if not peers:
             return
+        import asyncio
+
         import aiohttp
 
         headers = {"Content-Type": mime.decode() or "application/octet-stream"}
         if gzipped:
             headers["Content-Encoding"] = "gzip"
-        async with aiohttp.ClientSession(auto_decompress=False) as sess:
+        # every replica must land or the whole write fails (reference
+        # store_replicate.go:25) — so a transiently-flaky peer gets the
+        # retry envelope (jittered backoff + deadline) before we give up.
+        # Breakers record outcomes for observability but never skip a
+        # peer here: durability beats latency on the replica fan-out.
+        pol = retry.WRITE_POLICY
+        # per-attempt deadline: a black-holed peer costs attempt_timeout,
+        # not aiohttp's 5-minute default, and the envelope's overall
+        # deadline bounds the whole fan-out
+        timeout = aiohttp.ClientTimeout(total=pol.attempt_timeout)
+        deadline = time.monotonic() + pol.deadline  # bounds the WHOLE fan-out
+        async with aiohttp.ClientSession(auto_decompress=False,
+                                         timeout=timeout) as sess:
             for peer in peers:
-                # failpoint: a dead replica peer without killing a real
-                # process — drives the write-path failure handling
-                failpoints.check("replicate.peer")
-                url = f"http://{peer}/{fid}?type=replicate"
-                if name:
-                    url += "&" + urllib.parse.urlencode(
-                        {"name": name.decode(errors="replace")})
-                url += self._peer_jwt_param(fid)
-                async with sess.post(url, data=data, headers=headers) as r:
-                    if r.status >= 300:
-                        raise OSError(f"replicate to {peer}: HTTP {r.status}")
+                br = retry.breaker(peer)
+                last_err: Exception | None = None
+                for attempt in range(1, pol.max_attempts + 1):
+                    try:
+                        # failpoint: a dead replica peer without killing a
+                        # real process — drives write-path failure handling
+                        failpoints.check("replicate.peer")
+                        url = f"http://{peer}/{fid}?type=replicate"
+                        if name:
+                            url += "&" + urllib.parse.urlencode(
+                                {"name": name.decode(errors="replace")})
+                        url += self._peer_jwt_param(fid)
+                        async with sess.post(url, data=data,
+                                             headers=headers) as r:
+                            status = r.status
+                        if 300 <= status < 500:
+                            # deterministic rejection (auth/config
+                            # mismatch): the peer is alive and retrying
+                            # the identical request can't succeed — no
+                            # breaker charge, no backoff, write fails now
+                            last_err = OSError(f"replicate to {peer}: "
+                                               f"HTTP {status}")
+                            break
+                        if status >= 500:
+                            raise OSError(f"replicate to {peer}: "
+                                          f"HTTP {status}")
+                        br.record_success()
+                        retry.BUDGET.deposit()
+                        last_err = None
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        br.record_failure()
+                        last_err = e
+                        delay = pol.backoff(attempt)
+                        if (attempt >= pol.max_attempts
+                                or time.monotonic() + delay > deadline
+                                or not retry.BUDGET.withdraw()):
+                            break
+                        try:
+                            from ..stats import RETRY_ATTEMPTS
+                            RETRY_ATTEMPTS.inc("replicate.peer")
+                        except Exception:  # noqa: BLE001
+                            pass
+                        await asyncio.sleep(delay)
+                if last_err is not None:
+                    raise OSError(f"replicate to {peer} failed after "
+                                  f"retries: {last_err}")
 
     def _peer_jwt_param(self, fid: str) -> str:
         """Replica fan-out re-mints a write token with the shared signing key
@@ -534,7 +605,9 @@ class VolumeServer:
         if self.read_mode == "local":
             return json_response({"error": f"volume {vid} not local"},
                                  status=404)
-        peers = [u for u in self._lookup_replicas(vid) if u != self.url]
+        # known-dead holders go last on the proxy/redirect hop too
+        peers = retry.order_by_breaker(
+            [u for u in self._lookup_replicas(vid) if u != self.url])
         if not peers:
             return json_response({"error": f"volume {vid} not found"},
                                  status=404)
@@ -545,12 +618,26 @@ class VolumeServer:
             raise Redirect(f"http://{peers[0]}/{fid}{suffix}", status=301)
         import aiohttp
 
-        async with aiohttp.ClientSession() as sess:
-            async with sess.get(f"http://{peers[0]}/{fid}{suffix}") as r:
-                body = await r.read()
-                return Response(
-                    body, status=r.status,
-                    content_type=r.content_type or "application/octet-stream")
+        timeout = aiohttp.ClientTimeout(
+            total=retry.READ_POLICY.attempt_timeout)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+            last_err: Exception | None = None
+            for peer in peers:
+                br = retry.breaker(peer)
+                try:
+                    async with sess.get(f"http://{peer}/{fid}{suffix}") as r:
+                        body = await r.read()
+                        br.record_success()
+                        return Response(
+                            body, status=r.status,
+                            content_type=(r.content_type
+                                          or "application/octet-stream"))
+                except Exception as e:  # noqa: BLE001
+                    br.record_failure()
+                    last_err = e
+            return json_response(
+                {"error": f"proxy read vid {vid} failed: {last_err}"},
+                status=502)
 
     async def _handle_delete(self, request):
         from ..utils.fastweb import json_response
@@ -577,15 +664,27 @@ class VolumeServer:
             if peers:
                 import aiohttp
 
-                async with aiohttp.ClientSession() as sess:
+                timeout = aiohttp.ClientTimeout(
+                    total=retry.WRITE_POLICY.attempt_timeout)
+                async with aiohttp.ClientSession(timeout=timeout) as sess:
                     for peer in peers:
-                        await sess.delete(f"http://{peer}/{fid}?type=replicate"
-                                          + self._peer_jwt_param(fid))
+                        try:
+                            # failpoint: a replica missing the delete
+                            # fan-out (the tombstone heals on the next
+                            # write/vacuum) — per-peer best effort, the
+                            # local delete already succeeded
+                            failpoints.check("replicate.delete.peer")
+                            await sess.delete(
+                                f"http://{peer}/{fid}?type=replicate"
+                                + self._peer_jwt_param(fid))
+                        except Exception as e:  # noqa: BLE001
+                            log.warning("delete fan-out to %s: %s", peer, e)
         return json_response({"size": 1 if ok else 0}, status=202)
 
     # -- EC shard reader: remote fetch + degraded reconstruct ---------------
     def _fetch_remote_shard(self, vid: int, sid: int, offset: int,
-                            length: int, holders: "list[str]") -> bytes | None:
+                            length: int, holders: "list[str]",
+                            include_open: bool = False) -> bytes | None:
         try:
             # failpoint: shard fetch failure -> the caller's degraded
             # reconstruct-from-d-others path, without destroying a shard
@@ -593,7 +692,17 @@ class VolumeServer:
         except failpoints.FailpointError as e:
             log.warning("ec shard %d.%d read failpoint: %s", vid, sid, e)
             return None
-        for addr in holders:
+        # circuit-open holders are SKIPPED entirely (returning None sends
+        # the caller down the reconstruct path — that's the graceful
+        # degradation: a known-dead shard peer must not cost a connect
+        # timeout per read). `include_open=True` is the reconstruct
+        # path's last resort when the healthy shards alone can't reach d.
+        ordered = retry.order_by_breaker(holders)
+        if not include_open:
+            ordered = [a for a in ordered
+                       if retry.breaker(a).would_allow()]
+        for addr in ordered:
+            br = retry.breaker(addr)
             try:
                 stub = Stub(addr, VOLUME_SERVICE)
                 parts = [r.data for r in stub.call_stream(
@@ -602,8 +711,13 @@ class VolumeServer:
                         volume_id=vid, shard_id=sid,
                         offset=offset, size=length),
                     vpb.VolumeEcShardReadResponse)]
-                return b"".join(parts)
+                br.record_success()
+                # corrupt site: bit-flips on the shard wire — the needle
+                # CRC downstream must catch what reconstruction produces
+                return failpoints.corrupt("ec.shard.read.data",
+                                          b"".join(parts))
             except Exception as e:  # noqa: BLE001
+                br.record_failure()
                 log.warning("remote shard %d.%d read from %s: %s",
                             vid, sid, addr, e)
         return None
@@ -657,6 +771,19 @@ class VolumeServer:
                             f.cancel()  # fetches nobody will use
                         break
             if len(gathered) < geo.d:
+                # healthy shards alone can't reach d: as a last resort
+                # probe the circuit-open holders too — an open breaker
+                # should cost latency, never turn a recoverable read
+                # into an error
+                for sid in remote_sids:
+                    if sid in gathered or len(gathered) >= geo.d:
+                        continue
+                    data = self._fetch_remote_shard(
+                        vid, sid, offset, length, locs.get(sid, []),
+                        include_open=True)
+                    if data is not None:
+                        gathered[sid] = data
+            if len(gathered) < geo.d:
                 raise KeyError(
                     f"cannot reconstruct shard {shard_id}: only "
                     f"{len(gathered)} shards reachable")
@@ -667,6 +794,8 @@ class VolumeServer:
                            for s in present])
             coder = self.store.coder(geo.d, geo.p)
             out = np.asarray(coder.reconstruct(sl, present, (shard_id,)))
+            from ..stats import DEGRADED_EC_READS
+            DEGRADED_EC_READS.inc()
             return out[0].tobytes()
         return reader
 
@@ -1046,6 +1175,7 @@ class VolumeServer:
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
                    vpb.VolumeEcShardsRebuildResponse)
         def ec_rebuild(req, context):
+            failpoints.check("ec.rebuild")
             rebuilt = store.rebuild_ec_shards(req.volume_id, req.collection)
             vs.flush_heartbeat()
             return vpb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
@@ -1056,6 +1186,7 @@ class VolumeServer:
             """Pull shard files FROM source_data_node to this server.
             All of a volume's shard files stay in ONE location: prefer
             the location already holding its .ecx."""
+            failpoints.check("ec.shard.copy")
             src = Stub(req.source_data_node, VOLUME_SERVICE)
             loc = next((l for l in store.locations
                         if os.path.exists(

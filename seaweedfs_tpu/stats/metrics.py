@@ -144,12 +144,14 @@ class Histogram(_Metric):
             totals = dict(self._totals)
         for lv, counts in items:
             for i, b in enumerate(self.buckets):
+                le = f'le="{b}"'
                 out.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(self.label_names, lv, f'le=\"{b}\"')}"
+                    f"{_fmt_labels(self.label_names, lv, le)}"
                     f" {counts[i]}")
+            inf = 'le="+Inf"'
             out.append(f"{self.name}_bucket"
-                       f"{_fmt_labels(self.label_names, lv, 'le=\"+Inf\"')}"
+                       f"{_fmt_labels(self.label_names, lv, inf)}"
                        f" {totals[lv]}")
             out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)}"
                        f" {sums[lv]}")
@@ -239,6 +241,22 @@ EC_REBUILD_BYTES = _counter(
 FILER_AGGR_DEAD_LETTERS = _counter(
     "SeaweedFS_filer_aggregator_dead_letters",
     "peer metadata events dropped after apply retries", ("peer",))
+# Fault-tolerance layer (utils/retry.py): recovery behavior is observable,
+# not just tested — retries per logical op, per-peer circuit state
+# (0=closed, 1=open, 2=half-open), and EC reads that had to reconstruct.
+RETRY_ATTEMPTS = _counter(
+    "SeaweedFS_retry_attempts_total",
+    "cross-node call retries after a failed attempt", ("op",))
+BREAKER_STATE = _gauge(
+    "SeaweedFS_breaker_state",
+    "per-peer circuit breaker state (0=closed,1=open,2=half-open)",
+    ("peer",))
+BREAKER_TRANSITIONS = _counter(
+    "SeaweedFS_breaker_transitions_total",
+    "circuit breaker state transitions", ("peer", "to"))
+DEGRADED_EC_READS = _counter(
+    "SeaweedFS_degraded_ec_reads_total",
+    "EC reads served by reconstructing from surviving shards")
 
 
 async def aiohttp_metrics_handler(request):

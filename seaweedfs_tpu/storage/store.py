@@ -176,6 +176,7 @@ class Store:
         raise KeyError(f"volume {vid} not found")
 
     def delete_needle(self, vid: int, needle_id: int) -> bool:
+        failpoints.check("store.delete")  # bad disk on the tombstone path
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")

@@ -1,0 +1,237 @@
+"""Chaos harness: randomized failpoint schedules against a live
+mini-cluster (master + 3 volume servers, in-process), asserting the
+recovery invariants the fault-tolerance layer promises:
+
+  * every ACKED write is readable after the faults clear,
+  * payloads read back byte-identical (CRC integrity — verified again
+    server-side with a full VolumeScrub sweep),
+  * no duplicate fids were ever handed out,
+  * every circuit breaker eventually re-closes.
+
+Each schedule arms a random subset of failpoint sites with randomized
+kinds (kill/delay/flake per hop: client→master assign/lookup,
+client→volume upload/read, replication fan-out, store IO, heartbeats,
+the raw HTTP hop) for a bounded window while writer threads hammer the
+cluster through the retry envelope. The schedule seed is printed on
+failure — SWTPU_CHAOS_SEED replays it byte-for-byte
+(failpoints.seed() drives both the pct dice and corrupt bit picks).
+
+Opt-in like the stress gate (slow by design):
+    SWTPU_CHAOS=1 python -m pytest tests/chaos -q        # make chaos
+Knobs: SWTPU_CHAOS_SCHEDULES (3), SWTPU_CHAOS_SECONDS (4 per window),
+SWTPU_CHAOS_SEED (replay).
+"""
+
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if not os.environ.get("SWTPU_CHAOS"):
+    pytest.skip("chaos suite is opt-in: set SWTPU_CHAOS=1",
+                allow_module_level=True)
+
+from seaweedfs_tpu.client import operation  # noqa: E402
+from seaweedfs_tpu.client.master_client import MasterClient  # noqa: E402
+from seaweedfs_tpu.master.master_server import MasterServer  # noqa: E402
+from seaweedfs_tpu.pb import volume_server_pb2 as vpb  # noqa: E402
+from seaweedfs_tpu.server.volume_server import VolumeServer  # noqa: E402
+from seaweedfs_tpu.storage.disk_location import DiskLocation  # noqa: E402
+from seaweedfs_tpu.storage.store import Store  # noqa: E402
+from seaweedfs_tpu.utils import failpoints, retry  # noqa: E402
+from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE  # noqa: E402
+
+SCHEDULES = int(os.environ.get("SWTPU_CHAOS_SCHEDULES", "3"))
+WINDOW_S = float(os.environ.get("SWTPU_CHAOS_SECONDS", "4"))
+BASE_SEED = int(os.environ.get("SWTPU_CHAOS_SEED", "0")) \
+    or random.randrange(1 << 30)
+
+# the fault menu: (site, spec factory). Percentages stay moderate so the
+# retry envelope CAN win — the point is recovery under flakiness, and a
+# couple of hard-down windows via times: bursts.
+MENU = [
+    ("replicate.peer", lambda r: f"pct:{r.randint(10, 40)}:error:chaos"),
+    ("store.read", lambda r: f"pct:{r.randint(10, 30)}:delay:0.03"),
+    ("store.read", lambda r: f"pct:{r.randint(5, 20)}:error:chaos"),
+    ("master.assign", lambda r: f"pct:{r.randint(10, 40)}:error:chaos"),
+    ("master.lookup", lambda r: f"pct:{r.randint(10, 30)}:error:chaos"),
+    ("http.request", lambda r: f"pct:{r.randint(5, 20)}:error:chaos"),
+    ("client.upload", lambda r: f"pct:{r.randint(5, 25)}:error:chaos"),
+    ("filer.blob.read", lambda r: f"pct:{r.randint(5, 20)}:error:chaos"),
+    ("volume.heartbeat", lambda r: "times:1:error:chaos"),
+    ("store.delete", lambda r: f"pct:{r.randint(10, 40)}:error:chaos"),
+]
+
+_all_fids_ever: list = []  # across schedules: fids must never repeat
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3)
+    master.start()
+    servers = []
+    for i in range(3):
+        d = tmp_path_factory.mktemp(f"chaos{i}")
+        store = Store("127.0.0.1", 0, "",
+                      [DiskLocation(str(d), max_volume_count=20)],
+                      coder_name="numpy")
+        port = free_port()
+        store.port = port
+        store.public_url = f"127.0.0.1:{port}"
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.3)
+        vs.start()
+        servers.append(vs)
+    from conftest import wait_cluster_up
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+    yield master, servers, mc
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+class Workload:
+    """Writer threads submitting through the retry envelope; only ACKED
+    (fid returned) writes enter the ledger the invariants run against."""
+
+    def __init__(self, mc, rng: random.Random, threads: int = 3):
+        self.mc = mc
+        self.rng = rng
+        self.acked: dict[str, bytes] = {}
+        self.failed_writes = 0
+        self._ledger_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._writer, daemon=True,
+                                          args=(rng.randrange(1 << 30),))
+                         for _ in range(threads)]
+
+    def _writer(self, seed: int) -> None:
+        rng = random.Random(seed)
+        while not self._stop.is_set():
+            payload = rng.randbytes(rng.randint(100, 30000))
+            replication = "001" if rng.random() < 0.4 else ""
+            try:
+                res = operation.submit(self.mc, payload,
+                                       replication=replication)
+            except Exception:  # noqa: BLE001 — unacked: not our problem
+                self.failed_writes += 1
+                continue
+            with self._ledger_lock:
+                self.acked[res.fid] = payload
+
+    def run(self, seconds: float) -> None:
+        for t in self._threads:
+            t.start()
+        time.sleep(seconds)
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in self._threads), \
+            "writer thread hung past the fault window"
+
+
+def _probe_peer(addr: str) -> bool:
+    """Liveness probe for re-close: a raw TCP connect, recorded against
+    the breaker exactly like a real request would be."""
+    br = retry.breaker(addr)
+    if not br.allow():
+        return False
+    host, _, port = addr.rpartition(":")
+    try:
+        s = socket.create_connection((host, int(port)), timeout=1)
+        s.close()
+        br.record_success()
+        return True
+    except OSError:
+        br.record_failure()
+        return False
+
+
+@pytest.mark.parametrize("schedule", range(SCHEDULES))
+def test_randomized_fault_schedule(cluster, schedule):
+    master, servers, mc = cluster
+    seed = BASE_SEED + schedule
+    rng = random.Random(seed)
+    failpoints.seed(seed)
+    ctx = f"schedule={schedule} seed={seed} (SWTPU_CHAOS_SEED={BASE_SEED})"
+
+    # -- arm a random subset of the fault menu ------------------------------
+    armed = rng.sample(MENU, rng.randint(2, 4))
+    for site, spec_of in armed:
+        spec = spec_of(rng)
+        failpoints.configure(site, spec)
+        print(f"[chaos] {ctx}: armed {site}={spec}")
+
+    wl = Workload(mc, rng)
+    try:
+        wl.run(WINDOW_S)
+    finally:
+        failpoints.clear_all()
+
+    assert wl.acked, f"{ctx}: no write survived — schedule too brutal"
+    print(f"[chaos] {ctx}: {len(wl.acked)} acked, "
+          f"{wl.failed_writes} failed (unacked)")
+
+    # -- recovery: cluster re-stabilizes ------------------------------------
+    from conftest import wait_until
+    wait_until(lambda: len(master.topo.nodes) >= len(servers),
+               timeout=15, msg=f"{ctx}: all nodes re-registered")
+
+    # invariant: no duplicate fids, ever (within and across schedules)
+    fids = list(wl.acked)
+    assert len(fids) == len(set(fids)), f"{ctx}: duplicate fids in ledger"
+    dupes = set(fids) & set(_all_fids_ever)
+    assert not dupes, f"{ctx}: fids reused across schedules: {dupes}"
+    _all_fids_ever.extend(fids)
+
+    # invariant: every acked write readable, byte-identical
+    for fid, payload in wl.acked.items():
+        got = operation.read(mc, fid)
+        assert got == payload, \
+            f"{ctx}: acked {fid} corrupt ({len(got)}B vs {len(payload)}B)"
+
+    # invariant: every breaker eventually re-closes (live traffic +
+    # explicit probes drive the half-open transitions)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        open_peers = [p for p, s in retry.all_breakers().items()
+                      if s != retry.CLOSED]
+        if not open_peers:
+            break
+        for p in open_peers:
+            retry.breaker(p).cooldown = min(retry.breaker(p).cooldown, 0.5)
+            _probe_peer(p)
+        time.sleep(0.2)
+    still_open = {p: s for p, s in retry.all_breakers().items()
+                  if s != retry.CLOSED}
+    assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+    # invariant: server-side CRC sweep finds zero corruption
+    for vs in servers:
+        resp = Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeScrub", vpb.VolumeScrubRequest(device="host"),
+            vpb.VolumeScrubResponse, timeout=60)
+        for r in resp.results:
+            assert not list(r.corrupt_needle_ids), \
+                f"{ctx}: scrub found corrupt needles on {vs.url}: " \
+                f"vol {r.volume_id} -> {list(r.corrupt_needle_ids)}"

@@ -26,6 +26,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_tolerance():
+    """Circuit breakers and the retry budget are process-global (keyed by
+    peer address); ports are reused across fixtures, so leaked OPEN state
+    from one test must never fail-fast an unrelated test's requests."""
+    from seaweedfs_tpu.utils import retry
+
+    retry.reset_breakers()
+    yield
+    retry.reset_breakers()
+
 
 def free_port_pair() -> int:
     """A free port whose +10000 sibling is also free and VALID (<65536) —

@@ -80,6 +80,81 @@ class TestFacility:
     def test_bad_spec_rejected(self):
         with pytest.raises(ValueError):
             failpoints.configure("x", "explode:now")
+        with pytest.raises(ValueError):
+            failpoints.configure("x", "pct:150:error")
+
+    def test_pct_zero_never_fires_pct_100_always(self):
+        failpoints.configure("p0", "pct:0:error")
+        for _ in range(50):
+            failpoints.check("p0")  # never fires
+        assert failpoints.fired("p0") == 0
+        failpoints.configure("p100", "pct:100:error")
+        with pytest.raises(FailpointError):
+            failpoints.check("p100")
+
+    def test_pct_is_probabilistic_and_seeded(self):
+        failpoints.seed(1234)
+        failpoints.configure("flaky", "pct:50:error")
+        fired_a = 0
+        for _ in range(200):
+            try:
+                failpoints.check("flaky")
+            except FailpointError:
+                fired_a += 1
+        # a fair-ish coin: nowhere near 0% or 100%
+        assert 60 < fired_a < 140
+        # the same seed replays the same schedule exactly
+        failpoints.seed(1234)
+        failpoints.clear_all()
+        failpoints.configure("flaky", "pct:50:error")
+        fired_b = 0
+        for _ in range(200):
+            try:
+                failpoints.check("flaky")
+            except FailpointError:
+                fired_b += 1
+        assert fired_b == fired_a
+
+    def test_pct_composes_with_times(self):
+        """times counts actual FIRINGS, not dice rolls."""
+        failpoints.seed(7)
+        failpoints.configure("tp", "times:3:pct:50:error")
+        fired = 0
+        for _ in range(100):
+            try:
+                failpoints.check("tp")
+            except FailpointError:
+                fired += 1
+        assert fired == 3
+        assert failpoints.fired("tp") == 3
+
+    def test_corrupt_flips_requested_bits(self):
+        failpoints.seed(99)
+        failpoints.configure("c", "corrupt:3")
+        data = bytes(64)
+        out = failpoints.corrupt("c", data)
+        assert len(out) == len(data)
+        flipped = sum(bin(a ^ b).count("1") for a, b in zip(data, out))
+        assert 1 <= flipped <= 3  # two flips may land on the same bit
+        # disarmed site passes data through untouched
+        failpoints.clear("c")
+        assert failpoints.corrupt("c", data) == data
+
+    def test_corrupt_empty_payload_is_noop(self):
+        failpoints.configure("c0", "corrupt:2")
+        assert failpoints.corrupt("c0", b"") == b""
+
+    def test_corrupt_at_check_site_raises(self):
+        """A corrupt spec armed at a check-only site must surface, not
+        silently count a fault that never injected."""
+        failpoints.configure("chk", "corrupt:1")
+        with pytest.raises(FailpointError):
+            failpoints.check("chk")
+
+    def test_active_reports_composed_spec(self):
+        failpoints.configure("a1", "times:2:pct:25:error:x")
+        spec = failpoints.active()["a1"]
+        assert spec.startswith("times:2:pct:25")
 
 
 class TestTornWriteHeal:

@@ -1,0 +1,192 @@
+"""Cluster-level fault tolerance: degraded EC reads with exactly (n−k)
+shards injected down, with (n−k) shard PEERS circuit-open, and breaker
+re-close through live probes — the deterministic acceptance tests for the
+retry/breaker layer (the randomized schedules live in tests/chaos/).
+
+Spread (d=4, p=2 → n=6): data shards 2 and 3 live alone on two peers, so
+tripping those two peers takes down exactly n−k shards and every read of
+an interval on them must reconstruct from the four shards that remain.
+"""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.master_client import MasterClient
+from seaweedfs_tpu.ec.locate import EcGeometry
+from seaweedfs_tpu.master.master_server import MasterServer
+from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.utils import failpoints, retry
+from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def ec_cluster(tmp_path_factory):
+    """master + 3 volume servers, one EC volume spread so that two peers
+    hold exactly one data shard each: src=[0,1,4,5], B=[2], C=[3]."""
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3)
+    master.start()
+    servers = []
+    geo = EcGeometry(d=4, p=2, large_block=1 << 20, small_block=1 << 14)
+    for i in range(3):
+        d = tmp_path_factory.mktemp(f"ft{i}")
+        store = Store("127.0.0.1", 0, "",
+                      [DiskLocation(str(d), max_volume_count=10)],
+                      ec_geometry=geo, coder_name="numpy")
+        port = free_port()
+        store.port = port
+        store.public_url = f"127.0.0.1:{port}"
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.3)
+        vs.start()
+        servers.append(vs)
+    from conftest import wait_cluster_up, wait_until
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+
+    rng = np.random.default_rng(42)
+    blobs = {}
+    for _ in range(30):
+        data = rng.integers(0, 256, int(rng.integers(200, 20000)),
+                            dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data, collection="ecft")
+        blobs[res.fid] = data
+    vid = int(next(iter(blobs)).split(",")[0])
+    assert all(int(f.split(",")[0]) == vid for f in blobs)
+
+    src = next(vs for vs in servers if vs.store.find_volume(vid) is not None)
+    others = [vs for vs in servers if vs is not src]
+    src_stub = Stub(f"127.0.0.1:{src.grpc_port}", VOLUME_SERVICE)
+    src_stub.call("VolumeMarkReadonly",
+                  vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                  vpb.VolumeMarkReadonlyResponse)
+    src_stub.call("VolumeEcShardsGenerate",
+                  vpb.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                                    collection="ecft"),
+                  vpb.VolumeEcShardsGenerateResponse, timeout=120)
+    spread = {src: [0, 1, 4, 5], others[0]: [2], others[1]: [3]}
+    for vs, sids in spread.items():
+        if vs is not src:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection="ecft", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True,
+                    copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{src.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=vid, collection="ecft",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    from seaweedfs_tpu.ec import files as ec_files
+    base = src.store.find_ec_volume(vid).base
+    src_stub.call("VolumeEcShardsUnmount",
+                  vpb.VolumeEcShardsUnmountRequest(volume_id=vid,
+                                                   shard_ids=[2, 3]),
+                  vpb.VolumeEcShardsUnmountResponse)
+    for sid in (2, 3):
+        os.remove(base + ec_files.shard_ext(sid))
+    src_stub.call("VolumeEcShardsMount",
+                  vpb.VolumeEcShardsMountRequest(volume_id=vid,
+                                                 collection="ecft",
+                                                 shard_ids=[0, 1, 4, 5]),
+                  vpb.VolumeEcShardsMountResponse)
+    src_stub.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
+                  vpb.VolumeDeleteResponse)
+    wait_until(lambda: vid in master.topo.ec_locations,
+               msg="ec registry updated")
+    yield master, src, others, mc, vid, blobs
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+def test_full_health_ec_reads(ec_cluster):
+    master, src, others, mc, vid, blobs = ec_cluster
+    for fid, data in list(blobs.items())[:8]:
+        assert operation.read(mc, fid) == data
+
+
+def test_degraded_read_with_exactly_p_shards_injected_down(ec_cluster):
+    """ec.shard.read armed: every REMOTE shard fetch fails, which on the
+    src server takes down exactly shards 2 and 3 = (n−k). All reads must
+    still succeed via reconstruction and the degraded counter must move."""
+    from seaweedfs_tpu.stats import DEGRADED_EC_READS
+    master, src, others, mc, vid, blobs = ec_cluster
+    # pin reads to src (holder of the 4 surviving shards) so the injected
+    # remote-fetch failure is what forces reconstruction
+    for vs in others:
+        retry.breaker(f"127.0.0.1:{vs.port}").trip()
+    before = DEGRADED_EC_READS.value()
+    with failpoints.inject("ec.shard.read", "error:injected-down"):
+        for fid, data in blobs.items():
+            assert operation.read(mc, fid) == data, f"degraded read {fid}"
+    assert failpoints.fired("ec.shard.read") >= 1
+    assert DEGRADED_EC_READS.value() > before
+
+
+def test_ec_read_succeeds_with_p_shard_peers_circuit_open(ec_cluster):
+    """The acceptance bar: (n−k) shard PEERS circuit-open (their breakers
+    tripped, no failpoints armed) — reads reconstruct from the k healthy
+    shards instead of erroring, without a single connect to the dead
+    peers' gRPC plane."""
+    from seaweedfs_tpu.stats import DEGRADED_EC_READS
+    master, src, others, mc, vid, blobs = ec_cluster
+    for vs in others:
+        retry.breaker(f"127.0.0.1:{vs.port}").trip()       # HTTP plane
+        retry.breaker(f"127.0.0.1:{vs.grpc_port}").trip()  # shard fetches
+    before = DEGRADED_EC_READS.value()
+    for fid, data in blobs.items():
+        assert operation.read(mc, fid) == data, \
+            f"read {fid} with {len(others)} shard peers circuit-open"
+    assert DEGRADED_EC_READS.value() > before
+
+
+def test_breakers_reclose_after_recovery(ec_cluster):
+    """closed→open→half-open→closed against LIVE peers: after the
+    cooldown, one real probe through each hop re-closes the circuit."""
+    from seaweedfs_tpu.client import http_util
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb2
+    master, src, others, mc, vid, blobs = ec_cluster
+    peers = []
+    for vs in others:
+        for addr in (f"127.0.0.1:{vs.port}", f"127.0.0.1:{vs.grpc_port}"):
+            br = retry.breaker(addr)
+            br.cooldown = 0.05
+            br.trip()
+            peers.append((vs, addr, br))
+    import time
+    time.sleep(0.1)  # past every cooldown: probes now admitted
+    for vs, addr, br in peers:
+        assert br.state == retry.OPEN
+        if addr.endswith(str(vs.port)):
+            r = http_util.get(f"http://{addr}/status", timeout=5)
+            assert r.status == 200
+        else:
+            retry.retry_call(
+                lambda a=addr: Stub(a, VOLUME_SERVICE).call(
+                    "Ping", vpb2.PingRequest(), vpb2.PingResponse),
+                op="probe", peer=addr)
+        assert br.state == retry.CLOSED, f"{addr} did not re-close"

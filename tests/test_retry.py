@@ -1,0 +1,260 @@
+"""The fault-tolerance layer (utils/retry.py): backoff math, deadlines,
+retry budget, the breaker state machine, and the metrics surfaced through
+the prometheus registry. Cluster-level behavior (degraded EC reads with
+circuit-open shard peers) lives in tests/test_fault_tolerance.py; the
+randomized schedules in tests/chaos/."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.utils import retry
+from seaweedfs_tpu.utils.retry import (
+    BreakerOpenError, CircuitBreaker, RetryBudget, RetryPolicy)
+
+
+class TestBackoff:
+    def test_full_jitter_bounds(self):
+        pol = RetryPolicy(base_delay=0.1, max_delay=1.0)
+        for attempt in range(1, 8):
+            cap = min(1.0, 0.1 * 2 ** (attempt - 1))
+            for _ in range(50):
+                d = pol.backoff(attempt)
+                assert 0.0 <= d <= cap
+
+    def test_jitter_actually_varies(self):
+        pol = RetryPolicy(base_delay=0.5, max_delay=8.0)
+        draws = {round(pol.backoff(4), 6) for _ in range(30)}
+        assert len(draws) > 5  # not a fixed ladder
+
+    def test_with_override(self):
+        pol = RetryPolicy(max_attempts=3).with_(max_attempts=7)
+        assert pol.max_attempts == 7
+
+
+class TestRetryCall:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        pol = RetryPolicy(max_attempts=5, base_delay=0.001, deadline=5.0)
+        assert retry.retry_call(flaky, op="t", policy=pol) == "ok"
+        assert len(calls) == 3
+
+    def test_attempts_exhausted_raises_last(self):
+        pol = RetryPolicy(max_attempts=3, base_delay=0.001, deadline=5.0)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(OSError, match="down"):
+            retry.retry_call(dead, op="t", policy=pol)
+        assert len(calls) == 3
+
+    def test_overall_deadline_cuts_attempts_short(self):
+        pol = RetryPolicy(max_attempts=50, base_delay=0.2, max_delay=0.2,
+                          deadline=0.05)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise OSError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            retry.retry_call(dead, op="t", policy=pol)
+        # the envelope is spent after ~1 attempt, far before 50
+        assert len(calls) < 5
+        assert time.monotonic() - t0 < 1.0
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def bad_request():
+            calls.append(1)
+            raise ValueError("caller bug")
+
+        with pytest.raises(ValueError):
+            retry.retry_call(bad_request, op="t",
+                             retryable=lambda e: not isinstance(e, ValueError))
+        assert len(calls) == 1
+
+    def test_retry_increments_metric(self):
+        from seaweedfs_tpu.stats import RETRY_ATTEMPTS
+        before = RETRY_ATTEMPTS.value("metric-probe")
+        pol = RetryPolicy(max_attempts=2, base_delay=0.001, deadline=5.0)
+        with pytest.raises(OSError):
+            retry.retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                             op="metric-probe", policy=pol)
+        assert RETRY_ATTEMPTS.value("metric-probe") == before + 1
+
+    def test_peer_breaker_opens_then_fails_fast(self):
+        retry.reset_breakers()
+        br = retry.breaker("peer-a:1")
+        br.threshold, br.cooldown = 3, 60.0
+        pol = RetryPolicy(max_attempts=2, base_delay=0.001, deadline=5.0)
+
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry.retry_call(dead, op="t", peer="peer-a:1", policy=pol)
+        # 2 failures; one more trips the threshold of 3
+        with pytest.raises(OSError):
+            retry.retry_call(dead, op="t", peer="peer-a:1",
+                             policy=pol.with_(max_attempts=1))
+        assert br.state == retry.OPEN
+        with pytest.raises(BreakerOpenError):
+            retry.retry_call(dead, op="t", peer="peer-a:1", policy=pol)
+
+
+class TestBudget:
+    def test_dry_budget_fails_fast(self):
+        budget = RetryBudget(capacity=2.0, refill_per_success=0.1)
+        pol = RetryPolicy(max_attempts=10, base_delay=0.001, deadline=5.0)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry.retry_call(dead, op="t", policy=pol, budget=budget)
+        # 1 initial + 2 budgeted retries, then dry
+        assert len(calls) == 3
+        assert budget.tokens < 1.0
+
+    def test_success_refills(self):
+        budget = RetryBudget(capacity=10.0, refill_per_success=0.5)
+        budget._tokens = 0.0
+        for _ in range(4):
+            retry.retry_call(lambda: "ok", op="t", budget=budget)
+        assert budget.tokens == pytest.approx(2.0)
+
+
+class TestBreakerStateMachine:
+    def test_closed_to_open_to_halfopen_to_closed(self):
+        br = CircuitBreaker("peer-b:1", threshold=3, cooldown=0.05)
+        assert br.state == retry.CLOSED
+        for _ in range(2):
+            br.record_failure()
+        assert br.state == retry.CLOSED  # under threshold
+        br.record_failure()
+        assert br.state == retry.OPEN
+        assert not br.allow()  # cooling
+        time.sleep(0.06)
+        assert br.allow()  # the half-open probe
+        assert br.state == retry.HALF_OPEN
+        assert not br.allow()  # only ONE probe per window
+        br.record_success()
+        assert br.state == retry.CLOSED
+        assert br.allow()
+
+    def test_halfopen_probe_failure_reopens(self):
+        br = CircuitBreaker("peer-c:1", threshold=1, cooldown=0.05)
+        br.record_failure()
+        assert br.state == retry.OPEN
+        time.sleep(0.06)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == retry.OPEN  # full cooldown again
+        assert not br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("peer-d:1", threshold=3, cooldown=1.0)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == retry.CLOSED  # never 3 CONSECUTIVE
+
+    def test_trip_and_reset(self):
+        br = CircuitBreaker("peer-e:1", threshold=5, cooldown=60.0)
+        br.trip()
+        assert br.state == retry.OPEN and not br.allow()
+        br.reset()
+        assert br.state == retry.CLOSED and br.allow()
+
+    def test_would_allow_has_no_side_effects(self):
+        br = CircuitBreaker("peer-f:1", threshold=1, cooldown=0.05)
+        br.record_failure()
+        time.sleep(0.06)
+        assert br.would_allow()
+        assert br.state == retry.OPEN  # unchanged: no probe consumed
+        assert br.allow()  # the real gate takes the probe slot
+        assert br.state == retry.HALF_OPEN
+
+    def test_state_gauge_tracks_transitions(self):
+        from seaweedfs_tpu.stats import BREAKER_STATE
+        retry.reset_breakers()
+        br = retry.breaker("peer-gauge:1")
+        br.threshold, br.cooldown = 1, 60.0
+        br.record_failure()
+        assert BREAKER_STATE.value("peer-gauge:1") == 1.0
+        br.reset()
+        assert BREAKER_STATE.value("peer-gauge:1") == 0.0
+
+
+class TestOrdering:
+    def test_order_by_breaker_healthy_first_never_drops(self):
+        retry.reset_breakers()
+        retry.breaker("dead:1").trip()
+        out = retry.order_by_breaker(["dead:1", "live:1", "live:2"])
+        assert out == ["live:1", "live:2", "dead:1"]
+        retry.breaker("live:1").trip()
+        retry.breaker("live:2").trip()
+        # all open: list intact, caller keeps a last-resort attempt
+        assert sorted(retry.order_by_breaker(["dead:1", "live:1", "live:2"])) \
+            == ["dead:1", "live:1", "live:2"]
+
+    def test_registry_snapshot_and_reset(self):
+        retry.reset_breakers()
+        retry.breaker("x:1").trip()
+        retry.breaker("y:1")
+        snap = retry.all_breakers()
+        assert snap["x:1"] == retry.OPEN and snap["y:1"] == retry.CLOSED
+        retry.reset_breakers()
+        assert retry.all_breakers() == {}
+
+
+class TestHttpUtilEnvelope:
+    def test_connect_refused_retries_then_breaker_opens(self):
+        """A black-holed netloc: http_util retries with backoff, records
+        breaker failures, and once the breaker opens a replica-iterating
+        caller (fail_fast_open=True) gets an instant BreakerOpenError —
+        while the default still makes a real attempt, because an open
+        breaker must never make a single-target request impossible."""
+        import socket
+
+        from seaweedfs_tpu.client import http_util
+
+        retry.reset_breakers()
+        # a port with no listener
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        netloc = f"127.0.0.1:{port}"
+        br = retry.breaker(netloc)
+        br.threshold, br.cooldown = 3, 60.0
+        with pytest.raises(OSError):
+            http_util.get(f"http://{netloc}/x", timeout=0.5)
+        assert br.state == retry.OPEN  # 3 attempts = threshold
+        t0 = time.monotonic()
+        with pytest.raises(BreakerOpenError):
+            http_util.get(f"http://{netloc}/x", timeout=0.5,
+                          fail_fast_open=True)
+        assert time.monotonic() - t0 < 0.2  # fail-fast, no connect wait
+        # default: last-resort attempt goes through despite the open
+        # breaker (connect refused again, but it really TRIED)
+        with pytest.raises(ConnectionRefusedError):
+            http_util.get(f"http://{netloc}/x", timeout=0.5,
+                          max_attempts=1)
