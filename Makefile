@@ -1,6 +1,6 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos bench smoke protos
+.PHONY: test stress chaos bench smoke protos metrics-lint
 
 test:
 	python -m pytest tests/ -q
@@ -21,6 +21,12 @@ bench:
 
 smoke:
 	python bench.py --smoke
+
+# exposition-grammar check (HELP/TYPE pairing, label escaping, le
+# ordering, _sum/_count) + registry lint (duplicate names, peer/bucket
+# label-cardinality ceiling) — standalone, CI-friendly, exits non-zero
+metrics-lint:
+	python -m seaweedfs_tpu.stats.expo_lint
 
 protos:
 	python -m seaweedfs_tpu.pb.build

@@ -21,6 +21,7 @@ import urllib.parse
 import uuid
 
 
+from .. import tracing
 from ..utils import failpoints, retry
 from ..utils.fastweb import Headers  # shared case-insensitive header dict
 
@@ -199,6 +200,11 @@ def request(method: str, url: str, body: bytes | None = None,
     if headers:
         for k, v in headers.items():
             head += f"{k}: {v}\r\n"
+    # trace-context propagation: a sampled active span rides every hop as
+    # a W3C traceparent header; unsampled/absent adds NOTHING to the wire
+    traceparent = tracing.injectable()
+    if traceparent:
+        head += f"{tracing.TRACEPARENT_HEADER}: {traceparent}\r\n"
     if body or method in ("POST", "PUT"):
         head += f"Content-Length: {len(body)}\r\n"
     req_bytes = head.encode("latin1") + b"\r\n" + body
@@ -218,6 +224,8 @@ def request(method: str, url: str, body: bytes | None = None,
             # connect timeout here. The default attempts anyway — an open
             # breaker must cost latency, never availability, when this
             # netloc is the only way to serve the request.
+            tracing.add_event("breaker_open", peer=netloc,
+                              state=br.state)
             raise retry.BreakerOpenError(netloc, br.remaining_cooldown())
         sent = False
         reused = False
@@ -277,6 +285,10 @@ def request(method: str, url: str, body: bytes | None = None,
             RETRY_ATTEMPTS.inc(f"http.{method}")
         except Exception:  # noqa: BLE001
             pass
+        tracing.add_event("retry", op=f"http.{method}", peer=netloc,
+                          attempt=attempt, breaker=br.state,
+                          delay_ms=round(delay * 1e3, 2),
+                          error=str(last_err)[:200])
         time.sleep(delay)
 
 

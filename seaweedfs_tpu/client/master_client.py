@@ -232,6 +232,18 @@ class MasterClient:
         envelope (submit, filer _save_blob) bound this call's quorum
         sweeps too, so nested envelopes share one wall-clock budget
         instead of stacking multiplicatively."""
+        from .. import tracing
+        with tracing.start_span("client.assign", component="client",
+                                attrs={"collection": collection}) as sp:
+            resp = self._assign(count, collection, replication, ttl,
+                                disk_type, deadline)
+            sp.set_attr("fid", resp.fid)
+            sp.set_attr("master", self.leader)
+            return resp
+
+    def _assign(self, count: int, collection: str, replication: str,
+                ttl: str, disk_type: str,
+                deadline: float | None) -> pb.AssignResponse:
         if self.http_address and time.monotonic() >= self._http_assign_retry_at:
             try:
                 return self._assign_http(count, collection, replication, ttl,

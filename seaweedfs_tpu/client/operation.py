@@ -12,6 +12,7 @@ import gzip as _gzip
 import time
 from dataclasses import dataclass
 
+from .. import tracing
 from ..storage.types import parse_file_id
 from ..utils import failpoints, retry
 from . import http_util
@@ -32,6 +33,16 @@ def upload(url: str, data: bytes, name: str = "", mime: str = "",
            jwt: str = "") -> dict:
     """PUT one blob to a volume server (reference upload_content.go:151).
     `jwt` is the single-fid write token the master minted on Assign."""
+    with tracing.start_span("client.upload", component="client",
+                            attrs={"url": url, "bytes": len(data)}):
+        return _upload(url, data, name=name, mime=mime,
+                       gzip_if_worthwhile=gzip_if_worthwhile, ttl=ttl,
+                       jwt=jwt)
+
+
+def _upload(url: str, data: bytes, name: str = "", mime: str = "",
+            gzip_if_worthwhile: bool = True, ttl: str = "",
+            jwt: str = "") -> dict:
     failpoints.check("client.upload")
     body = data
     gzipped = False
@@ -80,6 +91,7 @@ def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
         a = mc.assign(collection=collection, replication=replication,
                       ttl=ttl, deadline=stop_at)
         target = a.location.public_url or a.location.url
+        tracing.add_event("assigned", fid=a.fid, target=target)
         res = upload(f"{target}/{a.fid}", data, name=name, mime=mime,
                      ttl=ttl, jwt=a.auth)
         return UploadResult(fid=a.fid, url=target,
@@ -87,13 +99,18 @@ def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
                             e_tag=res.get("eTag", ""),
                             name=res.get("name", name))
 
-    try:
-        return retry.retry_call(
-            attempt, op="client.submit",
-            policy=retry.WRITE_POLICY.with_(max_attempts=retries))
-    except Exception as e:
-        raise RuntimeError(f"submit failed after {retries} tries: {e}") \
-            from e
+    with tracing.start_span("client.submit", component="client",
+                            attrs={"bytes": len(data), "name": name,
+                                   "collection": collection}) as sp:
+        try:
+            result = retry.retry_call(
+                attempt, op="client.submit",
+                policy=retry.WRITE_POLICY.with_(max_attempts=retries))
+            sp.set_attr("fid", result.fid)
+            return result
+        except Exception as e:
+            raise RuntimeError(f"submit failed after {retries} tries: {e}") \
+                from e
 
 
 def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
@@ -103,6 +120,12 @@ def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
     (volume moved/evacuated), so one refreshed-lookup retry pass runs before
     giving up (LookupFileIdWithFallback masterclient.go:59).
     Pass `jwt` (a read-key token) when the cluster read-gates volumes."""
+    with tracing.start_span("client.read", component="client",
+                            attrs={"fid": fid}):
+        return _read(mc, fid, jwt=jwt)
+
+
+def _read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
     failpoints.check("client.read")
     vid, _, _ = parse_file_id(fid)
     last_err: Exception | None = None
@@ -179,14 +202,16 @@ def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
 
 
 def delete(mc: MasterClient, fid: str) -> bool:
-    jwt = mc.lookup_file_id_jwt(fid)
-    params = {"jwt": jwt} if jwt else None
-    ok = False
-    for url in mc.lookup_file_id(fid):
-        r = http_util.delete(url, params=params)
-        ok = ok or r.status in (200, 202)
-        break  # server fans out to replicas itself
-    return ok
+    with tracing.start_span("client.delete", component="client",
+                            attrs={"fid": fid}):
+        jwt = mc.lookup_file_id_jwt(fid)
+        params = {"jwt": jwt} if jwt else None
+        ok = False
+        for url in mc.lookup_file_id(fid):
+            r = http_util.delete(url, params=params)
+            ok = ok or r.status in (200, 202)
+            break  # server fans out to replicas itself
+        return ok
 
 
 def delete_batch(mc: MasterClient, fids: list[str]) -> int:

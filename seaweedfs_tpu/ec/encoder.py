@@ -57,12 +57,24 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
     Reference: RebuildEcFiles ec_encoder.go:61 / rebuildEcFiles :237-291.
     Returns the shard ids rebuilt.
     """
+    from .. import tracing
     present = find_shards(base, geo.n)
     missing = sorted(set(wanted) if wanted is not None
                      else set(range(geo.n)) - set(present))
     missing = [m for m in missing if m not in present]
     if not missing:
         return []
+    with tracing.start_span(
+            "ec.rebuild", component="ec",
+            attrs={"base": os.path.basename(base), "missing": missing,
+                   "present": len(present), "coder": type(coder).__name__}):
+        return _rebuild_shards(base, geo, coder, present, missing, chunk,
+                               batch)
+
+
+def _rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
+                    present: dict[int, str], missing: list[int],
+                    chunk: int, batch: int) -> list[int]:
     if len(present) < geo.d:
         raise RuntimeError(
             f"cannot rebuild: only {len(present)} shards present, need {geo.d}")

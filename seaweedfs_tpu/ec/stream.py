@@ -256,10 +256,19 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
     chunk = fit_chunk(geo, chunk)
     if null_sink and coder.async_dispatch:
         raise ValueError("null_sink is a sync-coder measurement mode")
-    if not coder.async_dispatch:
-        return _encode_volumes_sync(jobs, geo, coder, chunk, batch, stats,
-                                    null_sink=null_sink)
-    return _encode_volumes_async(jobs, geo, coder, chunk, batch, depth, stats)
+    from .. import tracing
+    total = sum(os.path.getsize(j[0]) for j in jobs
+                if os.path.exists(j[0]))
+    with tracing.start_span(
+            "ec.encode", component="ec",
+            attrs={"volumes": len(jobs), "bytes": total,
+                   "coder": type(coder).__name__,
+                   "geometry": f"{geo.d}+{geo.p}"}):
+        if not coder.async_dispatch:
+            return _encode_volumes_sync(jobs, geo, coder, chunk, batch,
+                                        stats, null_sink=null_sink)
+        return _encode_volumes_async(jobs, geo, coder, chunk, batch, depth,
+                                     stats)
 
 
 def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,

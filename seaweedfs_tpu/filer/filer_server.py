@@ -43,7 +43,8 @@ class FilerServer:
                  meta_aggregate: bool = False,
                  chunk_cache_mb: int = 64,
                  chunk_cache_dir: "str | None" = None,
-                 chunk_cache_disk_mb: int = 1024):
+                 chunk_cache_disk_mb: int = 1024,
+                 metrics_gateway: str = "", metrics_interval_s: int = 15):
         self.ip, self.port = ip, port
         self.grpc_port = grpc_port or port + 10000
         self.collection, self.replication = collection, replication
@@ -82,6 +83,10 @@ class FilerServer:
         self._stop = threading.Event()
         self._grpc = None
         self._http_thread = None
+        # optional push-gateway loop; started in start(), joined in stop()
+        self.metrics_gateway = metrics_gateway
+        self.metrics_interval_s = metrics_interval_s
+        self._metrics_push = None
 
     @property
     def url(self) -> str:
@@ -108,6 +113,11 @@ class FilerServer:
             # port no longer breaks mesh dialing
             from .meta_aggregator import MetaAggregator
             self.aggregator = MetaAggregator(self).start()
+        if self.metrics_gateway:
+            from ..stats import start_push_loop
+            self._metrics_push = start_push_loop(
+                self.metrics_gateway, f"filer-{self.url}",
+                self.metrics_interval_s)
         log.info("filer %s up (grpc :%d, store %s)", self.url, self.grpc_port,
                  self.filer.store.name)
         return self
@@ -118,6 +128,8 @@ class FilerServer:
         self._stop.set()
         if self.aggregator is not None:
             self.aggregator.stop()
+        if self._metrics_push is not None:
+            self._metrics_push.stop()
         if self._grpc:
             self._grpc.stop(grace=0.5)
         self.reader_cache.close()  # drop prefetch workers
@@ -170,6 +182,16 @@ class FilerServer:
     # -- chunk IO helpers ----------------------------------------------------
     def _save_blob(self, data: bytes, ttl: str = "",
                    path: str = "") -> fpb.FileChunk:
+        from .. import tracing
+        with tracing.start_span("filer.blob.write", component="filer",
+                                attrs={"bytes": len(data),
+                                       "path": path}) as sp:
+            chunk = self._save_blob_inner(data, ttl, path)
+            sp.set_attr("fid", chunk.file_id)
+            return chunk
+
+    def _save_blob_inner(self, data: bytes, ttl: str,
+                         path: str) -> fpb.FileChunk:
         from ..utils import failpoints, retry
         collection, replication, rule_ttl, disk = self._storage_rule(path)
         cipher_key = b""
@@ -211,12 +233,16 @@ class FilerServer:
                              cipher_key=cipher_key)
 
     def _fetch_blob_upstream(self, fid: str) -> bytes:
+        from .. import tracing
         from ..utils import failpoints
-        failpoints.check("filer.blob.read")
-        # operation.read carries the retry/breaker envelope; the corrupt
-        # site models a bad wire so CRC-style invariants can be drilled
-        return failpoints.corrupt("filer.blob.read.data",
-                                  operation.read(self.mc, fid))
+        with tracing.start_span("filer.blob.read", component="filer",
+                                attrs={"fid": fid}):
+            failpoints.check("filer.blob.read")
+            # operation.read carries the retry/breaker envelope; the
+            # corrupt site models a bad wire so CRC-style invariants can
+            # be drilled
+            return failpoints.corrupt("filer.blob.read.data",
+                                      operation.read(self.mc, fid))
 
     def _fetch_blob(self, fid: str, upcoming: "list[str] | None" = None
                     ) -> bytes:
@@ -282,29 +308,44 @@ class FilerServer:
         from ..stats import (FILER_REQUEST_COUNTER,
                              FILER_REQUEST_SECONDS)
 
+        from .. import tracing
+
         async def handle(request: web.Request):
             kind = request.method.lower()
             resp = None
-            with FILER_REQUEST_SECONDS.time(kind):
-                try:
-                    if request.method in ("POST", "PUT"):
-                        resp = await self._h_write(request)
-                    elif request.method in ("GET", "HEAD"):
-                        resp = await self._h_read(request)
-                    elif request.method == "DELETE":
-                        resp = await self._h_delete(request)
-                    else:
-                        resp = web.json_response(
-                            {"error": "method not allowed"}, status=405)
-                except FileNotFoundError as e:
-                    resp = web.json_response({"error": str(e)}, status=404)
-                except FileExistsError as e:
-                    resp = web.json_response({"error": str(e)}, status=409)
-                except OSError as e:
-                    resp = web.json_response({"error": str(e)}, status=409)
-                except Exception as e:  # noqa: BLE001
-                    log.error("filer http: %r", e)
-                    resp = web.json_response({"error": str(e)}, status=500)
+            # server span continues the caller's trace; the blob-IO
+            # child spans (filer.blob.write/read) land under it even
+            # through asyncio.to_thread (contextvars propagate there)
+            with tracing.start_span(
+                    f"filer.{kind}", component="filer",
+                    child_of=tracing.extract(request.headers),
+                    attrs={"path": request.path, "server": self.url}) as sp:
+                with FILER_REQUEST_SECONDS.time(kind):
+                    try:
+                        if request.method in ("POST", "PUT"):
+                            resp = await self._h_write(request)
+                        elif request.method in ("GET", "HEAD"):
+                            resp = await self._h_read(request)
+                        elif request.method == "DELETE":
+                            resp = await self._h_delete(request)
+                        else:
+                            resp = web.json_response(
+                                {"error": "method not allowed"}, status=405)
+                    except FileNotFoundError as e:
+                        resp = web.json_response({"error": str(e)},
+                                                 status=404)
+                    except FileExistsError as e:
+                        resp = web.json_response({"error": str(e)},
+                                                 status=409)
+                    except OSError as e:
+                        resp = web.json_response({"error": str(e)},
+                                                 status=409)
+                    except Exception as e:  # noqa: BLE001
+                        log.error("filer http: %r", e)
+                        sp.set_error(e)
+                        resp = web.json_response({"error": str(e)},
+                                                 status=500)
+                sp.set_attr("status", resp.status)
             FILER_REQUEST_COUNTER.inc(kind)
             return resp
 
@@ -338,10 +379,22 @@ class FilerServer:
                   ["name", "size", "chunks"], rows)])
             return web.Response(text=page, content_type="text/html")
 
+        async def debug_traces(request):
+            if request.method != "GET":
+                return web.json_response({"error": "method not allowed"},
+                                         status=405)
+            return web.json_response(
+                tracing.debug_traces_payload(dict(request.query)))
+
         def routes(app):
             app.router.add_get("/__status__", status)
             app.router.add_get("/__ui__", status_ui)
             app.router.add_get("/__metrics__", aiohttp_metrics_handler)
+            # exact debug route wins over the namespace catch-all for
+            # EVERY method (GET-only would let a POST fall through and
+            # create a file no read could ever reach): /debug/traces is
+            # fully reserved, like /__status__
+            app.router.add_route("*", "/debug/traces", debug_traces)
             app.router.add_route("*", "/{path:.*}", handle)
 
         from ..utils.webapp import serve_web_app

@@ -38,7 +38,8 @@ class MasterServer:
                  peers: list[str] | None = None,
                  raft_state_path: str | None = None,
                  maintenance_scripts: "list[str] | None" = None,
-                 maintenance_interval_s: float | None = None):
+                 maintenance_interval_s: float | None = None,
+                 metrics_gateway: str = "", metrics_interval_s: int = 15):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -75,6 +76,10 @@ class MasterServer:
         self._http = None
         self._http_stop = None
         self._stop = threading.Event()
+        # optional push-gateway loop; started in start(), joined in stop()
+        self.metrics_gateway = metrics_gateway
+        self.metrics_interval_s = metrics_interval_s
+        self._metrics_push = None
         # Self-driving maintenance (reference startAdminScripts
         # master_server.go:269): [] disables, None -> repair/balance defaults.
         # DisableVacuum/EnableVacuum RPC toggle: suppresses the cron's
@@ -136,11 +141,18 @@ class MasterServer:
         threading.Thread(target=self._janitor, daemon=True,
                          name="master-janitor").start()
         self.admin_cron.start()
+        if self.metrics_gateway:
+            from ..stats import start_push_loop
+            self._metrics_push = start_push_loop(
+                self.metrics_gateway, f"master-{self.address}",
+                self.metrics_interval_s)
         log.info("master up at %s (leader)", self.address)
 
     def stop(self) -> None:
         self._stop.set()
         self.admin_cron.stop()
+        if self._metrics_push is not None:
+            self._metrics_push.stop()
         if self.raft is not None:
             self.raft.stop()
         if self._grpc:
@@ -207,8 +219,13 @@ class MasterServer:
             return h
 
         def metrics(req):
-            from ..stats import REGISTRY
-            return fastweb.text_response(REGISTRY.gather())
+            from ..stats import scrape_payload
+            body, ctype = scrape_payload(req.headers.get("Accept", ""))
+            return fastweb.Response(body.encode(), content_type=ctype)
+
+        def debug_traces(req, q):
+            from .. import tracing
+            return json_response(tracing.debug_traces_payload(q))
 
         def dir_status(req, q):
             # leader_address, not ms.address: a follower answering here
@@ -218,50 +235,74 @@ class MasterServer:
                                   "IsLeader": ms.is_leader})
 
         def dir_lookup(req, q):
-            vid = q.get("volumeId", "").split(",")[0]
-            try:
-                nodes = ms.topo.lookup(int(vid))
-            except ValueError:
-                nodes = None
-            if not nodes:
-                return json_response({"error": f"volume {vid} not found"},
-                                     status=404)
-            return json_response({
-                "volumeId": vid,
-                "locations": [{"url": n.url, "publicUrl": n.public_url}
-                              for n in nodes]})
+            from .. import tracing
+            with tracing.start_span(
+                    "master.lookup", component="master",
+                    child_of=tracing.extract(req.headers),
+                    attrs={"vid": q.get("volumeId", "")}):
+                vid = q.get("volumeId", "").split(",")[0]
+                try:
+                    nodes = ms.topo.lookup(int(vid))
+                except ValueError:
+                    nodes = None
+                if not nodes:
+                    return json_response(
+                        {"error": f"volume {vid} not found"}, status=404)
+                return json_response({
+                    "volumeId": vid,
+                    "locations": [{"url": n.url, "publicUrl": n.public_url}
+                                  for n in nodes]})
 
         async def dir_assign(req, q):
-            areq = pb.AssignRequest(
-                count=int(q.get("count", 1)),
-                collection=q.get("collection", ""),
-                replication=q.get("replication", ""),
-                ttl=q.get("ttl", ""),
-                disk_type=q.get("disk_type", ""))
-            if ms.needs_growth(areq):
-                # growth does AllocateVolume RPCs + a raft commit —
-                # seconds, not microseconds: run it off-loop so other
-                # assigns/lookups/scrapes aren't head-of-line blocked
-                import asyncio
-                resp = await asyncio.get_running_loop().run_in_executor(
-                    None, ms.do_assign, areq)
-            else:
-                # inline fast path NEVER grows: a concurrent assign may
-                # have filled the last writable between the check above
-                # and here (TOCTOU) — the sentinel re-dispatches that
-                # loser to the executor instead of blocking the loop
-                resp = ms.do_assign(areq, allow_growth=False)
-                if resp.error == ms.NEEDS_GROWTH:
+            from .. import tracing
+            with tracing.start_span(
+                    "master.assign", component="master",
+                    child_of=tracing.extract(req.headers),
+                    attrs={"collection": q.get("collection", "")}) as sp:
+                areq = pb.AssignRequest(
+                    count=int(q.get("count", 1)),
+                    collection=q.get("collection", ""),
+                    replication=q.get("replication", ""),
+                    ttl=q.get("ttl", ""),
+                    disk_type=q.get("disk_type", ""))
+                # executor dispatches carry the contextvars context so
+                # the growth path's AllocateVolume RPCs inherit this
+                # span's trace instead of starting orphan roots
+                # (run_in_executor, unlike asyncio.to_thread, does not
+                # copy the context)
+                import contextvars
+
+                if ms.needs_growth(areq):
+                    # growth does AllocateVolume RPCs + a raft commit —
+                    # seconds, not microseconds: run it off-loop so other
+                    # assigns/lookups/scrapes aren't head-of-line blocked
                     import asyncio
+                    sp.add_event("volume_growth")
                     resp = await asyncio.get_running_loop().run_in_executor(
-                        None, ms.do_assign, areq)
-            if resp.error:
-                return json_response({"error": resp.error}, status=406)
-            return json_response({
-                "fid": resp.fid, "count": resp.count,
-                "url": resp.location.url,
-                "publicUrl": resp.location.public_url,
-                "auth": resp.auth})
+                        None, contextvars.copy_context().run,
+                        ms.do_assign, areq)
+                else:
+                    # inline fast path NEVER grows: a concurrent assign may
+                    # have filled the last writable between the check above
+                    # and here (TOCTOU) — the sentinel re-dispatches that
+                    # loser to the executor instead of blocking the loop
+                    resp = ms.do_assign(areq, allow_growth=False)
+                    if resp.error == ms.NEEDS_GROWTH:
+                        import asyncio
+                        sp.add_event("volume_growth")
+                        resp = await asyncio.get_running_loop(
+                            ).run_in_executor(
+                                None, contextvars.copy_context().run,
+                                ms.do_assign, areq)
+                if resp.error:
+                    sp.set_error(resp.error)
+                    return json_response({"error": resp.error}, status=406)
+                sp.set_attr("fid", resp.fid)
+                return json_response({
+                    "fid": resp.fid, "count": resp.count,
+                    "url": resp.location.url,
+                    "publicUrl": resp.location.public_url,
+                    "auth": resp.auth})
 
         def cluster_status(req, q):
             return json_response({
@@ -312,6 +353,11 @@ class MasterServer:
         app.route("/", offloaded(guarded("/", ui)))
         app.route("/debug/profile",
                   offloaded(guarded("/debug/profile", debug_profile)))
+        # guarded like /debug/profile (spans carry fids and peer
+        # addresses) and offloaded: snapshotting + serializing thousands
+        # of spans must not head-of-line-block inline assigns
+        app.route("/debug/traces",
+                  offloaded(guarded("/debug/traces", debug_traces)))
 
         self._http_stop = threading.Event()
         threading.Thread(

@@ -111,26 +111,37 @@ class S3Gateway:
 
         from aiohttp import web
 
+        from .. import tracing
         from ..stats import S3_REQUEST_COUNTER, S3_REQUEST_SECONDS
 
         async def dispatch(request: web.Request):
             kind = request.method.lower()
             resp = None
-            with S3_REQUEST_SECONDS.time(kind):
-                try:
-                    if request.method == "OPTIONS":
-                        resp = self._cors_preflight(request)
-                    else:
-                        resp = await self._route(request)
-                except S3Error as e:
-                    resp = _error_response(e, request.path)
-                except FileNotFoundError as e:
-                    resp = _error_response(
-                        S3Error("NoSuchKey", str(e), 404), request.path)
-                except Exception as e:  # noqa: BLE001
-                    log.error("s3 http: %r", e)
-                    resp = _error_response(
-                        S3Error("InternalError", str(e), 500), request.path)
+            # server span continues the caller's trace; the in-process
+            # filer + blob-IO child spans land under it
+            with tracing.start_span(
+                    f"s3.{kind}", component="s3",
+                    child_of=tracing.extract(request.headers),
+                    attrs={"path": request.path}) as sp:
+                with S3_REQUEST_SECONDS.time(kind):
+                    try:
+                        if request.method == "OPTIONS":
+                            resp = self._cors_preflight(request)
+                        else:
+                            resp = await self._route(request)
+                    except S3Error as e:
+                        sp.add_event("s3_error", code=e.code)
+                        resp = _error_response(e, request.path)
+                    except FileNotFoundError as e:
+                        resp = _error_response(
+                            S3Error("NoSuchKey", str(e), 404), request.path)
+                    except Exception as e:  # noqa: BLE001
+                        log.error("s3 http: %r", e)
+                        sp.set_error(e)
+                        resp = _error_response(
+                            S3Error("InternalError", str(e), 500),
+                            request.path)
+                sp.set_attr("status", resp.status)
             # Label by bucket only for successful requests — failed probes
             # (scanners, typos) would otherwise mint unbounded label sets.
             bucket = (request.path.lstrip("/").split("/", 1)[0]
@@ -139,10 +150,58 @@ class S3Gateway:
             self._apply_cors(request, resp)
             return resp
 
+        def _operator_gate(request):
+            """The S3 plane is tenant-facing: with IAM on, spans (fids,
+            paths, peer addresses) and metrics (per-bucket traffic
+            labels) are operator data — demand a SigV4-signed request
+            (deliberately NOT the legacy V2 scheme the object handlers
+            still accept). Unsigned or V2-only scrapers belong on the
+            filer/master/volume ports, which serve the same process
+            registry. Returns an error response, or None to proceed."""
+            if request.method == "OPTIONS":
+                return self._cors_preflight(request)
+            if request.method != "GET":
+                return web.json_response({"error": "method not allowed"},
+                                         status=405)
+            if not self.iam.enabled:
+                return None
+            try:
+                headers = {k.lower(): v
+                           for k, v in request.headers.items()}
+                self.iam.authenticate(
+                    request.method, request.path, dict(request.query),
+                    headers,
+                    headers.get("x-amz-content-sha256",
+                                "UNSIGNED-PAYLOAD"))
+            except S3Error as e:
+                return _error_response(e, request.path)
+            return None
+
+        async def debug_traces(request):
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            return web.json_response(
+                tracing.debug_traces_payload(dict(request.query)))
+
+        async def metrics(request):
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            from ..stats.metrics import aiohttp_metrics_handler
+            return await aiohttp_metrics_handler(request)
+
+        def routes(app):
+            # exact routes win over the bucket/key catch-all and claim
+            # EVERY method (a GET-only route would let PUT/POST fall
+            # through to the object handlers and mint entries no read
+            # can ever reach): these two paths are fully reserved
+            app.router.add_route("*", "/debug/traces", debug_traces)
+            app.router.add_route("*", "/metrics", metrics)
+            app.router.add_route("*", "/{tail:.*}", dispatch)
+
         from ..utils.webapp import serve_web_app
-        serve_web_app(lambda app: app.router.add_route("*", "/{tail:.*}",
-                                                       dispatch),
-                      self.ip, self.port, self._stop,
+        serve_web_app(routes, self.ip, self.port, self._stop,
                       ready=getattr(self, "_http_ready", None))
 
     # CORS (reference s3api_server.go cors.AllowAll-style middleware)

@@ -37,8 +37,14 @@ class VolumeServer:
                  grpc_port: int | None = None,
                  data_center: str = "", rack: str = "",
                  pulse_seconds: float = 2.0, read_mode: str = "proxy",
-                 guard=None):
+                 guard=None, metrics_gateway: str = "",
+                 metrics_interval_s: int = 15):
         self.store = store
+        # optional push-gateway loop (reference -metricsPort push config);
+        # started in start(), joined in stop() via the PushLoop handle
+        self.metrics_gateway = metrics_gateway
+        self.metrics_interval_s = metrics_interval_s
+        self._metrics_push = None
         # comma-separated master quorum; heartbeats follow leader hints
         # and rotate through the list on failure (reference
         # volume_grpc_client_to_master.go:28 checkWithMaster)
@@ -102,6 +108,11 @@ class VolumeServer:
                                            daemon=True,
                                            name=f"vs-hb-{self.port}")
         self._hb_thread.start()
+        if self.metrics_gateway:
+            from ..stats import start_push_loop
+            self._metrics_push = start_push_loop(
+                self.metrics_gateway, f"volume-{self.url}",
+                self.metrics_interval_s)
         log.info("volume server %s up (grpc :%d)", self.url, self.grpc_port)
 
     def stop(self) -> None:
@@ -121,6 +132,8 @@ class VolumeServer:
                 pass
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)
+        if self._metrics_push is not None:
+            self._metrics_push.stop()
         if self._grpc:
             self._grpc.stop(grace=0.5)
         self._ec_read_pool.shutdown(wait=False, cancel_futures=True)
@@ -279,6 +292,8 @@ class VolumeServer:
         from ..stats import (VOLUME_REQUEST_COUNTER,
                              VOLUME_REQUEST_SECONDS)
 
+        from .. import tracing
+
         _kind = {"POST": "post", "PUT": "put", "GET": "get",
                  "HEAD": "head", "DELETE": "delete"}
 
@@ -287,40 +302,57 @@ class VolumeServer:
             t0 = time.perf_counter()
             resp = None
             status = 500
-            try:
+            # server span continues the caller's trace (traceparent
+            # header) — a PUT's span parents the replication fan-out and
+            # a GET's the EC shard fetches; the latency observation runs
+            # INSIDE the span so the histogram captures its exemplar
+            with tracing.start_span(
+                    f"volume.{kind}", component="volume",
+                    child_of=tracing.extract(request.headers),
+                    attrs={"fid": request.path.lstrip("/"),
+                           "server": self.url}) as sp:
                 try:
-                    if request.method in ("POST", "PUT"):
-                        resp = await self._handle_write(request)
-                    elif request.method == "GET" or request.method == "HEAD":
-                        resp = await self._handle_read(request)
-                    elif request.method == "DELETE":
-                        resp = await self._handle_delete(request)
-                    else:
-                        resp = json_response(
-                            {"error": "method not allowed"}, status=405)
-                except KeyError as e:
-                    resp = json_response({"error": str(e)}, status=404)
-                except PermissionError as e:
-                    resp = json_response({"error": str(e)}, status=403)
-                except Redirect as e:
-                    status = e.status
-                    raise
-                except Exception as e:  # noqa: BLE001
-                    log.error("http error: %s", e)
-                    resp = json_response({"error": str(e)}, status=500)
-                status = resp.status
-                return resp
-            finally:
-                VOLUME_REQUEST_COUNTER.inc(kind, str(status))
-                VOLUME_REQUEST_SECONDS.observe(
-                    kind, value=time.perf_counter() - t0)
+                    try:
+                        if request.method in ("POST", "PUT"):
+                            resp = await self._handle_write(request)
+                        elif request.method in ("GET", "HEAD"):
+                            resp = await self._handle_read(request)
+                        elif request.method == "DELETE":
+                            resp = await self._handle_delete(request)
+                        else:
+                            resp = json_response(
+                                {"error": "method not allowed"}, status=405)
+                    except KeyError as e:
+                        resp = json_response({"error": str(e)}, status=404)
+                    except PermissionError as e:
+                        resp = json_response({"error": str(e)}, status=403)
+                    except Redirect as e:
+                        status = e.status
+                        sp.status = "redirect"  # control flow, not a fault
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        log.error("http error: %s", e)
+                        resp = json_response({"error": str(e)}, status=500)
+                    status = resp.status
+                    return resp
+                finally:
+                    sp.set_attr("status", status)
+                    if status >= 500:
+                        sp.set_error(f"HTTP {status}")
+                    VOLUME_REQUEST_COUNTER.inc(kind, str(status))
+                    VOLUME_REQUEST_SECONDS.observe(
+                        kind, value=time.perf_counter() - t0)
 
         def status(request):
             return json_response({"version": "swtpu", **self.store.status()})
 
         def metrics(request):
-            from ..stats import REGISTRY
-            return fastweb.text_response(REGISTRY.gather())
+            from ..stats import scrape_payload
+            body, ctype = scrape_payload(request.headers.get("Accept", ""))
+            return fastweb.Response(body.encode(), content_type=ctype)
+
+        def debug_traces(request):
+            return json_response(tracing.debug_traces_payload(request.query))
 
         async def debug_profile(request):
             from ..utils import profiling
@@ -387,6 +419,7 @@ class VolumeServer:
         app.route("/debug/profile", debug_profile)
         app.route("/debug/jax-profiler", debug_jax_profiler)
         app.route("/debug/failpoints", debug_failpoints)
+        app.route("/debug/traces", debug_traces)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
                                client_max_size=256 << 20, logger=log)
@@ -466,53 +499,69 @@ class VolumeServer:
         # deadline bounds the whole fan-out
         timeout = aiohttp.ClientTimeout(total=pol.attempt_timeout)
         deadline = time.monotonic() + pol.deadline  # bounds the WHOLE fan-out
+        from .. import tracing
         async with aiohttp.ClientSession(auto_decompress=False,
                                          timeout=timeout) as sess:
             for peer in peers:
                 br = retry.breaker(peer)
                 last_err: Exception | None = None
-                for attempt in range(1, pol.max_attempts + 1):
-                    try:
-                        # failpoint: a dead replica peer without killing a
-                        # real process — drives write-path failure handling
-                        failpoints.check("replicate.peer")
-                        url = f"http://{peer}/{fid}?type=replicate"
-                        if name:
-                            url += "&" + urllib.parse.urlencode(
-                                {"name": name.decode(errors="replace")})
-                        url += self._peer_jwt_param(fid)
-                        async with sess.post(url, data=data,
-                                             headers=headers) as r:
-                            status = r.status
-                        if 300 <= status < 500:
-                            # deterministic rejection (auth/config
-                            # mismatch): the peer is alive and retrying
-                            # the identical request can't succeed — no
-                            # breaker charge, no backoff, write fails now
-                            last_err = OSError(f"replicate to {peer}: "
-                                               f"HTTP {status}")
-                            break
-                        if status >= 500:
-                            raise OSError(f"replicate to {peer}: "
-                                          f"HTTP {status}")
-                        br.record_success()
-                        retry.BUDGET.deposit()
-                        last_err = None
-                        break
-                    except Exception as e:  # noqa: BLE001
-                        br.record_failure()
-                        last_err = e
-                        delay = pol.backoff(attempt)
-                        if (attempt >= pol.max_attempts
-                                or time.monotonic() + delay > deadline
-                                or not retry.BUDGET.withdraw()):
-                            break
+                # one child span per replica hop: a slow or retried write
+                # shows WHICH peer cost it directly in the trace
+                with tracing.start_span(
+                        "volume.replicate", component="volume",
+                        attrs={"peer": peer, "fid": fid,
+                               "bytes": len(data)}) as sp:
+                    for attempt in range(1, pol.max_attempts + 1):
                         try:
-                            from ..stats import RETRY_ATTEMPTS
-                            RETRY_ATTEMPTS.inc("replicate.peer")
-                        except Exception:  # noqa: BLE001
-                            pass
-                        await asyncio.sleep(delay)
+                            # failpoint: a dead replica peer without
+                            # killing a real process — drives write-path
+                            # failure handling
+                            failpoints.check("replicate.peer")
+                            url = f"http://{peer}/{fid}?type=replicate"
+                            if name:
+                                url += "&" + urllib.parse.urlencode(
+                                    {"name": name.decode(errors="replace")})
+                            url += self._peer_jwt_param(fid)
+                            async with sess.post(
+                                    url, data=data,
+                                    headers=tracing.inject(headers)) as r:
+                                status = r.status
+                            if 300 <= status < 500:
+                                # deterministic rejection (auth/config
+                                # mismatch): the peer is alive and retrying
+                                # the identical request can't succeed — no
+                                # breaker charge, no backoff, write fails now
+                                last_err = OSError(f"replicate to {peer}: "
+                                                   f"HTTP {status}")
+                                break
+                            if status >= 500:
+                                raise OSError(f"replicate to {peer}: "
+                                              f"HTTP {status}")
+                            br.record_success()
+                            retry.BUDGET.deposit()
+                            last_err = None
+                            break
+                        except Exception as e:  # noqa: BLE001
+                            br.record_failure()
+                            last_err = e
+                            delay = pol.backoff(attempt)
+                            if (attempt >= pol.max_attempts
+                                    or time.monotonic() + delay > deadline
+                                    or not retry.BUDGET.withdraw()):
+                                break
+                            try:
+                                from ..stats import RETRY_ATTEMPTS
+                                RETRY_ATTEMPTS.inc("replicate.peer")
+                            except Exception:  # noqa: BLE001
+                                pass
+                            sp.add_event("retry", op="replicate.peer",
+                                         attempt=attempt,
+                                         breaker=br.state,
+                                         delay_ms=round(delay * 1e3, 2),
+                                         error=str(e)[:200])
+                            await asyncio.sleep(delay)
+                    if last_err is not None:
+                        sp.set_error(last_err)
                 if last_err is not None:
                     raise OSError(f"replicate to {peer} failed after "
                                   f"retries: {last_err}")
@@ -620,12 +669,14 @@ class VolumeServer:
 
         timeout = aiohttp.ClientTimeout(
             total=retry.READ_POLICY.attempt_timeout)
+        from .. import tracing
         async with aiohttp.ClientSession(timeout=timeout) as sess:
             last_err: Exception | None = None
             for peer in peers:
                 br = retry.breaker(peer)
                 try:
-                    async with sess.get(f"http://{peer}/{fid}{suffix}") as r:
+                    async with sess.get(f"http://{peer}/{fid}{suffix}",
+                                        headers=tracing.inject(None)) as r:
                         body = await r.read()
                         br.record_success()
                         return Response(
@@ -685,12 +736,32 @@ class VolumeServer:
     def _fetch_remote_shard(self, vid: int, sid: int, offset: int,
                             length: int, holders: "list[str]",
                             include_open: bool = False) -> bytes | None:
+        # one span per shard fetch: a degraded read's trace shows every
+        # attempted shard as a child, INCLUDING the failed/missing ones
+        # (status=error with the per-holder failures as events)
+        from .. import tracing
+        with tracing.start_span(
+                "ec.shard.fetch", component="volume",
+                attrs={"vid": vid, "shard": sid, "offset": offset,
+                       "length": length, "holders": len(holders)}) as sp:
+            data = self._fetch_remote_shard_inner(vid, sid, offset, length,
+                                                  holders, include_open, sp)
+            if data is None:
+                sp.set_error("no holder served shard"
+                             if holders else "shard has no holders")
+            return data
+
+    def _fetch_remote_shard_inner(self, vid: int, sid: int, offset: int,
+                                  length: int, holders: "list[str]",
+                                  include_open: bool,
+                                  sp) -> bytes | None:
         try:
             # failpoint: shard fetch failure -> the caller's degraded
             # reconstruct-from-d-others path, without destroying a shard
             failpoints.check("ec.shard.read")
         except failpoints.FailpointError as e:
             log.warning("ec shard %d.%d read failpoint: %s", vid, sid, e)
+            sp.add_event("failpoint", error=str(e)[:200])
             return None
         # circuit-open holders are SKIPPED entirely (returning None sends
         # the caller down the reconstruct path — that's the graceful
@@ -699,8 +770,15 @@ class VolumeServer:
         # path's last resort when the healthy shards alone can't reach d.
         ordered = retry.order_by_breaker(holders)
         if not include_open:
-            ordered = [a for a in ordered
-                       if retry.breaker(a).would_allow()]
+            allowed = []
+            for addr in ordered:
+                br = retry.breaker(addr)
+                if br.would_allow():
+                    allowed.append(addr)
+                else:
+                    sp.add_event("breaker_open", peer=addr,
+                                 state=br.state)
+            ordered = allowed
         for addr in ordered:
             br = retry.breaker(addr)
             try:
@@ -712,17 +790,22 @@ class VolumeServer:
                         offset=offset, size=length),
                     vpb.VolumeEcShardReadResponse)]
                 br.record_success()
+                sp.set_attr("holder", addr)
                 # corrupt site: bit-flips on the shard wire — the needle
                 # CRC downstream must catch what reconstruction produces
                 return failpoints.corrupt("ec.shard.read.data",
                                           b"".join(parts))
             except Exception as e:  # noqa: BLE001
                 br.record_failure()
+                sp.add_event("holder_failed", peer=addr,
+                             error=str(e)[:200])
                 log.warning("remote shard %d.%d read from %s: %s",
                             vid, sid, addr, e)
         return None
 
     def _make_shard_reader(self, vid: int):
+        from .. import tracing
+
         def reader(shard_id: int, offset: int, length: int) -> bytes:
             locs = self._lookup_ec_shards(vid)
             data = self._fetch_remote_shard(vid, shard_id, offset, length,
@@ -732,6 +815,8 @@ class VolumeServer:
                 # (11 s tier, store_ec.go:263) — refresh once and retry
                 fresh = self._lookup_ec_shards(vid, failed=True)
                 if fresh.get(shard_id, []) != locs.get(shard_id, []):
+                    tracing.add_event("stale_locations_refreshed", vid=vid,
+                                      shard=shard_id)
                     data = self._fetch_remote_shard(
                         vid, shard_id, offset, length,
                         fresh.get(shard_id, []))
@@ -742,6 +827,14 @@ class VolumeServer:
             # shards fetched CONCURRENTLY (store_ec.go:357-400 fans out
             # one goroutine per shard; sequential fetches would stack one
             # RTT per shard onto the degraded p99)
+            with tracing.start_span(
+                    "ec.reconstruct", component="volume",
+                    attrs={"vid": vid, "shard": shard_id, "offset": offset,
+                           "length": length}) as sp:
+                return _reconstruct(shard_id, offset, length, locs, sp)
+
+        def _reconstruct(shard_id: int, offset: int, length: int,
+                         locs: dict, sp) -> bytes:
             ev = self.store.find_ec_volume(vid)
             if ev is None:
                 raise KeyError(f"shard {shard_id} unreachable")
@@ -756,12 +849,19 @@ class VolumeServer:
                     gathered[sid] = local.read_at(offset, length)
                 elif local is None:
                     remote_sids.append(sid)
+            sp.set_attr("local_shards", len(gathered))
             if len(gathered) < geo.d and remote_sids:
                 import concurrent.futures as cf
-                futs = {self._ec_read_pool.submit(
-                            self._fetch_remote_shard, vid, sid, offset,
-                            length, locs.get(sid, [])): sid
-                        for sid in remote_sids}
+                import contextvars
+                # copy_context per submit: the pool threads' fetch spans
+                # must land under THIS reconstruct span, not as orphan
+                # roots (ThreadPoolExecutor does not propagate contextvars)
+                futs = {}
+                for sid in remote_sids:
+                    ctx = contextvars.copy_context()
+                    futs[self._ec_read_pool.submit(
+                        ctx.run, self._fetch_remote_shard, vid, sid,
+                        offset, length, locs.get(sid, []))] = sid
                 for fut in cf.as_completed(futs):
                     data = fut.result()
                     if data is not None:
@@ -783,6 +883,8 @@ class VolumeServer:
                         include_open=True)
                     if data is not None:
                         gathered[sid] = data
+            sp.set_attr("gathered", len(gathered))
+            sp.set_attr("needed", geo.d)
             if len(gathered) < geo.d:
                 raise KeyError(
                     f"cannot reconstruct shard {shard_id}: only "

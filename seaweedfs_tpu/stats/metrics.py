@@ -19,6 +19,13 @@ log = logger("stats")
 _DEFAULT_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                     0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# Exposition content types: strict Prometheus scrapers require the
+# version parameter on text/plain; exemplar-aware scrapers negotiate the
+# OpenMetrics format via Accept.
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8")
+
 
 def _fmt_labels(label_names: tuple[str, ...], label_values: tuple[str, ...],
                 extra: str = "") -> str:
@@ -38,7 +45,8 @@ class _Metric:
         self.label_names = labels
         self._lock = threading.Lock()
 
-    def expose(self) -> list[str]:  # pragma: no cover - overridden
+    def expose(self, openmetrics: bool = False
+               ) -> list[str]:  # pragma: no cover - overridden
         raise NotImplementedError
 
 
@@ -58,13 +66,24 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
-    def expose(self) -> list[str]:
+    def expose(self, openmetrics: bool = False) -> list[str]:
         with self._lock:
             items = sorted(self._values.items())
         if not items and not self.label_names:
             return [f"{self.name} 0"]
         return [f"{self.name}{_fmt_labels(self.label_names, lv)} {v}"
                 for lv, v in items]
+
+    def om_header(self) -> tuple[str, str]:
+        """(family, kind) for the OpenMetrics HELP/TYPE header. Sample
+        names NEVER change between formats (a scraper negotiating OM
+        must not silently rename series under existing dashboards), so:
+        `X_total` counters expose the spec-compliant suffix-free family
+        `X`; legacy counters without the suffix degrade to `unknown`,
+        whose samples may legally carry the bare family name."""
+        if self.name.endswith("_total"):
+            return self.name[:-len("_total")], "counter"
+        return self.name, "unknown"
 
 
 class Gauge(_Metric):
@@ -88,7 +107,7 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
-    def expose(self) -> list[str]:
+    def expose(self, openmetrics: bool = False) -> list[str]:
         with self._lock:
             items = sorted(self._values.items())
         return [f"{self.name}{_fmt_labels(self.label_names, lv)} {v}"
@@ -105,16 +124,37 @@ class Histogram(_Metric):
         self._counts: dict[tuple[str, ...], list[int]] = {}
         self._sums: dict[tuple[str, ...], float] = {}
         self._totals: dict[tuple[str, ...], int] = {}
+        # labelset -> bucket index -> (trace_id, value, unix_ts): the
+        # latest traced observation landing in that bucket (index
+        # len(buckets) = +Inf). Exposed only in the OpenMetrics rendering
+        # — plain text/plain 0.0.4 scrapers would reject exemplars.
+        self._exemplars: dict[tuple[str, ...],
+                              dict[int, tuple[str, float, float]]] = {}
 
-    def observe(self, *label_values: str, value: float) -> None:
+    def observe(self, *label_values: str, value: float,
+                trace_id: str | None = None) -> None:
+        """Record one observation. `trace_id` links the latency to a
+        trace (an OpenMetrics exemplar); when omitted, the active
+        sampled trace — if any — is captured automatically."""
+        if trace_id is None:
+            try:
+                from ..tracing import current_trace_id
+                trace_id = current_trace_id()
+            except Exception:  # noqa: BLE001 — exemplars must never break IO
+                trace_id = ""
         lv = tuple(str(v) for v in label_values)
         with self._lock:
             counts = self._counts.setdefault(lv, [0] * len(self.buckets))
+            idx = len(self.buckets)  # +Inf unless a finite bucket matches
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     counts[i] += 1
+                    idx = min(idx, i)
             self._sums[lv] = self._sums.get(lv, 0.0) + value
             self._totals[lv] = self._totals.get(lv, 0) + 1
+            if trace_id:
+                self._exemplars.setdefault(lv, {})[idx] = (
+                    trace_id, value, time.time())
 
     def time(self, *label_values: str):
         """Context manager observing elapsed seconds."""
@@ -136,23 +176,34 @@ class Histogram(_Metric):
         with self._lock:
             return self._totals.get(tuple(str(v) for v in label_values), 0)
 
-    def expose(self) -> list[str]:
+    def expose(self, openmetrics: bool = False) -> list[str]:
         out = []
         with self._lock:
             items = sorted(self._counts.items())
             sums = dict(self._sums)
             totals = dict(self._totals)
+            exemplars = {lv: dict(ex) for lv, ex in self._exemplars.items()}
+
+        def _ex(lv, idx) -> str:
+            if not openmetrics:
+                return ""
+            ex = exemplars.get(lv, {}).get(idx)
+            if ex is None:
+                return ""
+            tid, val, ts = ex
+            return f' # {{trace_id="{tid}"}} {val} {ts:.3f}'
+
         for lv, counts in items:
             for i, b in enumerate(self.buckets):
                 le = f'le="{b}"'
                 out.append(
                     f"{self.name}_bucket"
                     f"{_fmt_labels(self.label_names, lv, le)}"
-                    f" {counts[i]}")
+                    f" {counts[i]}{_ex(lv, i)}")
             inf = 'le="+Inf"'
             out.append(f"{self.name}_bucket"
                        f"{_fmt_labels(self.label_names, lv, inf)}"
-                       f" {totals[lv]}")
+                       f" {totals[lv]}{_ex(lv, len(self.buckets))}")
             out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)}"
                        f" {sums[lv]}")
             out.append(f"{self.name}_count{_fmt_labels(self.label_names, lv)}"
@@ -170,19 +221,33 @@ class Registry:
             self._metrics.append(metric)
         return metric
 
-    def gather(self) -> str:
-        """Prometheus text format (reference metrics.go:31 Gather)."""
+    def gather(self, openmetrics: bool = False) -> str:
+        """Prometheus text format (reference metrics.go:31 Gather).
+        `openmetrics=True` renders the OpenMetrics dialect instead:
+        histogram bucket lines carry `# {trace_id="..."} value ts`
+        exemplars linking latencies to /debug/traces, and the exposition
+        ends with the mandatory `# EOF` terminator."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
-            body = m.expose()
+            body = m.expose(openmetrics=openmetrics)
             if not body:
                 continue
-            lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+            family, kind = m.name, m.kind
+            if openmetrics and isinstance(m, Counter):
+                family, kind = m.om_header()
+            lines.append(f"# HELP {family} {m.help}")
+            lines.append(f"# TYPE {family} {kind}")
             lines.extend(body)
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+    def metrics(self) -> "list[_Metric]":
+        """Registered families snapshot (metrics-lint, tests)."""
+        with self._lock:
+            return list(self._metrics)
 
 
 REGISTRY = Registry()
@@ -257,18 +322,59 @@ BREAKER_TRANSITIONS = _counter(
 DEGRADED_EC_READS = _counter(
     "SeaweedFS_degraded_ec_reads_total",
     "EC reads served by reconstructing from surviving shards")
+# Tracing layer (tracing/trace.py): spans recorded per component, and
+# spans evicted from the bounded ring buffer before anyone read them.
+TRACE_SPANS = _counter(
+    "SeaweedFS_trace_spans_total",
+    "finished sampled trace spans recorded", ("component",))
+
+
+def scrape_payload(accept: str = "") -> tuple[str, str]:
+    """(body, content_type) for a /metrics response, negotiated on the
+    scraper's Accept header: OpenMetrics (with trace exemplars) when
+    requested, else the Prometheus text format with the strict
+    `version=0.0.4` parameter scrapers require."""
+    if "application/openmetrics-text" in (accept or ""):
+        return REGISTRY.gather(openmetrics=True), OPENMETRICS_CONTENT_TYPE
+    return REGISTRY.gather(), PROM_CONTENT_TYPE
 
 
 async def aiohttp_metrics_handler(request):
     """Shared /metrics handler for the aiohttp-based servers."""
     from aiohttp import web
-    return web.Response(text=REGISTRY.gather(), content_type="text/plain")
+    body, ctype = scrape_payload(request.headers.get("Accept", ""))
+    return web.Response(body=body.encode(),
+                        headers={"Content-Type": ctype})
+
+
+class PushLoop:
+    """Handle for a running push-gateway loop: `stop()` sets the event
+    AND joins the thread, so server shutdown paths can tear it down
+    deterministically instead of leaking a daemon thread mid-PUT."""
+
+    def __init__(self, thread: threading.Thread, stop_event: threading.Event):
+        self.thread = thread
+        self._stop = stop_event
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self.thread.is_alive():
+            self.thread.join(timeout)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def is_alive(self) -> bool:
+        return self.thread.is_alive()
 
 
 def start_push_loop(gateway_url: str, job: str, interval_seconds: int = 15,
                     registry: Registry = REGISTRY,
-                    stop_event: threading.Event | None = None) -> threading.Thread:
-    """Push-gateway loop (reference metrics.go:306 LoopPushingMetric)."""
+                    stop_event: threading.Event | None = None) -> PushLoop:
+    """Push-gateway loop (reference metrics.go:306 LoopPushingMetric).
+    Returns a PushLoop whose stop() joins the thread — callers' shutdown
+    paths (master/volume/filer stop()) use it."""
     stop = stop_event or threading.Event()
 
     def loop():
@@ -277,12 +383,11 @@ def start_push_loop(gateway_url: str, job: str, interval_seconds: int = 15,
             try:
                 req = urllib.request.Request(
                     url, data=registry.gather().encode(), method="PUT",
-                    headers={"Content-Type": "text/plain"})
+                    headers={"Content-Type": PROM_CONTENT_TYPE})
                 urllib.request.urlopen(req, timeout=5)
             except Exception as e:  # noqa: BLE001
                 log.warning("metrics push to %s: %s", gateway_url, e)
 
     t = threading.Thread(target=loop, daemon=True, name="metrics-push")
-    t._stop_event = stop  # type: ignore[attr-defined]
     t.start()
-    return t
+    return PushLoop(t, stop)

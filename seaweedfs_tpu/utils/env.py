@@ -1,0 +1,20 @@
+"""Typed environment-variable readers shared by the config-by-env
+modules (utils/retry.py knobs, tracing sampling/buffer knobs)."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default

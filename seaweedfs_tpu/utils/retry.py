@@ -46,29 +46,16 @@ Env knobs (read once, overridable via configure()):
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
 from dataclasses import dataclass, replace
 
+from .env import env_float as _env_float
+from .env import env_int as _env_int
 from .log import logger
 
 log = logger("retry")
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 @dataclass(frozen=True)
@@ -309,12 +296,17 @@ def retry_call(fn, *, op: str, peer: str | None = None,
     against the peer's breaker — a peer answering garbage is as useless
     as a dead one is NOT true for application errors, so callers should
     classify; transport-level callers usually leave the default)."""
+    from .. import tracing
     budget = budget if budget is not None else BUDGET
     br = breaker(peer) if peer else None
     deadline = time.monotonic() + policy.deadline
     last_err: Exception | None = None
     for attempt in range(1, policy.max_attempts + 1):
         if br is not None and not br.allow():
+            # annotate the active span: a fast-failed request
+            # self-explains as "the peer's circuit was open"
+            tracing.add_event("breaker_open", op=op, peer=peer,
+                              state=br.state)
             raise BreakerOpenError(peer, br.remaining_cooldown())
         try:
             result = fn()
@@ -340,6 +332,12 @@ def retry_call(fn, *, op: str, peer: str | None = None,
                 RETRY_ATTEMPTS.inc(op)
             except Exception:  # noqa: BLE001
                 pass
+            tracing.add_event(
+                "retry", op=op, attempt=attempt,
+                delay_ms=round(delay * 1e3, 2),
+                error=str(e)[:200],
+                **({"peer": peer, "breaker": br.state} if br is not None
+                   else {}))
             time.sleep(delay)
             continue
         if br is not None:

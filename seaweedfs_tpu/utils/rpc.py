@@ -99,11 +99,20 @@ def set_cluster_key(key: str) -> None:
 
 
 def _outgoing_metadata() -> list[tuple[str, str]]:
+    md = []
+    # trace-context propagation: a sampled active span rides every gRPC
+    # hop as traceparent metadata (the HTTP plane uses the header form);
+    # unsampled/absent adds nothing to the wire
+    from .. import tracing
+    tp = tracing.injectable()
+    if tp:
+        md.append((tracing.TRACEPARENT_HEADER, tp))
     if not _cluster_key:
-        return []
+        return md
     from ..security.jwt import gen_jwt_for_filer_server
-    return [("authorization", "Bearer "
-             + gen_jwt_for_filer_server(_cluster_key, 60))]
+    md.append(("authorization", "Bearer "
+               + gen_jwt_for_filer_server(_cluster_key, 60)))
+    return md
 
 
 class _AuthInterceptor(grpc.ServerInterceptor):
@@ -151,25 +160,101 @@ class _AuthInterceptor(grpc.ServerInterceptor):
             handler.response_serializer)
 
 
+def _extract_trace_context(context):
+    """Inbound traceparent metadata -> SpanContext | None."""
+    from .. import tracing
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == tracing.TRACEPARENT_HEADER:
+                return tracing.parse_traceparent(v)
+    except Exception:  # noqa: BLE001 — tracing must never break dispatch
+        pass
+    return None
+
+
+def _component_of(service: str) -> str:
+    # "swtpu.master.Master" -> "master"
+    parts = service.split(".")
+    return parts[1] if len(parts) > 1 else service
+
+
+# Server-streaming methods that are SUBSCRIPTIONS, not requests: the
+# stream lives for the subscriber's connection lifetime, so a span around
+# it would be a giant-duration root that dominates min_ms queries and
+# trips the slow-span log on every routine disconnect.
+_LONG_LIVED_STREAMS = frozenset({
+    "SubscribeMetadata", "SubscribeLocalMetadata", "Subscribe",
+    "SubscribeFollowMe", "VolumeTailSender", "KeepConnected",
+})
+
+
 class RpcService:
-    """Declarative service: register handlers, then mount on a grpc.Server."""
+    """Declarative service: register handlers, then mount on a grpc.Server.
+
+    Unary and bounded server-streaming handlers run inside a tracing
+    span (`rpc/<Method>`) parented on the caller's traceparent metadata,
+    so a cross-process gRPC hop (master assign/lookup, EC shard reads,
+    filer entry RPCs) lands in the same trace as the HTTP hops around
+    it. Long-lived connections — bidirectional streams (heartbeats,
+    KeepConnected) and the subscription streams in _LONG_LIVED_STREAMS —
+    are not spanned."""
 
     def __init__(self, name: str):
         self.name = name  # e.g. "swtpu.master.Master"
         self._handlers: dict[str, grpc.RpcMethodHandler] = {}
+        self._component = _component_of(name)
+
+    def _traced_unary(self, method: str, fn: Callable) -> Callable:
+        from .. import tracing
+        comp = self._component
+
+        def wrapped(request, context):
+            with tracing.start_span(
+                    f"rpc/{method}", component=comp,
+                    child_of=_extract_trace_context(context)) as sp:
+                try:
+                    return fn(request, context)
+                except Exception as e:  # noqa: BLE001 — incl. grpc aborts
+                    sp.set_error(e)
+                    raise
+        return wrapped
+
+    def _traced_stream(self, method: str, fn: Callable) -> Callable:
+        from .. import tracing
+        comp = self._component
+
+        def wrapped(request, context):
+            with tracing.start_span(
+                    f"rpc/{method}", component=comp,
+                    child_of=_extract_trace_context(context)) as sp:
+                try:
+                    yield from fn(request, context)
+                except GeneratorExit:
+                    # client cancelled / stopped consuming: routine
+                    # teardown, not a stream failure
+                    sp.status = "cancelled"
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    sp.set_error(e)
+                    raise
+        return wrapped
 
     def unary(self, method: str, req_cls, resp_cls):
         def deco(fn: Callable):
             self._handlers[method] = grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString,
+                self._traced_unary(method, fn),
+                request_deserializer=req_cls.FromString,
                 response_serializer=resp_cls.SerializeToString)
             return fn
         return deco
 
     def unary_stream(self, method: str, req_cls, resp_cls):
         def deco(fn: Callable):
+            handler = (fn if method in _LONG_LIVED_STREAMS
+                       else self._traced_stream(method, fn))
             self._handlers[method] = grpc.unary_stream_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString,
+                handler,
+                request_deserializer=req_cls.FromString,
                 response_serializer=resp_cls.SerializeToString)
             return fn
         return deco

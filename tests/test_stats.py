@@ -108,9 +108,39 @@ class TestScrapeEndpoints:
             mc.stop()
         r = requests.get(f"http://{vs.url}/metrics", timeout=5)
         assert r.status_code == 200
+        # strict scrapers demand the version parameter on the exposition
+        assert r.headers["Content-Type"] == \
+            "text/plain; version=0.0.4; charset=utf-8"
         assert "SeaweedFS_volumeServer_request_total" in r.text
         assert 'type="post"' in r.text and 'type="get"' in r.text
         assert "SeaweedFS_volumeServer_request_seconds_bucket" in r.text
+
+    def test_openmetrics_negotiation_with_exemplars(self, mini_cluster):
+        """Accept: application/openmetrics-text switches the rendering:
+        exemplars on the request-seconds buckets and the # EOF
+        terminator. Does its own traced submit so it holds standalone."""
+        import requests
+
+        from seaweedfs_tpu import tracing
+        from seaweedfs_tpu.client import operation
+        from seaweedfs_tpu.client.master_client import MasterClient
+
+        ms, vs = mini_cluster
+        mc = MasterClient(ms.address).start()
+        mc.wait_connected()
+        try:
+            with tracing.start_span("exemplar-seed", component="test"):
+                operation.submit(mc, b"exemplar-payload", name="e.bin")
+        finally:
+            mc.stop()
+        r = requests.get(
+            f"http://{vs.url}/metrics", timeout=5,
+            headers={"Accept": "application/openmetrics-text"})
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        assert r.text.rstrip().endswith("# EOF")
+        assert '# {trace_id="' in r.text
 
     def test_master_http_api(self, mini_cluster):
         import requests
@@ -143,6 +173,80 @@ class TestScrapeEndpoints:
         from conftest import wait_until
         wait_until(lambda: VOLUME_SERVER_VOLUME_GAUGE.value("", "hdd") >= 1,
                    timeout=5, msg="volume gauge updated")
+
+
+class TestExpositionGrammar:
+    """Strict line-grammar validation of the registry's output (the
+    satellite of the tracing PR: a malformed family fails CI, not a
+    production scrape)."""
+
+    def test_registry_exposition_is_grammatical(self):
+        from seaweedfs_tpu import tracing
+        from seaweedfs_tpu.stats import (BREAKER_STATE, REGISTRY,
+                                         RETRY_ATTEMPTS,
+                                         VOLUME_REQUEST_SECONDS)
+        from seaweedfs_tpu.stats.expo_lint import (check_exposition,
+                                                   lint_registry)
+
+        RETRY_ATTEMPTS.inc("lint.op")
+        BREAKER_STATE.set("127.0.0.1:1", value=1)
+        with tracing.start_span("lint", component="test"):
+            VOLUME_REQUEST_SECONDS.observe("get", value=0.003)
+        fams = check_exposition(REGISTRY.gather())
+        assert "SeaweedFS_volumeServer_request_seconds" in fams
+        assert "SeaweedFS_retry_attempts_total" in fams
+        # the OpenMetrics rendering (with exemplars) must parse too
+        check_exposition(REGISTRY.gather(openmetrics=True))
+        assert lint_registry() == []
+
+    def test_checker_rejects_bad_expositions(self):
+        from seaweedfs_tpu.stats.expo_lint import (ExpositionError,
+                                                   check_exposition)
+
+        cases = {
+            "sample without HELP/TYPE": 'x_total{op="a"} 1',
+            "unsorted le": (
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="0.5"} 1\nh_bucket{le="0.1"} 1\n'
+                'h_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1'),
+            "bad label escaping": (
+                "# HELP c x\n# TYPE c counter\nc{op=unquoted} 1"),
+            "missing _count": (
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="+Inf"} 2\nh_sum 1'),
+            "missing +Inf": (
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="1.0"} 2\nh_sum 1\nh_count 2'),
+            "non-monotone buckets": (
+                "# HELP h x\n# TYPE h histogram\n"
+                'h_bucket{le="0.1"} 3\nh_bucket{le="+Inf"} 2\n'
+                "h_sum 1\nh_count 2"),
+            "TYPE before HELP": "# TYPE t counter\n# HELP t x\nt 1",
+            "bad value": "# HELP g x\n# TYPE g gauge\ng notanumber",
+        }
+        for why, text in cases.items():
+            with pytest.raises(ExpositionError):
+                check_exposition(text)
+
+    def test_registry_lint_flags_cardinality_leak(self):
+        from seaweedfs_tpu.stats.metrics import Counter, Registry
+        from seaweedfs_tpu.stats.expo_lint import lint_registry
+
+        reg = Registry()
+        c = reg.register(Counter("leaky_total", "h", ("peer",)))
+        for i in range(20):
+            c.inc(f"10.0.0.{i}:8080")
+        assert lint_registry(reg, ceiling=10)
+        assert not lint_registry(reg, ceiling=100)
+
+    def test_push_loop_handle_stops_and_joins(self):
+        from seaweedfs_tpu.stats import start_push_loop
+
+        h = start_push_loop("http://127.0.0.1:1/nowhere", "t",
+                            interval_seconds=30)
+        assert h.is_alive()
+        h.stop(timeout=5)
+        assert h.stopped and not h.is_alive()
 
 
 def test_status_ui_pages(tmp_path):

@@ -1,0 +1,488 @@
+"""Distributed tracing: context propagation across real cross-node hops.
+
+The acceptance path mirrors tests/test_compose_e2e.py's shape: a live
+master + volume servers, a client write whose ONE trace id shows up in
+client, master, and volume spans, retrievable via each node's
+/debug/traces; a degraded EC read whose trace shows per-shard child
+spans including the failed ones; and sampling=0 adding nothing to the
+wire."""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu import tracing
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.master_client import MasterClient
+from seaweedfs_tpu.master.master_server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.store import Store
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(autouse=True)
+def _full_sampling():
+    """Deterministic sampling for every test here; restore after."""
+    tracing.configure(sample=1.0, slow_ms=0.0)
+    yield
+    tracing.configure(sample=1.0, slow_ms=0.0)
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        ctx = tracing.SpanContext("ab" * 16, "cd" * 8, True)
+        assert tracing.parse_traceparent(ctx.to_traceparent()) == ctx
+        unsampled = tracing.SpanContext("ab" * 16, "cd" * 8, False)
+        parsed = tracing.parse_traceparent(unsampled.to_traceparent())
+        assert parsed is not None and parsed.sampled is False
+
+    def test_malformed_inputs_return_none(self):
+        bad = ["", "00", "00-xyz-abc-01",
+               "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+               "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+               "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+               "00-" + "g" * 32 + "-" + "b" * 16 + "-01"]  # non-hex
+        for v in bad:
+            assert tracing.parse_traceparent(v) is None, v
+
+    def test_parent_child_share_trace(self):
+        with tracing.start_span("parent", component="t") as p:
+            with tracing.start_span("child", component="t") as c:
+                assert c.context.trace_id == p.context.trace_id
+                assert c.parent_id == p.context.span_id
+            assert tracing.current_span() is p
+        assert tracing.current_span() is None
+
+    def test_remote_parent(self):
+        remote = tracing.SpanContext("12" * 16, "34" * 8, True)
+        with tracing.start_span("server", child_of=remote) as sp:
+            assert sp.context.trace_id == remote.trace_id
+            assert sp.parent_id == remote.span_id
+
+    def test_extract_inject_headers(self):
+        with tracing.start_span("x") as sp:
+            headers = tracing.inject({"other": "kept"})
+            assert headers["other"] == "kept"
+            ctx = tracing.extract(headers)
+            assert ctx is not None
+            assert ctx.trace_id == sp.context.trace_id
+            assert ctx.span_id == sp.context.span_id
+
+
+class TestSampling:
+    def test_sampling_zero_adds_no_headers(self):
+        tracing.configure(sample=0.0)
+        before = len(tracing.BUFFER)
+        with tracing.start_span("unsampled") as sp:
+            assert not sp.context.sampled
+            assert tracing.injectable() == ""
+            headers = {"a": "b"}
+            assert tracing.inject(headers) is headers  # untouched
+            # children inherit the no-sample decision
+            with tracing.start_span("child") as c:
+                assert not c.context.sampled
+        assert len(tracing.BUFFER) == before  # nothing recorded
+
+    def test_no_active_span_injects_nothing(self):
+        assert tracing.injectable() == ""
+        assert tracing.inject(None) is None
+
+    def test_fractional_rate_propagates_unsampled_decision(self):
+        """Under 0 < rate < 1 an unsampled trace still rides the wire
+        with the 00 flag, so downstream nodes inherit the no-sample
+        decision instead of re-rolling and recording fragment roots."""
+        tracing.configure(sample=0.5)
+        # force an unsampled root deterministically
+        unsampled = None
+        for _ in range(200):
+            sp = tracing.start_span("probe")
+            if not sp.context.sampled:
+                unsampled = sp
+                break
+        assert unsampled is not None
+        with unsampled:
+            tp = tracing.injectable()
+            assert tp.endswith("-00"), tp
+            ctx = tracing.parse_traceparent(tp)
+            assert ctx is not None and not ctx.sampled
+            # a server extracting this context records nothing
+            before = len(tracing.BUFFER)
+            with tracing.start_span("server", child_of=ctx) as child:
+                assert not child.context.sampled
+            assert len(tracing.BUFFER) == before
+
+    def test_unsampled_request_costs_no_wire_bytes(self):
+        """The exact bytes http_util puts on the wire must be identical
+        with tracing unsampled vs no span at all."""
+        from seaweedfs_tpu.client import http_util
+        captured = []
+
+        class _FakeSock:
+            def sendall(self, data):
+                captured.append(bytes(data))
+                raise OSError("stop here")  # abort before any read
+
+        class _FakeConn:
+            sock = _FakeSock()
+            used = 1
+
+        def run_once():
+            captured.clear()
+            orig = http_util._conn
+            http_util._conn = lambda netloc, timeout: _FakeConn()
+            try:
+                http_util.request("GET", "http://127.0.0.1:1/x",
+                                  max_attempts=1)
+            except Exception:  # noqa: BLE001 — the fake always errors
+                pass
+            finally:
+                http_util._conn = orig
+            return captured[0] if captured else b""
+
+        bare = run_once()
+        tracing.configure(sample=0.0)
+        with tracing.start_span("unsampled"):
+            unsampled = run_once()
+        tracing.configure(sample=1.0)
+        with tracing.start_span("sampled"):
+            sampled = run_once()
+        assert unsampled == bare
+        assert b"traceparent" not in unsampled
+        assert b"traceparent" in sampled
+
+
+class TestBuffer:
+    def test_ring_buffer_bounds_and_filters(self):
+        buf = tracing.TraceBuffer(capacity=8)
+        spans = []
+        for i in range(12):
+            sp = tracing.start_span(f"s{i}", component="t")
+            with sp:
+                pass
+            spans.append(sp)
+        # fill the small buffer directly
+        for sp in spans:
+            buf.add(sp)
+        assert len(buf) == 8
+        assert buf.dropped == 4
+        tid = spans[-1].context.trace_id
+        only = buf.snapshot(trace_id=tid)
+        assert len(only) == 1 and only[0]["trace_id"] == tid
+        assert buf.snapshot(min_ms=1e9) == []
+
+    def test_debug_traces_payload_filters(self):
+        tracing.BUFFER.clear()
+        with tracing.start_span("a", component="t") as sp:
+            tid = sp.context.trace_id
+        with tracing.start_span("b", component="t"):
+            pass
+        body = tracing.debug_traces_payload({"trace_id": tid})
+        assert body["count"] == 1
+        assert body["spans"][0]["name"] == "a"
+        assert tracing.debug_traces_payload({})["count"] == 2
+        assert tracing.debug_traces_payload({"limit": "1"})["count"] == 1
+
+    def test_span_events_and_attrs_capped(self):
+        with tracing.start_span("capped") as sp:
+            for i in range(200):
+                sp.add_event("e", i=i)
+                sp.set_attr(f"k{i}", i)
+        d = sp.to_dict()
+        assert len(d["events"]) <= 64
+        assert len(d["attrs"]) <= 32
+
+
+class TestRetryAnnotations:
+    def test_retry_call_annotates_span(self):
+        from seaweedfs_tpu.utils import retry
+
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("boom")
+            return "ok"
+
+        with tracing.start_span("op") as sp:
+            pol = retry.RetryPolicy(max_attempts=5, base_delay=0.001,
+                                    max_delay=0.002)
+            assert retry.retry_call(flaky, op="t.flaky",
+                                    policy=pol) == "ok"
+        names = [e["name"] for e in sp.events]
+        assert names.count("retry") == 2
+        assert sp.events[0]["op"] == "t.flaky"
+
+    def test_breaker_open_annotates_span(self):
+        from seaweedfs_tpu.utils import retry
+
+        retry.breaker("trace-peer:1").trip()
+        with tracing.start_span("op") as sp:
+            with pytest.raises(retry.BreakerOpenError):
+                retry.retry_call(lambda: "x", op="t.open",
+                                 peer="trace-peer:1")
+        assert any(e["name"] == "breaker_open" for e in sp.events)
+        retry.reset_breakers()
+
+
+@pytest.fixture(scope="module")
+def mini_cluster(tmp_path_factory):
+    """master (with HTTP API) + one volume server, separate HTTP/gRPC
+    planes — the client → master assign → volume PUT acceptance path."""
+    mport, hport, vport = free_port(), free_port(), free_port()
+    ms = MasterServer(port=mport, volume_size_limit_mb=64,
+                      pulse_seconds=0.3, http_port=hport,
+                      maintenance_scripts=[])
+    ms.start()
+    d = tmp_path_factory.mktemp("trace-vs")
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(d), max_volume_count=8)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
+                      pulse_seconds=0.3)
+    vs.start()
+    from conftest import wait_cluster_up
+    wait_cluster_up(ms, [vs])
+    mc = MasterClient(ms.address).start()
+    mc.wait_connected()
+    yield ms, vs, mc
+    mc.stop()
+    vs.stop()
+    ms.stop()
+
+
+class TestEndToEnd:
+    def test_one_write_traces_across_three_nodes(self, mini_cluster):
+        """One submit produces spans on client, master, and volume
+        sharing a single trace id, each retrievable via /debug/traces."""
+        ms, vs, mc = mini_cluster
+        tracing.BUFFER.clear()
+        with tracing.start_span("e2e.write", component="test") as root:
+            res = operation.submit(mc, b"traced payload", name="t.bin")
+            tid = root.context.trace_id
+        assert operation.read(mc, res.fid) == b"traced payload"
+
+        spans = tracing.BUFFER.snapshot(trace_id=tid)
+        comps = {s["component"] for s in spans}
+        assert {"test", "client", "master", "volume"} <= comps, comps
+        names = {s["name"] for s in spans}
+        assert "client.submit" in names
+        assert "volume.post" in names
+        assert "rpc/Assign" in names  # the master hop, via gRPC metadata
+
+        # every span's parent chain stays inside the one trace
+        by_id = {s["span_id"]: s for s in spans}
+        for s in spans:
+            if s["parent_id"]:
+                parent = by_id.get(s["parent_id"])
+                if parent is not None:
+                    assert parent["trace_id"] == tid
+
+        # /debug/traces on each node's HTTP plane serves the trace,
+        # filterable by trace_id
+        for base in (f"http://{ms.ip}:{ms.http_port}",
+                     f"http://{vs.url}"):
+            r = requests.get(f"{base}/debug/traces",
+                             params={"trace_id": tid}, timeout=5)
+            assert r.status_code == 200
+            body = r.json()
+            assert body["count"] >= 1
+            assert all(s["trace_id"] == tid for s in body["spans"])
+
+        # an unknown trace id filters down to nothing
+        r = requests.get(f"http://{vs.url}/debug/traces",
+                         params={"trace_id": "f" * 32}, timeout=5)
+        assert r.json()["count"] == 0
+
+    def test_min_ms_filter(self, mini_cluster):
+        ms, vs, mc = mini_cluster
+        r = requests.get(f"http://{vs.url}/debug/traces",
+                         params={"min_ms": "1e9"}, timeout=5)
+        assert r.status_code == 200 and r.json()["count"] == 0
+
+    def test_http_read_continues_trace(self, mini_cluster):
+        ms, vs, mc = mini_cluster
+        res = operation.submit(mc, b"read-trace", name="r.bin")
+        tracing.BUFFER.clear()
+        with tracing.start_span("e2e.read", component="test") as root:
+            assert operation.read(mc, res.fid) == b"read-trace"
+            tid = root.context.trace_id
+        names = {s["name"]
+                 for s in tracing.BUFFER.snapshot(trace_id=tid)}
+        assert "client.read" in names
+        assert "volume.get" in names
+
+    def test_sampling_off_is_invisible_end_to_end(self, mini_cluster):
+        """SWTPU_TRACE_SAMPLE=0 equivalent: a full write produces zero
+        recorded spans anywhere in the (shared-process) cluster."""
+        ms, vs, mc = mini_cluster
+        tracing.configure(sample=0.0)
+        tracing.BUFFER.clear()
+        res = operation.submit(mc, b"dark payload", name="d.bin")
+        assert operation.read(mc, res.fid) == b"dark payload"
+        assert len(tracing.BUFFER) == 0
+
+    def test_slow_span_logging(self, mini_cluster, caplog):
+        import logging
+        ms, vs, mc = mini_cluster
+        tracing.configure(slow_ms=0.000001)
+        with caplog.at_level(logging.WARNING, logger="swtpu.trace"):
+            with tracing.start_span("deliberately.slow",
+                                    component="test") as sp:
+                tid = sp.context.trace_id
+        tracing.configure(slow_ms=0.0)
+        slow = [r for r in caplog.records if "slow-span" in r.getMessage()]
+        assert slow and tid in slow[0].getMessage()
+
+
+@pytest.fixture(scope="module")
+def ec_cluster(tmp_path_factory):
+    """master + 3 volume servers with one EC volume spread so two peers
+    hold exactly one data shard each (the test_fault_tolerance layout,
+    scaled down): src=[0,1,4,5], B=[2], C=[3]."""
+    from seaweedfs_tpu.ec.locate import EcGeometry
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3, maintenance_scripts=[])
+    master.start()
+    servers = []
+    geo = EcGeometry(d=4, p=2, large_block=1 << 20, small_block=1 << 14)
+    for i in range(3):
+        d = tmp_path_factory.mktemp(f"trace-ec{i}")
+        store = Store("127.0.0.1", 0, "",
+                      [DiskLocation(str(d), max_volume_count=10)],
+                      ec_geometry=geo, coder_name="numpy")
+        port = free_port()
+        store.port = port
+        store.public_url = f"127.0.0.1:{port}"
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.3)
+        vs.start()
+        servers.append(vs)
+    from conftest import wait_cluster_up, wait_until
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+
+    rng = np.random.default_rng(7)
+    blobs = {}
+    for _ in range(8):
+        data = rng.integers(0, 256, int(rng.integers(500, 20000)),
+                            dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data, collection="trc")
+        blobs[res.fid] = data
+    vid = int(next(iter(blobs)).split(",")[0])
+
+    src = next(vs for vs in servers
+               if vs.store.find_volume(vid) is not None)
+    others = [vs for vs in servers if vs is not src]
+    src_stub = Stub(f"127.0.0.1:{src.grpc_port}", VOLUME_SERVICE)
+    src_stub.call("VolumeMarkReadonly",
+                  vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                  vpb.VolumeMarkReadonlyResponse)
+    src_stub.call("VolumeEcShardsGenerate",
+                  vpb.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                                    collection="trc"),
+                  vpb.VolumeEcShardsGenerateResponse, timeout=120)
+    spread = {src: [0, 1, 4, 5], others[0]: [2], others[1]: [3]}
+    for vs, sids in spread.items():
+        if vs is not src:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection="trc", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True,
+                    copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{src.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=vid, collection="trc",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    from seaweedfs_tpu.ec import files as ec_files
+    base = src.store.find_ec_volume(vid).base
+    src_stub.call("VolumeEcShardsUnmount",
+                  vpb.VolumeEcShardsUnmountRequest(volume_id=vid,
+                                                   shard_ids=[2, 3]),
+                  vpb.VolumeEcShardsUnmountResponse)
+    for sid in (2, 3):
+        os.remove(base + ec_files.shard_ext(sid))
+    src_stub.call("VolumeEcShardsMount",
+                  vpb.VolumeEcShardsMountRequest(volume_id=vid,
+                                                 collection="trc",
+                                                 shard_ids=[0, 1, 4, 5]),
+                  vpb.VolumeEcShardsMountResponse)
+    src_stub.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
+                  vpb.VolumeDeleteResponse)
+    wait_until(lambda: vid in master.topo.ec_locations,
+               msg="ec registry updated")
+    yield master, src, others, mc, vid, blobs
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+class TestDegradedEcTrace:
+    def test_degraded_read_trace_shows_failed_shard_children(
+            self, ec_cluster):
+        """With every remote shard fetch failing (failpoint) and reads
+        pinned to the 4-shard holder, reads reconstruct — and the trace
+        shows the failed per-shard fetch spans under ec.reconstruct."""
+        from seaweedfs_tpu.stats import DEGRADED_EC_READS
+        from seaweedfs_tpu.utils import failpoints, retry
+
+        master, src, others, mc, vid, blobs = ec_cluster
+        for vs in others:
+            retry.breaker(f"127.0.0.1:{vs.port}").trip()
+        tracing.BUFFER.clear()
+        before = DEGRADED_EC_READS.value()
+        tids = []
+        with failpoints.inject("ec.shard.read", "error:injected-down"):
+            for fid, data in blobs.items():
+                with tracing.start_span("ec.e2e.read",
+                                        component="test") as root:
+                    assert operation.read(mc, fid) == data
+                    tids.append(root.context.trace_id)
+        assert DEGRADED_EC_READS.value() > before
+        retry.reset_breakers()
+
+        # at least one read went degraded: its trace must contain the
+        # reconstruct span AND failed per-shard fetch children
+        degraded = []
+        for tid in tids:
+            spans = tracing.BUFFER.snapshot(trace_id=tid, limit=1000)
+            if any(s["name"] == "ec.reconstruct" for s in spans):
+                degraded.append((tid, spans))
+        assert degraded, "no degraded read left an ec.reconstruct span"
+        tid, spans = degraded[0]
+        recon = [s for s in spans if s["name"] == "ec.reconstruct"]
+        fetches = [s for s in spans if s["name"] == "ec.shard.fetch"]
+        assert fetches, "per-shard fetch spans missing"
+        failed = [s for s in fetches if s["status"] == "error"]
+        assert failed, "the failed shard fetch is not visible as a span"
+        # the failed fetch hangs off this trace like everything else
+        assert all(s["trace_id"] == tid for s in recon + fetches)
+        # and the degraded read's shard fan-out is queryable over HTTP
+        r = requests.get(f"http://{src.url}/debug/traces",
+                         params={"trace_id": tid, "limit": 1000},
+                         timeout=5)
+        names = [s["name"] for s in r.json()["spans"]]
+        assert "ec.reconstruct" in names and "ec.shard.fetch" in names
