@@ -2,7 +2,11 @@
 
 .PHONY: test stress chaos bench smoke protos metrics-lint
 
-test:
+# metrics-lint runs FIRST so an exposition-grammar or registry
+# regression fails the default path before the suite spends minutes;
+# the suite itself includes the cluster.check-against-mini-cluster
+# smoke (tests/test_health.py) so health regressions fail tier-1 too
+test: metrics-lint
 	python -m pytest tests/ -q
 
 # race/stress harness with artifact (tests/stress/run_stress.py);

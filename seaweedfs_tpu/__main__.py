@@ -40,6 +40,11 @@ def _add_master_flags(p):
                         "'' disables")
     p.add_argument("-maintenanceIntervalS", type=float, default=0,
                    help="cron interval seconds (0 = reference default 17 min)")
+    p.add_argument("-ecParityShards", type=int, default=0,
+                   help="parity shard count of the cluster's EC geometry, "
+                        "used by the health engine to derive k = n - parity "
+                        "(0 = fork default 2; MUST match ec.encode's "
+                        "-parityShards or /cluster/health mis-scores stripes)")
     _add_security_flags(p)
 
 
@@ -117,7 +122,8 @@ def run_master(argv):
                       peers=[p for p in opt.peers.split(",") if p],
                       raft_state_path=raft_state,
                       maintenance_scripts=scripts,
-                      maintenance_interval_s=opt.maintenanceIntervalS or None)
+                      maintenance_interval_s=opt.maintenanceIntervalS or None,
+                      ec_parity_shards=opt.ecParityShards or None)
     ms.start()
     _wait_forever()
 
@@ -222,9 +228,18 @@ def run_shell(argv):
     if opt.filer:
         env.option["filer"] = opt.filer
     if opt.script:
-        for line in opt.script.split(";"):
-            if not run_command(env, line):
-                break
+        # scripted mode is CI/cron-facing: a failing command (e.g.
+        # cluster.check tripping on an AT_RISK verdict, volume.scrub
+        # finding corruption) must surface as a non-zero process exit,
+        # not a printed-and-swallowed error like in the interactive REPL
+        try:
+            for line in opt.script.split(";"):
+                if not run_command(env, line):
+                    break
+        except Exception as e:  # noqa: BLE001
+            print(f"error: {e}", file=sys.stderr)
+            env.release_lock()
+            sys.exit(2)
         env.release_lock()
     else:
         repl(env)

@@ -386,15 +386,39 @@ class FilerServer:
             return web.json_response(
                 tracing.debug_traces_payload(dict(request.query)))
 
+        async def debug_events(request):
+            if request.method != "GET":
+                return web.json_response({"error": "method not allowed"},
+                                         status=405)
+            from ..ops import events
+            return web.json_response(
+                events.debug_events_payload(dict(request.query)))
+
+        async def debug_profile(request):
+            # pprof-style sampler (utils/profiling.py) — previously only
+            # master/volume exposed it; sampling runs off the event loop
+            # so an N-second capture can't stall filer IO
+            if request.method != "GET":
+                return web.json_response({"error": "method not allowed"},
+                                         status=405)
+            import asyncio as _asyncio
+
+            from ..utils import profiling
+            secs = float(request.query.get("seconds", "5"))
+            text = await _asyncio.to_thread(profiling.cpu_profile, secs)
+            return web.Response(text=text, content_type="text/plain")
+
         def routes(app):
             app.router.add_get("/__status__", status)
             app.router.add_get("/__ui__", status_ui)
             app.router.add_get("/__metrics__", aiohttp_metrics_handler)
-            # exact debug route wins over the namespace catch-all for
+            # exact debug routes win over the namespace catch-all for
             # EVERY method (GET-only would let a POST fall through and
-            # create a file no read could ever reach): /debug/traces is
+            # create a file no read could ever reach): /debug/* is
             # fully reserved, like /__status__
             app.router.add_route("*", "/debug/traces", debug_traces)
+            app.router.add_route("*", "/debug/events", debug_events)
+            app.router.add_route("*", "/debug/profile", debug_profile)
             app.router.add_route("*", "/{path:.*}", handle)
 
         from ..utils.webapp import serve_web_app

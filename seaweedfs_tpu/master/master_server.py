@@ -39,7 +39,8 @@ class MasterServer:
                  raft_state_path: str | None = None,
                  maintenance_scripts: "list[str] | None" = None,
                  maintenance_interval_s: float | None = None,
-                 metrics_gateway: str = "", metrics_interval_s: int = 15):
+                 metrics_gateway: str = "", metrics_interval_s: int = 15,
+                 ec_parity_shards: int | None = None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -89,6 +90,19 @@ class MasterServer:
         # a plain topology bool (topology.go:42 isDisableVacuum); operators
         # re-disable after a failover.
         self.vacuum_disabled = False
+        # Health plane (master/health.py): scores the topology into
+        # severity buckets every janitor tick and on /cluster/health.
+        # Heartbeats don't carry RS(k,m), so the engine derives k from
+        # each volume's observed stripe width minus the configured
+        # parity count (fork default RS(14,2)).
+        from .health import DEFAULT_PARITY_SHARDS, HealthEngine
+        self.health = HealthEngine(
+            self.topo,
+            parity=(ec_parity_shards if ec_parity_shards is not None
+                    else DEFAULT_PARITY_SHARDS),
+            # stale = several missed pulses; stream death already
+            # unregisters dead nodes, this catches wedged-but-connected
+            stale_after_s=max(4 * pulse_seconds, 5.0))
         from .admin_cron import DEFAULT_INTERVAL_S, AdminCron
         self.admin_cron = AdminCron(
             self.address, scripts=maintenance_scripts,
@@ -227,6 +241,15 @@ class MasterServer:
             from .. import tracing
             return json_response(tracing.debug_traces_payload(q))
 
+        def debug_events(req, q):
+            from ..ops import events
+            return json_response(events.debug_events_payload(q))
+
+        def cluster_health(req, q):
+            # a fresh scan per request: the operator asking "is data at
+            # risk NOW" must not get a stale janitor-tick answer
+            return json_response(ms.health.scan())
+
         def dir_status(req, q):
             # leader_address, not ms.address: a follower answering here
             # must hint at the real leader (empty mid-election)
@@ -358,6 +381,12 @@ class MasterServer:
         # of spans must not head-of-line-block inline assigns
         app.route("/debug/traces",
                   offloaded(guarded("/debug/traces", debug_traces)))
+        # same policy: events carry node addresses and volume ids, and a
+        # full-topology health scan is milliseconds, not microseconds
+        app.route("/debug/events",
+                  offloaded(guarded("/debug/events", debug_events)))
+        app.route("/cluster/health",
+                  offloaded(guarded("/cluster/health", cluster_health)))
 
         self._http_stop = threading.Event()
         threading.Thread(
@@ -381,6 +410,9 @@ class MasterServer:
                        ttl=TTL.parse(req.ttl), disk_type=req.disk_type)
         self.topo.incremental_volumes(node, [v], [])
         self.layouts.register_volume(v)
+        from ..ops import events
+        events.emit("volume.grow", vid=vid, collection=req.collection,
+                    replication=req.replication, node=node.id)
         self._broadcast_location(node, new_vids=[vid])
 
     # -- broadcast to KeepConnected subscribers ------------------------------
@@ -421,6 +453,10 @@ class MasterServer:
                     vids, ec_vids = ms.topo.unregister_node(node)
                     log.info("node %s disconnected; dropped %d vols %d ec",
                              node.id, len(vids), len(ec_vids))
+                    from ..ops import events
+                    events.emit("node.leave", severity=events.WARN,
+                                node=node.id, volumes=len(vids),
+                                ec_volumes=len(ec_vids))
                     ms._broadcast_location(node, deleted_vids=vids,
                                            deleted_ec=ec_vids)
 
@@ -700,6 +736,10 @@ class MasterServer:
                 hb.data_center, hb.rack, dict(hb.max_volume_counts))
             log.info("node %s registered (dc=%s rack=%s)", node.id,
                      hb.data_center, hb.rack)
+            from ..ops import events
+            events.emit("node.join", node=node.id,
+                        dc=hb.data_center or "DefaultDataCenter",
+                        rack=hb.rack or "DefaultRack")
         node.last_seen = time.time()
         if hb.max_file_key:
             self.sequencer.set_max(hb.max_file_key)
@@ -857,3 +897,10 @@ class MasterServer:
         while not self._stop.wait(self.pulse_seconds):
             for lo in self.layouts.all_layouts():
                 lo.ensure_correct_writables()
+            try:
+                # per-tick health scan keeps the at-risk gauges live for
+                # scrapers and journals severity transitions as they
+                # happen, not only when someone asks /cluster/health
+                self.health.scan()
+            except Exception as e:  # noqa: BLE001
+                log.warning("health scan: %s", e)

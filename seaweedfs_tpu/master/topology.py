@@ -12,6 +12,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .. import ec as ec_accounting
 from ..storage.types import TTL, DiskType, ReplicaPlacement
 
 
@@ -70,7 +71,8 @@ class Disk:
 
     @property
     def ec_shard_count(self) -> int:
-        return sum(bin(s.shard_bits).count("1") for s in self.ec_shards.values())
+        return sum(ec_accounting.shard_count(s.shard_bits)
+                   for s in self.ec_shards.values())
 
     def free_slots(self, ec_shards_per_slot: int = 14) -> int:
         used = self.volume_count + (self.ec_shard_count + ec_shards_per_slot - 1) // ec_shards_per_slot
@@ -153,6 +155,11 @@ class Topology:
         # vid -> {shard_id -> set[node_id]}, and vid -> collection
         self.ec_locations: dict[int, dict[int, set[str]]] = {}
         self.ec_collections: dict[int, str] = {}
+        # vid -> stripe-width high-water mark (max shard id ever seen + 1):
+        # heartbeats don't carry RS(k,m), so the health plane infers each
+        # volume's expected n from the ids observed over time — robust to
+        # later shard loss, reset only when the volume itself goes away
+        self.ec_expected: dict[int, int] = {}
         self.nodes: dict[str, DataNode] = {}
 
     # -- registration ------------------------------------------------------
@@ -227,8 +234,10 @@ class Topology:
                     new.append(s)
                 node.disk(s.disk_type).ec_shards[vid] = s
                 self.ec_collections[vid] = s.collection
+                self._note_ec_width(vid, s.shard_bits)
                 locs = self.ec_locations.setdefault(vid, {})
-                for sid in range(32):
+                # full-state diff needs the ABSENT ids too (discard arm)
+                for sid in range(ec_accounting.MAX_SHARD_ID):
                     if s.shard_bits >> sid & 1:
                         locs.setdefault(sid, set()).add(node.id)
                     else:
@@ -248,10 +257,10 @@ class Topology:
                 node.disk(s.disk_type).ec_shards[s.volume_id] = EcShardInfo(
                     s.volume_id, s.collection, bits, s.disk_type, s.destroy_time)
                 self.ec_collections[s.volume_id] = s.collection
+                self._note_ec_width(s.volume_id, s.shard_bits)
                 locs = self.ec_locations.setdefault(s.volume_id, {})
-                for sid in range(32):
-                    if s.shard_bits >> sid & 1:
-                        locs.setdefault(sid, set()).add(node.id)
+                for sid in ec_accounting.shard_ids(s.shard_bits):
+                    locs.setdefault(sid, set()).add(node.id)
             for s in deleted:
                 for d in node.disks.values():
                     cur = d.ec_shards.get(s.volume_id)
@@ -260,9 +269,15 @@ class Topology:
                         if cur.shard_bits == 0:
                             d.ec_shards.pop(s.volume_id, None)
                 locs = self.ec_locations.get(s.volume_id, {})
-                for sid in range(32):
-                    if s.shard_bits >> sid & 1:
-                        locs.get(sid, set()).discard(node.id)
+                for sid in ec_accounting.shard_ids(s.shard_bits):
+                    locs.get(sid, set()).discard(node.id)
+
+    def _note_ec_width(self, vid: int, shard_bits: int) -> None:
+        # lock held by caller
+        ids = ec_accounting.shard_ids(shard_bits)
+        if ids:
+            self.ec_expected[vid] = max(self.ec_expected.get(vid, 0),
+                                        ids[-1] + 1)
 
     def _drop_node_ec(self, node: DataNode, vid: int) -> None:
         for d in node.disks.values():
@@ -275,6 +290,7 @@ class Topology:
         if not locs:
             self.ec_locations.pop(vid, None)
             self.ec_collections.pop(vid, None)
+            self.ec_expected.pop(vid, None)
 
     def unregister_node(self, node: DataNode) -> tuple[list[int], list[int]]:
         """Node death: remove all its volumes/shards; returns (vids, ec_vids)

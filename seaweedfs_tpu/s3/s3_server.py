@@ -184,6 +184,29 @@ class S3Gateway:
             return web.json_response(
                 tracing.debug_traces_payload(dict(request.query)))
 
+        async def debug_events(request):
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            from ..ops import events
+            return web.json_response(
+                events.debug_events_payload(dict(request.query)))
+
+        async def debug_profile(request):
+            # pprof-style sampler (utils/profiling.py), operator-gated
+            # like /debug/traces (stacks leak paths and peer addresses);
+            # sampling runs off the event loop so a capture can't stall
+            # tenant traffic
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            import asyncio as _asyncio
+
+            from ..utils import profiling
+            secs = float(request.query.get("seconds", "5"))
+            text = await _asyncio.to_thread(profiling.cpu_profile, secs)
+            return web.Response(text=text, content_type="text/plain")
+
         async def metrics(request):
             denied = _operator_gate(request)
             if denied is not None:
@@ -197,6 +220,8 @@ class S3Gateway:
             # through to the object handlers and mint entries no read
             # can ever reach): these two paths are fully reserved
             app.router.add_route("*", "/debug/traces", debug_traces)
+            app.router.add_route("*", "/debug/events", debug_events)
+            app.router.add_route("*", "/debug/profile", debug_profile)
             app.router.add_route("*", "/metrics", metrics)
             app.router.add_route("*", "/{tail:.*}", dispatch)
 

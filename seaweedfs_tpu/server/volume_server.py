@@ -354,6 +354,10 @@ class VolumeServer:
         def debug_traces(request):
             return json_response(tracing.debug_traces_payload(request.query))
 
+        def debug_events(request):
+            from ..ops import events
+            return json_response(events.debug_events_payload(request.query))
+
         async def debug_profile(request):
             from ..utils import profiling
             secs = float(request.query.get("seconds", "5"))
@@ -420,6 +424,7 @@ class VolumeServer:
         app.route("/debug/jax-profiler", debug_jax_profiler)
         app.route("/debug/failpoints", debug_failpoints)
         app.route("/debug/traces", debug_traces)
+        app.route("/debug/events", debug_events)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
                                client_max_size=256 << 20, logger=log)
@@ -615,8 +620,33 @@ class VolumeServer:
             n = self.store.read_needle(vid, key, cookie=cookie,
                                        shard_reader=self._make_shard_reader(vid))
         except KeyError:
-            # not local: proxy or redirect by master lookup (ReadMode)
+            if (self.store.find_volume(vid) is not None
+                    or self.store.find_ec_volume(vid) is not None):
+                # the VOLUME is local, so this server is an authoritative
+                # replica: a missing/deleted needle is a definitive 404.
+                # Proxying here would ping-pong between replicas that
+                # each re-proxy — a livelock on read-after-delete (write
+                # fan-out fails the whole write on any replica failure,
+                # so replicas can't silently diverge on live needles).
+                raise
+            if request.query.get("proxied"):
+                raise  # one forwarding hop max: never proxy a proxy
+            # volume not local: proxy or redirect by master lookup (ReadMode)
             return await self._read_remote(request, fid, vid)
+        except OSError as e:
+            # degraded EC read that couldn't gather d shards from HERE —
+            # another holder may reach a different shard subset, so fail
+            # over unless this request is already a forwarded hop. When
+            # no failover exists (local read mode, sole holder, already
+            # proxied) answer 503, NOT 404: the object is recoverable,
+            # and a 404 would read as "deleted" to clients and filers.
+            if (self.store.find_ec_volume(vid) is not None
+                    and not request.query.get("proxied")
+                    and self.read_mode != "local"
+                    and [u for u in self._lookup_replicas(vid)
+                         if u != self.url]):
+                return await self._read_remote(request, fid, vid)
+            return json_response({"error": str(e)}, status=503)
         body = n.data
         headers = {}
         if n.name:
@@ -660,9 +690,12 @@ class VolumeServer:
         if not peers:
             return json_response({"error": f"volume {vid} not found"},
                                  status=404)
-        # preserve the caller's query (jwt, resize params, …) on proxy/redirect
+        # preserve the caller's query (jwt, resize params, …) on
+        # proxy/redirect, marking the hop so the receiver never forwards
+        # again (bounds the proxy chain at one hop — no ping-pong)
         qs = request.query_string
-        suffix = f"?{qs}" if qs else ""
+        qs = (f"{qs}&" if qs else "") + "proxied=1"
+        suffix = f"?{qs}"
         if self.read_mode == "redirect":
             raise Redirect(f"http://{peers[0]}/{fid}{suffix}", status=301)
         import aiohttp
@@ -710,7 +743,11 @@ class VolumeServer:
             if ev is None:
                 raise KeyError(f"volume {vid} not local")
             ok = ev.delete_needle(key)
-        if not is_replicate and ok:
+        # fan out even when the needle wasn't found locally (reference
+        # ReplicatedDelete): a replica that missed an earlier delete's
+        # best-effort fan-out still holds the needle, and re-deleting
+        # through any holder must converge the set, not just this copy
+        if not is_replicate:
             peers = [u for u in self._lookup_replicas(vid) if u != self.url]
             if peers:
                 import aiohttp
@@ -886,7 +923,10 @@ class VolumeServer:
             sp.set_attr("gathered", len(gathered))
             sp.set_attr("needed", geo.d)
             if len(gathered) < geo.d:
-                raise KeyError(
+                # availability failure, NOT a lookup miss: OSError so the
+                # read handler fails over to another holder instead of
+                # reporting a recoverable object as 404/deleted
+                raise OSError(
                     f"cannot reconstruct shard {shard_id}: only "
                     f"{len(gathered)} shards reachable")
             import numpy as np
@@ -1235,9 +1275,22 @@ class VolumeServer:
         @svc.unary("VolumeEcShardsGenerate", vpb.VolumeEcShardsGenerateRequest,
                    vpb.VolumeEcShardsGenerateResponse)
         def ec_generate(req, context):
-            store.generate_ec_shards(req.volume_id, req.collection,
-                                     req.data_shards or None,
-                                     req.parity_shards or None)
+            from ..ops import events
+            events.emit("ec.encode.start", vid=req.volume_id,
+                        collection=req.collection, node=vs.url)
+            t0 = time.perf_counter()
+            try:
+                store.generate_ec_shards(req.volume_id, req.collection,
+                                         req.data_shards or None,
+                                         req.parity_shards or None)
+            except Exception as e:  # noqa: BLE001
+                events.emit("ec.encode.finish", severity=events.ERROR,
+                            vid=req.volume_id, node=vs.url, ok=False,
+                            error=str(e)[:200])
+                raise
+            events.emit("ec.encode.finish", vid=req.volume_id, node=vs.url,
+                        ok=True,
+                        duration_ms=round((time.perf_counter() - t0) * 1e3, 1))
             return vpb.VolumeEcShardsGenerateResponse()
 
         @svc.unary("VolumeEcShardsGenerateBatch",
@@ -1277,8 +1330,22 @@ class VolumeServer:
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
                    vpb.VolumeEcShardsRebuildResponse)
         def ec_rebuild(req, context):
+            from ..ops import events
             failpoints.check("ec.rebuild")
-            rebuilt = store.rebuild_ec_shards(req.volume_id, req.collection)
+            events.emit("ec.rebuild.start", vid=req.volume_id,
+                        collection=req.collection, node=vs.url)
+            t0 = time.perf_counter()
+            try:
+                rebuilt = store.rebuild_ec_shards(req.volume_id,
+                                                  req.collection)
+            except Exception as e:  # noqa: BLE001
+                events.emit("ec.rebuild.finish", severity=events.ERROR,
+                            vid=req.volume_id, node=vs.url, ok=False,
+                            error=str(e)[:200])
+                raise
+            events.emit("ec.rebuild.finish", vid=req.volume_id, node=vs.url,
+                        ok=True, rebuilt_shard_ids=list(rebuilt),
+                        duration_ms=round((time.perf_counter() - t0) * 1e3, 1))
             vs.flush_heartbeat()
             return vpb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 

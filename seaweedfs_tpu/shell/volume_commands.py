@@ -125,8 +125,33 @@ def cmd_volume_scrub(env: CommandEnv, args):
             f"{corrupt} corrupt needles, {troubled} troubled volumes/servers")
 
 
-@command("cluster.check", "ping every node and report health")
+@command("cluster.check",
+         "[-url http://master:port] [-failOn AT_RISK]: ping every node, "
+         "score data redundancy, report cluster health")
 def cmd_cluster_check(env: CommandEnv, args):
+    """The reference's volume.fsck/cluster.check workflow: liveness pings
+    PLUS the data-at-risk report (master/health.py). With -url the report
+    is fetched from the master's live /cluster/health engine (accurate
+    staleness + stripe-width high-water marks); without it the same
+    scoring runs locally over a VolumeList topology dump, probing one
+    holder per EC volume for its true RS(k,m). Raises (shell: prints
+    error; `-c` scripts: non-zero exit) when the verdict reaches
+    -failOn (default AT_RISK) — wire it into cron/CI as a tripwire."""
+    import json as _json
+    import urllib.request as _rq
+
+    from ..master.health import _RANK, evaluate, snapshot_from_topology_info
+
+    p = argparse.ArgumentParser(prog="cluster.check")
+    p.add_argument("-url", default="",
+                   help="master HTTP base URL; fetch /cluster/health "
+                        "instead of recomputing from a topology dump")
+    p.add_argument("-failOn", default="AT_RISK",
+                   choices=["DEGRADED", "AT_RISK", "DATA_LOSS", "never"])
+    p.add_argument("-verbose", action="store_true",
+                   help="also print per-node slot usage")
+    opt = p.parse_args(args)
+
     ok = 0
     for srv in env.collect_volume_servers():
         try:
@@ -165,6 +190,91 @@ def cmd_cluster_check(env: CommandEnv, args):
                 env.println(f"  {ctype} {n.address}: ok")
             except Exception as e:  # noqa: BLE001
                 env.println(f"  {ctype} {n.address}: UNREACHABLE ({e})")
+
+    # -- data-at-risk report -------------------------------------------------
+    if opt.url:
+        with _rq.urlopen(f"{opt.url.rstrip('/')}/cluster/health",
+                         timeout=10) as r:
+            report = _json.loads(r.read().decode())
+    else:
+        resp = env.mc.volume_list()
+        ti = resp.topology_info
+        ec_holders: dict[int, list[tuple[str, int]]] = {}
+        for dc in ti.data_center_infos:
+            for rack in dc.rack_infos:
+                for node in rack.data_node_infos:
+                    for disk in node.disk_infos.values():
+                        for s in disk.ec_shard_infos:
+                            ec_holders.setdefault(s.id, []).append(
+                                (node.id, node.grpc_port))
+
+        def probe_geometry(vid, present_ids):
+            # one holder knows the stripe's true RS(k,m) from its .vif
+            # (VolumeEcShardsInfo) — a topology dump alone undercounts
+            # expected_n when the HIGHEST shard ids are the lost ones
+            for node_id, gport in ec_holders.get(vid, ()):
+                try:
+                    info = _vs_stub(env, node_id, gport).call(
+                        "VolumeEcShardsInfo",
+                        vpb.VolumeEcShardsInfoRequest(volume_id=vid),
+                        vpb.VolumeEcShardsInfoResponse, timeout=5)
+                    if info.data_shards:
+                        return (info.data_shards + info.parity_shards,
+                                info.parity_shards)
+                except Exception:  # noqa: BLE001
+                    continue
+            return (max(present_ids) + 1) if present_ids else 0
+
+        snap = snapshot_from_topology_info(
+            ti, volume_size_limit=resp.volume_size_limit_mb << 20,
+            expected_n_of=probe_geometry)
+        report = evaluate(snap)
+
+    totals = report.get("totals", {})
+    env.println(f"cluster verdict: {report.get('verdict', '?')}  "
+                f"(replica deficit {totals.get('replica_deficit', 0)}, "
+                f"ec shards missing {totals.get('ec_shards_missing', 0)}, "
+                f"stale nodes {totals.get('nodes_stale', 0)}, "
+                f"read-only volumes {totals.get('volumes_read_only', 0)})")
+    for it in report.get("items", ()):
+        if it["severity"] == "OK":
+            continue
+        if it["kind"] == "volume":
+            env.println(
+                f"  [{it['severity']}] volume {it['id']} "
+                f"col={it.get('collection', '')!r}: "
+                f"{it['replicas_present']}/{it['replicas_expected']} "
+                f"replicas, distance_to_data_loss="
+                f"{it['distance_to_data_loss']}")
+        elif it["kind"] == "ec":
+            rs = it.get("rs", {})
+            env.println(
+                f"  [{it['severity']}] ec volume {it['id']} "
+                f"col={it.get('collection', '')!r}: "
+                f"{len(it['shards_present'])}/{rs.get('n', '?')} shards "
+                f"(missing {it['shards_missing']}), "
+                f"distance_to_data_loss={it['distance_to_data_loss']}")
+        elif it["kind"] == "node":
+            env.println(f"  [{it['severity']}] node {it['id']}: stale "
+                        f"(last heartbeat {it.get('age_s', '?')}s ago)")
+        else:
+            env.println(f"  [{it['severity']}] {it['kind']} {it['id']}: "
+                        f"{it.get('used_slots')}/{it.get('max_slots')} "
+                        "slots used")
+    if opt.verbose:
+        for nd in report.get("nodes", ()):
+            env.println(f"  node {nd['id']}: {nd['used_slots']}/"
+                        f"{nd['max_slots']} slots"
+                        + (" STALE" if nd.get("stale") else ""))
+    verdict = report.get("verdict", "OK")
+    if opt.failOn != "never" and _RANK.get(verdict, 0) >= _RANK[opt.failOn]:
+        # RuntimeError, not SystemExit: the admin cron catches Exception
+        # to survive failing scripts; `swtpu shell -c` maps it to a
+        # non-zero process exit for scripting
+        raise RuntimeError(
+            f"cluster verdict {verdict} (failing at {opt.failOn}+): "
+            f"replica deficit {totals.get('replica_deficit', 0)}, "
+            f"ec shards missing {totals.get('ec_shards_missing', 0)}")
 
 
 @command("collection.list", "list collections")

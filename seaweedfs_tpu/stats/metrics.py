@@ -327,6 +327,23 @@ DEGRADED_EC_READS = _counter(
 TRACE_SPANS = _counter(
     "SeaweedFS_trace_spans_total",
     "finished sampled trace spans recorded", ("component",))
+# Health plane (master/health.py): the master's per-scan data-at-risk
+# roll-up — items per severity bucket, plus the raw repair-debt totals
+# the Facebook warehouse study identifies as THE operational signal of
+# an RS(k,m) store (stripes at reduced redundancy awaiting repair).
+VOLUMES_AT_RISK = _gauge(
+    "SeaweedFS_volumes_at_risk",
+    "health items per severity bucket (OK/DEGRADED/AT_RISK/DATA_LOSS)",
+    ("severity",))
+EC_SHARDS_MISSING = _gauge(
+    "SeaweedFS_ec_shards_missing",
+    "EC shards missing vs. each volume's expected RS stripe width")
+REPLICA_DEFICIT = _gauge(
+    "SeaweedFS_replica_deficit",
+    "replicas missing vs. each volume's replication policy")
+NODES_STALE = _gauge(
+    "SeaweedFS_nodes_stale",
+    "registered volume servers whose last heartbeat is overdue")
 
 
 def scrape_payload(accept: str = "") -> tuple[str, str]:

@@ -165,12 +165,26 @@ class CircuitBreaker:
         # lock held by caller
         if self._state == to:
             return
+        came_from = self._state
         self._state = to
         try:
             from ..stats import BREAKER_STATE, BREAKER_TRANSITIONS
             BREAKER_STATE.set(self.peer, value=_STATE_VALUE[to])
             BREAKER_TRANSITIONS.inc(self.peer, to)
         except Exception:  # noqa: BLE001 — metrics must never break IO
+            pass
+        try:
+            # journal the transition so /debug/events answers "which
+            # peer tripped, when, and on whose request" (the event
+            # carries the active trace id) next to the
+            # breaker_transitions_total counter it mirrors
+            from ..ops import events
+            events.emit(f"breaker.{to}",
+                        severity=(events.WARN if to == OPEN
+                                  else events.INFO),
+                        peer=self.peer, previous=came_from,
+                        failures=self._failures)
+        except Exception:  # noqa: BLE001 — the journal must never break IO
             pass
         log.info("breaker %s -> %s", self.peer, to)
 

@@ -111,13 +111,27 @@ def cluster(tmp_path_factory):
 
 
 class Workload:
-    """Writer threads submitting through the retry envelope; only ACKED
-    (fid returned) writes enter the ledger the invariants run against."""
+    """Writer threads driving a MIXED mutation workload (write /
+    overwrite / delete) through the retry envelope, against a
+    tombstone-aware ledger. Only ACKED operations move the ledger:
+
+      * acked write/overwrite  -> acked[fid] = latest payload
+      * acked delete           -> fid moves to `tombstones`
+      * op raised (indeterminate: the mutation may or may not have
+        landed on some replicas) -> fid quarantined in `unknown`,
+        excluded from both invariants
+
+    Each thread only ever mutates fids IT created, so every fid's
+    ledger state has a single writer and the read-back invariants
+    (live fids byte-identical, tombstoned fids unreadable) hold across
+    delete/overwrite races too."""
 
     def __init__(self, mc, rng: random.Random, threads: int = 3):
         self.mc = mc
         self.rng = rng
         self.acked: dict[str, bytes] = {}
+        self.tombstones: set[str] = set()
+        self.unknown: set[str] = set()
         self.failed_writes = 0
         self._ledger_lock = threading.Lock()
         self._stop = threading.Event()
@@ -127,17 +141,60 @@ class Workload:
 
     def _writer(self, seed: int) -> None:
         rng = random.Random(seed)
+        mine: list[str] = []  # live fids owned by this thread
         while not self._stop.is_set():
-            payload = rng.randbytes(rng.randint(100, 30000))
-            replication = "001" if rng.random() < 0.4 else ""
-            try:
-                res = operation.submit(self.mc, payload,
-                                       replication=replication)
-            except Exception:  # noqa: BLE001 — unacked: not our problem
-                self.failed_writes += 1
-                continue
-            with self._ledger_lock:
-                self.acked[res.fid] = payload
+            dice = rng.random()
+            if mine and dice < 0.15:
+                self._delete(rng.choice(mine), mine)
+            elif mine and dice < 0.30:
+                self._overwrite(rng.choice(mine), rng)
+            else:
+                payload = rng.randbytes(rng.randint(100, 30000))
+                replication = "001" if rng.random() < 0.4 else ""
+                try:
+                    res = operation.submit(self.mc, payload,
+                                           replication=replication)
+                except Exception:  # noqa: BLE001 — unacked: not our problem
+                    self.failed_writes += 1
+                    continue
+                with self._ledger_lock:
+                    self.acked[res.fid] = payload
+                mine.append(res.fid)
+
+    def _delete(self, fid: str, mine: list) -> None:
+        try:
+            ok = operation.delete(self.mc, fid)
+        except Exception:  # noqa: BLE001 — indeterminate outcome
+            ok = None
+        mine.remove(fid)
+        with self._ledger_lock:
+            if ok:  # an acked delete is determinate even for a
+                self.acked.pop(fid, None)  # previously-unknown fid
+                self.unknown.discard(fid)
+                self.tombstones.add(fid)
+            else:  # failed OR indeterminate: exclude from invariants
+                self.acked.pop(fid, None)
+                self.unknown.add(fid)
+
+    def _overwrite(self, fid: str, rng: random.Random) -> None:
+        payload = rng.randbytes(rng.randint(100, 30000))
+        try:
+            # upload() takes a scheme-less host:port/fid target (same
+            # convention as submit's assign result)
+            url = self.mc.lookup_file_id(fid)[0]
+            url = url.split("://", 1)[-1]
+            operation.upload(url, payload,
+                             jwt=self.mc.lookup_file_id_jwt(fid))
+        except Exception:  # noqa: BLE001 — indeterminate: some replica
+            with self._ledger_lock:  # may hold the new bytes already
+                self.acked.pop(fid, None)
+                self.unknown.add(fid)
+            return
+        with self._ledger_lock:
+            # an acked overwrite re-determines the content, even for a
+            # fid an earlier failed mutation had quarantined
+            self.unknown.discard(fid)
+            self.acked[fid] = payload
 
     def run(self, seconds: float) -> None:
         for t in self._threads:
@@ -189,7 +246,8 @@ def test_randomized_fault_schedule(cluster, schedule):
         failpoints.clear_all()
 
     assert wl.acked, f"{ctx}: no write survived — schedule too brutal"
-    print(f"[chaos] {ctx}: {len(wl.acked)} acked, "
+    print(f"[chaos] {ctx}: {len(wl.acked)} live, "
+          f"{len(wl.tombstones)} tombstoned, {len(wl.unknown)} unknown, "
           f"{wl.failed_writes} failed (unacked)")
 
     # -- recovery: cluster re-stabilizes ------------------------------------
@@ -198,17 +256,33 @@ def test_randomized_fault_schedule(cluster, schedule):
                timeout=15, msg=f"{ctx}: all nodes re-registered")
 
     # invariant: no duplicate fids, ever (within and across schedules)
-    fids = list(wl.acked)
-    assert len(fids) == len(set(fids)), f"{ctx}: duplicate fids in ledger"
+    fids = sorted(set(wl.acked) | wl.tombstones | wl.unknown)
     dupes = set(fids) & set(_all_fids_ever)
     assert not dupes, f"{ctx}: fids reused across schedules: {dupes}"
     _all_fids_ever.extend(fids)
 
-    # invariant: every acked write readable, byte-identical
+    # invariant: every acked write/overwrite readable, byte-identical
+    # (an acked overwrite implies the fan-out reached every replica, so
+    # no replica can serve the OLD bytes back)
     for fid, payload in wl.acked.items():
         got = operation.read(mc, fid)
         assert got == payload, \
             f"{ctx}: acked {fid} corrupt ({len(got)}B vs {len(payload)}B)"
+
+    # invariant: tombstoned fids stay dead. The delete fan-out is
+    # best-effort per replica (store_replicate semantics: the local
+    # delete acks, a missed peer heals later), so converge first with
+    # one clean re-delete per tombstone — faults are cleared, it must
+    # reach every replica — then assert nothing resurrects.
+    for fid in wl.tombstones:
+        operation.delete(mc, fid)
+    for fid in sorted(wl.tombstones):
+        try:
+            got = operation.read(mc, fid)
+        except (KeyError, RuntimeError):
+            continue
+        raise AssertionError(
+            f"{ctx}: tombstoned {fid} resurrected ({len(got)}B)")
 
     # invariant: every breaker eventually re-closes (live traffic +
     # explicit probes drive the half-open transitions)
@@ -225,6 +299,13 @@ def test_randomized_fault_schedule(cluster, schedule):
     still_open = {p: s for p, s in retry.all_breakers().items()
                   if s != retry.CLOSED}
     assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+    # invariant: the health plane agrees the cluster recovered — once
+    # every node re-registered and replicas converged, a fresh master
+    # scan must report verdict OK (no replica deficit, no missing
+    # shards, no stale nodes left behind by the fault window)
+    wait_until(lambda: master.health.scan()["verdict"] == "OK",
+               timeout=20, msg=f"{ctx}: health verdict returns to OK")
 
     # invariant: server-side CRC sweep finds zero corruption
     for vs in servers:

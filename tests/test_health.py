@@ -1,0 +1,391 @@
+"""Cluster health plane: data-at-risk scoring, event journal, cluster.check.
+
+Unit layer: pure scoring (score_ec / score_replicated / evaluate) and the
+event journal's ring/filter/trace-correlation semantics.
+
+Cluster layer (the PR's acceptance scenario): a 1-master/3-volume
+mini-cluster running RS(4,2) EC. Killing one node that holds exactly one
+EC shard AND one replica of a 001-volume must flip /cluster/health to
+AT_RISK (the replica at distance 0) with the EC volume DEGRADED at
+distance 1, emit severity-change events visible at /debug/events, raise
+SeaweedFS_ec_shards_missing on /metrics, and make cluster.check fail —
+then restarting the node must return the verdict to OK.
+"""
+
+import io
+import json
+import os
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+from conftest import wait_cluster_up, wait_until
+
+from seaweedfs_tpu.client import operation
+from seaweedfs_tpu.client.master_client import MasterClient
+from seaweedfs_tpu.ec.locate import EcGeometry
+from seaweedfs_tpu.master import health
+from seaweedfs_tpu.master.master_server import MasterServer
+from seaweedfs_tpu.ops import events
+from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.shell import volume_commands  # noqa: F401 (register)
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+
+
+# -- unit: scoring -----------------------------------------------------------
+
+def test_score_ec_table():
+    # RS(4,2): readable while >= 4 distinct shards survive
+    assert health.score_ec(6, 4, 6) == (health.OK, 2)
+    assert health.score_ec(5, 4, 6) == (health.DEGRADED, 1)
+    assert health.score_ec(4, 4, 6) == (health.AT_RISK, 0)
+    assert health.score_ec(3, 4, 6) == (health.DATA_LOSS, -1)
+    assert health.score_ec(0, 4, 6) == (health.DATA_LOSS, -4)
+
+
+def test_score_replicated_table():
+    assert health.score_replicated(2, 2) == (health.OK, 1)
+    assert health.score_replicated(3, 2) == (health.OK, 2)
+    assert health.score_replicated(1, 2) == (health.AT_RISK, 0)
+    assert health.score_replicated(2, 3) == (health.DEGRADED, 1)
+    assert health.score_replicated(0, 2) == (health.DATA_LOSS, -1)
+    # single-copy by POLICY is OK (the operator chose 000)…
+    assert health.score_replicated(1, 1) == (health.OK, 0)
+
+
+def test_evaluate_synthetic_snapshot():
+    report = health.evaluate({
+        "volumes": [
+            {"id": 1, "present": 2, "expected": 2, "holders": {"a", "b"}},
+            {"id": 2, "present": 1, "expected": 2, "holders": {"a"}},
+        ],
+        "ec_volumes": [
+            {"id": 3, "present_ids": [0, 1, 2, 4, 5], "expected_n": 6},
+        ],
+        "nodes": [
+            {"id": "a", "age_s": 0.1, "used_slots": 1, "max_slots": 10},
+            {"id": "b", "age_s": 60.0, "used_slots": 10, "max_slots": 10},
+        ],
+        "volume_size_limit": 1 << 30,
+    }, parity=2, stale_after_s=10)
+    assert report["verdict"] == health.AT_RISK
+    assert report["totals"]["replica_deficit"] == 1
+    assert report["totals"]["ec_shards_missing"] == 1
+    assert report["totals"]["nodes_stale"] == 1
+    by_kind = {(it["kind"], it["id"]): it for it in report["items"]}
+    assert by_kind[("volume", 2)]["severity"] == health.AT_RISK
+    assert by_kind[("volume", 2)]["distance_to_data_loss"] == 0
+    ec_item = by_kind[("ec", 3)]
+    assert ec_item["severity"] == health.DEGRADED
+    assert ec_item["shards_missing"] == [3]
+    assert ec_item["distance_to_data_loss"] == 1
+    assert by_kind[("node", "b")]["stale"] is True
+    assert ("disk", "b") in by_kind  # full disk surfaces too
+    # items are sorted most-severe first
+    sevs = [health._RANK[it["severity"]] for it in report["items"]]
+    assert sevs == sorted(sevs, reverse=True)
+
+
+def test_evaluate_per_volume_parity_overrides_default():
+    # a snapshot that KNOWS a stripe is RS(8,2) must not score it with
+    # the cluster default parity
+    report = health.evaluate({
+        "volumes": [], "nodes": [],
+        "ec_volumes": [{"id": 9, "present_ids": list(range(8)),
+                        "expected_n": 10, "parity": 2}],
+    }, parity=4)
+    (item,) = report["items"]
+    assert item["severity"] == health.AT_RISK  # 8 == k, not 8 > k=6
+    assert item["rs"] == {"k": 8, "n": 10}
+
+
+# -- unit: event journal -----------------------------------------------------
+
+def test_event_journal_ring_and_filters():
+    j = events.EventJournal(capacity=8)
+    for i in range(12):
+        j.emit("tick.even" if i % 2 == 0 else "tick.odd", i=i)
+    assert len(j) == 8
+    assert j.dropped == 4
+    assert j.last_seq == 12
+    # prefix filter catches both subtypes; since= tails exactly
+    assert len(j.snapshot(etype="tick")) == 8
+    evens = j.snapshot(etype="tick.even")
+    assert [e["attrs"]["i"] for e in evens] == [4, 6, 8, 10]  # 0,2 evicted
+    tail = j.snapshot(since=10)
+    assert [e["seq"] for e in tail] == [11, 12]
+    # limit keeps the NEWEST events, ascending order preserved
+    capped = j.snapshot(etype="tick", limit=3)
+    assert [e["seq"] for e in capped] == [10, 11, 12]
+
+
+def test_event_trace_correlation():
+    from seaweedfs_tpu import tracing
+    with tracing.start_span("corr", component="test") as sp:
+        events.emit("health.test.corr", answer=42)
+        # the event mirrors onto the active span too (event<->trace)
+        assert any(e["name"] == "health.test.corr" for e in sp.events)
+    got = events.JOURNAL.snapshot(etype="health.test.corr")[-1]
+    assert got["trace_id"] == sp.context.trace_id
+    assert got["attrs"] == {"answer": 42}
+
+
+def test_breaker_transitions_land_in_journal():
+    from seaweedfs_tpu.utils import retry
+    since = events.JOURNAL.last_seq
+    br = retry.breaker("198.51.100.7:8080")
+    br.trip()
+    br.reset()
+    kinds = [(e["type"], e["attrs"].get("peer"))
+             for e in events.JOURNAL.snapshot(since=since, etype="breaker")]
+    assert ("breaker.open", "198.51.100.7:8080") in kinds
+    assert ("breaker.closed", "198.51.100.7:8080") in kinds
+
+
+# -- cluster: the acceptance scenario ---------------------------------------
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _make_server(tmpdir, mport, port=None, grpc_port=None, rack=""):
+    geo = EcGeometry(d=4, p=2, large_block=1 << 20, small_block=1 << 14)
+    port = port or free_port()
+    store = Store("127.0.0.1", port, f"127.0.0.1:{port}",
+                  [DiskLocation(str(tmpdir), max_volume_count=10)],
+                  ec_geometry=geo, coder_name="numpy")
+    vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                      grpc_port=grpc_port or free_port(),
+                      pulse_seconds=0.3, rack=rack)
+    vs.start()
+    return vs
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport, hport = free_port(), free_port()
+    master = MasterServer(port=mport, http_port=hport,
+                          volume_size_limit_mb=64, pulse_seconds=0.3,
+                          ec_parity_shards=2)
+    master.start()
+    dirs = [tmp_path_factory.mktemp(f"hvs{i}") for i in range(3)]
+    servers = [_make_server(dirs[i], mport, rack=f"rack{i % 2}")
+               for i in range(3)]
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+    env_out = io.StringIO()
+    env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=env_out)
+    yield master, servers, dirs, mc, env, env_out, hport
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+def _http_json(hport, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{hport}{path}",
+                                timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _metrics_text(hport):
+    with urllib.request.urlopen(f"http://127.0.0.1:{hport}/metrics",
+                                timeout=10) as r:
+        return r.read().decode()
+
+
+def sh(env, out, line):
+    out.truncate(0)
+    out.seek(0)
+    run_command(env, line)
+    return out.getvalue()
+
+
+def test_health_ok_and_join_events(cluster):
+    master, servers, dirs, mc, env, out, hport = cluster
+    operation.submit(mc, b"healthy payload" * 50, collection="hok")
+    report = _http_json(hport, "/cluster/health")
+    assert report["verdict"] == "OK"
+    assert report["totals"]["replica_deficit"] == 0
+    assert report["totals"]["ec_shards_missing"] == 0
+    assert report["items"] == []
+    assert len(report["nodes"]) == 3
+    # the journal saw all three nodes join and the first volume grow
+    ev = _http_json(hport, "/debug/events?type=node.join")
+    assert len(ev["events"]) >= 3
+    ev = _http_json(hport, "/debug/events?type=volume.grow")
+    assert len(ev["events"]) >= 1
+    # the new gauges ride the existing exposition pipe
+    text = _metrics_text(hport)
+    assert 'SeaweedFS_volumes_at_risk{severity="DATA_LOSS"} 0' in text
+    assert "SeaweedFS_ec_shards_missing 0" in text
+    assert "SeaweedFS_replica_deficit 0" in text
+    assert "SeaweedFS_nodes_stale 0" in text
+
+
+def test_cluster_check_healthy(cluster):
+    master, servers, dirs, mc, env, out, hport = cluster
+    # local scoring path (topology dump + holder geometry probes)
+    text = sh(env, out, "cluster.check")
+    assert "3 volume servers healthy" in text
+    assert "cluster verdict: OK" in text
+    # fetch path against the master's live engine
+    text = sh(env, out, f"cluster.check -url http://127.0.0.1:{hport}")
+    assert "cluster verdict: OK" in text
+
+
+def _spread_ec(master, servers, vid, want):
+    """Encode vid on its holder and spread shards per `want`
+    (server -> shard id list), removing non-local shards from src."""
+    from seaweedfs_tpu.ec import files as ec_files
+    src_vs = next(vs for vs in servers
+                  if vs.store.find_volume(vid) is not None)
+    src = Stub(f"127.0.0.1:{src_vs.grpc_port}", VOLUME_SERVICE)
+    src.call("VolumeMarkReadonly",
+             vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+             vpb.VolumeMarkReadonlyResponse)
+    src.call("VolumeEcShardsGenerate",
+             vpb.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                               collection="hec"),
+             vpb.VolumeEcShardsGenerateResponse, timeout=120)
+    for vs, sids in want.items():
+        if vs is not src_vs:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection="hec", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True,
+                    copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{src_vs.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=vid, collection="hec",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    src_sids = want[src_vs]
+    others = sorted(set(range(6)) - set(src_sids))
+    base = src_vs.store.find_ec_volume(vid).base
+    src.call("VolumeEcShardsUnmount",
+             vpb.VolumeEcShardsUnmountRequest(volume_id=vid,
+                                              shard_ids=others),
+             vpb.VolumeEcShardsUnmountResponse)
+    for sid in others:
+        os.remove(base + ec_files.shard_ext(sid))
+    src.call("VolumeEcShardsMount",
+             vpb.VolumeEcShardsMountRequest(volume_id=vid, collection="hec",
+                                            shard_ids=src_sids),
+             vpb.VolumeEcShardsMountResponse)
+    src.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
+             vpb.VolumeDeleteResponse)
+
+
+def test_degraded_cluster_flow(cluster):
+    """The acceptance scenario end-to-end. Runs LAST in this module: it
+    kills and resurrects a volume server."""
+    master, servers, dirs, mc, env, out, hport = cluster
+
+    # a replicated volume whose holders we can observe
+    rng = np.random.default_rng(7)
+    rep = operation.submit(mc, os.urandom(4000), replication="001",
+                           collection="hrep")
+    rep_vid = int(rep.fid.split(",")[0])
+    wait_until(lambda: len(master.topo.lookup(rep_vid)) == 2,
+               msg="both replicas registered")
+    victim = next(vs for vs in servers
+                  if f"127.0.0.1:{vs.port}" in
+                  {n.id for n in master.topo.lookup(rep_vid)})
+
+    # EC volume: victim holds EXACTLY shard 3; the others split the rest
+    blobs = {}
+    for _ in range(25):
+        data = rng.integers(0, 256, int(rng.integers(500, 8000)),
+                            dtype=np.uint8).tobytes()
+        res = operation.submit(mc, data, collection="hec")
+        blobs[res.fid] = data
+    ec_vid = int(next(iter(blobs)).split(",")[0])
+    rest = [vs for vs in servers if vs is not victim]
+    _spread_ec(master, servers, ec_vid,
+               {victim: [3], rest[0]: [0, 1, 2], rest[1]: [4, 5]})
+    wait_until(lambda: sorted(master.topo.lookup_ec(ec_vid)) ==
+               [0, 1, 2, 3, 4, 5], msg="all 6 shards registered")
+    assert master.topo.ec_expected[ec_vid] == 6
+
+    wait_until(lambda: _http_json(hport, "/cluster/health")["verdict"]
+               == "OK", msg="baseline verdict OK")
+    since = _http_json(hport, "/debug/events?limit=1")["last_seq"]
+
+    # -- kill the node holding shard 3 + one replica ------------------------
+    victim_idx = servers.index(victim)
+    victim_id = f"127.0.0.1:{victim.port}"
+    vport, vgrpc = victim.port, victim.grpc_port
+    victim.stop()
+    wait_until(lambda: len(master.topo.nodes) == 2, msg="victim dropped")
+
+    report = _http_json(hport, "/cluster/health")
+    assert report["verdict"] == "AT_RISK"
+    items = {(it["kind"], it["id"]): it for it in report["items"]}
+    ec_item = items[("ec", ec_vid)]
+    assert ec_item["severity"] == "DEGRADED"
+    assert ec_item["distance_to_data_loss"] == 1  # RS(4,2) minus 1 shard
+    assert ec_item["shards_missing"] == [3]
+    assert ec_item["rs"] == {"k": 4, "n": 6}
+    rep_item = items[("volume", rep_vid)]
+    assert rep_item["severity"] == "AT_RISK"
+    assert rep_item["distance_to_data_loss"] == 0
+    assert rep_item["replica_deficit"] == 1
+
+    # severity-change + node.leave events, with the verdict transition
+    ev = _http_json(hport, f"/debug/events?since={since}")
+    kinds = [(e["type"], e["attrs"].get("kind"), e["attrs"].get("id"),
+              e["attrs"].get("to")) for e in ev["events"]]
+    assert ("health.severity", "ec", ec_vid, "DEGRADED") in kinds
+    assert ("health.severity", "volume", rep_vid, "AT_RISK") in kinds
+    assert any(e["type"] == "node.leave"
+               and e["attrs"]["node"] == victim_id for e in ev["events"])
+    assert any(e["type"] == "health.verdict"
+               and e["attrs"]["to"] == "AT_RISK" for e in ev["events"])
+
+    # gauges on /metrics
+    text = _metrics_text(hport)
+    assert "SeaweedFS_ec_shards_missing 1" in text
+    assert "SeaweedFS_replica_deficit 1" in text
+    assert 'SeaweedFS_volumes_at_risk{severity="AT_RISK"} 1' in text
+
+    # cluster.check trips at the default AT_RISK threshold, both paths;
+    # data stays readable throughout (degraded EC read)
+    with pytest.raises(RuntimeError, match="AT_RISK"):
+        sh(env, out, "cluster.check")
+    assert "cluster verdict: AT_RISK" in out.getvalue()
+    assert f"ec volume {ec_vid}" in out.getvalue()
+    with pytest.raises(RuntimeError, match="AT_RISK"):
+        sh(env, out, f"cluster.check -url http://127.0.0.1:{hport}")
+    fid, data = next(iter(blobs.items()))
+    assert operation.read(mc, fid) == data
+
+    # -- recovery: resurrect the node over the same directory ---------------
+    servers[victim_idx] = _make_server(dirs[victim_idx],
+                                       master.port, port=vport,
+                                       grpc_port=vgrpc)
+    wait_until(lambda: _http_json(hport, "/cluster/health")["verdict"]
+               == "OK", timeout=20, msg="verdict back to OK")
+    report = _http_json(hport, "/cluster/health")
+    assert report["totals"]["ec_shards_missing"] == 0
+    assert report["totals"]["replica_deficit"] == 0
+    ev = _http_json(hport, f"/debug/events?since={since}&type=health")
+    assert any(e["type"] == "health.verdict" and e["attrs"]["to"] == "OK"
+               for e in ev["events"])
+    text = sh(env, out, "cluster.check")
+    assert "cluster verdict: OK" in text
