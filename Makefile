@@ -16,7 +16,9 @@ stress:
 
 # randomized fault schedules against a live mini-cluster (opt-in gate
 # like stress); bounded time, failing runs print their seed — replay with
-# SWTPU_CHAOS_SEED=<seed> make chaos
+# SWTPU_CHAOS_SEED=<seed> make chaos. The last schedule kills a replica
+# holder for good and asserts the health-driven repair loop alone
+# converges the verdict back to OK (no manual ec.rebuild/fix.replication)
 chaos:
 	SWTPU_CHAOS=1 python -m pytest tests/chaos -q
 

@@ -40,6 +40,14 @@ def _add_master_flags(p):
                         "'' disables")
     p.add_argument("-maintenanceIntervalS", type=float, default=0,
                    help="cron interval seconds (0 = reference default 17 min)")
+    p.add_argument("-maintenanceHealthDriven", default="on",
+                   choices=["on", "off"],
+                   help="on (default): cron sweeps repair from the health "
+                        "plane's report, most-at-risk first under the "
+                        "admission budget, instead of blind ec.rebuild/"
+                        "volume.fix.replication; off: legacy script list")
+    p.add_argument("-maintenanceMaxConcurrentRepairs", type=int, default=2,
+                   help="repairs in flight per health-driven sweep")
     p.add_argument("-ecParityShards", type=int, default=0,
                    help="parity shard count of the cluster's EC geometry, "
                         "used by the health engine to derive k = n - parity "
@@ -123,7 +131,10 @@ def run_master(argv):
                       raft_state_path=raft_state,
                       maintenance_scripts=scripts,
                       maintenance_interval_s=opt.maintenanceIntervalS or None,
+                      maintenance_health_driven=(
+                          opt.maintenanceHealthDriven == "on"),
                       ec_parity_shards=opt.ecParityShards or None)
+    ms.admin_cron.repair_max_concurrent = opt.maintenanceMaxConcurrentRepairs
     ms.start()
     _wait_forever()
 

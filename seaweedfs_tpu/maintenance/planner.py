@@ -1,0 +1,243 @@
+"""Repair planner: one health report in, one prioritized repair plan out.
+
+The Facebook warehouse-cluster study (PAPERS arXiv:1309.0186) frames
+recovery as a scheduling problem: erasure-code repair traffic is a
+first-class network load, so the repair queue must be *ordered* (the
+stripes closest to data loss first) and the executor *throttled* — not
+an indiscriminate sweep. The planner owns the ordering half:
+
+  * items sorted by ascending `distance_to_data_loss` (0 = the next
+    failure loses data), then by descending severity, EC stripes before
+    replicated volumes on ties, remounts before rebuilds (a remount is
+    IO-free compared to a reconstruction), volume id last — so two
+    planners over the same report emit byte-identical plans;
+  * each item carries the CONCRETE action and its source/target
+    selection:
+      - `ec.remount`  — a missing shard still sits on a live holder's
+        disk (found by the caller's remount probe): mount it back, no
+        reconstruction traffic at all;
+      - `ec.rebuild`  — reconstruct missing shards from the k survivors;
+      - `volume.replicate` — copy a replica-deficient volume from a
+        healthy holder to servers that lack it (targets picked by free
+        slots, ordered healthy-first through the circuit breakers);
+  * DATA_LOSS items are *reported, never "repaired"*: a stripe below k
+    shards (or a volume with zero holders) cannot be reconstructed from
+    the cluster — pretending otherwise would burn the repair budget and
+    hide the outage. They land in `plan.unrepairable`.
+
+The planner is a pure function over the report plus an optional probe —
+it performs no RPCs of its own, so `cluster.repair -dryRun` prints the
+exact plan the executor would run.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..master.health import AT_RISK, DATA_LOSS, DEGRADED, _RANK
+from ..utils.log import logger
+
+log = logger("repair.planner")
+
+ACTION_EC_REMOUNT = "ec.remount"
+ACTION_EC_REBUILD = "ec.rebuild"
+ACTION_REPLICATE = "volume.replicate"
+
+# remount first (free), then reconstruction, then replica copies — used
+# only to break ties AFTER distance/severity/kind (see _sort_key)
+_ACTION_ORDER = {ACTION_EC_REMOUNT: 0, ACTION_EC_REBUILD: 1,
+                 ACTION_REPLICATE: 2}
+
+
+@dataclass
+class RepairItem:
+    """One concrete repair: what to do, to which volume, from/to where."""
+    action: str
+    kind: str                  # "ec" | "volume" (health item kind)
+    vid: int
+    collection: str
+    severity: str
+    distance: int              # distance_to_data_loss at plan time
+    shard_ids: list[int] = field(default_factory=list)
+    deficit: int = 0
+    sources: list[str] = field(default_factory=list)   # donor node ids
+    targets: list[str] = field(default_factory=list)   # landing node ids
+    # ec.remount: node id -> shard ids found on that node's disk
+    remount: dict[str, list[int]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> tuple[str, int]:
+        """Per-volume lock key: two items on one volume never run
+        concurrently (a remount and a rebuild of the same stripe)."""
+        return (self.kind, self.vid)
+
+    def describe(self) -> str:
+        if self.action == ACTION_EC_REMOUNT:
+            where = ", ".join(f"{n}:{sids}" for n, sids in
+                              sorted(self.remount.items()))
+            return (f"{self.action} ec volume {self.vid} "
+                    f"shards on disk at {where}")
+        if self.action == ACTION_EC_REBUILD:
+            return (f"{self.action} ec volume {self.vid} "
+                    f"missing shards {self.shard_ids}")
+        return (f"{self.action} volume {self.vid} "
+                f"x{self.deficit} {self.sources[:1]} -> {self.targets}")
+
+    def to_dict(self) -> dict:
+        return {"action": self.action, "kind": self.kind, "vid": self.vid,
+                "collection": self.collection, "severity": self.severity,
+                "distance_to_data_loss": self.distance,
+                "shard_ids": list(self.shard_ids), "deficit": self.deficit,
+                "sources": list(self.sources), "targets": list(self.targets),
+                "remount": {n: list(s) for n, s in self.remount.items()}}
+
+
+@dataclass
+class RepairPlan:
+    items: list[RepairItem]
+    unrepairable: list[dict]   # DATA_LOSS health items, verbatim + reason
+    verdict: str
+    generated_ms: int
+
+    def __bool__(self) -> bool:
+        return bool(self.items)
+
+    def to_dict(self) -> dict:
+        return {"verdict": self.verdict, "generated_ms": self.generated_ms,
+                "items": [it.to_dict() for it in self.items],
+                "unrepairable": list(self.unrepairable)}
+
+    def render(self, println) -> None:
+        """Human plan listing (cluster.repair and -dryRun print this)."""
+        println(f"repair plan: {len(self.items)} action(s), "
+                f"{len(self.unrepairable)} unrepairable, "
+                f"verdict {self.verdict}")
+        for i, it in enumerate(self.items, 1):
+            println(f"  {i}. [{it.severity} d={it.distance}] "
+                    f"{it.describe()}")
+        for u in self.unrepairable:
+            println(f"  !! [DATA_LOSS] {u['kind']} {u['id']}: "
+                    f"{u.get('reason', 'unreadable with registered holders')}"
+                    " — restore from backup or re-register its holders")
+
+
+def _sort_key(it: RepairItem):
+    return (it.distance, -_RANK[it.severity],
+            0 if it.kind == "ec" else 1,
+            _ACTION_ORDER.get(it.action, 9), it.vid)
+
+
+def _pick_replica_targets(report: dict, holders: list[str],
+                          deficit: int) -> list[str]:
+    """Servers that do NOT hold the volume: fresh heartbeats before
+    stale (a wedged-but-registered node must not be the landing zone),
+    most free slots first (id breaks ties), then ordered healthy-first
+    through the circuit breakers — deterministically within each
+    breaker class. Stale nodes stay at the tail rather than dropping
+    out entirely: with no fresh candidate a degraded copy beats none."""
+    from ..utils import retry
+    nodes = [nd for nd in report.get("nodes", ())
+             if nd["id"] not in set(holders)]
+    nodes.sort(key=lambda nd: (bool(nd.get("stale")),
+                               -(nd.get("max_slots", 0)
+                                 - nd.get("used_slots", 0)), nd["id"]))
+    ranked = retry.order_by_breaker([nd["id"] for nd in nodes])
+    return ranked[:deficit]
+
+
+def build_plan(report: dict, probe_remountable=None) -> RepairPlan:
+    """Derive the repair plan from a health report (master/health.py
+    evaluate() / HealthEngine.scan() / GET /cluster/health — all three
+    produce the same shape).
+
+    `probe_remountable(vid, missing_sids, collection) -> {node: [sids]}`
+    is optional and read-only: it reports missing shards that still
+    exist ON DISK on live holders (executor.make_remount_probe wires it
+    to VolumeEcShardsInfo). Shards it finds become `ec.remount` items;
+    the remainder become `ec.rebuild`.
+    """
+    from ..utils import retry
+
+    items: list[RepairItem] = []
+    unrepairable: list[dict] = []
+    for it in report.get("items", ()):
+        kind, sev = it.get("kind"), it.get("severity")
+        if sev == DATA_LOSS:
+            u = dict(it)
+            u.setdefault("reason",
+                         "below reconstruction threshold" if kind == "ec"
+                         else "no live holders")
+            unrepairable.append(u)
+            continue
+        if sev not in (DEGRADED, AT_RISK):
+            continue
+        if kind == "ec":
+            missing = sorted(it.get("shards_missing", ()))
+            if not missing:
+                continue
+            remount: dict[str, list[int]] = {}
+            if probe_remountable is not None:
+                try:
+                    found = probe_remountable(it["id"], missing,
+                                              it.get("collection", ""))
+                    remount = {n: sorted(s) for n, s in sorted(found.items())
+                               if s}
+                except Exception as e:  # noqa: BLE001 — probe is best-effort
+                    log.warning("remount probe for ec %s failed: %s",
+                                it["id"], e)
+            remountable = sorted({s for sids in remount.values()
+                                  for s in sids})
+            if remountable:
+                items.append(RepairItem(
+                    action=ACTION_EC_REMOUNT, kind="ec", vid=it["id"],
+                    collection=it.get("collection", ""), severity=sev,
+                    distance=it["distance_to_data_loss"],
+                    shard_ids=remountable, remount=remount))
+            rebuild = [s for s in missing if s not in remountable]
+            if rebuild:
+                # donors are the surviving shard holders; the executor
+                # resolves them live (holder sets drift between plan and
+                # execution as heartbeats land)
+                items.append(RepairItem(
+                    action=ACTION_EC_REBUILD, kind="ec", vid=it["id"],
+                    collection=it.get("collection", ""), severity=sev,
+                    distance=it["distance_to_data_loss"],
+                    shard_ids=rebuild))
+        elif kind == "volume":
+            deficit = it.get("replica_deficit", 0)
+            if not deficit:
+                continue
+            holders = sorted(it.get("holders", ()))
+            items.append(RepairItem(
+                action=ACTION_REPLICATE, kind="volume", vid=it["id"],
+                collection=it.get("collection", ""), severity=sev,
+                distance=it["distance_to_data_loss"], deficit=deficit,
+                sources=retry.order_by_breaker(holders),
+                targets=_pick_replica_targets(report, holders, deficit)))
+        # node/disk items (stale heartbeats, full disks) are operator
+        # signals, not volume repairs — the plan leaves them to alerts
+    items.sort(key=_sort_key)
+    plan = RepairPlan(items=items, unrepairable=unrepairable,
+                      verdict=report.get("verdict", "OK"),
+                      generated_ms=int(time.time() * 1000))
+    _publish_pending(plan)
+    return plan
+
+
+def _publish_pending(plan: RepairPlan) -> None:
+    """SeaweedFS_repairs_pending{severity}: planned-but-not-done repairs,
+    refreshed on every plan build (shell and cron alike); the executor
+    decrements as repairs land. DATA_LOSS pending = unrepairable items,
+    so a nonzero DATA_LOSS gauge is an alert, not a queue."""
+    try:
+        from ..master.health import SEVERITIES
+        from ..stats import REPAIRS_PENDING
+        counts = {s: 0 for s in SEVERITIES}
+        for it in plan.items:
+            counts[it.severity] += 1
+        counts[DATA_LOSS] = len(plan.unrepairable)
+        for sev, n in counts.items():
+            REPAIRS_PENDING.set(sev, value=n)
+    except Exception:  # noqa: BLE001 — metrics must never break planning
+        pass

@@ -10,11 +10,21 @@ Same shape here: the cron owns a CommandEnv dialing its own master, takes the
 admin lease per sweep (so it never races an operator's shell — if a human
 holds the lock the sweep is skipped), runs each script line, and releases.
 Script failures are logged and do not stop the remaining lines or the loop.
+
+Beyond the reference, the cron is HEALTH-DRIVEN: when wired to the
+master's HealthEngine (`health_fetch`), the blind fixed-order
+`ec.rebuild` / `volume.fix.replication` lines are replaced each sweep by
+the repair plane (maintenance/planner + executor) — the most-at-risk
+items repaired first under an admission budget, with cooldowns that
+persist across sweeps. If the health fetch fails the sweep falls back to
+the legacy script list, so a broken health plane degrades to the
+reference behavior instead of to no repair at all.
 """
 
 from __future__ import annotations
 
 import io
+import random
 import threading
 
 from ..utils.log import logger
@@ -40,20 +50,52 @@ DEFAULT_SCRIPTS = [
 ]
 DEFAULT_INTERVAL_S = 17 * 60  # master_server.go:278 sleep_minutes default
 
+# script lines the health-driven repair plane supersedes: a sweep with a
+# live health report runs planner->executor ONCE in their place
+REPAIR_SCRIPTS = ("ec.rebuild", "volume.fix.replication")
+
 
 class AdminCron:
     def __init__(self, master_address: str, scripts: "list[str] | None" = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  is_leader=lambda: True,
-                 vacuum_enabled=lambda: True):
+                 vacuum_enabled=lambda: True,
+                 health_fetch=None,
+                 initial_delay_s: float | None = None,
+                 repair_max_concurrent: int = 2,
+                 repair_cooldown_s: float = 60.0):
         self.master_address = master_address
         self.scripts = list(DEFAULT_SCRIPTS if scripts is None else scripts)
         self.interval_s = interval_s
         self.is_leader = is_leader
         self.vacuum_enabled = vacuum_enabled
+        # () -> health report dict; None = legacy scripted repair only
+        self.health_fetch = health_fetch
+        self.repair_max_concurrent = repair_max_concurrent
+        self.repair_cooldown_s = repair_cooldown_s
+        # A node dying right after a master restart should not wait a full
+        # 17-minute interval for its first repair: the first sweep runs
+        # after a small delay, jittered as a fraction of the interval so
+        # a fleet of masters restarting together doesn't stampede the
+        # volume servers with synchronized sweeps. <= 0 restores the
+        # legacy wait-a-full-interval behavior; SWTPU_CRON_INITIAL_DELAY_S
+        # overrides (the test suite pins it to 0 so long-lived fixture
+        # masters never start surprise balance/vacuum sweeps mid-test).
+        if initial_delay_s is None:
+            from ..utils.env import env_float
+            initial_delay_s = env_float("SWTPU_CRON_INITIAL_DELAY_S", -1.0)
+            if initial_delay_s < 0:
+                initial_delay_s = min(
+                    max(5.0, random.uniform(0.05, 0.15) * interval_s), 120.0)
+        self.initial_delay_s = initial_delay_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._env = None
+        # serializes trigger() against the loop: concurrent sweeps would
+        # interleave on one CommandEnv (clobbering env.out mid-script)
+        # and double-run repairs
+        self._sweep_lock = threading.Lock()
+        self._repair_exec = None  # lazy; cooldowns persist across sweeps
         self.sweeps = 0          # completed sweeps (observability + tests)
         self.last_output = ""
 
@@ -74,7 +116,8 @@ class AdminCron:
                 pass
 
     def trigger(self) -> None:
-        """Run one sweep immediately (tests / admin HTTP hook)."""
+        """Run one sweep immediately (tests / admin HTTP hook).
+        Serialized against the background loop's sweeps."""
         self._sweep()
 
     # -- internals ----------------------------------------------------------
@@ -92,7 +135,10 @@ class AdminCron:
         return self._env
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        wait = (min(self.initial_delay_s, self.interval_s)
+                if self.initial_delay_s > 0 else self.interval_s)
+        while not self._stop.wait(wait):
+            wait = self.interval_s
             if not self.is_leader():
                 continue
             try:
@@ -101,6 +147,10 @@ class AdminCron:
                 log.warning("maintenance sweep failed: %s", e)
 
     def _sweep(self) -> None:
+        with self._sweep_lock:
+            self._sweep_locked()
+
+    def _sweep_locked(self) -> None:
         from ..shell.commands import run_command
         env = self._get_env()
         env.out = out = io.StringIO()
@@ -109,8 +159,33 @@ class AdminCron:
         except Exception as e:  # noqa: BLE001 — operator holds it, or no quorum
             log.info("skipping maintenance sweep (lock unavailable: %s)", e)
             return
+        # health-driven mode: fetch the report once per sweep; a fetch
+        # failure falls back to the legacy scripted repair lines
+        report = None
+        if self.health_fetch is not None:
+            try:
+                report = self.health_fetch()
+            except Exception as e:  # noqa: BLE001
+                log.warning("health fetch failed (%s); falling back to "
+                            "scripted repair", e)
+                out.write(f"health fetch failed ({e}); legacy repair\n")
+        repaired = False
         try:
             for line in self.scripts:
+                name = line.split()[0] if line.split() else ""
+                if report is not None and name in REPAIR_SCRIPTS:
+                    if repaired:
+                        out.write("skipped (health-driven repair already "
+                                  f"ran): {line}\n")
+                        continue
+                    repaired = True
+                    try:
+                        env.acquire_lock()  # renew before the repair burst
+                        self._run_repair(env, report, out)
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("health-driven repair failed: %s", e)
+                        out.write(f"error: health-driven repair: {e}\n")
+                    continue
                 if line.startswith("volume.vacuum") and not self.vacuum_enabled():
                     out.write(f"skipped (vacuum disabled): {line}\n")
                     continue
@@ -134,3 +209,25 @@ class AdminCron:
         if self.last_output.strip():
             log.info("maintenance sweep #%d:\n%s", self.sweeps,
                      self.last_output.rstrip())
+
+    def _run_repair(self, env, report: dict, out) -> None:
+        """planner -> executor over this sweep's health report. ONE
+        executor lives across sweeps so failed repairs keep cooling
+        instead of being retried every 17 minutes at full rate."""
+        from ..maintenance import (RepairExecutor, build_plan,
+                                   make_remount_probe)
+        plan = build_plan(report, probe_remountable=make_remount_probe(env))
+        if self._repair_exec is None:
+            self._repair_exec = RepairExecutor(
+                env, max_concurrent=self.repair_max_concurrent,
+                cooldown_s=self.repair_cooldown_s)
+        if not plan.items and not plan.unrepairable:
+            out.write("health-driven repair: nothing to do\n")
+            # still publish the (empty) plan event + zeroed pending gauge
+            self._repair_exec.execute(plan, dry_run=True)
+            return
+        plan.render(lambda *a: out.write(" ".join(str(x) for x in a) + "\n"))
+        res = self._repair_exec.execute(plan)
+        out.write(f"health-driven repair: {len(res['done'])} done, "
+                  f"{len(res['failed'])} failed, "
+                  f"{len(res['skipped'])} skipped\n")

@@ -39,6 +39,8 @@ class MasterServer:
                  raft_state_path: str | None = None,
                  maintenance_scripts: "list[str] | None" = None,
                  maintenance_interval_s: float | None = None,
+                 maintenance_initial_delay_s: float | None = None,
+                 maintenance_health_driven: bool = True,
                  metrics_gateway: str = "", metrics_interval_s: int = 15,
                  ec_parity_shards: int | None = None):
         self.ip = ip
@@ -104,11 +106,18 @@ class MasterServer:
             # unregisters dead nodes, this catches wedged-but-connected
             stale_after_s=max(4 * pulse_seconds, 5.0))
         from .admin_cron import DEFAULT_INTERVAL_S, AdminCron
+        # health-driven: each sweep consumes the in-process engine's
+        # report and runs planner->executor (maintenance/) in place of
+        # the blind ec.rebuild / volume.fix.replication lines, falling
+        # back to them if the scan itself fails
         self.admin_cron = AdminCron(
             self.address, scripts=maintenance_scripts,
             interval_s=maintenance_interval_s or DEFAULT_INTERVAL_S,
+            initial_delay_s=maintenance_initial_delay_s,
             is_leader=lambda: self.is_leader,
-            vacuum_enabled=lambda: not self.vacuum_disabled)
+            vacuum_enabled=lambda: not self.vacuum_disabled,
+            health_fetch=(self.health.scan if maintenance_health_driven
+                          else None))
 
     @property
     def is_leader(self) -> bool:

@@ -1309,14 +1309,24 @@ class VolumeServer:
                    vpb.VolumeEcShardsInfoResponse)
         def ec_info(req, context):
             """Geometry probe from the .vif (TPU extension; the reference
-            hardcodes RS(14,2) so it never needs this)."""
+            hardcodes RS(14,2) so it never needs this). local_shard_ids
+            reports every shard file ON DISK — mounted or not — which is
+            what the repair planner's remount probe needs: a shard
+            unmounted by a crashed move while its server stayed up is a
+            zero-copy repair (mount it back) instead of a rebuild."""
             from ..ec import files as ec_files
+
+            def on_disk(base):
+                return sorted(sid for sid in range(32)
+                              if os.path.exists(base
+                                                + ec_files.shard_ext(sid)))
             ev = store.find_ec_volume(req.volume_id)
             if ev is not None:
                 return vpb.VolumeEcShardsInfoResponse(
                     data_shards=ev.geo.d, parity_shards=ev.geo.p,
                     dat_size=ev.dat_size or 0,
-                    local_shard_ids=sorted(ev.shards))
+                    local_shard_ids=sorted(set(ev.shards)
+                                           | set(on_disk(ev.base))))
             for loc in store.locations:
                 base = loc.base_name(req.collection, req.volume_id)
                 if os.path.exists(base + ".vif"):
@@ -1324,7 +1334,8 @@ class VolumeServer:
                     return vpb.VolumeEcShardsInfoResponse(
                         data_shards=info.get("d", 0),
                         parity_shards=info.get("p", 0),
-                        dat_size=info.get("dat_size", 0))
+                        dat_size=info.get("dat_size", 0),
+                        local_shard_ids=on_disk(base))
             raise KeyError(f"ec volume {req.volume_id} not found")
 
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,

@@ -1,0 +1,65 @@
+"""Shared fetch-or-recompute health report for shell commands.
+
+`cluster.check` and `cluster.repair` both need the same input: the
+cluster health report (master/health.py shape). With -url it comes from
+the master's live engine at /cluster/health (accurate staleness +
+stripe-width high-water marks); without it the identical scoring runs
+locally over a VolumeList topology dump, probing one holder per EC
+volume for its true RS(k,m) — a dump alone undercounts expected_n when
+the HIGHEST shard ids are the lost ones. Extracted here so the fetch
+logic, the geometry probe, and their failure modes are fixed in one
+place instead of drifting between the two commands.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..pb import volume_server_pb2 as vpb
+from ..utils.rpc import Stub, VOLUME_SERVICE
+
+
+def fetch_or_compute_health(env, url: str = "", timeout: float = 10.0) -> dict:
+    """The health report, from the master's engine (`url`) or recomputed
+    locally from a topology dump. Raises on an unreachable -url (the
+    caller asked for the live engine; silently degrading to a dump would
+    hide a dead master)."""
+    if url:
+        with urllib.request.urlopen(
+                f"{url.rstrip('/')}/cluster/health", timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    from ..master.health import evaluate, snapshot_from_topology_info
+
+    resp = env.mc.volume_list()
+    ti = resp.topology_info
+    ec_holders: dict[int, list[tuple[str, int]]] = {}
+    for dc in ti.data_center_infos:
+        for rack in dc.rack_infos:
+            for node in rack.data_node_infos:
+                for disk in node.disk_infos.values():
+                    for s in disk.ec_shard_infos:
+                        ec_holders.setdefault(s.id, []).append(
+                            (node.id, node.grpc_port))
+
+    def probe_geometry(vid, present_ids):
+        # one holder knows the stripe's true RS(k,m) from its .vif
+        for node_id, gport in ec_holders.get(vid, ()):
+            try:
+                info = Stub(env.grpc_addr(node_id, gport),
+                            VOLUME_SERVICE).call(
+                    "VolumeEcShardsInfo",
+                    vpb.VolumeEcShardsInfoRequest(volume_id=vid),
+                    vpb.VolumeEcShardsInfoResponse, timeout=5)
+                if info.data_shards:
+                    return (info.data_shards + info.parity_shards,
+                            info.parity_shards)
+            except Exception:  # noqa: BLE001 — try the next holder
+                continue
+        return (max(present_ids) + 1) if present_ids else 0
+
+    snap = snapshot_from_topology_info(
+        ti, volume_size_limit=resp.volume_size_limit_mb << 20,
+        expected_n_of=probe_geometry)
+    return evaluate(snap)

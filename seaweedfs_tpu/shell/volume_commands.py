@@ -137,10 +137,8 @@ def cmd_cluster_check(env: CommandEnv, args):
     holder per EC volume for its true RS(k,m). Raises (shell: prints
     error; `-c` scripts: non-zero exit) when the verdict reaches
     -failOn (default AT_RISK) — wire it into cron/CI as a tripwire."""
-    import json as _json
-    import urllib.request as _rq
-
-    from ..master.health import _RANK, evaluate, snapshot_from_topology_info
+    from ..master.health import _RANK
+    from .health_util import fetch_or_compute_health
 
     p = argparse.ArgumentParser(prog="cluster.check")
     p.add_argument("-url", default="",
@@ -191,44 +189,8 @@ def cmd_cluster_check(env: CommandEnv, args):
             except Exception as e:  # noqa: BLE001
                 env.println(f"  {ctype} {n.address}: UNREACHABLE ({e})")
 
-    # -- data-at-risk report -------------------------------------------------
-    if opt.url:
-        with _rq.urlopen(f"{opt.url.rstrip('/')}/cluster/health",
-                         timeout=10) as r:
-            report = _json.loads(r.read().decode())
-    else:
-        resp = env.mc.volume_list()
-        ti = resp.topology_info
-        ec_holders: dict[int, list[tuple[str, int]]] = {}
-        for dc in ti.data_center_infos:
-            for rack in dc.rack_infos:
-                for node in rack.data_node_infos:
-                    for disk in node.disk_infos.values():
-                        for s in disk.ec_shard_infos:
-                            ec_holders.setdefault(s.id, []).append(
-                                (node.id, node.grpc_port))
-
-        def probe_geometry(vid, present_ids):
-            # one holder knows the stripe's true RS(k,m) from its .vif
-            # (VolumeEcShardsInfo) — a topology dump alone undercounts
-            # expected_n when the HIGHEST shard ids are the lost ones
-            for node_id, gport in ec_holders.get(vid, ()):
-                try:
-                    info = _vs_stub(env, node_id, gport).call(
-                        "VolumeEcShardsInfo",
-                        vpb.VolumeEcShardsInfoRequest(volume_id=vid),
-                        vpb.VolumeEcShardsInfoResponse, timeout=5)
-                    if info.data_shards:
-                        return (info.data_shards + info.parity_shards,
-                                info.parity_shards)
-                except Exception:  # noqa: BLE001
-                    continue
-            return (max(present_ids) + 1) if present_ids else 0
-
-        snap = snapshot_from_topology_info(
-            ti, volume_size_limit=resp.volume_size_limit_mb << 20,
-            expected_n_of=probe_geometry)
-        report = evaluate(snap)
+    # -- data-at-risk report (shared fetch-or-recompute helper) --------------
+    report = fetch_or_compute_health(env, opt.url)
 
     totals = report.get("totals", {})
     env.println(f"cluster verdict: {report.get('verdict', '?')}  "
@@ -275,6 +237,102 @@ def cmd_cluster_check(env: CommandEnv, args):
             f"cluster verdict {verdict} (failing at {opt.failOn}+): "
             f"replica deficit {totals.get('replica_deficit', 0)}, "
             f"ec shards missing {totals.get('ec_shards_missing', 0)}")
+
+
+@command("cluster.repair",
+         "[-url http://master:port] [-dryRun] [-maxConcurrent 2] "
+         "[-failOn AT_RISK]: plan and run prioritized repairs from the "
+         "health report")
+def cmd_cluster_repair(env: CommandEnv, args):
+    """The heal half of detect-and-heal (cluster.check detects): score
+    the cluster (same fetch-or-recompute path as cluster.check), build a
+    deterministic repair plan — most-at-risk items first, DATA_LOSS
+    reported but never 'repaired' — and execute it under the admission
+    budget (maintenance/executor.py). -dryRun prints the exact plan and
+    performs zero mutating RPCs; -failOn raises (shell: error; `-c`
+    scripts: exit 2) when the cluster is still at/above that severity
+    AFTER repairs (or, in -dryRun, at plan time) — the CI tripwire
+    shape cluster.check established."""
+    import time as _time
+
+    from ..maintenance import RepairExecutor, build_plan, make_remount_probe
+    from ..master.health import _RANK
+    from .health_util import fetch_or_compute_health
+
+    p = argparse.ArgumentParser(prog="cluster.repair")
+    p.add_argument("-url", default="",
+                   help="master HTTP base URL; fetch /cluster/health "
+                        "instead of recomputing from a topology dump")
+    p.add_argument("-dryRun", action="store_true",
+                   help="print the plan, mutate nothing")
+    p.add_argument("-maxConcurrent", type=int, default=2,
+                   help="repairs in flight at once (admission budget)")
+    p.add_argument("-maxRepairs", type=int, default=64,
+                   help="repairs admitted this run; the rest journal "
+                        "repair.skipped reason=budget")
+    p.add_argument("-failOn", default="AT_RISK",
+                   choices=["DEGRADED", "AT_RISK", "DATA_LOSS", "never"])
+    opt = p.parse_args(args)
+
+    report = fetch_or_compute_health(env, opt.url)
+    plan = build_plan(report, probe_remountable=make_remount_probe(env))
+    plan.render(env.println)
+
+    def check_verdict(verdict):
+        if opt.failOn != "never" and \
+                _RANK.get(verdict, 0) >= _RANK[opt.failOn]:
+            raise RuntimeError(
+                f"cluster verdict {verdict} (failing at {opt.failOn}+)")
+
+    if opt.dryRun:
+        # journals repair.plan (dry_run=true) and dispatches nothing —
+        # operators see planned-but-not-executed in /debug/events too
+        RepairExecutor(env).execute(plan, dry_run=True)
+        env.println("dry run: nothing executed")
+        check_verdict(report.get("verdict", "OK"))
+        return
+
+    # mutating mode needs the exclusive cluster lock (renews if the
+    # caller — e.g. the admin cron — already holds it; released only
+    # if this command took it fresh)
+    had_lock = bool(env.lock_token)
+    env.acquire_lock()
+    try:
+        executor = RepairExecutor(env, max_concurrent=opt.maxConcurrent,
+                                  max_repairs=opt.maxRepairs)
+        res = executor.execute(plan)
+    finally:
+        if not had_lock:
+            try:
+                env.release_lock()
+            except Exception:  # noqa: BLE001
+                pass
+    env.println(f"repairs: {len(res['done'])} done, "
+                f"{len(res['failed'])} failed, "
+                f"{len(res['skipped'])} skipped")
+    for f in res["failed"]:
+        env.println(f"  FAILED {f['action']} volume {f['vid']}: "
+                    f"{f['error']}")
+    if opt.failOn == "never":
+        return
+    # repairs mount/copy synchronously but the master's view is
+    # heartbeat-propagated: give the verdict a short settle window
+    # before declaring failure
+    deadline = _time.time() + 15
+    verdict = report.get("verdict", "OK")
+    while _time.time() < deadline:
+        try:
+            verdict = fetch_or_compute_health(env, opt.url).get(
+                "verdict", "OK")
+        except Exception as e:  # noqa: BLE001 — a blip mid-settle must
+            env.println(f"  (health re-check failed: {e}; retrying)")
+            _time.sleep(0.5)  # not fail a repair that already landed
+            continue
+        if _RANK.get(verdict, 0) < _RANK[opt.failOn]:
+            break
+        _time.sleep(0.5)
+    env.println(f"post-repair verdict: {verdict}")
+    check_verdict(verdict)
 
 
 @command("collection.list", "list collections")
@@ -399,12 +457,16 @@ def _safe_copy_volume(env: CommandEnv, vid: int, collection: str,
 
 
 @command("volume.fix.replication",
-         "re-replicate volumes whose replica sets are incomplete",
-         needs_lock=True)
+         "[-volumeId N] re-replicate volumes whose replica sets are "
+         "incomplete", needs_lock=True)
 def cmd_fix_replication(env: CommandEnv, args):
     """Reference command_volume_fix_replication.go: for every volume whose
     live replica count < replica placement target, copy it from a healthy
-    holder to a server that lacks it."""
+    holder to a server that lacks it. -volumeId limits the sweep to one
+    volume (targeted operator repair)."""
+    p = argparse.ArgumentParser(prog="volume.fix.replication")
+    p.add_argument("-volumeId", type=int, default=0)
+    opt = p.parse_args(args)
     servers = env.collect_volume_servers()
     # volume -> holders, and volume -> info
     holders: dict[int, list[dict]] = {}
@@ -412,6 +474,8 @@ def cmd_fix_replication(env: CommandEnv, args):
     for srv in servers:
         for disk in srv["disks"].values():
             for v in disk.volume_infos:
+                if opt.volumeId and v.id != opt.volumeId:
+                    continue
                 holders.setdefault(v.id, []).append(srv)
                 infos[v.id] = v
     fixed = 0

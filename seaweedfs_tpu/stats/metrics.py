@@ -344,6 +344,18 @@ REPLICA_DEFICIT = _gauge(
 NODES_STALE = _gauge(
     "SeaweedFS_nodes_stale",
     "registered volume servers whose last heartbeat is overdue")
+# Repair plane (maintenance/): the queue the planner built but the
+# executor hasn't drained (pending, per severity; DATA_LOSS pending =
+# unrepairable items, an alert not a queue) and every repair outcome
+# (result: ok/error/skipped) per action (ec.remount/ec.rebuild/
+# volume.replicate).
+REPAIRS_PENDING = _gauge(
+    "SeaweedFS_repairs_pending",
+    "planned repairs not yet executed, per item severity", ("severity",))
+REPAIRS_TOTAL = _counter(
+    "SeaweedFS_repairs_total",
+    "repair executions by action and result (ok/error/skipped)",
+    ("action", "result"))
 
 
 def scrape_payload(accept: str = "") -> tuple[str, str]:

@@ -21,6 +21,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # when the TPU tunnel is wedged (jax.devices() otherwise blocks forever
 # inside make_c_api_client regardless of JAX_PLATFORMS=cpu).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Masters in fixtures run the real AdminCron; its production default now
+# schedules an initial jittered sweep ~1-2 min after start, which would
+# fire surprise balance/vacuum sweeps inside long-lived module fixtures.
+# Pin to the legacy wait-a-full-interval behavior; tests that exercise
+# the initial sweep pass initial_delay_s explicitly.
+os.environ.setdefault("SWTPU_CRON_INITIAL_DELAY_S", "0")
 
 import jax  # noqa: E402
 

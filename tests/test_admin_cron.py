@@ -97,6 +97,92 @@ def _ec_holders(master):
     return holders
 
 
+def test_initial_sweep_runs_shortly_after_start(cluster):
+    """Satellite: the loop must not wait a full interval (17 min default)
+    before its FIRST sweep — a small jittered initial delay brings the
+    first repair pass up moments after a (re)start."""
+    master, servers, mc, geo = cluster
+    cron = AdminCron(f"127.0.0.1:{master.port}", scripts=["cluster.ps"],
+                     interval_s=3600, initial_delay_s=0.2)
+    cron.start()
+    try:
+        wait_until(lambda: cron.sweeps >= 1, timeout=10,
+                   msg="initial sweep fires well before interval_s")
+    finally:
+        cron.stop()
+
+
+def test_initial_delay_default_is_jittered_fraction(monkeypatch):
+    # without the env pin the default is a small jittered fraction of
+    # the interval, clamped to [5s, 120s]
+    monkeypatch.delenv("SWTPU_CRON_INITIAL_DELAY_S", raising=False)
+    cron = AdminCron("127.0.0.1:1", scripts=["noop"], interval_s=17 * 60)
+    assert 5.0 <= cron.initial_delay_s <= 120.0
+    assert cron.initial_delay_s < cron.interval_s
+
+
+def test_trigger_serialized_against_loop(cluster):
+    """Satellite: trigger() and the background loop share one CommandEnv;
+    concurrent sweeps must serialize instead of clobbering env.out."""
+    import threading
+    import time as _time
+
+    master, servers, mc, geo = cluster
+    cron = master.admin_cron
+    active, overlap = [0], [0]
+
+    def slow_sweep():
+        # runs under cron._sweep_lock (trigger() holds it): if two
+        # sweeps ever ran concurrently, active would exceed 1
+        active[0] += 1
+        overlap[0] = max(overlap[0], active[0])
+        _time.sleep(0.2)
+        active[0] -= 1
+
+    real_sweep = cron._sweep_locked
+    cron._sweep_locked = slow_sweep
+    try:
+        threads = [threading.Thread(target=cron.trigger) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert overlap[0] == 1, "sweeps ran concurrently"
+    finally:
+        cron._sweep_locked = real_sweep
+
+
+def test_health_driven_sweep_replaces_repair_lines(cluster):
+    """With a live health fetch, ec.rebuild / volume.fix.replication run
+    as ONE planner->executor pass instead of two blind scripts."""
+    master, servers, mc, geo = cluster
+    master.admin_cron.scripts = ["ec.rebuild", "volume.fix.replication"]
+    master.admin_cron.trigger()
+    out = master.admin_cron.last_output
+    assert "health-driven repair" in out
+    assert "skipped (health-driven repair already ran)" in out
+
+
+def test_health_fetch_failure_falls_back_to_scripts(cluster):
+    """A broken health plane degrades to the reference's scripted
+    repair, not to no repair at all."""
+    master, servers, mc, geo = cluster
+
+    def boom():
+        raise RuntimeError("health plane down")
+
+    old_fetch = master.admin_cron.health_fetch
+    master.admin_cron.scripts = ["ec.rebuild"]
+    master.admin_cron.health_fetch = boom
+    try:
+        master.admin_cron.trigger()
+        out = master.admin_cron.last_output
+        assert "legacy repair" in out
+        assert "rebuilt 0 shards" in out  # the scripted line actually ran
+    finally:
+        master.admin_cron.health_fetch = old_fetch
+
+
 def test_cron_rebuilds_lost_shards_without_operator(cluster):
     master, servers, mc, geo = cluster
     rng = np.random.default_rng(0)
@@ -138,9 +224,16 @@ def test_cron_rebuilds_lost_shards_without_operator(cluster):
     missing = set(range(geo.n)) - set(_ec_holders(master))
     assert missing == lost
 
-    # ONE cron sweep, no operator
+    # ONE cron sweep, no operator; the sweep runs the health-driven
+    # repair plane (planner -> budgeted executor), journaling its work
+    from seaweedfs_tpu.ops import events
+    since = events.JOURNAL.last_seq
     master.admin_cron.trigger()
     assert master.admin_cron.sweeps == 1
+    kinds = {e["type"]
+             for e in events.JOURNAL.snapshot(since=since, etype="repair")}
+    assert "repair.plan" in kinds
+    assert "repair.start" in kinds and "repair.done" in kinds
 
     wait_until(lambda: set(range(geo.n)) <= set(_ec_holders(master)),
                msg="shards rebuilt and re-registered")
