@@ -1,38 +1,54 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos bench smoke protos metrics-lint
+.PHONY: test stress chaos race bench smoke protos lint metrics-lint swtpu-lint
 
-# metrics-lint runs FIRST so an exposition-grammar or registry
+# lint runs FIRST so a concurrency-rule or exposition-grammar
 # regression fails the default path before the suite spends minutes;
 # the suite itself includes the cluster.check-against-mini-cluster
 # smoke (tests/test_health.py) so health regressions fail tier-1 too
-test: metrics-lint
+test: lint
 	python -m pytest tests/ -q
+
+# static analysis gate: the repo-specific AST rules (blocking calls in
+# async bodies, I/O under locks, wall-clock durations, silenced
+# exceptions, unjoined threads, FIPS-fatal md5, context-dropping
+# executor hops — devtools/swtpu_lint.py) plus the metrics registry
+# lint. `swtpu-lint --json` is the machine-readable mode CI archives.
+lint: swtpu-lint metrics-lint
+
+swtpu-lint:
+	python -m seaweedfs_tpu.devtools.swtpu_lint seaweedfs_tpu
+
+metrics-lint:
+	python -m seaweedfs_tpu.stats.expo_lint
 
 # race/stress harness with artifact (tests/stress/run_stress.py);
 # bounded ~60s total at 6 s/scenario on an idle box
 stress:
 	python tests/stress/run_stress.py STRESS_r05.json 6
 
+# the stress suite under the runtime lock-order/race detector
+# (utils/locktrack.py): every threading.Lock/RLock/Condition is wrapped,
+# ABBA ordering cycles and >100ms holds are reported at process exit
+# and via /debug/locks on every daemon
+race:
+	SWTPU_LOCKCHECK=1 python tests/stress/run_stress.py STRESS_race.json 6
+
 # randomized fault schedules against a live mini-cluster (opt-in gate
 # like stress); bounded time, failing runs print their seed — replay with
 # SWTPU_CHAOS_SEED=<seed> make chaos. The last schedule kills a replica
 # holder for good and asserts the health-driven repair loop alone
-# converges the verdict back to OK (no manual ec.rebuild/fix.replication)
+# converges the verdict back to OK (no manual ec.rebuild/fix.replication).
+# Runs with the lock-order detector on: the chaos conftest asserts the
+# session ends with zero ordering cycles.
 chaos:
-	SWTPU_CHAOS=1 python -m pytest tests/chaos -q
+	SWTPU_CHAOS=1 SWTPU_LOCKCHECK=1 python -m pytest tests/chaos -q
 
 bench:
 	python bench.py
 
 smoke:
 	python bench.py --smoke
-
-# exposition-grammar check (HELP/TYPE pairing, label escaping, le
-# ordering, _sum/_count) + registry lint (duplicate names, peer/bucket
-# label-cardinality ceiling) — standalone, CI-friendly, exits non-zero
-metrics-lint:
-	python -m seaweedfs_tpu.stats.expo_lint
 
 protos:
 	python -m seaweedfs_tpu.pb.build

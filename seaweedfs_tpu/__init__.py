@@ -1,1 +1,12 @@
-__version__ = "0.3.0"
+__version__ = "0.4.0"
+
+import os as _os
+
+# Opt-in runtime lock-order/race detector (utils/locktrack.py): patching
+# here means ANY entry point — pytest, `python -m seaweedfs_tpu`, the
+# stress/chaos harnesses, `make race` — gets tracked locks by exporting
+# one env var, before any daemon module creates its first lock.
+if _os.environ.get("SWTPU_LOCKCHECK") == "1":  # pragma: no cover
+    from .utils import locktrack as _locktrack
+
+    _locktrack.install()

@@ -593,7 +593,7 @@ def run_filer_meta_tail(argv):
                    help="start N seconds in the past (0 = now)")
     opt = p.parse_args(argv)
     fc = FilerClient(opt.filer, client_name="meta-tail")
-    since = time.time_ns() - int(opt.timeAgo * 1e9)
+    since = time.time_ns() - int(opt.timeAgo * 1e9)  # swtpu-lint: disable=wallclock-duration (wire cursor: filer events carry wall-clock ts_ns)
     stop = _threading.Event()
     try:
         for resp in fc.filer.subscribe(since, stop,

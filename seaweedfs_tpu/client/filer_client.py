@@ -230,8 +230,8 @@ class FilerClient:
             for url, jwt in uploaded:
                 try:
                     http_util.delete(url, params={"jwt": jwt} if jwt else None)
-                except Exception:  # noqa: BLE001 - best effort
-                    pass
+                except Exception as e:  # noqa: BLE001 - best effort
+                    log.debug("orphan chunk cleanup %s failed: %s", url, e)
             raise
         entry = fpb.Entry(name=name)
         entry.chunks.extend(chunks)

@@ -58,11 +58,11 @@ class _Conn:
     def close(self) -> None:
         try:
             self.rfile.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (discarding a dead socket)
             pass
         try:
             self.sock.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (discarding a dead socket)
             pass
 
 
@@ -283,7 +283,7 @@ def request(method: str, url: str, body: bytes | None = None,
         try:
             from ..stats import RETRY_ATTEMPTS
             RETRY_ATTEMPTS.inc(f"http.{method}")
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break IO)
             pass
         tracing.add_event("retry", op=f"http.{method}", peer=netloc,
                           attempt=attempt, breaker=br.state,

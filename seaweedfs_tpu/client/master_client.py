@@ -98,8 +98,8 @@ class MasterClient:
         if stream is not None:
             try:
                 stream.cancel()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("keep-connected stream cancel failed: %s", e)
 
     def wait_connected(self, timeout: float = 5.0) -> bool:
         return self._connected.wait(timeout)

@@ -1,0 +1,10 @@
+"""Developer tooling for the concurrency correctness plane.
+
+`swtpu_lint` is the AST-based static analyzer (`make lint`,
+`python -m seaweedfs_tpu.devtools.swtpu_lint`); its runtime sibling is
+`utils/locktrack.py` (SWTPU_LOCKCHECK=1), which watches real lock
+acquisition order instead of source text. Both exist because four PRs
+of advisor rounds kept surfacing the same *classes* of concurrency bug
+(I/O under a lock, wall-clock deadlines, silenced exceptions, leaked
+threads) — classes are exactly what tooling can extinguish.
+"""

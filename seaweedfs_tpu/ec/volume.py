@@ -99,7 +99,7 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         for i, p in sorted(self._scan_shards().items()):
             self.shards[i] = EcVolumeShard(i, p)
-        self.last_read_at = time.time()
+        self.last_read_at = time.monotonic()
 
     def _scan_shards(self) -> dict[int, str]:
         return {i: self.base + files.shard_ext(i)
@@ -121,7 +121,7 @@ class EcVolume:
         """Fork behavior (ec_volume.go:303-319,348-353 IsExpire/idle close):
         release file handles of EC volumes nobody read recently; reads
         lazily reopen. Returns True if handles were closed."""
-        if time.time() - self.last_read_at < idle_s:
+        if time.monotonic() - self.last_read_at < idle_s:
             return False
         closed = False
         for shard in self.shards.values():
@@ -143,7 +143,7 @@ class EcVolume:
 
         Reference store_ec.go:154 ReadEcShardNeedle -> readEcShardIntervals.
         """
-        self.last_read_at = time.time()
+        self.last_read_at = time.monotonic()
         loc = self.find_needle(needle_id)
         if loc is None:
             raise KeyError(f"needle {needle_id:x} not in ec volume {self.id}")

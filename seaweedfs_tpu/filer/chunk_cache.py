@@ -35,7 +35,6 @@ def assemble_window(chunks, offset: int, size: int, fetch) -> bytes:
     this window hint at their successors, and when the window covers the
     request tail the file's chunks beyond it are hinted so a sequential
     reader's next request finds them warm."""
-    from ..security.cipher import decrypt
     from .chunks import read_views
 
     buf = bytearray(size)
@@ -45,6 +44,9 @@ def assemble_window(chunks, offset: int, size: int, fetch) -> bytes:
         upcoming = [w.file_id for w in views[i + 1:i + 3]] or beyond
         blob = fetch(v.file_id, upcoming)
         if v.cipher_key:
+            # lazy: cipher needs the optional `cryptography` package —
+            # plaintext reads must work without it installed
+            from ..security.cipher import decrypt
             blob = decrypt(blob, v.cipher_key)
         part = blob[v.chunk_offset:v.chunk_offset + v.size]
         at = v.logical_offset - offset

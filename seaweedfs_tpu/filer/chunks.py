@@ -45,7 +45,7 @@ def etag(chunks: list[fpb.FileChunk]) -> str:
         return chunks[0].e_tag
     import hashlib
 
-    h = hashlib.md5()
+    h = hashlib.md5(usedforsecurity=False)  # ETag fingerprint, FIPS-safe
     for c in chunks:
         h.update(c.e_tag.encode())
     return f"{h.hexdigest()}-{len(chunks)}"

@@ -255,7 +255,7 @@ class Filer:
     @staticmethod
     def _expired(entry: fpb.Entry) -> bool:
         ttl = entry.attributes.ttl_sec
-        return bool(ttl) and entry.attributes.mtime + ttl < time.time()
+        return bool(ttl) and entry.attributes.mtime + ttl < time.time()  # swtpu-lint: disable=wallclock-duration (mtime is persisted wall-clock)
 
     def list_entries(self, directory: str, start_from: str = "",
                      inclusive: bool = False, limit: int = 2**31,

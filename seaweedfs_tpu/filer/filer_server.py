@@ -278,7 +278,7 @@ class FilerServer:
             from ..storage.types import TTL
             ttl_sec = TTL.parse(rule_ttl).seconds
         chunks: list[fpb.FileChunk] = []
-        md5 = hashlib.md5(data)
+        md5 = hashlib.md5(data, usedforsecurity=False)  # content fingerprint
         for off in range(0, len(data), self.chunk_size):
             piece = data[off:off + self.chunk_size]
             c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "",
@@ -394,6 +394,14 @@ class FilerServer:
             return web.json_response(
                 events.debug_events_payload(dict(request.query)))
 
+        async def debug_locks(request):
+            if request.method != "GET":
+                return web.json_response({"error": "method not allowed"},
+                                         status=405)
+            from ..utils import locktrack
+            return web.json_response(
+                locktrack.debug_locks_payload(dict(request.query)))
+
         async def debug_profile(request):
             # pprof-style sampler (utils/profiling.py) — previously only
             # master/volume exposed it; sampling runs off the event loop
@@ -418,6 +426,7 @@ class FilerServer:
             # fully reserved, like /__status__
             app.router.add_route("*", "/debug/traces", debug_traces)
             app.router.add_route("*", "/debug/events", debug_events)
+            app.router.add_route("*", "/debug/locks", debug_locks)
             app.router.add_route("*", "/debug/profile", debug_profile)
             app.router.add_route("*", "/{path:.*}", handle)
 

@@ -332,7 +332,7 @@ class RepairExecutor:
         try:
             from ..stats import REPAIRS_TOTAL
             REPAIRS_TOTAL.inc(action, result)
-        except Exception:  # noqa: BLE001 — metrics must never break repair
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break repair)
             pass
 
     @staticmethod
@@ -341,5 +341,5 @@ class RepairExecutor:
             from ..stats import REPAIRS_PENDING
             if REPAIRS_PENDING.value(severity) > 0:
                 REPAIRS_PENDING.add(severity, amount=-1)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break repair)
             pass

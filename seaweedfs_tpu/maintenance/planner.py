@@ -239,5 +239,5 @@ def _publish_pending(plan: RepairPlan) -> None:
         counts[DATA_LOSS] = len(plan.unrepairable)
         for sev, n in counts.items():
             REPAIRS_PENDING.set(sev, value=n)
-    except Exception:  # noqa: BLE001 — metrics must never break planning
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break planning)
         pass

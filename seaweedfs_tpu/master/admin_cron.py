@@ -112,8 +112,8 @@ class AdminCron:
         if self._env is not None:
             try:
                 self._env.mc.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("cron master-client stop failed: %s", e)
 
     def trigger(self) -> None:
         """Run one sweep immediately (tests / admin HTTP hook).
@@ -202,8 +202,8 @@ class AdminCron:
         finally:
             try:
                 env.release_lock()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("sweep admin-lock release failed: %s", e)
         self.last_output = out.getvalue()
         self.sweeps += 1
         if self.last_output.strip():

@@ -276,7 +276,7 @@ class HealthEngine:
     def snapshot(self) -> dict:
         """Plain-data view of the live topology (under its lock)."""
         topo = self.topo
-        now = time.time()
+        now = time.monotonic()  # ages against DataNode.last_seen
         volumes: dict[int, dict] = {}
         nodes: list[dict] = []
         with topo.lock:
@@ -348,7 +348,7 @@ class HealthEngine:
             EC_SHARDS_MISSING.set(value=report["totals"]["ec_shards_missing"])
             REPLICA_DEFICIT.set(value=report["totals"]["replica_deficit"])
             NODES_STALE.set(value=report["totals"]["nodes_stale"])
-        except Exception:  # noqa: BLE001 — metrics must never break the scan
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break the scan)
             pass
 
     def _journal_transitions(self, report: dict,

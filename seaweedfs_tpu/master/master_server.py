@@ -235,10 +235,13 @@ class MasterServer:
         # to a thread so it cannot head-of-line-block assigns.
         def offloaded(handler):
             import asyncio
+            import contextvars
 
             async def h(req):
+                # carry the active trace span across the executor hop
+                ctx = contextvars.copy_context()
                 return await asyncio.get_running_loop().run_in_executor(
-                    None, handler, req)
+                    None, ctx.run, handler, req)
             return h
 
         def metrics(req):
@@ -376,6 +379,13 @@ class MasterServer:
             return fastweb.text_response(
                 profiling.cpu_profile(float(q.get("seconds", "5"))))
 
+        def debug_locks(req, q):
+            # lock-order cycles + long holds from the SWTPU_LOCKCHECK=1
+            # runtime detector (utils/locktrack.py); cheap no-op payload
+            # when the detector is off
+            from ..utils import locktrack
+            return json_response(locktrack.debug_locks_payload(q))
+
         app = fastweb.FastApp()
         app.route("/metrics", metrics)
         app.route("/dir/status", offloaded(guarded("/dir/status", dir_status)))
@@ -394,6 +404,9 @@ class MasterServer:
         # full-topology health scan is milliseconds, not microseconds
         app.route("/debug/events",
                   offloaded(guarded("/debug/events", debug_events)))
+        # guarded like the other /debug routes (stacks leak paths)
+        app.route("/debug/locks",
+                  offloaded(guarded("/debug/locks", debug_locks)))
         app.route("/cluster/health",
                   offloaded(guarded("/cluster/health", cluster_health)))
 
@@ -643,7 +656,7 @@ class MasterServer:
         @svc.unary("LeaseAdminToken", pb.LeaseAdminTokenRequest,
                    pb.LeaseAdminTokenResponse)
         def lease_admin(req, context):
-            now = time.time_ns()
+            now = time.monotonic_ns()  # lease age is a duration
             cur = ms._admin_locks.get(req.lock_name)
             if cur and cur[0] != req.previous_token and now - cur[1] < 60e9:
                 context.abort(7, f"lock {req.lock_name} held by {cur[2]}")
@@ -749,7 +762,7 @@ class MasterServer:
             events.emit("node.join", node=node.id,
                         dc=hb.data_center or "DefaultDataCenter",
                         rack=hb.rack or "DefaultRack")
-        node.last_seen = time.time()
+        node.last_seen = time.monotonic()
         if hb.max_file_key:
             self.sequencer.set_max(hb.max_file_key)
             node.max_file_key = hb.max_file_key

@@ -18,6 +18,7 @@ failover); production would raise them.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import random
@@ -320,10 +321,13 @@ class RaftNode:
             self._reset_election_timer()
         log.info("%s: starting election term %d", self.address, term)
         votes = 1
-        futs = {self._pool.submit(self._call, peer, "RequestVote", {
-                    "term": term, "candidate": self.address,
-                    "last_log_index": last_idx, "last_log_term": last_term,
-                }): peer for peer in self.peers}
+        futs = {self._pool.submit(
+                    contextvars.copy_context().run, self._call, peer,
+                    "RequestVote", {
+                        "term": term, "candidate": self.address,
+                        "last_log_index": last_idx,
+                        "last_log_term": last_term,
+                    }): peer for peer in self.peers}
         try:
             for fut in as_completed(futs, timeout=self.rpc_timeout * 3):
                 try:
@@ -397,8 +401,9 @@ class RaftNode:
         futs = {}
         for peer, args in per_peer.items():
             ni = args.pop("_ni")
-            futs[self._pool.submit(self._call, peer, "AppendEntries",
-                                   args)] = (peer, ni, len(args["entries"]))
+            futs[self._pool.submit(
+                contextvars.copy_context().run, self._call, peer,
+                "AppendEntries", args)] = (peer, ni, len(args["entries"]))
         reached = 1
         try:
             for fut in as_completed(futs, timeout=self.rpc_timeout * 3):
@@ -465,10 +470,12 @@ class RaftNode:
                             try:
                                 args = self._append_args_for(peer)
                                 args.pop("_ni")
-                                self._pool.submit(self._call, peer,
-                                                  "AppendEntries", args)
-                            except Exception:  # noqa: BLE001
-                                pass
+                                self._pool.submit(
+                                    contextvars.copy_context().run,
+                                    self._call, peer, "AppendEntries", args)
+                            except Exception as e:  # noqa: BLE001
+                                log.debug("config-change catch-up append "
+                                          "to %s not queued: %s", peer, e)
                     self._apply_config(cmd["raft_members"])
                 elif cmd:
                     self.apply_fn(cmd)

@@ -88,7 +88,7 @@ class DataNode:
         self.public_url = public_url or f"{ip}:{port}"
         self.rack = rack
         self.disks: dict[str, Disk] = {}
-        self.last_seen = time.time()
+        self.last_seen = time.monotonic()  # staleness clock, not wall
         self.max_file_key = 0
 
     @property
@@ -178,7 +178,7 @@ class Topology:
                 self.nodes[nid] = node
             for dtype, cnt in (max_volume_counts or {}).items():
                 node.disk(dtype).max_volume_count = cnt
-            node.last_seen = time.time()
+            node.last_seen = time.monotonic()
             return node
 
     def sync_volumes(self, node: DataNode, volumes: list[VolumeInfo]

@@ -22,7 +22,8 @@ from ..pb import mount_pb2 as mpb
 def mount_socket_path(mount_dir: str) -> str:
     """Stable per-mountpoint socket path (reference HashToInt32 of the
     dir; any stable digest works as long as shell and mount agree)."""
-    h = hashlib.md5(os.path.abspath(mount_dir).encode()).hexdigest()[:12]
+    h = hashlib.md5(os.path.abspath(mount_dir).encode(),
+                    usedforsecurity=False).hexdigest()[:12]
     return f"/tmp/swtpu-mount-{h}.sock"
 
 

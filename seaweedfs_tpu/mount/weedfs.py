@@ -451,8 +451,8 @@ class WeedFS:
                         (e.attributes.file_size or 0)
                         for _, e in self._walk_all("/"))
                     self._usage_cached_at = now
-                except Exception:  # noqa: BLE001 — quota display best-effort
-                    pass
+                except Exception as e:  # noqa: BLE001 — quota display best-effort
+                    log.debug("statfs usage scan failed: %s", e)
             free = max(0, blocks - self._usage_cached // bsize)
             return {"f_bsize": bsize, "f_blocks": blocks,
                     "f_bfree": free, "f_bavail": free,
@@ -475,8 +475,8 @@ class WeedFS:
         for fh in list(self._handles):
             try:
                 self.release(fh)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("handle %s release at unmount failed: %s", fh, e)
         self.meta.close()
 
 

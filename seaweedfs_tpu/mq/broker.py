@@ -357,7 +357,7 @@ class BrokerServer:
                 mpb.ListClusterNodesRequest(client_type="broker"),
                 mpb.ListClusterNodesResponse, timeout=2)
             addrs.update(n.address for n in resp.cluster_nodes)
-        except Exception:  # noqa: BLE001 — masterless dev mode: self only
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (masterless dev mode: self only)
             pass
         out = sorted(addrs)
         self._broker_cache = (now, out)
@@ -720,8 +720,8 @@ class BrokerServer:
                 try:
                     for _ in request_iter:
                         pass
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log.debug("subscribe request stream drain ended: %s", e)
                 inst.responses.put(None)
 
             threading.Thread(target=drain, daemon=True,

@@ -210,16 +210,23 @@ class GroupConsumer:
                         # processed would turn the purge into loss
                         self._purge_queued(rs)
                         self._delivered.pop(rs, None)
+            to_start = []
             for rs, (p, leader) in want.items():
                 if rs in self._workers:
                     continue
                 stop = threading.Event()
                 self._workers[rs] = stop
                 self.assigned[rs] = (p, leader)
-                threading.Thread(
+                to_start.append(threading.Thread(
                     target=self._consume_partition,
                     args=(p, leader, stop), daemon=True,
-                    name=f"mq-part-{self.instance_id}-{rs}").start()
+                    name=f"mq-part-{self.instance_id}-{rs}"))
+        # spawn OUTSIDE the lock: Thread.start() blocks on the new
+        # thread's bootstrap, and under load N spawns serialized behind
+        # self._lock stall every concurrent poll()/commit() for the
+        # whole rebalance (locktrack long-hold finding)
+        for t in to_start:
+            t.start()
 
     def _purge_queued(self, range_start: int) -> None:
         """Drop a revoked partition's not-yet-polled records, preserving

@@ -50,7 +50,9 @@ def split_ring(partition_count: int, ring_size: int = RING_SIZE
 def key_slot(key: bytes, ring_size: int = RING_SIZE) -> int:
     if not key:
         return 0
-    return int.from_bytes(hashlib.md5(key).digest()[:4], "big") % ring_size
+    return int.from_bytes(
+        hashlib.md5(key, usedforsecurity=False).digest()[:4],
+        "big") % ring_size
 
 
 def partition_for_key(key: bytes, partitions: list[Partition]) -> Partition:

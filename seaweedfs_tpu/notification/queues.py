@@ -160,6 +160,6 @@ class MqQueue(MessageQueue):
             if self._pub is not None:
                 try:
                     self._pub.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log.debug("notification publisher close failed: %s", e)
                 self._pub = None

@@ -63,7 +63,7 @@ class EventJournal:
             from .. import tracing
             trace_id, span_id = tracing.current_ids()
             tracing.add_event(etype, **attrs)
-        except Exception:  # noqa: BLE001 — correlation must never break emit
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (correlation must never break emit)
             pass
         with self._lock:
             self._seq += 1
@@ -119,7 +119,7 @@ def emit(etype: str, severity: str = INFO, **attrs) -> None:
     except Exception as e:  # noqa: BLE001
         try:
             log.warning("event emit %s failed: %s", etype, e)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (last resort: logging itself failed)
             pass
 
 

@@ -48,8 +48,10 @@ class FilerSync:
             raw = self.target.filer.store.kv_get(self._offset_key)
             if raw:
                 return struct.unpack("<q", raw)[0]
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001
+            # a silent fallback here replays the WHOLE journal from 0 —
+            # that is correct (sync is idempotent) but never invisible
+            log.warning("sync offset read failed (%s); replaying from 0", e)
         return 0
 
     def _save_offset(self, ts_ns: int) -> None:

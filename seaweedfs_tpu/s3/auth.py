@@ -180,7 +180,7 @@ class IdentityAccessManagement:
             ts = _amz_time(amz_date)
         except ValueError:
             raise ErrSignatureMismatch() from None
-        if abs(_time.time() - ts) > MAX_CLOCK_SKEW_S:
+        if abs(_time.time() - ts) > MAX_CLOCK_SKEW_S:  # swtpu-lint: disable=wallclock-duration (vs client clock)
             raise S3Error("RequestTimeTooSkewed",
                           "The difference between the request time and the "
                           "server's time is too large.", 403)
@@ -194,7 +194,7 @@ class IdentityAccessManagement:
             ttl = int(expires) if expires else 604800
         except ValueError:
             raise ErrSignatureMismatch() from None
-        if _time.time() > ts + min(ttl, 604800):  # 7-day cap like AWS
+        if _time.time() > ts + min(ttl, 604800):  # 7-day cap like AWS  # swtpu-lint: disable=wallclock-duration (vs client clock)
             raise ErrRequestExpired()
 
     @staticmethod
@@ -366,7 +366,7 @@ def verify_v2_presigned(iam: "IdentityAccessManagement", method: str,
     ident, secret = iam.lookup(query.get("AWSAccessKeyId", ""))
     expires = query.get("Expires", "0")
     try:
-        if _time.time() > int(expires):
+        if _time.time() > int(expires):  # swtpu-lint: disable=wallclock-duration (vs client clock)
             raise ErrRequestExpired()
     except ValueError:
         raise ErrSignatureMismatch() from None
@@ -407,7 +407,7 @@ def verify_post_policy(iam: "IdentityAccessManagement",
         raise S3Error("InvalidPolicyDocument", "malformed policy", 400) \
             from None
     import time as _time
-    if _time.time() > exp_ts:
+    if _time.time() > exp_ts:  # swtpu-lint: disable=wallclock-duration (vs client clock)
         raise ErrRequestExpired()
     # enforce the conditions we understand (bucket equality, key prefix)
     for cond in policy.get("conditions", []):

@@ -192,6 +192,14 @@ class S3Gateway:
             return web.json_response(
                 events.debug_events_payload(dict(request.query)))
 
+        async def debug_locks(request):
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            from ..utils import locktrack
+            return web.json_response(
+                locktrack.debug_locks_payload(dict(request.query)))
+
         async def debug_profile(request):
             # pprof-style sampler (utils/profiling.py), operator-gated
             # like /debug/traces (stacks leak paths and peer addresses);
@@ -221,6 +229,7 @@ class S3Gateway:
             # can ever reach): these two paths are fully reserved
             app.router.add_route("*", "/debug/traces", debug_traces)
             app.router.add_route("*", "/debug/events", debug_events)
+            app.router.add_route("*", "/debug/locks", debug_locks)
             app.router.add_route("*", "/debug/profile", debug_profile)
             app.router.add_route("*", "/metrics", metrics)
             app.router.add_route("*", "/{tail:.*}", dispatch)
@@ -328,7 +337,8 @@ class S3Gateway:
                 if md5_hdr:
                     import base64
                     actual = base64.b64encode(
-                        hashlib.md5(body).digest()).decode()
+                        hashlib.md5(body,
+                                    usedforsecurity=False).digest()).decode()
                     if actual != md5_hdr:
                         raise S3Error("BadDigest",
                                       "The Content-MD5 you specified did "
@@ -936,18 +946,26 @@ class S3Gateway:
             if k.startswith("x-amz-meta-"):
                 headers[k] = v.decode()
         # response header overrides (s3tests test_object_response_headers:
-        # GetObject response-* query params rewrite the reply headers)
-        for qparam, hname in (("response-content-type", "Content-Type"),
-                              ("response-content-language",
-                               "Content-Language"),
-                              ("response-expires", "Expires"),
-                              ("response-cache-control", "Cache-Control"),
-                              ("response-content-disposition",
-                               "Content-Disposition"),
-                              ("response-content-encoding",
-                               "Content-Encoding")):
-            v = request.query.get(qparam)
-            if v:
+        # GetObject response-* query params rewrite the reply headers) —
+        # honored only on authenticated (signed) requests; real S3 answers
+        # InvalidRequest when an anonymous GET carries any response-*
+        # parameter, and an unsigned request here never gets an identity
+        wanted = [(qparam, hname, request.query[qparam])
+                  for qparam, hname in
+                  (("response-content-type", "Content-Type"),
+                   ("response-content-language", "Content-Language"),
+                   ("response-expires", "Expires"),
+                   ("response-cache-control", "Cache-Control"),
+                   ("response-content-disposition", "Content-Disposition"),
+                   ("response-content-encoding", "Content-Encoding"))
+                  if request.query.get(qparam)]
+        if wanted:
+            if request.get("s3_identity") is None:
+                raise S3Error(
+                    "InvalidRequest",
+                    "Request specific response headers cannot be used "
+                    "for anonymous GET requests.", 400)
+            for _qparam, hname, v in wanted:
                 headers[hname] = v
         rng = request.http_range
         has_range = rng.start is not None or rng.stop is not None
@@ -1283,7 +1301,7 @@ class S3Gateway:
         # zero-copy concat: rebase each part's chunks onto the final offset
         final = fpb.Entry()
         offset = 0
-        md5s = hashlib.md5()
+        md5s = hashlib.md5(usedforsecurity=False)  # multipart ETag
         for p in order:
             pe = parts[p]
             md5s.update(pe.attributes.md5)

@@ -128,8 +128,8 @@ class VolumeServer:
         if stream is not None:
             try:
                 stream.cancel()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("heartbeat stream cancel failed: %s", e)
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)
         if self._metrics_push is not None:
@@ -358,12 +358,20 @@ class VolumeServer:
             from ..ops import events
             return json_response(events.debug_events_payload(request.query))
 
+        def debug_locks(request):
+            from ..utils import locktrack
+            return json_response(
+                locktrack.debug_locks_payload(request.query))
+
         async def debug_profile(request):
+            import contextvars
+
             from ..utils import profiling
             secs = float(request.query.get("seconds", "5"))
             loop = asyncio.get_running_loop()
+            ctx = contextvars.copy_context()  # keep the trace span
             text = await loop.run_in_executor(
-                None, profiling.cpu_profile, secs)
+                None, ctx.run, profiling.cpu_profile, secs)
             return fastweb.text_response(text)
 
         def debug_jax_profiler(request):
@@ -425,6 +433,7 @@ class VolumeServer:
         app.route("/debug/failpoints", debug_failpoints)
         app.route("/debug/traces", debug_traces)
         app.route("/debug/events", debug_events)
+        app.route("/debug/locks", debug_locks)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
                                client_max_size=256 << 20, logger=log)
@@ -557,7 +566,7 @@ class VolumeServer:
                             try:
                                 from ..stats import RETRY_ATTEMPTS
                                 RETRY_ATTEMPTS.inc("replicate.peer")
-                            except Exception:  # noqa: BLE001
+                            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break IO)
                                 pass
                             sp.add_event("retry", op="replicate.peer",
                                          attempt=attempt,
@@ -952,7 +961,7 @@ class VolumeServer:
     def _lookup_ec_shards(self, vid: int, failed: bool = False,
                           ) -> dict[int, list[str]]:
         """shard id -> gRPC addresses of holders, via the tiered cache."""
-        now = time.time()
+        now = time.monotonic()
         with self._ec_loc_lock:
             ent = self._ec_loc_cache.get(vid)
             if ent is not None:

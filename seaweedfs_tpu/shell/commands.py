@@ -119,10 +119,10 @@ def run_command(env: CommandEnv, line: str) -> bool:
         return True
     if cmd.needs_lock:
         env.confirm_is_locked()
-    t0 = time.time()
+    t0 = time.monotonic()
     cmd.fn(env, args)
     if env.option.get("timing"):
-        env.println(f"({time.time() - t0:.2f}s)")
+        env.println(f"({time.monotonic() - t0:.2f}s)")
     return True
 
 
@@ -165,6 +165,6 @@ def discover_cluster_node(env: "CommandEnv", client_type: str
         nodes = list_cluster_nodes(env, client_type)
         if nodes:
             return nodes[0].address, nodes[0].grpc_port
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (no such node type yet; caller reports)
         pass
     return "", 0

@@ -150,7 +150,7 @@ def _encode_on_server(env: CommandEnv, srv: dict,
                 stub.call("VolumeMarkWritable",
                           vpb.VolumeMarkWritableRequest(volume_id=vid),
                           vpb.VolumeMarkWritableResponse)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (best-effort rollback of mark-readonly)
                 pass
     coll_by_vid = dict(vols)
     for vid in done:
@@ -319,7 +319,7 @@ def _probe_n_shards(env: CommandEnv, srv: dict, vid: int, collection: str) -> in
             vpb.VolumeEcShardsInfoResponse)
         if resp.data_shards:
             return resp.data_shards + resp.parity_shards
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (pre-geometry-RPC server: fork default)
         pass
     return 14
 

@@ -373,7 +373,7 @@ def cmd_fs_mv(env: CommandEnv, args):
                       fpb.LookupDirectoryEntryResponse)
         if t.entry.is_directory:
             dd, dn = dst_path.rstrip("/"), sn
-    except Exception:  # noqa: BLE001 — destination doesn't exist: plain rename
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (destination doesn't exist: plain rename)
         pass
     stub.call("AtomicRenameEntry", fpb.AtomicRenameEntryRequest(
         old_directory=sd or "/", old_name=sn,
@@ -571,7 +571,7 @@ def cmd_s3_clean_uploads(env: CommandEnv, args):
     p.add_argument("-timeAgo", default="24h")
     opt = p.parse_args(args)
     stub = _filer_stub(env, opt.filer)
-    cutoff = _time.time() - TTL.parse(opt.timeAgo).seconds
+    cutoff = _time.time() - TTL.parse(opt.timeAgo).seconds  # swtpu-lint: disable=wallclock-duration (compared to persisted mtime)
     removed = 0
     for b in _list_entries(stub, BUCKETS_DIR):
         if not b.is_directory:

@@ -87,7 +87,7 @@ def cmd_mq_topic_desc(env: CommandEnv, args):
             env.println(f"schema: {{{fields}}}")
         else:
             env.println("schema: (none)")
-    except Exception:  # noqa: BLE001 — older broker without the RPC
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (older broker without the RPC)
         pass
     # consumer groups: every live broker reports the groups ITS
     # coordinator manages (sub_coordinator.py); merge across brokers

@@ -305,7 +305,7 @@ def cmd_cluster_repair(env: CommandEnv, args):
         if not had_lock:
             try:
                 env.release_lock()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (lease already expired/released)
                 pass
     env.println(f"repairs: {len(res['done'])} done, "
                 f"{len(res['failed'])} failed, "
@@ -318,9 +318,9 @@ def cmd_cluster_repair(env: CommandEnv, args):
     # repairs mount/copy synchronously but the master's view is
     # heartbeat-propagated: give the verdict a short settle window
     # before declaring failure
-    deadline = _time.time() + 15
+    deadline = _time.monotonic() + 15
     verdict = report.get("verdict", "OK")
-    while _time.time() < deadline:
+    while _time.monotonic() < deadline:
         try:
             verdict = fetch_or_compute_health(env, opt.url).get(
                 "verdict", "OK")
@@ -957,7 +957,7 @@ def cmd_cluster_raft_ps(env: CommandEnv, args):
             env.println(f"member: {s.address} {s.suffrage}"
                         + (" (leader)" if s.is_leader else ""))
         return
-    except Exception:  # noqa: BLE001 — pre-membership-RPC master
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (pre-membership-RPC master)
         pass
     env.println(f"leader: {env.mc.leader}")
     for m in env.mc.masters:

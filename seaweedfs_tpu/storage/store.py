@@ -99,8 +99,8 @@ class Store:
             if v is not None:
                 try:
                     v.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log.debug("stale volume handle close failed: %s", e)
                 nv = Volume(loc.directory, v.collection, vid,
                             create_if_missing=False)
                 loc.volumes[vid] = nv
@@ -335,7 +335,7 @@ class Store:
 
     def delete_expired_ec_volumes(self) -> list[int]:
         """Fork behavior (store.go:389): reap EC volumes past DestroyTime."""
-        now = time.time()
+        now = time.time()  # swtpu-lint: disable=wallclock-duration (destroy_time is persisted wall-clock)
         reaped = []
         for loc in self.locations:
             for vid, ev in list(loc.ec_volumes.items()):

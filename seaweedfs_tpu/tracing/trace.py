@@ -366,7 +366,7 @@ class TraceBuffer:
         try:
             from ..stats import TRACE_SPANS
             TRACE_SPANS.inc(span.component or "unknown")
-        except Exception:  # noqa: BLE001 — metrics must never break IO
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break IO)
             pass
 
     def snapshot(self, trace_id: str = "", min_ms: float = 0.0,

@@ -171,7 +171,7 @@ class CircuitBreaker:
             from ..stats import BREAKER_STATE, BREAKER_TRANSITIONS
             BREAKER_STATE.set(self.peer, value=_STATE_VALUE[to])
             BREAKER_TRANSITIONS.inc(self.peer, to)
-        except Exception:  # noqa: BLE001 — metrics must never break IO
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break IO)
             pass
         try:
             # journal the transition so /debug/events answers "which
@@ -184,7 +184,7 @@ class CircuitBreaker:
                                   else events.INFO),
                         peer=self.peer, previous=came_from,
                         failures=self._failures)
-        except Exception:  # noqa: BLE001 — the journal must never break IO
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (the journal must never break IO)
             pass
         log.info("breaker %s -> %s", self.peer, to)
 
@@ -344,7 +344,7 @@ def retry_call(fn, *, op: str, peer: str | None = None,
             try:
                 from ..stats import RETRY_ATTEMPTS
                 RETRY_ATTEMPTS.inc(op)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break IO)
                 pass
             tracing.add_event(
                 "retry", op=op, attempt=attempt,

@@ -167,7 +167,7 @@ def _extract_trace_context(context):
         for k, v in context.invocation_metadata() or ():
             if k == tracing.TRACEPARENT_HEADER:
                 return tracing.parse_traceparent(v)
-    except Exception:  # noqa: BLE001 — tracing must never break dispatch
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (tracing must never break dispatch)
         pass
     return None
 

@@ -47,6 +47,25 @@ from seaweedfs_tpu.storage.store import Store  # noqa: E402
 from seaweedfs_tpu.utils import failpoints, retry  # noqa: E402
 from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE  # noqa: E402
 
+@pytest.fixture(scope="session", autouse=True)
+def no_lock_order_cycles():
+    """`make chaos` runs with SWTPU_LOCKCHECK=1: every threading
+    primitive in the mini-cluster is wrapped by utils/locktrack, so a
+    session of randomized faults doubles as a lock-order fuzzer. The
+    session must end with ZERO ordering cycles — a cycle is a deadlock
+    waiting for the right interleaving, whether or not this run hit it."""
+    yield
+    if os.environ.get("SWTPU_LOCKCHECK") != "1":
+        return
+    from seaweedfs_tpu.utils import locktrack
+
+    rep = locktrack.findings()
+    assert rep["cycles"] == [], (
+        "lock-order cycles observed during the chaos session "
+        "(potential ABBA deadlocks): "
+        + "; ".join(" -> ".join(c["locks"]) for c in rep["cycles"]))
+
+
 SCHEDULES = int(os.environ.get("SWTPU_CHAOS_SCHEDULES", "3"))
 WINDOW_S = float(os.environ.get("SWTPU_CHAOS_SECONDS", "4"))
 BASE_SEED = int(os.environ.get("SWTPU_CHAOS_SEED", "0")) \

@@ -50,12 +50,23 @@ def main() -> int:
     if mq:
         artifact["mq_churn"] = {"messages": int(mq.group(1)),
                                 "duplicates": int(mq.group(2))}
+    rc = proc.returncode
+    if os.environ.get("SWTPU_LOCKCHECK") == "1":
+        # `make race`: utils/locktrack prints its exit report to stderr
+        # (nothing when no findings). An ABBA ordering cycle fails the
+        # run even if every scenario's assertions passed — a deadlock
+        # that didn't fire this time is still a deadlock.
+        lk = re.search(r"== (\d+) cycle\(s\), (\d+) long hold\(s\)", text)
+        cycles, holds = (int(lk.group(1)), int(lk.group(2))) if lk else (0, 0)
+        artifact["lockcheck"] = {"cycles": cycles, "long_holds": holds}
+        if cycles:
+            rc = rc or 3
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps(artifact))
-    if proc.returncode != 0:
+    if rc != 0:
         sys.stderr.write(text[-4000:])
-    return proc.returncode
+    return rc
 
 
 if __name__ == "__main__":

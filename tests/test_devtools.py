@@ -1,0 +1,499 @@
+"""Concurrency correctness plane: swtpu-lint rule fixtures (detection,
+suppression, clean shipped tree, exit codes, JSON mode) and the
+locktrack runtime lock-order detector (ABBA cycle reported, consistent
+order not, long holds, Condition integration), plus the monotonic-sweep
+regression test that a wall-clock jump cannot stall cooldown expiry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.devtools import swtpu_lint as lint
+from seaweedfs_tpu.utils import locktrack
+
+PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+
+
+def _lint_src(tmp_path, src, name="fx.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return lint.lint_file(str(p))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# -- one fixture per rule -----------------------------------------------------
+
+def test_rule_async_blocking(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+        async def handler():
+            time.sleep(1)
+        """)
+    assert _rules(fs) == {"async-blocking"}
+
+
+def test_rule_async_blocking_aliased_import(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time as _t
+        async def handler():
+            _t.sleep(1)
+        """)
+    assert _rules(fs) == {"async-blocking"}
+
+
+def test_rule_io_under_lock(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import threading
+        import time
+        _lock = threading.Lock()
+        def sweep():
+            with _lock:
+                time.sleep(0.1)
+        """)
+    assert _rules(fs) == {"io-under-lock"}
+
+
+def test_rule_io_under_lock_allows_local_file_io(tmp_path):
+    # per-volume locks protecting their own file are the storage
+    # engine's design — local file I/O under a lock is NOT a finding
+    fs = _lint_src(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+        def read_index(path):
+            with _lock:
+                with open(path, "rb") as f:
+                    return f.read()
+        """)
+    assert fs == []
+
+
+def test_rule_io_under_lock_rpc(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+        def heal(stub, req):
+            with _lock:
+                return stub.call("VolumeCopy", req)
+        """)
+    assert _rules(fs) == {"io-under-lock"}
+
+
+def test_rule_wallclock_duration(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+        def expired(t0, timeout):
+            return time.time() - t0 > timeout
+        """)
+    assert _rules(fs) == {"wallclock-duration"}
+
+
+def test_rule_wallclock_duration_dataflow(tmp_path):
+    # `now = time.time()` ... `now - started`: the ASSIGN line is the
+    # conversion site and is what gets flagged
+    fs = _lint_src(tmp_path, """\
+        import time
+        def age(started):
+            now = time.time()
+            return now - started
+        """)
+    assert _rules(fs) == {"wallclock-duration"}
+    assert fs[0].line == 3
+
+
+def test_rule_wallclock_timestamp_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time
+        def stamp():
+            return int(time.time() * 1000)
+        def record():
+            ts = time.time()
+            return {"at": ts}
+        """)
+    assert fs == []
+
+
+def test_rule_silent_except(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        def f(risky):
+            try:
+                risky()
+            except Exception:
+                pass
+        """)
+    assert _rules(fs) == {"silent-except"}
+
+
+def test_rule_silent_except_logged_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import logging
+        def f(risky):
+            try:
+                risky()
+            except Exception as e:
+                logging.debug("risky failed: %s", e)
+        """)
+    assert fs == []
+
+
+def test_rule_thread_no_join(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import threading
+        def spawn():
+            t = threading.Thread(target=print)
+            t.start()
+        """)
+    assert _rules(fs) == {"thread-no-join"}
+
+
+def test_rule_thread_daemon_or_joined_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import threading
+        def spawn_daemon():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        def spawn_joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+        def spawn_batch(n):
+            ts = [threading.Thread(target=print) for _ in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        """)
+    assert fs == []
+
+
+def test_rule_md5_fips(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import hashlib
+        def etag(b):
+            return hashlib.md5(b).hexdigest()
+        """)
+    assert _rules(fs) == {"md5-fips"}
+
+
+def test_rule_md5_fips_usedforsecurity_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import hashlib
+        def etag(b):
+            return hashlib.md5(b, usedforsecurity=False).hexdigest()
+        """)
+    assert fs == []
+
+
+def test_rule_executor_no_context(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        def offload(loop, fn):
+            return loop.run_in_executor(None, fn)
+        def fan_out(pool, fn):
+            return pool.submit(fn)
+        """)
+    assert _rules(fs) == {"executor-no-context"}
+    assert len(fs) == 2
+
+
+def test_rule_executor_with_context_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import contextvars
+        def offload(loop, fn):
+            ctx = contextvars.copy_context()
+            return loop.run_in_executor(None, ctx.run, fn)
+        def fan_out(pool, fn):
+            return pool.submit(contextvars.copy_context().run, fn)
+        """)
+    assert fs == []
+
+
+def test_rule_parse_error(tmp_path):
+    fs = _lint_src(tmp_path, "def broken(:\n")
+    assert _rules(fs) == {"parse-error"}
+
+
+# -- suppression comments -----------------------------------------------------
+
+def test_suppression_comment_honored(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import threading
+        import time
+        _lock = threading.Lock()
+        def sweep():
+            with _lock:
+                time.sleep(0.1)  # swtpu-lint: disable=io-under-lock (handoff pause)
+        """)
+    assert fs == []
+
+
+def test_suppression_all_and_wrong_rule(tmp_path):
+    flagged = _lint_src(tmp_path, """\
+        import hashlib
+        def a(b):
+            return hashlib.md5(b).digest()  # swtpu-lint: disable=silent-except
+        """, name="wrong.py")
+    assert _rules(flagged) == {"md5-fips"}  # wrong rule: still reported
+    clean = _lint_src(tmp_path, """\
+        import hashlib
+        def a(b):
+            return hashlib.md5(b).digest()  # swtpu-lint: disable=all
+        """, name="all.py")
+    assert clean == []
+
+
+# -- whole-tree + CLI contract ------------------------------------------------
+
+def test_shipped_tree_is_clean():
+    findings, nfiles = lint.lint_paths([PKG_DIR])
+    assert nfiles > 100
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import hashlib\nh = hashlib.md5(b'x')\n")
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    assert lint.main([str(bad)]) == 1
+    assert lint.main([str(clean)]) == 0
+    assert lint.main(["--select", "no-such-rule", str(clean)]) == 2
+    capsys.readouterr()
+    assert lint.main(["--list-rules"]) == 0
+    assert "io-under-lock" in capsys.readouterr().out
+
+
+def test_main_json_mode(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import hashlib\nh = hashlib.md5(b'x')\n")
+    assert lint.main(["--json", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["count"] == 1 and doc["files"] == 1
+    f = doc["findings"][0]
+    assert f["rule"] == "md5-fips" and f["line"] == 2
+
+
+def test_module_entrypoint(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu.devtools.swtpu_lint",
+         str(bad)], capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(PKG_DIR))
+    assert r.returncode == 1
+    assert "async-blocking" in r.stdout
+
+
+# -- locktrack: runtime lock-order detector -----------------------------------
+
+@pytest.fixture
+def lt():
+    locktrack.reset()
+    yield locktrack
+    locktrack.reset()
+
+
+def _in_thread(fn, name):
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+
+
+def test_abba_cycle_reported(lt):
+    a, b = lt.Lock(name="abba-A"), lt.Lock(name="abba-B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    # sequential threads: the ORDERINGS conflict even though the runs
+    # never actually contend — exactly the near-miss lockdep catches
+    _in_thread(order_ab, "t-ab")
+    _in_thread(order_ba, "t-ba")
+    rep = lt.findings()
+    assert len(rep["cycles"]) == 1
+    assert set(rep["cycles"][0]["locks"]) == {"abba-A", "abba-B"}
+    assert rep["cycles"][0]["stack"]  # acquisition stack captured
+
+
+def test_consistent_order_not_reported(lt):
+    a, b = lt.Lock(name="ord-A"), lt.Lock(name="ord-B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    for name in ("t-1", "t-2"):
+        _in_thread(order_ab, name)
+    order_ab()  # and once from the main thread
+    assert lt.findings()["cycles"] == []
+
+
+def test_three_lock_cycle(lt):
+    a, b, c = (lt.Lock(name="c3-A"), lt.Lock(name="c3-B"),
+               lt.Lock(name="c3-C"))
+    for first, second in ((a, b), (b, c), (c, a)):
+        with first:
+            with second:
+                pass
+    rep = lt.findings()
+    assert len(rep["cycles"]) == 1
+    assert set(rep["cycles"][0]["locks"]) == {"c3-A", "c3-B", "c3-C"}
+
+
+def test_long_hold_reported(lt):
+    h = lt.Lock(name="holdy")
+    with h:
+        time.sleep(lt._state.hold_threshold_s + 0.05)
+    holds = lt.findings()["long_holds"]
+    assert any(x["lock"] == "holdy" for x in holds)
+    assert holds[0]["held_ms"] >= lt._state.hold_threshold_s * 1e3
+
+
+def test_reentrant_lock_single_node(lt):
+    r = lt.RLock(name="re")
+    with r:
+        with r:  # re-entry: no self-edge, no cycle
+            pass
+    rep = lt.findings()
+    assert rep["cycles"] == [] and rep["edges"] == 0
+
+
+def test_condition_wait_notify_roundtrip(lt):
+    cond = lt.Condition()
+    done = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            done.append(1)
+
+    t = threading.Thread(target=waiter, name="cond-waiter")
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with cond:
+            cond.notify()
+        if done:
+            break
+        time.sleep(0.01)
+    t.join(5)
+    assert done
+
+
+def test_condition_wait_at_depth_no_phantom_orphans(lt):
+    """Condition.wait() while the shared RLock is held at recursion
+    depth > 1 (raft/broker shape): _acquire_restore must restore the
+    SAVED depth, or the trailing releases masquerade as cross-thread
+    orphans and purge live held entries from other threads."""
+    r = lt.RLock(name="deep-re")
+    cond = threading.Condition(r)
+    with r:
+        with r:
+            with cond:
+                cond.wait(timeout=0.05)  # times out, restores depth 3
+    assert lt._state.orphans == {}
+    with r:  # still balanced afterwards
+        pass
+    assert lt._state.orphans == {}
+
+
+def test_debug_locks_payload_shape(lt):
+    a, b = lt.Lock(name="pl-A"), lt.Lock(name="pl-B")
+    with a:
+        with b:
+            pass
+    out = lt.debug_locks_payload()
+    assert {"enabled", "cycles", "long_holds", "edges",
+            "hold_threshold_ms"} <= set(out)
+    assert "edge_list" not in out
+    full = lt.debug_locks_payload({"edges": "1"})
+    assert any(e["from"] == "pl-A" and e["to"] == "pl-B"
+               for e in full["edge_list"])
+
+
+def test_cross_thread_handoff_no_false_edges(lt):
+    """Lock handoff (acquire here, release there) is legal for Lock;
+    the stale held-stack entry it leaves must not fabricate ordering
+    edges from the original thread's later acquisitions."""
+    a, b = lt.Lock(name="ho-A"), lt.Lock(name="ho-B")
+    a.acquire()
+    _in_thread(a.release, "releaser")
+    with b:  # without the orphan purge this would record edge A -> B
+        pass
+    assert lt.debug_locks_payload({"edges": "1"})["edge_list"] == []
+
+
+def test_external_only_cycle_not_reported(lt):
+    """Unnamed locks created outside the package (stdlib/third-party
+    internals once install() patches the factories) contribute edges
+    but a cycle touching none of OUR locks is not our finding."""
+    x, y = lt.TrackedLock(), lt.TrackedLock()  # unnamed, created in tests/
+    with x:
+        with y:
+            pass
+    with y:
+        with x:
+            pass
+    rep = lt.findings()
+    assert rep["cycles"] == []
+    assert rep["edges"] == 2  # both orderings are still in the graph
+
+
+def test_install_uninstall_roundtrip():
+    orig = threading.Lock
+    assert locktrack.install()
+    try:
+        assert threading.Lock is locktrack.Lock
+        lk = threading.Lock()
+        with lk:
+            pass
+        assert isinstance(lk, locktrack.TrackedLock)
+        assert locktrack.installed()
+    finally:
+        locktrack.uninstall()
+    assert threading.Lock is orig
+    assert not locktrack.installed()
+
+
+# -- monotonic sweep regression -----------------------------------------------
+
+def test_cooldown_immune_to_wallclock_jump(monkeypatch):
+    """A backwards NTP step must not stall cooldown expiry: the executor
+    keys cooldowns to time.monotonic, so warping time.time a day into
+    the past (or future) cannot change the remaining wait."""
+    from seaweedfs_tpu.maintenance.executor import RepairExecutor
+
+    ex = RepairExecutor(env=None, cooldown_s=30.0)
+    key = ("ec.rebuild", 7)
+    ex._record_failure(key)
+    before = ex._cooling(key)
+    assert 0.0 < before <= 30.0
+
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() - 86400.0)
+    assert abs(ex._cooling(key) - before) < 1.0
+    monkeypatch.setattr(time, "time", lambda: real_time() + 86400.0)
+    assert abs(ex._cooling(key) - before) < 1.0  # forward jump: no fire
+
+    # second failure backs off exponentially, still on the monotonic clock
+    ex._record_failure(key)
+    assert 30.0 < ex._cooling(key) <= 60.0
+    ex._record_success(key)
+    assert ex._cooling(key) == 0.0

@@ -319,7 +319,7 @@ def test_idle_ecx_close_and_lazy_reopen(tmp_path):
     nid, data = next(iter(payloads.items()))
     assert ev.read_needle(nid, cookie=0xAB).data == data
     assert not ev.close_idle(idle_s=3600)  # just read: not idle
-    ev.last_read_at = _time.time() - 7200
+    ev.last_read_at = _time.monotonic() - 7200  # idle age is monotonic
     assert ev.close_idle(idle_s=3600)
     assert all(s._f.closed for s in ev.shards.values())
     # lazy reopen on next read

@@ -389,6 +389,22 @@ def test_auth_wrong_secret_and_scoping(s3_auth):
     assert r.status_code == 403 and "AccessDenied" in r.text
 
 
+def test_response_headers_signed(s3_auth):
+    """response-* overrides are honored for signed requests only; the
+    anonymous rejection (real S3: InvalidRequest) is covered in
+    test_s3_conformance_ext.py::test_object_response_headers_anonymous_rejected."""
+    gw, base = s3_auth
+    assert _signed("PUT", f"{base}/secure").status_code == 200
+    assert _signed("PUT", f"{base}/secure/rh.bin", b"x").status_code == 200
+    url = (f"{base}/secure/rh.bin"
+           "?response-content-type=application/weird"
+           "&response-cache-control=no-cache")
+    r = _signed("GET", url)
+    assert r.status_code == 200, r.text[:300]
+    assert r.headers["Content-Type"] == "application/weird"
+    assert r.headers["Cache-Control"] == "no-cache"
+
+
 # -- streaming-chunked sigv4, CORS, circuit breaker (round-3 hardening) ------
 
 def test_streaming_chunked_put_roundtrip(s3_auth):

@@ -830,9 +830,11 @@ def test_ranged_request_single_byte(bucket):  # noqa: F811
     assert r.headers["Content-Range"] == "bytes 4-4/10"
 
 
-def test_object_response_headers(bucket):  # noqa: F811
-    # s3tests: test_object_response_headers — response-* query params
-    # override the reply headers
+def test_object_response_headers_anonymous_rejected(bucket):  # noqa: F811
+    # s3tests: response-* query params are only honored on signed
+    # requests — real S3 answers InvalidRequest for anonymous GETs
+    # carrying them (the signed-request path is covered in
+    # test_s3.py::test_response_headers_signed)
     base, b = bucket
     _put(base, b, "rh.bin", b"x", {"Content-Type": "text/plain"})
     r = requests.get(
@@ -840,12 +842,12 @@ def test_object_response_headers(bucket):  # noqa: F811
         "?response-content-type=application/weird"
         "&response-content-disposition=attachment%3B%20filename%3Dd.bin"
         "&response-cache-control=no-cache", timeout=10)
-    assert r.status_code == 200
-    assert r.headers["Content-Type"] == "application/weird"
-    assert r.headers["Content-Disposition"] == "attachment; filename=d.bin"
-    assert r.headers["Cache-Control"] == "no-cache"
-    # without overrides the stored type serves
+    assert r.status_code == 400
+    assert "<Code>InvalidRequest</Code>" in r.text
+    assert "anonymous" in r.text
+    # without overrides the anonymous GET serves the stored type
     r = requests.get(f"{base}/{b}/rh.bin", timeout=10)
+    assert r.status_code == 200
     assert r.headers["Content-Type"] == "text/plain"
 
 
