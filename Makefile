@@ -1,12 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec smoke protos lint metrics-lint swtpu-lint
 
-# lint runs FIRST so a concurrency-rule or exposition-grammar
-# regression fails the default path before the suite spends minutes;
-# the suite itself includes the cluster.check-against-mini-cluster
-# smoke (tests/test_health.py) so health regressions fail tier-1 too
-test: lint
+# lint and the EC pipeline smoke run FIRST so a concurrency-rule,
+# exposition-grammar, or encode-pipeline regression fails the default
+# path before the suite spends minutes; the suite itself includes the
+# cluster.check-against-mini-cluster smoke (tests/test_health.py) so
+# health regressions fail tier-1 too
+test: lint bench-ec
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -46,6 +47,12 @@ chaos:
 
 bench:
 	python bench.py
+
+# seconds-long fixed-size encode through the full writeback plane (CPU
+# coder, tiny volumes): asserts the fill/compute/write overlap accounting
+# is sane and the writer pool drains — the encode-pipeline smoke gate
+bench-ec:
+	JAX_PLATFORMS=cpu python bench.py --ec-only
 
 smoke:
 	python bench.py --smoke

@@ -30,16 +30,21 @@ DEFAULT_BATCH = 32        # slabs per device call
 def encode_volume(dat_path: str, out_base: str, geo: EcGeometry,
                   coder: ErasureCoder, idx_path: str | None = None,
                   chunk: int = DEFAULT_CHUNK, batch: int = DEFAULT_BATCH,
+                  stats: "dict | None" = None,
+                  writers: "int | None" = None,
                   ) -> list[str]:
     """Produce .ec00..ec{n-1} (+ .ecx if idx_path given). Returns shard paths.
 
     Reference flow: VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:39)
     -> WriteEcFiles + WriteSortedFileFromIdx. Single-volume wrapper over the
-    streaming multi-volume pipeline (ec/stream.py).
+    streaming multi-volume pipeline (ec/stream.py); `stats` receives the
+    fill/dispatch/drain/write stage breakdown and `writers` sizes the
+    writeback plane.
     """
     from . import stream
     res = stream.encode_volumes([(dat_path, out_base, idx_path)], geo, coder,
-                                chunk=chunk, batch=batch)
+                                chunk=chunk, batch=batch, stats=stats,
+                                writers=writers)
     return res[dat_path]
 
 

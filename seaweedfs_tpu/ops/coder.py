@@ -132,6 +132,13 @@ _REGISTRY = {"numpy": NumpyCoder, "jax": JaxCoder, "pallas": PallasCoder}
 
 
 def get_coder(name: str, d: int, p: int) -> ErasureCoder:
+    if name not in _REGISTRY:
+        # self-registering implementations live in modules nobody has
+        # imported yet when a CLI asks for them by name
+        if name == "native":
+            from . import native  # noqa: F401 — registers "native"
+        elif name == "mesh":
+            from ..parallel import pipeline  # noqa: F401 — registers "mesh"
     try:
         cls = _REGISTRY[name]
     except KeyError:

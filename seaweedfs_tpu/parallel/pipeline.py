@@ -25,6 +25,11 @@ from ..ops import gf8
 from ..ops.crc32c import device_crc_states
 from ..ops.rs_jax import pack_bits, unpack_bits
 
+try:  # jax >= 0.4.31 exports it at top level; older trees ship experimental
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent import
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -57,7 +62,7 @@ def encode_sharded(mesh: Mesh, data: jax.Array, d: int, p: int) -> jax.Array:
                          preferred_element_type=jnp.int32)
         return pack_bits(acc & 1)  # [B_loc, rows_per, L]
 
-    fn = jax.shard_map(kernel, mesh=mesh,
+    fn = _shard_map(kernel, mesh=mesh,
                        in_specs=P("data", None, None),
                        out_specs=P("data", "shard", None))
     return fn(data)
@@ -104,7 +109,7 @@ def rebuild_sharded(mesh: Mesh, shards: jax.Array,
                          preferred_element_type=jnp.int32)
         return pack_bits(acc & 1)
 
-    fn = jax.shard_map(kernel, mesh=mesh,
+    fn = _shard_map(kernel, mesh=mesh,
                        in_specs=P("data", "shard", None),
                        out_specs=P("data", "shard", None))
     return fn(shards)
@@ -127,7 +132,7 @@ def scrub_sharded(mesh: Mesh, blocks: jax.Array, expected_states: jax.Array,
         bad = jnp.sum((states != exp).astype(jnp.int32))
         return jax.lax.psum(bad, ("data", "shard"))
 
-    fn = jax.shard_map(kernel, mesh=mesh,
+    fn = _shard_map(kernel, mesh=mesh,
                        in_specs=(P(("data", "shard"), None), P(("data", "shard"))),
                        out_specs=P())
     return fn(blocks, expected_states)
@@ -162,10 +167,22 @@ class MeshCoder:
             pad = _ceil_to(b, n_data) - b
             data = np.concatenate(
                 [data, np.zeros((pad,) + data.shape[1:], np.uint8)])
-            return encode_sharded(self.mesh, jnp.asarray(data),
+            return encode_sharded(self.mesh, self._put(data),
                                   self.d, self.p)[:b, :self.p, :]
-        return encode_sharded(self.mesh, jnp.asarray(data),
+        return encode_sharded(self.mesh, self._put(data),
                               self.d, self.p)[:, :self.p, :]
+
+    def _put(self, data) -> jax.Array:
+        """Host batch -> mesh, split along 'data' at transfer time.
+
+        An explicit NamedSharding device_put sends each device only its
+        B/n_data batch rows (parallel host->device DMA); a plain
+        jnp.asarray would land the whole array on one device and reshard
+        over the interconnect inside the jit."""
+        if isinstance(data, jax.Array):
+            return data
+        return jax.device_put(
+            data, NamedSharding(self.mesh, P("data", None, None)))
 
     def reconstruct(self, survivors, present, wanted):
         """survivors [B, d, L] = shard rows sorted(present)[:d]."""
@@ -178,3 +195,16 @@ class MeshCoder:
         rebuilt = rebuild_sharded(self.mesh, jnp.asarray(wiped), present,
                                   self.d, self.p)
         return rebuilt[:, list(wanted), :]
+
+
+def _all_device_mesh_coder(d: int, p: int) -> MeshCoder:
+    """Registry factory: MeshCoder over every visible device, so the volume
+    server CLI can ask for multi-chip encode with `-coder mesh` exactly like
+    any other coder name (ops.coder.get_coder lazily imports this module)."""
+    from .mesh import build_mesh
+    return MeshCoder(build_mesh(), d, p)
+
+
+from ..ops.coder import register_coder  # noqa: E402 — avoid cycle at import
+
+register_coder("mesh", _all_device_mesh_coder)

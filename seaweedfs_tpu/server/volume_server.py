@@ -31,6 +31,22 @@ from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 log = logger("volume")
 
 
+def _ec_stage_fields(stats: dict) -> dict:
+    """ec.encode.finish event fields from an encode pipeline stats dict:
+    the fill/dispatch/drain/write stage split plus the overlap fraction, so
+    /debug/events shows WHERE an encode spent its wall time without pulling
+    the trace."""
+    fields = {}
+    for key in ("fill_s", "dispatch_s", "coder_s", "drain_block_s",
+                "write_s", "write_block_s", "wall_s"):
+        if key in stats:
+            fields[key] = round(stats[key], 3)
+    for key in ("write_overlap", "writers", "batches", "mode"):
+        if key in stats:
+            fields[key] = stats[key]
+    return fields
+
+
 class VolumeServer:
     def __init__(self, store: Store, master_address: str,
                  ip: str = "127.0.0.1", port: int = 8080,
@@ -1288,10 +1304,12 @@ class VolumeServer:
             events.emit("ec.encode.start", vid=req.volume_id,
                         collection=req.collection, node=vs.url)
             t0 = time.perf_counter()
+            stats: dict = {}
             try:
                 store.generate_ec_shards(req.volume_id, req.collection,
                                          req.data_shards or None,
-                                         req.parity_shards or None)
+                                         req.parity_shards or None,
+                                         stats=stats)
             except Exception as e:  # noqa: BLE001
                 events.emit("ec.encode.finish", severity=events.ERROR,
                             vid=req.volume_id, node=vs.url, ok=False,
@@ -1299,16 +1317,31 @@ class VolumeServer:
                 raise
             events.emit("ec.encode.finish", vid=req.volume_id, node=vs.url,
                         ok=True,
-                        duration_ms=round((time.perf_counter() - t0) * 1e3, 1))
+                        duration_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                        **_ec_stage_fields(stats))
             return vpb.VolumeEcShardsGenerateResponse()
 
         @svc.unary("VolumeEcShardsGenerateBatch",
                    vpb.VolumeEcShardsGenerateBatchRequest,
                    vpb.VolumeEcShardsGenerateBatchResponse)
         def ec_generate_batch(req, context):
-            done = store.generate_ec_shards_batch(
-                list(req.volume_ids), req.collection,
-                req.data_shards or None, req.parity_shards or None)
+            from ..ops import events
+            t0 = time.perf_counter()
+            stats: dict = {}
+            try:
+                done = store.generate_ec_shards_batch(
+                    list(req.volume_ids), req.collection,
+                    req.data_shards or None, req.parity_shards or None,
+                    stats=stats)
+            except Exception as e:  # noqa: BLE001
+                events.emit("ec.encode.finish", severity=events.ERROR,
+                            node=vs.url, ok=False,
+                            vids=list(req.volume_ids), error=str(e))
+                raise
+            events.emit("ec.encode.finish", node=vs.url, ok=True,
+                        vids=list(done),
+                        duration_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                        **_ec_stage_fields(stats))
             return vpb.VolumeEcShardsGenerateBatchResponse(
                 encoded_volume_ids=done,
                 data_shards=req.data_shards or store.ec_geometry.d,

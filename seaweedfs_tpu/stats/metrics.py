@@ -301,6 +301,21 @@ EC_ENCODE_BYTES = _counter(
     "SeaweedFS_ec_encode_bytes_total", "bytes EC-encoded", ("coder",))
 EC_REBUILD_BYTES = _counter(
     "SeaweedFS_ec_rebuild_bytes_total", "bytes EC-rebuilt", ("coder",))
+# EC encode pipeline stage breakdown (ec/stream.py): per encode_volumes
+# call, seconds spent filling host batches, dispatching to the coder,
+# blocked draining device results, and inside writer-pool pwrites. write
+# >> the others with low write_overlap on the span means the writeback
+# plane — not the coder — bounds the encode. Exemplar-linked to the
+# ec.encode trace via the shared Histogram plumbing.
+EC_PIPELINE_SECONDS = _histogram(
+    "SeaweedFS_ec_pipeline_seconds",
+    "EC encode pipeline stage seconds per encode_volumes call",
+    ("stage",),
+    buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0, 300.0))
+EC_WRITER_QUEUE_DEPTH = _gauge(
+    "SeaweedFS_ec_writer_queue_depth",
+    "shard-write runs queued to the EC writeback writer pool")
 # Mesh divergence: events a filer could not apply from a peer after
 # retries (operators should alarm on any non-zero rate).
 FILER_AGGR_DEAD_LETTERS = _counter(

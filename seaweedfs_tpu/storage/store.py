@@ -184,7 +184,8 @@ class Store:
 
     # -- EC operations (reference volume_grpc_erasure_coding.go) -----------
     def generate_ec_shards(self, vid: int, collection: str = "",
-                           d: int | None = None, p: int | None = None) -> str:
+                           d: int | None = None, p: int | None = None,
+                           stats: "dict | None" = None) -> str:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
@@ -194,11 +195,12 @@ class Store:
         v.sync()
         base = v.file_name()
         encode_volume(base + ".dat", base, geo, self.coder(geo.d, geo.p),
-                      idx_path=base + ".idx")
+                      idx_path=base + ".idx", stats=stats)
         return base
 
     def generate_ec_shards_batch(self, vids: "list[int]", collection: str = "",
                                  d: int | None = None, p: int | None = None,
+                                 stats: "dict | None" = None,
                                  ) -> "list[int]":
         """Encode many local volumes through ONE shared device stream.
 
@@ -224,7 +226,8 @@ class Store:
             jobs.append((base + ".dat", base, base + ".idx"))
             done.append(vid)
         if jobs:
-            stream.encode_volumes(jobs, geo, self.coder(geo.d, geo.p))
+            stream.encode_volumes(jobs, geo, self.coder(geo.d, geo.p),
+                                  stats=stats)
         return done
 
     def mount_ec_shards(self, vid: int, collection: str = "") -> EcVolume:
