@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest smoke protos lint metrics-lint swtpu-lint
 
-# lint and the EC pipeline smoke run FIRST so a concurrency-rule,
-# exposition-grammar, or encode-pipeline regression fails the default
-# path before the suite spends minutes; the suite itself includes the
-# cluster.check-against-mini-cluster smoke (tests/test_health.py) so
-# health regressions fail tier-1 too
-test: lint bench-ec
+# lint and the EC pipeline + bulk-ingest smokes run FIRST so a
+# concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
+# regression fails the default path before the suite spends minutes; the
+# suite itself includes the cluster.check-against-mini-cluster smoke
+# (tests/test_health.py) so health regressions fail tier-1 too
+test: lint bench-ec bench-ingest
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -53,6 +53,13 @@ bench:
 # is sane and the writer pool drains — the encode-pipeline smoke gate
 bench-ec:
 	JAX_PLATFORMS=cpu python bench.py --ec-only
+
+# seconds-long bulk-ingest smoke on a separate-process cluster: fid-range
+# leases + framed /bulk PUTs at small N, asserting zero errors, bulk
+# frames observed on the volume server, and the master's
+# SeaweedFS_fid_leases_active gauge draining back to 0
+bench-ingest:
+	JAX_PLATFORMS=cpu python bench.py --ingest-only
 
 smoke:
 	python bench.py --smoke

@@ -52,6 +52,11 @@ def run(argv=None) -> dict:
     p.add_argument("-collection", default="benchmark")
     p.add_argument("-write", action="store_true", default=True)
     p.add_argument("-read", action="store_true", default=True)
+    p.add_argument("-bulk", action="store_true", default=False,
+                   help="batched ingest: fid-range leases + framed "
+                        "/bulk PUTs instead of per-needle assign+PUT")
+    p.add_argument("-batch", type=int, default=256,
+                   help="needles per submit_batch call in -bulk mode")
     opt = p.parse_args(argv)
 
     mc = MasterClient(opt.master, http_address=opt.masterHttp).start()
@@ -63,6 +68,12 @@ def run(argv=None) -> dict:
     write_lat: list[float] = []
     read_lat: list[float] = []
     errors = [0]
+
+    # ONE allocator shared by every writer thread: that sharing is the
+    # control-plane amortization under test (disjoint ranges per take)
+    from .client.master_client import FidLeaseAllocator
+    alloc = FidLeaseAllocator(mc, collection=opt.collection,
+                              lease_count=max(4096, 4 * opt.batch))
 
     def writer(k: int):
         local_lat = []
@@ -76,6 +87,27 @@ def run(argv=None) -> dict:
             except Exception:  # noqa: BLE001
                 errors[0] += 1
             local_lat.append(time.perf_counter() - t0)
+        with fid_lock:
+            write_lat.extend(local_lat)
+
+    def bulk_writer(k: int):
+        # latencies are PER BATCH (one submit_batch = one+ framed PUTs);
+        # rps stays per needle so bulk and per-op runs compare directly
+        local_lat = []
+        done = 0
+        while done < k:
+            n = min(opt.batch, k - done)
+            t0 = time.perf_counter()
+            try:
+                res = operation.submit_batch(
+                    mc, [payload] * n, collection=opt.collection,
+                    allocator=alloc, retries=2)
+                with fid_lock:
+                    fids.extend(r.fid for r in res)
+            except Exception:  # noqa: BLE001
+                errors[0] += n
+            local_lat.append(time.perf_counter() - t0)
+            done += n
         with fid_lock:
             write_lat.extend(local_lat)
 
@@ -98,21 +130,32 @@ def run(argv=None) -> dict:
 
     results = {}
     per_worker = opt.n // opt.c
-    print(f"writing {opt.n} x {opt.size}B files, concurrency {opt.c} ...")
+    mode = f"bulk (batch {opt.batch})" if opt.bulk else "per-needle"
+    print(f"writing {opt.n} x {opt.size}B files, concurrency {opt.c}, "
+          f"{mode} ...")
     t0 = time.perf_counter()
-    threads = [threading.Thread(target=writer, args=(per_worker,))
+    threads = [threading.Thread(target=bulk_writer if opt.bulk else writer,
+                                args=(per_worker,))
                for _ in range(opt.c)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wdt = time.perf_counter() - t0
+    wrote = len(fids)
     results["write"] = {
-        "requests": len(write_lat), "seconds": wdt,
-        "rps": len(write_lat) / wdt,
-        "MBps": len(write_lat) * opt.size / wdt / 1e6,
+        # requests = needles written; in bulk mode the latency
+        # percentiles are per BATCH (what one client call experiences)
+        "requests": wrote if opt.bulk else len(write_lat),
+        "seconds": wdt,
+        "rps": (wrote if opt.bulk else len(write_lat)) / wdt,
+        "MBps": (wrote if opt.bulk else len(write_lat))
+        * opt.size / wdt / 1e6,
         **_percentiles(write_lat),
     }
+    if opt.bulk:
+        results["write"]["batch"] = opt.batch
+        results["write"]["leases"] = alloc.leases_taken
     print(f"  write: {results['write']['rps']:.1f} req/s "
           f"avg {results['write']['avg_ms']:.1f} ms "
           f"p99 {results['write']['p99_ms']:.1f} ms")

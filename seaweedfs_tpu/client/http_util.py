@@ -23,7 +23,17 @@ import uuid
 
 from .. import tracing
 from ..utils import failpoints, retry
+from ..utils.env import env_float, env_int
 from ..utils.fastweb import Headers  # shared case-insensitive header dict
+
+# Keep-alive pool hygiene: without caps a long-lived bulk-ingest client
+# pins one socket per (thread, host) forever — stale after a volume
+# server restart (first request eats a reconnect) and unbounded across
+# wide topologies. Age/idle limits recycle sockets proactively; the
+# per-thread connection cap evicts the least-recently-used host.
+POOL_MAX_IDLE_S = env_float("SWTPU_HTTP_POOL_IDLE_S", 60.0)
+POOL_MAX_AGE_S = env_float("SWTPU_HTTP_POOL_MAX_AGE_S", 600.0)
+POOL_MAX_CONNS = max(1, env_int("SWTPU_HTTP_POOL_CONNS", 32))
 
 
 class Response:
@@ -44,7 +54,7 @@ class Response:
 
 
 class _Conn:
-    __slots__ = ("sock", "rfile", "used")
+    __slots__ = ("sock", "rfile", "used", "created", "last_used")
 
     def __init__(self, netloc: str, timeout: float):
         host, _, port = netloc.rpartition(":")
@@ -54,6 +64,7 @@ class _Conn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb", buffering=1 << 16)
         self.used = 0  # requests served; >0 = reused pool connection
+        self.created = self.last_used = time.monotonic()
 
     def close(self) -> None:
         try:
@@ -73,12 +84,34 @@ def _conn(netloc: str, timeout: float) -> _Conn:
     pool = getattr(_local, "pool", None)
     if pool is None:
         pool = _local.pool = {}
+    now = time.monotonic()
     c = pool.get(netloc)
+    if c is not None and (now - c.created > POOL_MAX_AGE_S
+                          or now - c.last_used > POOL_MAX_IDLE_S):
+        # proactive recycle: an aged/idle socket is likely half-dead
+        # (server restarted, LB idle-closed) — paying a fresh dial here
+        # beats a send-then-_Stale round trip on the next request
+        pool.pop(netloc, None)
+        c.close()
+        c = None
     if c is None:
         c = _Conn(netloc, timeout)
         pool[netloc] = c
+        while len(pool) > POOL_MAX_CONNS:
+            # cap the per-thread pool: evict least-recently-used OTHER
+            # hosts so wide-topology clients don't hoard sockets (loop:
+            # a lowered cap must shrink an over-full pool, not trail it)
+            victim = min((k for k in pool if k != netloc),
+                         key=lambda k: pool[k].last_used)
+            pool.pop(victim).close()
     else:
         c.sock.settimeout(timeout)
+        try:
+            from ..stats import HTTP_POOL_REUSE
+            HTTP_POOL_REUSE.inc()
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break IO)
+            pass
+    c.last_used = now
     return c
 
 

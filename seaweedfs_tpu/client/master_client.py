@@ -13,10 +13,117 @@ import time
 from ..pb import master_pb2 as pb
 from ..storage.types import parse_file_id
 from ..utils import retry
+from ..utils.env import env_float, env_int
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, Stub
 
 log = logger("wdclient")
+
+# fid-range lease client defaults: how many keys one master round-trip
+# reserves (the assign amortization factor) and how long the client
+# trusts a lease when the master didn't advertise a TTL (the gRPC
+# AssignResponse carries no TTL field; HTTP /dir/assign does). The
+# client default sits UNDER the master's 60 s default so a clockless
+# client never writes on a lease whose range token just expired.
+DEFAULT_LEASE_COUNT = env_int("SWTPU_FID_LEASE_COUNT", 4096)
+DEFAULT_CLIENT_LEASE_TTL_S = env_float("SWTPU_FID_LEASE_CLIENT_TTL_S", 30.0)
+
+
+class FidLease:
+    """One leased contiguous fid range on one volume: keys
+    [next_key, end_key) sharing a single cookie and (when security is
+    on) a single range-scoped write JWT. Allocation via take() is local
+    arithmetic — zero master round-trips. NOT thread-safe on its own;
+    FidLeaseAllocator serializes access."""
+
+    __slots__ = ("vid", "next_key", "end_key", "cookie", "url",
+                 "public_url", "auth", "expires_at", "collection")
+
+    def __init__(self, vid: int, first_key: int, count: int, cookie: int,
+                 url: str, public_url: str, auth: str, ttl_s: float,
+                 collection: str = ""):
+        self.vid = vid
+        self.next_key = first_key
+        self.end_key = first_key + count
+        self.cookie = cookie
+        self.url = url
+        self.public_url = public_url
+        self.auth = auth
+        self.expires_at = time.monotonic() + ttl_s
+        self.collection = collection
+
+    def remaining(self) -> int:
+        return max(0, self.end_key - self.next_key)
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+    def take(self, n: int) -> tuple[int, int]:
+        """(start_key, got) — up to n keys off the front of the range.
+        Taken keys are NEVER handed out again, even if the write they
+        fed fails: fid uniqueness beats key thrift."""
+        got = min(n, self.remaining())
+        start = self.next_key
+        self.next_key += got
+        return start, got
+
+    def fid(self, key: int) -> str:
+        from ..storage.types import file_id
+        return file_id(self.vid, key, self.cookie)
+
+
+class FidLeaseAllocator:
+    """Thread-safe local fid source for bulk ingest: hands out keys from
+    the current lease and transparently re-leases (one master assign)
+    when the range is exhausted, expired, or discarded after a failed
+    bulk write. One allocator is meant to be SHARED across writer
+    threads — that is what amortizes the master round-trip N-fold."""
+
+    def __init__(self, mc: "MasterClient", lease_count: int | None = None,
+                 collection: str = "", replication: str = "", ttl: str = "",
+                 disk_type: str = "", lease_ttl_s: float | None = None):
+        self.mc = mc
+        self.lease_count = lease_count or DEFAULT_LEASE_COUNT
+        self.collection = collection
+        self.replication = replication
+        self.ttl = ttl
+        self.disk_type = disk_type
+        # explicit override (tests/chaos force mid-stream expiry);
+        # None = trust the master's advertised TTL, capped by the
+        # conservative client default
+        self.lease_ttl_s = lease_ttl_s
+        self.leases_taken = 0  # re-lease round-trips performed
+        self._lease: FidLease | None = None
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> tuple[FidLease, int, int]:
+        """(lease, start_key, got): up to n contiguous fids, all on the
+        lease's volume. got < n near a range boundary — callers loop."""
+        with self._lock:
+            lease = self._lease
+            if lease is None or lease.expired() or not lease.remaining():
+                lease = self._lease = self._relet(n)
+            start, got = lease.take(n)
+            return lease, start, got
+
+    def discard(self, lease: FidLease) -> None:
+        """Drop a lease after a failed bulk write: the attempted fids
+        are burned (a partial landing is possible), and the NEXT take
+        re-leases against live topology instead of re-targeting a
+        possibly-dead volume. Un-taken keys simply go unused — the
+        sequencer never reissues them, so uniqueness holds."""
+        with self._lock:
+            if self._lease is lease:
+                self._lease = None
+
+    def _relet(self, want: int) -> FidLease:
+        count = max(self.lease_count, want)
+        lease = self.mc.lease_fids(
+            count, collection=self.collection,
+            replication=self.replication, ttl=self.ttl,
+            disk_type=self.disk_type, lease_ttl_s=self.lease_ttl_s)
+        self.leases_taken += 1
+        return lease
 
 
 class _HttpAssignRejected(Exception):
@@ -84,6 +191,11 @@ class MasterClient:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._connected = threading.Event()
+        # per-thread side-channel: the HTTP assign body carries lease
+        # fields (leaseTtlS) the pb.AssignResponse cannot (frozen proto);
+        # _assign_http stashes them here for lease_fids to read back on
+        # the same thread right after the assign returns
+        self._tl = threading.local()
 
     # -- background vid-map subscription ------------------------------------
     def start(self) -> "MasterClient":
@@ -360,7 +472,46 @@ class MasterClient:
                                  auth=body.get("auth", ""))
         resp.location.url = body.get("url", "")
         resp.location.public_url = body.get("publicUrl", "")
+        self._tl.lease_ttl = float(body.get("leaseTtlS") or 0.0)
         return resp
+
+    def lease_fids(self, count: int, collection: str = "",
+                   replication: str = "", ttl: str = "",
+                   disk_type: str = "",
+                   lease_ttl_s: float | None = None) -> FidLease:
+        """Lease a contiguous fid range: one assign(count=N) round-trip
+        whose response already IS the lease (fid encodes vid/first key/
+        cookie, count is the width, auth is the range-scoped JWT when
+        security is on). The client-side expiry is the master-advertised
+        TTL minus a safety margin, capped by the conservative client
+        default; `lease_ttl_s` overrides (chaos forces mid-stream
+        expiry with it)."""
+        self._tl.lease_ttl = 0.0
+        resp = self.assign(count=count, collection=collection,
+                           replication=replication, ttl=ttl,
+                           disk_type=disk_type)
+        vid, key, cookie = parse_file_id(resp.fid)
+        if lease_ttl_s is not None:
+            eff_ttl = lease_ttl_s
+        else:
+            advertised = getattr(self._tl, "lease_ttl", 0.0)
+            eff_ttl = DEFAULT_CLIENT_LEASE_TTL_S
+            if advertised:
+                # 10% safety margin against clock/wire skew
+                eff_ttl = min(eff_ttl, max(1.0, advertised * 0.9))
+            if resp.auth:
+                # the gRPC assign carries no TTL field, but the range
+                # token's own exp is authoritative — never outlive it,
+                # or every frame past exp 401s on an "expired" lease
+                # the client still trusts
+                from ..security.jwt import peek_claims
+                exp = peek_claims(resp.auth).get("exp")
+                if exp:
+                    remain = float(exp) - time.time()  # swtpu-lint: disable=wallclock-duration (jwt exp IS wall time; the server compares it against wall clock too)
+                    eff_ttl = min(eff_ttl, max(1.0, remain * 0.9))
+        return FidLease(vid, key, int(resp.count) or count, cookie,
+                        resp.location.url, resp.location.public_url,
+                        resp.auth, eff_ttl, collection=collection)
 
     def lookup(self, vid: int) -> list[dict]:
         cached = self.vid_map.get(vid)

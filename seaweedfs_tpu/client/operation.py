@@ -13,10 +13,18 @@ import time
 from dataclasses import dataclass
 
 from .. import tracing
-from ..storage.types import parse_file_id
+from ..storage.types import file_id, parse_file_id
 from ..utils import failpoints, retry
+from ..utils.env import env_int
 from . import http_util
-from .master_client import MasterClient
+from .master_client import FidLeaseAllocator, MasterClient
+
+# Per-frame packing caps for submit_batch: enough needles to amortize
+# the PUT protocol to noise, small enough that one frame stays far
+# under the volume server's 256 MB body cap and a retry re-sends
+# megabytes, not the whole batch.
+BULK_MAX_FRAME_NEEDLES = env_int("SWTPU_BULK_FRAME_NEEDLES", 1024)
+BULK_MAX_FRAME_BYTES = env_int("SWTPU_BULK_FRAME_BYTES", 8 << 20)
 
 
 @dataclass
@@ -111,6 +119,125 @@ def submit(mc: MasterClient, data: bytes, name: str = "", mime: str = "",
         except Exception as e:
             raise RuntimeError(f"submit failed after {retries} tries: {e}") \
                 from e
+
+
+def submit_batch(mc: MasterClient, payloads: "list[bytes]",
+                 collection: str = "", replication: str = "", ttl: str = "",
+                 allocator: "FidLeaseAllocator | None" = None,
+                 retries: int = 3) -> "list[UploadResult]":
+    """Bulk ingest: lease fid ranges and pack many needles per PUT.
+
+    Where submit() pays one master assign + one HTTP PUT per needle,
+    this path takes fids from a FidLeaseAllocator (one assign per
+    SWTPU_FID_LEASE_COUNT keys) and ships each contiguous run as ONE
+    framed /bulk request over the keep-alive pool — the control plane
+    amortizes to ~1/N of the per-needle cost and the volume server
+    appends the whole frame under a single lock + fsync.
+
+    A failed frame retries with FRESH fids (the attempted range may
+    have partially landed on some replica — reusing it could alias two
+    payloads under one fid); the failing lease is discarded so the
+    retry re-leases against live topology. The retry budget is
+    PER FRAME — `failures` resets and the deadline re-arms on every
+    frame success — so a batch that streams for minutes survives
+    unrelated transient hiccups; only `retries` consecutive frame
+    failures (or one frame exceeding the write deadline) raise.
+    Needles acked before a raise are durable but unreported, like any
+    partially-failed batch API.
+    """
+    if not payloads:
+        return []
+    if allocator is not None:
+        # placement/expiry come from the allocator's leases — an
+        # explicit arg that CONTRADICTS it would be silently ignored
+        # (needles land without the requested redundancy/ttl), so
+        # conflicts are errors and blanks inherit the allocator's
+        for name, ours, theirs in (("collection", collection,
+                                    allocator.collection),
+                                   ("replication", replication,
+                                    allocator.replication),
+                                   ("ttl", ttl, allocator.ttl)):
+            if ours and ours != theirs:
+                raise ValueError(
+                    f"submit_batch {name}={ours!r} conflicts with the "
+                    f"allocator's {name}={theirs!r} — leases are placed "
+                    f"with the allocator's settings")
+        ttl = ttl or allocator.ttl
+    alloc = allocator or FidLeaseAllocator(
+        mc, collection=collection, replication=replication, ttl=ttl)
+    results: "list[UploadResult]" = []
+    pol = retry.WRITE_POLICY
+    stop_at = time.monotonic() + pol.deadline
+    frames = 0
+    failures = 0
+    with tracing.start_span(
+            "client.submit_batch", component="client",
+            attrs={"needles": len(payloads),
+                   "bytes": sum(len(p) for p in payloads),
+                   "collection": collection}) as sp:
+        idx = 0
+        while idx < len(payloads):
+            failpoints.check("client.bulk.submit")
+            # frame sizing: cap by needle count AND payload bytes so one
+            # frame never balloons past the server's body limit (at
+            # least one needle always ships, however large)
+            want, budget = 0, BULK_MAX_FRAME_BYTES
+            for p in payloads[idx:idx + BULK_MAX_FRAME_NEEDLES]:
+                if want and len(p) > budget:
+                    break
+                budget -= len(p)
+                want += 1
+            lease, start, got = alloc.take(want)
+            chunk = payloads[idx:idx + got]
+            from ..storage import bulk as bulk_frame
+            frame = bulk_frame.pack_frame(
+                lease.vid,
+                [(start + i, lease.cookie, data, 0)
+                 for i, data in enumerate(chunk)])
+            target = lease.public_url or lease.url
+            params: dict = {"vid": lease.vid}
+            if ttl:
+                params["ttl"] = ttl
+            if lease.auth:
+                params["jwt"] = lease.auth
+            try:
+                r = http_util.request("PUT", f"http://{target}/bulk",
+                                      body=frame, params=params)
+                if not r.ok:
+                    raise RuntimeError(f"bulk put to {target}: HTTP "
+                                       f"{r.status} {r.content[:200]!r}")
+            except Exception as e:  # noqa: BLE001
+                alloc.discard(lease)
+                failures += 1
+                delay = pol.backoff(failures)
+                if (failures >= retries
+                        or time.monotonic() + delay > stop_at
+                        or not retry.BUDGET.withdraw()):
+                    sp.set_error(e)
+                    raise RuntimeError(
+                        f"submit_batch failed after {failures} tries at "
+                        f"needle {idx}/{len(payloads)}: {e}") from e
+                from ..stats import RETRY_ATTEMPTS
+                RETRY_ATTEMPTS.inc("client.submit_batch")
+                tracing.add_event("retry", op="client.submit_batch",
+                                  attempt=failures, target=target,
+                                  delay_ms=round(delay * 1e3, 2),
+                                  error=str(e)[:200])
+                time.sleep(delay)
+                continue
+            etags = r.json().get("eTags", [])
+            results.extend(
+                UploadResult(fid=file_id(lease.vid, start + i, lease.cookie),
+                             url=target, size=len(data),
+                             e_tag=etags[i] if i < len(etags) else "")
+                for i, data in enumerate(chunk))
+            idx += got
+            frames += 1
+            failures = 0  # per-frame budget: a landed frame clears it
+            stop_at = time.monotonic() + pol.deadline
+        sp.set_attr("frames", frames)
+        sp.set_attr("leases", alloc.leases_taken)
+    return results
 
 
 def read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:

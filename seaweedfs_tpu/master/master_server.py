@@ -97,6 +97,12 @@ class MasterServer:
         # Heartbeats don't carry RS(k,m), so the engine derives k from
         # each volume's observed stripe width minus the configured
         # parity count (fork default RS(14,2)).
+        # Fid-range leases (batched ingest): Assign(count=N) is a lease —
+        # the registry tracks outstanding grants for the
+        # SeaweedFS_fid_leases_active gauge and supplies the TTL the
+        # HTTP assign response advertises / the range JWT expires at.
+        from .lease import FidLeaseRegistry
+        self.fid_leases = FidLeaseRegistry()
         from .health import DEFAULT_PARITY_SHARDS, HealthEngine
         self.health = HealthEngine(
             self.topo,
@@ -333,11 +339,26 @@ class MasterServer:
                     sp.set_error(resp.error)
                     return json_response({"error": resp.error}, status=406)
                 sp.set_attr("fid", resp.fid)
-                return json_response({
+                body = {
                     "fid": resp.fid, "count": resp.count,
                     "url": resp.location.url,
                     "publicUrl": resp.location.public_url,
-                    "auth": resp.auth})
+                    "auth": resp.auth}
+                if resp.count > 1:
+                    # fid-range lease: spell the range out so clients
+                    # need no fid arithmetic of their own — first key as
+                    # hex (snowflake keys overflow JSON float precision),
+                    # the shared cookie, the advertised TTL, and the
+                    # replica set the lease's volume lives on
+                    from ..storage.types import parse_file_id
+                    vid, key, cookie = parse_file_id(resp.fid)
+                    body.update({
+                        "keyHex": f"{key:x}", "cookie": cookie,
+                        "leaseTtlS": ms.fid_leases.ttl_s,
+                        "replicas": [{"url": r.url,
+                                      "publicUrl": r.public_url}
+                                     for r in resp.replicas]})
+                return json_response(body)
 
         def cluster_status(req, q):
             return json_response({
@@ -867,10 +888,30 @@ class MasterServer:
         for n in nodes:
             resp.replicas.add(url=n.url, public_url=n.public_url,
                               grpc_port=n.grpc_port)
+        lease_ttl = 0.0
+        if count > 1:
+            # a multi-count assign IS a fid-range lease: the sequencer
+            # reserved [key, key+count) above; record the grant so the
+            # leases-active gauge reflects outstanding ingest ranges
+            lease_ttl = self.fid_leases.grant(count)
         if self.guard is not None and self.guard.signing_key:
-            from ..security import gen_jwt_for_volume_server
-            resp.auth = gen_jwt_for_volume_server(
-                self.guard.signing_key, self.guard.expires_after_sec, resp.fid)
+            if count > 1:
+                # range-scoped token: ONE signature authorizes all N
+                # needles of the lease (per-fid minting at bulk rates
+                # would put the master back on the per-needle hot path).
+                # exp IS the lease TTL — the token is what makes the
+                # TTL real (lease.py contract), so a short lease must
+                # mean a short token, never floored by the guard expiry
+                from ..security import gen_jwt_for_fid_range
+                resp.auth = gen_jwt_for_fid_range(
+                    self.guard.signing_key,
+                    max(1, int(lease_ttl)),
+                    vid, key, count, cookie)
+            else:
+                from ..security import gen_jwt_for_volume_server
+                resp.auth = gen_jwt_for_volume_server(
+                    self.guard.signing_key, self.guard.expires_after_sec,
+                    resp.fid)
         return resp
 
     # -- topology dump -------------------------------------------------------
@@ -919,6 +960,8 @@ class MasterServer:
         while not self._stop.wait(self.pulse_seconds):
             for lo in self.layouts.all_layouts():
                 lo.ensure_correct_writables()
+            # decay the leases-active gauge even when no assigns arrive
+            self.fid_leases.prune()
             try:
                 # per-tick health scan keeps the at-risk gauges live for
                 # scrapers and journals severity transitions as they

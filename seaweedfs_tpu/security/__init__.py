@@ -9,18 +9,24 @@ IP white list and basic auth, checked in that order (guard.go:27-28).
 
 from .jwt import (
     gen_jwt_for_volume_server,
+    gen_jwt_for_fid_range,
     gen_jwt_for_filer_server,
     decode_jwt,
     jwt_from_request,
+    parse_range_claim,
+    range_covers_fid,
     JwtError,
 )
 from .guard import Guard
 
 __all__ = [
     "gen_jwt_for_volume_server",
+    "gen_jwt_for_fid_range",
     "gen_jwt_for_filer_server",
     "decode_jwt",
     "jwt_from_request",
+    "parse_range_claim",
+    "range_covers_fid",
     "JwtError",
     "Guard",
 ]

@@ -74,33 +74,73 @@ class Guard:
             return False
         return user == self.username and pw == self.password
 
+    def _admit_write(self, remote_ip: str, query: dict, headers,
+                     ) -> "tuple[bool | None, str, dict]":
+        """Shared write-admission preamble (guard.go:27-28 ordering:
+        write-active, whitelist, basic auth, then jwt). Returns
+        (decision, reason, claims): decision True/False is final;
+        None means 'token decoded OK — caller applies its scope check
+        on claims'."""
+        if not self.is_write_active:
+            return True, "", {}
+        if self.white_listed(remote_ip):
+            return True, "", {}
+        if self.basic_auth_ok(headers):
+            return True, "", {}
+        if not self.signing_key:
+            return False, "not in white list", {}
+        token = _jwt.jwt_from_request(query, headers)
+        if not token:
+            return False, "missing jwt", {}
+        try:
+            return None, "", _jwt.decode_jwt(token, self.signing_key)
+        except _jwt.JwtError as e:
+            return False, str(e), {}
+
     def check_write(self, remote_ip: str, query: dict, headers,
                     fid: str = "") -> tuple[bool, str]:
         """Gate a mutating request. Returns (allowed, reason)."""
-        if not self.is_write_active:
-            return True, ""
-        if self.white_listed(remote_ip):
-            return True, ""
-        if self.basic_auth_ok(headers):
-            return True, ""
-        if self.signing_key:
-            token = _jwt.jwt_from_request(query, headers)
-            if not token:
-                return False, "missing jwt"
-            try:
-                claims = _jwt.decode_jwt(token, self.signing_key)
-            except _jwt.JwtError as e:
-                return False, str(e)
-            # The master scopes write tokens to one file id (jwt.go:18-21)
-            # and the volume server demands an EXACT match
-            # (volume_server_handlers.go:199) — an empty claimed fid must
-            # NOT act as a wildcard on fid-scoped checks, else any
-            # filer-style token doubles as a write-everything pass.
-            claimed = claims.get("fid", "")
-            if fid and claimed != fid:
-                return False, "jwt fid mismatch"
-            return True, ""
-        return False, "not in white list"
+        decision, why, claims = self._admit_write(remote_ip, query, headers)
+        if decision is not None:
+            return decision, why
+        # The master scopes write tokens to one file id (jwt.go:18-21)
+        # and the volume server demands an EXACT match
+        # (volume_server_handlers.go:199) — an empty claimed fid must
+        # NOT act as a wildcard on fid-scoped checks, else any
+        # filer-style token doubles as a write-everything pass. A
+        # range token (fid-range lease, jwt.py gen_jwt_for_fid_range)
+        # is accepted for any fid INSIDE its leased range, so leased
+        # clients can also issue plain per-needle PUTs.
+        if fid and "rng" in claims:
+            if _jwt.range_covers_fid(claims, fid):
+                return True, ""
+            return False, "jwt fid outside leased range"
+        claimed = claims.get("fid", "")
+        if fid and claimed != fid:
+            return False, "jwt fid mismatch"
+        return True, ""
+
+    def check_bulk(self, remote_ip: str, query: dict, headers, vid: int,
+                   keys, cookie: int) -> tuple[bool, str]:
+        """Gate one bulk-PUT frame with a SINGLE token validation: the
+        range token must cover every needle key in the frame (all share
+        one cookie by lease construction). Admission ordering is
+        check_write's, via the shared preamble."""
+        decision, why, claims = self._admit_write(remote_ip, query, headers)
+        if decision is not None:
+            return decision, why
+        rng = _jwt.parse_range_claim(claims)
+        if rng is None:
+            return False, "bulk write requires a range jwt"
+        r_vid, r_start, r_count, r_cookie = rng
+        if r_vid != vid:
+            return False, "jwt vid mismatch"
+        if r_cookie != cookie:
+            return False, "jwt cookie mismatch"
+        lo, hi = min(keys), max(keys)
+        if lo < r_start or hi >= r_start + r_count:
+            return False, "jwt fid outside leased range"
+        return True, ""
 
     def check_read(self, remote_ip: str, query: dict, headers,
                    fid: str = "") -> tuple[bool, str]:

@@ -83,6 +83,66 @@ def gen_jwt_for_volume_server(signing_key: str | bytes,
     return encode(claims, signing_key)
 
 
+def gen_jwt_for_fid_range(signing_key: str | bytes,
+                          expires_after_sec: int, vid: int,
+                          start_key: int, count: int, cookie: int) -> str:
+    """Range-scoped write token for a fid-range lease (TPU extension;
+    the reference's Assign(count=N) still mints a single-fid token,
+    master_grpc_server_assign.go). One signature covers the whole leased
+    key range [start_key, start_key+count) on `vid`, so a bulk client
+    can write N needles without N master-minted tokens. Claim layout:
+    `rng = "<vid>,<start_hex>,<count>,<cookie_hex>"` — hex keys avoid
+    any JSON big-int precision questions for snowflake-sized keys."""
+    if not signing_key:
+        return ""
+    claims: dict = {"rng": f"{vid},{start_key:x},{count},{cookie:08x}"}
+    if expires_after_sec > 0:
+        claims["exp"] = int(time.time()) + expires_after_sec
+    return encode(claims, signing_key)
+
+
+def parse_range_claim(claims: dict) -> "tuple[int, int, int, int] | None":
+    """(vid, start_key, count, cookie) from a range token's claims, or
+    None when the token carries no (or a malformed) `rng` claim."""
+    rng = claims.get("rng", "")
+    if not rng:
+        return None
+    try:
+        vid_s, start_s, count_s, cookie_s = rng.split(",")
+        return int(vid_s), int(start_s, 16), int(count_s), int(cookie_s, 16)
+    except ValueError:
+        return None
+
+
+def range_covers_fid(claims: dict, fid: str) -> bool:
+    """True when the token's leased range covers `fid` (vid, key within
+    [start, start+count), cookie equal)."""
+    rng = parse_range_claim(claims)
+    if rng is None:
+        return False
+    vid, start, count, cookie = rng
+    # one fid grammar for the whole tree (lazy: keep this module
+    # importable without the storage package on the path)
+    from ..storage.types import parse_file_id
+    try:
+        f_vid, f_key, f_cookie = parse_file_id(fid)
+    except ValueError:
+        return False
+    return (f_vid == vid and f_cookie == cookie
+            and start <= f_key < start + count)
+
+
+def peek_claims(token: str) -> dict:
+    """UNVERIFIED claims decode — for a client reading its OWN token's
+    exp/rng (e.g. deriving a lease TTL from the range JWT the master
+    minted when the transport carried no TTL field). Never use for
+    authorization: the signature is not checked."""
+    try:
+        return json.loads(_unb64url(token.split(".")[1]))
+    except Exception:  # noqa: BLE001 — opaque/foreign token: no claims
+        return {}
+
+
 def gen_jwt_for_filer_server(signing_key: str | bytes,
                              expires_after_sec: int) -> str:
     """Filer-API token used by gateways (jwt.go:53 GenJwtForFilerServer)."""

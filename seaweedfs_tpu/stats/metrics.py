@@ -371,6 +371,21 @@ REPAIRS_TOTAL = _counter(
     "SeaweedFS_repairs_total",
     "repair executions by action and result (ok/error/skipped)",
     ("action", "result"))
+# Batched ingest plane (fid-range leases + bulk PUT): outstanding leases
+# on the master (a drained system reads 0 — the bench-ingest smoke
+# asserts it), the per-frame batching the /bulk handler actually sees
+# (low percentiles = clients not amortizing), and client keep-alive
+# pool reuse (a bulk workload should reuse ~every request).
+FID_LEASES_ACTIVE = _gauge(
+    "SeaweedFS_fid_leases_active",
+    "fid-range leases granted by this master and not yet expired")
+BULK_PUT_NEEDLES = _histogram(
+    "SeaweedFS_bulk_put_needles",
+    "needles per bulk PUT frame accepted by the volume server",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+HTTP_POOL_REUSE = _counter(
+    "SeaweedFS_http_pool_reuse_total",
+    "client HTTP requests served over a reused keep-alive connection")
 
 
 def scrape_payload(accept: str = "") -> tuple[str, str]:

@@ -1,0 +1,108 @@
+"""Bulk-PUT wire framing: many needles in one HTTP body.
+
+The single-needle PUT pays ~115 us of HTTP protocol per write; packing N
+needles into one framed body amortizes that to ~115/N us. The frame is
+deliberately dumb — length-prefixed binary, no compression, no nesting —
+so both ends parse it with one struct walk and the volume server can
+hand payload views straight to the needle encoder without copying.
+
+Layout (little-endian):
+
+    frame header : magic "SWBF" | version u8 (=1) | count u32 | vid u32
+    per needle   : key u64 | cookie u32 | size u32 | flags u8 | crc u32
+                   | data[size]
+
+`flags` carries the needle flag bits that survive bulk ingest (gzip).
+`crc` is crc32c(data) — the same checksum the needle trailer stores, so
+the server verifies wire integrity once and reuses the value as the
+needle's eTag. The reference has no bulk frame (its Assign(count=N)
+clients still PUT per needle); this is the fork's ingest data plane.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, NamedTuple
+
+from ..ops.crc32c import crc32c
+
+FRAME_MAGIC = b"SWBF"
+FRAME_VERSION = 1
+_FRAME_HEADER = struct.Struct("<4sBII")   # magic | version | count | vid
+_NEEDLE_HEADER = struct.Struct("<QIIBI")  # key | cookie | size | flags | crc
+
+# a single frame is bounded well under the volume server's 256 MB body
+# cap; clients chunk larger batches into multiple frames
+MAX_FRAME_NEEDLES = 65536
+
+
+class FrameError(ValueError):
+    """Malformed/corrupt bulk frame (maps to HTTP 400 — the client must
+    not retry the identical bytes)."""
+
+
+class BulkEntry(NamedTuple):
+    key: int
+    cookie: int
+    flags: int
+    crc: int
+    data: memoryview  # zero-copy view into the frame body
+
+
+def pack_frame(vid: int, entries: "list[tuple[int, int, bytes, int]]",
+               ) -> bytes:
+    """Build one frame from (key, cookie, data, flags) tuples."""
+    if not entries:
+        raise FrameError("empty bulk frame")
+    if len(entries) > MAX_FRAME_NEEDLES:
+        raise FrameError(f"frame of {len(entries)} needles exceeds "
+                         f"{MAX_FRAME_NEEDLES}")
+    parts = [_FRAME_HEADER.pack(FRAME_MAGIC, FRAME_VERSION,
+                                len(entries), vid)]
+    for key, cookie, data, flags in entries:
+        parts.append(_NEEDLE_HEADER.pack(key, cookie, len(data),
+                                         flags & 0xFF, crc32c(data)))
+        parts.append(bytes(data))
+    return b"".join(parts)
+
+
+def unpack_frame(body: bytes | memoryview,
+                 verify_crc: bool = True) -> "tuple[int, list[BulkEntry]]":
+    """(vid, entries) from a frame body. Raises FrameError on a bad
+    magic/version, truncation, count mismatch, or (when verify_crc) a
+    payload whose crc32c disagrees with its header — the whole frame is
+    rejected before a single byte lands in a volume."""
+    buf = memoryview(body)
+    if len(buf) < _FRAME_HEADER.size:
+        raise FrameError("frame shorter than its header")
+    magic, version, count, vid = _FRAME_HEADER.unpack_from(buf, 0)
+    if magic != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {bytes(magic)!r}")
+    if version != FRAME_VERSION:
+        raise FrameError(f"unsupported frame version {version}")
+    if not 0 < count <= MAX_FRAME_NEEDLES:
+        raise FrameError(f"bad frame needle count {count}")
+    entries: list[BulkEntry] = []
+    off = _FRAME_HEADER.size
+    for _ in range(count):
+        if off + _NEEDLE_HEADER.size > len(buf):
+            raise FrameError("truncated needle header")
+        key, cookie, size, flags, crc = _NEEDLE_HEADER.unpack_from(buf, off)
+        off += _NEEDLE_HEADER.size
+        if off + size > len(buf):
+            raise FrameError(f"truncated needle payload (key {key:x})")
+        data = buf[off:off + size]
+        off += size
+        if verify_crc and crc32c(data) != crc:
+            raise FrameError(f"needle {key:x} crc mismatch on the wire")
+        entries.append(BulkEntry(key, cookie, flags, crc, data))
+    if off != len(buf):
+        raise FrameError(f"{len(buf) - off} trailing bytes after "
+                         f"{count} needles")
+    return vid, entries
+
+
+def iter_frame(body: bytes | memoryview) -> Iterator[BulkEntry]:
+    """Convenience generator over a frame's entries."""
+    _, entries = unpack_frame(body)
+    yield from entries

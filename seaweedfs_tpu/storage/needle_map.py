@@ -161,6 +161,26 @@ class NeedleMap:
         self.max_key = max(self.max_key, key)
         self._idx.write(_ENTRY.pack(key, stored, size & 0xFFFFFFFF))
 
+    def put_many(self, entries: "list[tuple[int, int, int]]") -> None:
+        """Batched put of (key, actual_offset, size) entries: identical
+        accounting to N put() calls, but the .idx log grows by ONE write
+        of all the packed entries — the bulk ingest path's needle-map
+        update is one syscall per frame, not one per needle."""
+        packed = bytearray()
+        for key, actual_offset, size in entries:
+            old = self.map.get(key)
+            if old is not None:
+                self.deleted_counter += 1
+                self.deleted_size += old.size
+            stored = t.offset_to_stored(actual_offset)
+            self.map.set(key, stored, size)
+            self.file_counter += 1
+            self.data_size += size
+            self.max_key = max(self.max_key, key)
+            packed += _ENTRY.pack(key, stored, size & 0xFFFFFFFF)
+        if packed:
+            self._idx.write(bytes(packed))
+
     def delete(self, key: int) -> bool:
         old = self.map.get(key)
         if old is None:

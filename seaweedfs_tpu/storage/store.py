@@ -163,6 +163,16 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         return v.write_needle(n)
 
+    def write_needles_bulk(self, vid: int, needles: "list[Needle]",
+                           ) -> "list[int]":
+        """Bulk-PUT storage path: one lock, one .dat write, one batched
+        needle-map update, one fsync for the whole frame."""
+        failpoints.check("volume.bulk.write")  # bad disk mid-frame
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.write_needles(needles)
+
     def read_needle(self, vid: int, needle_id: int, cookie: int | None = None,
                     shard_reader=None) -> Needle:
         failpoints.check("store.read")  # delay = slow disk; error = bad disk

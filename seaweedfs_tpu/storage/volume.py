@@ -148,13 +148,19 @@ class Volume:
             live = sizes != t.TOMBSTONE_SIZE
             if live.any():
                 starts = offs[live].astype("int64") * t.NEEDLE_PADDING
-                i = int(starts.argmax())
-                off = int(starts[i])
-                rec = record_size_from_header(int(sizes[live][i]))
-                if off + rec <= dat_size:
-                    start = off + rec
-                else:
-                    start = off  # torn final record: rescan will drop it
+                # resume the record walk after the highest entry whose
+                # WHOLE record fits the .dat. A torn BULK frame can
+                # leave many indexed entries past EOF (the batched .idx
+                # append landed, the .dat write tore mid-frame), so
+                # anchoring on the max offset alone would skip the
+                # truncation repair entirely.
+                raw = (t.NEEDLE_HEADER_SIZE + t.NEEDLE_CHECKSUM_SIZE
+                       + t.TIMESTAMP_SIZE + sizes[live].astype("int64"))
+                pad = (-raw) % t.NEEDLE_PADDING
+                ends = starts + raw + pad
+                fits = ends <= dat_size
+                if fits.any():
+                    start = int(ends[fits].max())
         end = self._scan_forward(start, dat_size)
         if end < dat_size:
             self._dat.truncate(end)
@@ -328,6 +334,50 @@ class Volume:
             self.nm.put(n.id, off, self._body_size(rec))
             self.last_append_at_ns = n.append_at_ns
             return off
+
+    def write_needles(self, needles: "list[Needle]",
+                      sync: bool = True) -> "list[int]":
+        """Append a whole bulk frame under ONE lock acquisition: all
+        records concatenated into a single .dat write, the needle map
+        updated with one batched .idx append, and (by default) one
+        fsync covering every needle — the per-frame durability point
+        the bulk-PUT ack stands on. Returns each needle's offset.
+
+        All-or-nothing admission: sizes are checked before any byte
+        lands, so a frame that would overflow the volume leaves it
+        untouched (the master's size accounting rolls the volume over
+        on the next heartbeat, same as the single-needle path)."""
+        if not needles:
+            return []
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.id} is read-only")
+            recs = []
+            offs = []
+            off = self._append_offset
+            for n in needles:
+                rec = n.to_bytes()
+                offs.append(off)
+                recs.append(rec)
+                off += len(rec)
+            if off > t.MAX_VOLUME_SIZE:
+                raise OSError(f"volume {self.id} exceeds max size")
+            buf = b"".join(recs)
+            self._dat.seek(self._append_offset)
+            # same torn-write failpoint as the single path: a crash can
+            # tear the frame mid-record; _check_integrity truncates back
+            # to the last whole record on reopen
+            self._dat.write(failpoints.torn("volume.write.torn", buf))
+            self._append_offset = off
+            self.nm.put_many([(n.id, o, self._body_size(rec))
+                              for n, o, rec in zip(needles, offs, recs)])
+            self.last_append_at_ns = needles[-1].append_at_ns
+            if sync:
+                self._dat.flush()
+                if self.remote_spec is None:
+                    os.fsync(self._dat.fileno())
+                self.nm.flush()
+            return offs
 
     @staticmethod
     def _body_size(rec: bytes) -> int:

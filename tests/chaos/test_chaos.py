@@ -337,6 +337,160 @@ def test_randomized_fault_schedule(cluster, schedule):
                 f"vol {r.volume_id} -> {list(r.corrupt_needle_ids)}"
 
 
+def test_bulk_ingest_schedule(cluster):
+    """The batched-ingest schedule (ISSUE 7): writer threads drive
+    submit_batch — fid-range leases + framed /bulk PUTs — while the
+    frame path flakes (server "dies" mid-bulk-PUT before the write,
+    ack lost after the frame is durable, replica hop errors), leases
+    expire MID-STREAM (0.5 s TTL against a multi-second window), and
+    one volume server is ACTUALLY killed mid-stream and resurrected
+    over the same directory after the faults clear. Invariants:
+
+      * every acked needle readable byte-identical after the crash
+        (read-back runs only after the victim resurrects, so needles
+        acked onto it before the kill are part of the check),
+      * fid uniqueness across retries/re-leases — a failed frame burns
+        its fids; un-acked leased keys are never reissued,
+      * every breaker re-closes, health verdict returns to OK.
+
+    Runs before the repair-loop test (which removes a server for good).
+    """
+    from conftest import wait_until
+    from seaweedfs_tpu.client.master_client import FidLeaseAllocator
+    from seaweedfs_tpu.stats import BULK_PUT_NEEDLES
+
+    master, servers, mc = cluster
+    seed = BASE_SEED + 7777
+    rng = random.Random(seed)
+    failpoints.seed(seed)
+    ctx = f"bulk schedule seed={seed} (SWTPU_CHAOS_SEED={BASE_SEED})"
+    wait_until(lambda: len(master.topo.nodes) >= 3, timeout=15,
+               msg=f"{ctx}: all nodes registered before the window")
+
+    # shared allocators = the amortization under test; the tiny client
+    # TTL forces several mid-stream expiries + re-leases per window
+    alloc_plain = FidLeaseAllocator(mc, lease_count=256, lease_ttl_s=0.5)
+    alloc_repl = FidLeaseAllocator(mc, lease_count=256, lease_ttl_s=0.5,
+                                   replication="001")
+    acked: dict[str, bytes] = {}
+    ledger_lock = threading.Lock()
+    failed_batches = [0]
+    stop = threading.Event()
+    frames_before = BULK_PUT_NEEDLES.count()
+
+    def bulk_writer(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        batch_no = 0
+        while not stop.is_set():
+            batch_no += 1
+            n = wrng.randint(16, 64)
+            payloads = [b"blk-%d-%d-%d-" % (wseed, batch_no, i)
+                        + wrng.randbytes(wrng.randint(50, 4000))
+                        for i in range(n)]
+            use_repl = wrng.random() < 0.4
+            alloc = alloc_repl if use_repl else alloc_plain
+            try:
+                res = operation.submit_batch(
+                    mc, payloads, allocator=alloc,
+                    replication="001" if use_repl else "", retries=8)
+            except Exception:  # noqa: BLE001 — whole batch unacked
+                failed_batches[0] += 1
+                continue
+            with ledger_lock:
+                for r, p in zip(res, payloads):
+                    acked[r.fid] = p
+
+    # -- arm the frame-path fault menu ---------------------------------------
+    for site, spec in [
+            ("volume.bulk.put", f"pct:{rng.randint(10, 25)}:error:chaos"),
+            ("volume.bulk.ack", f"pct:{rng.randint(5, 15)}:error:chaos"),
+            ("replicate.peer", f"pct:{rng.randint(10, 30)}:error:chaos"),
+            ("http.request", f"pct:{rng.randint(3, 10)}:error:chaos")]:
+        failpoints.configure(site, spec)
+        print(f"[chaos] {ctx}: armed {site}={spec}")
+
+    threads = [threading.Thread(target=bulk_writer, daemon=True,
+                                args=(rng.randrange(1 << 30),))
+               for _ in range(3)]
+    victim_idx = rng.randrange(len(servers))
+    victim = servers[victim_idx]
+    vdir = victim.store.locations[0].directory
+    vport, vgrpc = victim.port, victim.grpc_port
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(WINDOW_S / 2)
+        # the real kill, mid-stream: in-flight frames die with it; the
+        # client burns those fids and re-leases onto the survivors
+        victim.stop()
+        print(f"[chaos] {ctx}: killed {vport} mid-stream")
+        time.sleep(WINDOW_S / 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            f"{ctx}: bulk writer hung past the fault window"
+    finally:
+        stop.set()
+        failpoints.clear_all()
+
+    assert acked, f"{ctx}: no batch survived — schedule too brutal"
+    frames = BULK_PUT_NEEDLES.count() - frames_before
+    print(f"[chaos] {ctx}: {len(acked)} needles acked over {frames} "
+          f"frames, {failed_batches[0]} failed batches, "
+          f"{alloc_plain.leases_taken + alloc_repl.leases_taken} leases")
+    assert frames > 0, f"{ctx}: no bulk frame ever landed"
+    # mid-stream expiry really happened: far more leases than strict
+    # range exhaustion would need
+    assert alloc_plain.leases_taken + alloc_repl.leases_taken >= 3
+
+    # -- recovery: resurrect the victim over the same directory --------------
+    store = Store("127.0.0.1", 0, "",
+                  [DiskLocation(vdir, max_volume_count=20)],
+                  coder_name="numpy")
+    store.port = vport
+    store.public_url = f"127.0.0.1:{vport}"
+    reborn = VolumeServer(store, f"127.0.0.1:{master.port}", port=vport,
+                          grpc_port=vgrpc, pulse_seconds=0.3)
+    reborn.start()
+    servers[victim_idx] = reborn  # fixture teardown stops the new one
+    wait_until(lambda: len(master.topo.nodes) >= len(servers),
+               timeout=20, msg=f"{ctx}: victim re-registered")
+
+    # invariant: no duplicate fids — within this schedule and against
+    # everything any earlier schedule handed out
+    fids = sorted(acked)
+    assert len(fids) == len(set(fids))
+    dupes = set(fids) & set(_all_fids_ever)
+    assert not dupes, f"{ctx}: leased fids reused: {dupes}"
+    _all_fids_ever.extend(fids)
+
+    # invariant: every acked needle readable, byte-identical — including
+    # the ones whose only copy rode a frame acked before the kill
+    for fid, payload in acked.items():
+        got = operation.read(mc, fid)
+        assert got == payload, \
+            f"{ctx}: acked {fid} corrupt ({len(got)}B vs {len(payload)}B)"
+
+    # invariant: breakers re-close once traffic/probes return
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        open_peers = [p for p, s in retry.all_breakers().items()
+                      if s != retry.CLOSED]
+        if not open_peers:
+            break
+        for p in open_peers:
+            retry.breaker(p).cooldown = min(retry.breaker(p).cooldown, 0.5)
+            _probe_peer(p)
+        time.sleep(0.2)
+    still_open = {p: s for p, s in retry.all_breakers().items()
+                  if s != retry.CLOSED}
+    assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+    wait_until(lambda: master.health.scan()["verdict"] == "OK",
+               timeout=30, msg=f"{ctx}: health verdict returns to OK")
+
+
 def test_repair_loop_converges_after_node_death(cluster):
     """The self-healing schedule: a node holding a replica dies FOR GOOD
     (no failpoint, no resurrection) and the master's health-driven

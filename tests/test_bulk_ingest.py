@@ -1,0 +1,566 @@
+"""Batched ingest control plane: fid-range leases, bulk framing, the
+/bulk volume-server handler, and the client-side lease allocator.
+
+Covers the ISSUE-7 acceptance surface:
+  * multi-count assign arithmetic — key contiguity, cookie sharing,
+    disjoint ranges across assigns, and survival across a sequencer
+    restart (heartbeat max_file_key re-seeds the new master);
+  * the wire frame (pack/unpack roundtrip, truncation/crc/magic/cookie
+    rejection) and the single-lock batched storage write (reopen
+    durability, torn-tail heal);
+  * range-scoped JWTs end to end (guard unit checks + a signed
+    mini-cluster);
+  * FidLeaseAllocator re-leasing on exhaustion/expiry/discard with fid
+    uniqueness throughout;
+  * submit_batch against a live replicated mini-cluster, including the
+    one-hop frame replication fan-out;
+  * the http_util keep-alive pool's new age/idle caps + reuse counter.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+from conftest import wait_cluster_up, wait_until
+
+from seaweedfs_tpu.client import http_util, operation
+from seaweedfs_tpu.client.master_client import (FidLeaseAllocator,
+                                                MasterClient)
+from seaweedfs_tpu.master.master_server import MasterServer
+from seaweedfs_tpu.security import Guard, decode_jwt
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage import bulk
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.types import file_id, parse_file_id
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.utils import failpoints
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# wire frame
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip():
+    entries = [(100 + i, 0xC0FFEE, os.urandom(10 + 13 * i), i & 1)
+               for i in range(20)]
+    frame = bulk.pack_frame(42, entries)
+    vid, got = bulk.unpack_frame(frame)
+    assert vid == 42
+    assert len(got) == 20
+    for (key, cookie, data, flags), e in zip(entries, got):
+        assert (e.key, e.cookie, e.flags) == (key, cookie, flags)
+        assert bytes(e.data) == data
+        from seaweedfs_tpu.ops.crc32c import crc32c
+        assert e.crc == crc32c(data)
+
+
+def test_frame_rejects_malformed():
+    frame = bulk.pack_frame(1, [(5, 7, b"payload", 0), (6, 7, b"more", 0)])
+    with pytest.raises(bulk.FrameError):
+        bulk.unpack_frame(frame[:-2])  # truncated payload
+    with pytest.raises(bulk.FrameError):
+        bulk.unpack_frame(frame + b"x")  # trailing bytes
+    with pytest.raises(bulk.FrameError):
+        bulk.unpack_frame(b"NOPE" + frame[4:])  # bad magic
+    corrupt = bytearray(frame)
+    corrupt[-1] ^= 0xFF  # flip a payload byte: crc must catch it
+    with pytest.raises(bulk.FrameError):
+        bulk.unpack_frame(bytes(corrupt))
+    with pytest.raises(bulk.FrameError):
+        bulk.pack_frame(1, [])
+
+
+# ---------------------------------------------------------------------------
+# storage batch write
+# ---------------------------------------------------------------------------
+
+def test_volume_write_needles_batch_and_reopen(tmp_path):
+    v = Volume(str(tmp_path), "", 9)
+    needles = [Needle(id=i, cookie=0xAB, data=b"data-%04d" % i)
+               for i in range(200)]
+    offs = v.write_needles(needles)
+    assert offs == sorted(offs) and len(set(offs)) == 200
+    assert v.file_count == 200
+    # the frame fsync already ran inside write_needles; reopen from disk
+    # and every needle must be there (this is what the bulk ack means)
+    v.close()
+    v2 = Volume(str(tmp_path), "", 9, create_if_missing=False)
+    for i in range(200):
+        assert v2.read_needle(i, cookie=0xAB).data == b"data-%04d" % i
+    assert v2.file_count == 200
+    v2.close()
+
+
+def test_volume_write_needles_torn_tail_heals(tmp_path):
+    v = Volume(str(tmp_path), "", 11)
+    v.write_needles([Needle(id=i, cookie=1, data=b"pre%d" % i)
+                     for i in range(5)])
+    # tear the NEXT frame mid-write (crash model: batched .idx landed,
+    # .dat write cut inside the 3rd record): reopen must keep the whole
+    # records, truncate the torn tail, and drop the phantom idx entries
+    # torn:N keeps the first N bytes of the frame buffer; each record is
+    # 5040 B (16B header + 5005B body + 12B trailer, padded to 8), so
+    # 11792 cuts inside the 3rd record
+    failpoints.configure("volume.write.torn", "times:1:torn:11792")
+    try:
+        v.write_needles([Needle(id=100 + i, cookie=1, data=b"T" * 5000)
+                         for i in range(8)])
+    finally:
+        failpoints.clear_all()
+    v.close()
+    v2 = Volume(str(tmp_path), "", 11, create_if_missing=False)
+    for i in range(5):
+        assert v2.read_needle(i, cookie=1).data == b"pre%d" % i
+    # two whole 5000-byte records survive the cut; the torn third and
+    # the never-written tail are gone from both the .dat and the map
+    import os as _os
+    assert v2.content_size <= _os.path.getsize(v2.dat_path)
+    survivors = [k for k in range(100, 108) if v2.nm.get(k) is not None]
+    assert survivors == [100, 101], survivors
+    for key in survivors:
+        assert v2.read_needle(key, cookie=1).data == b"T" * 5000
+    # and the healed volume appends cleanly right where it truncated
+    v2.write_needle(Needle(id=500, cookie=1, data=b"after-heal"))
+    assert v2.read_needle(500, cookie=1).data == b"after-heal"
+    v2.close()
+
+
+def test_needle_map_put_many_matches_put(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import NeedleMap
+    a = NeedleMap(str(tmp_path / "a.idx"))
+    b = NeedleMap(str(tmp_path / "b.idx"))
+    entries = [(i, i * 1024, 100 + i) for i in range(1, 50)]
+    for k, off, sz in entries:
+        a.put(k, off, sz)
+    b.put_many(entries)
+    assert (a.file_counter, a.data_size, a.max_key) == \
+           (b.file_counter, b.data_size, b.max_key)
+    for k, off, sz in entries:
+        av, bv = a.get(k), b.get(k)
+        assert (av.offset, av.size) == (bv.offset, bv.size) == (off, sz)
+    a.close()
+    b.close()
+    # identical .idx bytes: the batched log replays exactly like N puts
+    assert (tmp_path / "a.idx").read_bytes() == \
+           (tmp_path / "b.idx").read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# mini-cluster (module-scoped): master + 2 volume servers, no security
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport = free_port()
+    mhttp = free_port()
+    master = MasterServer(port=mport, http_port=mhttp,
+                          volume_size_limit_mb=128, pulse_seconds=0.3)
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path_factory.mktemp(f"bulk{i}")
+        port = free_port()
+        store = Store("127.0.0.1", port, "",
+                      [DiskLocation(str(d), max_volume_count=10)],
+                      coder_name="numpy")
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.3)
+        vs.start()
+        servers.append(vs)
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}",
+                      http_address=f"127.0.0.1:{mhttp}").start()
+    mc.wait_connected()
+    yield master, servers, mc
+    mc.stop()
+    for vs in servers:
+        vs.stop()
+    master.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-count assign semantics (satellite: nothing tested this before)
+# ---------------------------------------------------------------------------
+
+def test_assign_count_key_contiguity_and_cookie(cluster):
+    master, _, mc = cluster
+    a = mc.assign(count=8)
+    vid, key, cookie = parse_file_id(a.fid)
+    assert a.count == 8
+    # reference multi-count Assign semantics: ONE fid + count, the
+    # client derives fid(i) = key+i with the SAME cookie — every
+    # derived fid must be writable and cookie-checked readable
+    fids = [file_id(vid, key + i, cookie) for i in range(8)]
+    assert len(set(fids)) == 8
+    b = mc.assign(count=4)
+    vid_b, key_b, _ = parse_file_id(b.fid)
+    # disjoint, and (memory sequencer) allocated AFTER the first range
+    if vid_b == vid:
+        assert key_b >= key + 8
+    store = next(vs.store for vs in cluster[1]
+                 if vs.store.find_volume(vid) is not None)
+    for i, fid in enumerate(fids):
+        store.write_needle(vid, Needle(id=key + i, cookie=cookie,
+                                       data=b"c%d" % i))
+    for i in range(8):
+        n = store.read_needle(vid, key + i, cookie=cookie)  # cookie shared
+        assert n.data == b"c%d" % i
+
+
+def test_assign_count_http_lease_fields(cluster):
+    master, _, mc = cluster
+    r = http_util.get(
+        f"http://127.0.0.1:{master.http_port}/dir/assign",
+        params={"count": 16})
+    body = r.json()
+    assert body["count"] == 16
+    vid, key, cookie = parse_file_id(body["fid"])
+    assert int(body["keyHex"], 16) == key
+    assert body["cookie"] == cookie
+    assert body["leaseTtlS"] == master.fid_leases.ttl_s > 0
+    assert isinstance(body["replicas"], list)
+    # count=1 keeps the lean single-fid response shape
+    r1 = http_util.get(
+        f"http://127.0.0.1:{master.http_port}/dir/assign",
+        params={"count": 1})
+    assert "keyHex" not in r1.json()
+
+
+def test_assign_count_survives_sequencer_restart(tmp_path):
+    """A restarted master's FRESH sequencer must never re-issue leased
+    keys: the volume server's heartbeat max_file_key re-seeds it
+    (reference memory_sequencer + master_grpc_server.go:130), so keys
+    only ever move forward — provided the lease was actually used."""
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.2)
+    master.start()
+    port = free_port()
+    store = Store("127.0.0.1", port, "",
+                  [DiskLocation(str(tmp_path), max_volume_count=4)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                      grpc_port=free_port(), pulse_seconds=0.2)
+    vs.start()
+    mc = None
+    try:
+        wait_cluster_up(master, [vs])
+        mc = MasterClient(f"127.0.0.1:{mport}").start()
+        a = mc.assign(count=32)
+        vid, key, cookie = parse_file_id(a.fid)
+        # use the range: write the needles so max_file_key covers it
+        store.write_needles_bulk(vid, [
+            Needle(id=key + i, cookie=cookie, data=b"s%d" % i)
+            for i in range(32)])
+        vs.trigger_heartbeat()
+        # restart the master on the same port with a fresh sequencer
+        master.stop()
+        master2 = MasterServer(port=mport, volume_size_limit_mb=64,
+                               pulse_seconds=0.2)
+        master2.start()
+        try:
+            wait_until(lambda: len(master2.topo.nodes) >= 1, timeout=15,
+                       msg="volume server re-registered after restart")
+            wait_until(lambda: master2.sequencer.peek > key + 31,
+                       timeout=10, msg="heartbeat max_file_key re-seeded "
+                                       "the fresh sequencer")
+            b = mc.assign(count=16)
+            _, key_b, _ = parse_file_id(b.fid)
+            assert key_b > key + 31, \
+                f"restarted master re-issued leased keys: {key_b} vs {key}"
+        finally:
+            master2.stop()
+    finally:
+        if mc is not None:
+            mc.stop()
+        vs.stop()
+        try:
+            master.stop()
+        except Exception:  # noqa: BLE001 — already stopped mid-test
+            pass
+
+
+# ---------------------------------------------------------------------------
+# lease allocator
+# ---------------------------------------------------------------------------
+
+def test_lease_allocator_releases_on_exhaustion_and_expiry(cluster):
+    _, _, mc = cluster
+    alloc = FidLeaseAllocator(mc, lease_count=10)
+    seen = set()
+    for _ in range(25):
+        lease, start, got = alloc.take(1)
+        assert got == 1
+        fid = lease.fid(start)
+        assert fid not in seen
+        seen.add(fid)
+    assert alloc.leases_taken >= 3  # 10-key leases, 25 takes
+    # forced expiry: the next take must re-lease, never reuse keys
+    alloc2 = FidLeaseAllocator(mc, lease_count=100, lease_ttl_s=0.0)
+    l1, s1, _ = alloc2.take(5)
+    l2, s2, _ = alloc2.take(5)
+    assert alloc2.leases_taken == 2  # ttl 0 = expired immediately
+    r1 = set(range(s1, s1 + 5))
+    r2 = set(range(s2, s2 + 5))
+    assert not (r1 & r2) or l1.vid != l2.vid
+
+
+def test_lease_allocator_discard_burns_attempted_fids(cluster):
+    _, _, mc = cluster
+    alloc = FidLeaseAllocator(mc, lease_count=50)
+    lease, start, got = alloc.take(10)
+    alloc.discard(lease)  # as after a failed bulk PUT
+    lease2, start2, _ = alloc.take(10)
+    assert lease2 is not lease
+    if lease2.vid == lease.vid:
+        # fresh range: no overlap with ANY key of the discarded lease
+        assert start2 >= start + 50 or start2 + 10 <= start
+
+
+def test_lease_spans_take_boundaries(cluster):
+    _, _, mc = cluster
+    alloc = FidLeaseAllocator(mc, lease_count=16)
+    lease, start, got = alloc.take(100)
+    assert got == 100  # _relet sizes the lease to the want when larger
+
+
+# ---------------------------------------------------------------------------
+# submit_batch end to end (replication 001 -> one-hop frame fan-out)
+# ---------------------------------------------------------------------------
+
+def test_submit_batch_roundtrip_and_metrics(cluster):
+    _, servers, mc = cluster
+    from seaweedfs_tpu.stats import BULK_PUT_NEEDLES, FID_LEASES_ACTIVE
+    frames_before = BULK_PUT_NEEDLES.count()
+    payloads = [b"bulk-%05d-" % i + os.urandom(50) for i in range(300)]
+    alloc = FidLeaseAllocator(mc, lease_count=128)
+    import seaweedfs_tpu.client.operation as op
+    old = op.BULK_MAX_FRAME_NEEDLES
+    op.BULK_MAX_FRAME_NEEDLES = 64
+    try:
+        res = operation.submit_batch(mc, payloads, allocator=alloc)
+    finally:
+        op.BULK_MAX_FRAME_NEEDLES = old
+    assert len(res) == 300
+    assert len({r.fid for r in res}) == 300, "duplicate fids handed out"
+    for r, p in zip(res[::29], payloads[::29]):
+        assert operation.read(mc, r.fid) == p
+    assert BULK_PUT_NEEDLES.count() - frames_before >= 300 // 64
+    assert FID_LEASES_ACTIVE.value() >= 1  # leases outstanding until TTL
+
+
+def test_submit_batch_replicated_lands_on_both_replicas(cluster):
+    _, servers, mc = cluster
+    payloads = [b"repl-%03d" % i for i in range(40)]
+    res = operation.submit_batch(mc, payloads, replication="001")
+    assert len(res) == 40
+    vid, _, _ = parse_file_id(res[0].fid)
+    wait_until(lambda: len(mc.refresh_lookup(vid)) == 2, timeout=10,
+               msg="both replicas registered")
+    # every replica holds every needle LOCALLY (one-hop frame fan-out)
+    holders = [vs.store for vs in servers
+               if vs.store.find_volume(vid) is not None]
+    assert len(holders) == 2
+    for r, p in zip(res, payloads):
+        _, key, cookie = parse_file_id(r.fid)
+        for store in holders:
+            assert store.find_volume(vid).read_needle(
+                key, cookie=cookie).data == p
+
+
+def test_submit_batch_ttl_reaches_replicas(cluster):
+    """The replica hop forwards the frame's ttl param: primary and
+    replica copies of every needle must carry the SAME stored TTL, or
+    expiry semantics diverge between holders."""
+    _, servers, mc = cluster
+    payloads = [b"ttl-%02d" % i for i in range(10)]
+    res = operation.submit_batch(mc, payloads, replication="001",
+                                 ttl="1h")
+    assert len(res) == 10
+    vid, _, _ = parse_file_id(res[0].fid)
+    wait_until(lambda: len(mc.refresh_lookup(vid)) == 2, timeout=10,
+               msg="both replicas registered")
+    holders = [vs.store for vs in servers
+               if vs.store.find_volume(vid) is not None]
+    assert len(holders) == 2
+    for r in res:
+        _, key, cookie = parse_file_id(r.fid)
+        ttls = {(n.ttl.count, n.ttl.unit) for n in
+                (s.find_volume(vid).read_needle(key, cookie=cookie)
+                 for s in holders)}
+        assert len(ttls) == 1, f"holders disagree on ttl: {ttls}"
+        assert next(iter(ttls))[0] > 0, "ttl lost on the bulk path"
+
+
+def test_bulk_handler_rejects_bad_frames(cluster):
+    _, servers, mc = cluster
+    vs = servers[0]
+    a = mc.assign(count=4)
+    vid, key, cookie = parse_file_id(a.fid)
+    target = next(s for s in servers
+                  if s.store.find_volume(vid) is not None)
+    frame = bulk.pack_frame(vid, [(key + i, cookie, b"ok%d" % i, 0)
+                                  for i in range(4)])
+    # corrupt a payload byte: the crc check must 400 the whole frame
+    corrupt = bytearray(frame)
+    corrupt[-1] ^= 0x55
+    r = http_util.request("PUT", f"http://{target.url}/bulk",
+                          body=bytes(corrupt), params={"vid": vid})
+    assert r.status == 400
+    # mixed cookies: stitched frame, rejected before auth/storage
+    mixed = bulk.pack_frame(vid, [(key, cookie, b"a", 0),
+                                  (key + 1, cookie + 1, b"b", 0)])
+    r = http_util.request("PUT", f"http://{target.url}/bulk",
+                          body=mixed, params={"vid": vid})
+    assert r.status == 400
+    # vid mismatch between query and frame
+    r = http_util.request("PUT", f"http://{target.url}/bulk",
+                          body=frame, params={"vid": vid + 999})
+    assert r.status == 400
+    # GET is not a bulk verb
+    assert http_util.get(f"http://{target.url}/bulk").status == 405
+    # the clean frame still lands after all the rejects
+    r = http_util.request("PUT", f"http://{target.url}/bulk",
+                          body=frame, params={"vid": vid})
+    assert r.status == 201
+    assert r.json()["count"] == 4
+
+
+# ---------------------------------------------------------------------------
+# range JWT: guard units + signed cluster end to end
+# ---------------------------------------------------------------------------
+
+def test_guard_range_token_scoping():
+    from seaweedfs_tpu.security import gen_jwt_for_fid_range
+    g = Guard(signing_key="sekrit")
+    tok = gen_jwt_for_fid_range("sekrit", 60, 7, 0x100, 16, 0xBEEF)
+    in_range = file_id(7, 0x10F, 0xBEEF)
+    out_range = file_id(7, 0x110, 0xBEEF)
+    wrong_cookie = file_id(7, 0x100, 0xDEAD)
+    assert g.check_write("", {"jwt": tok}, {}, in_range)[0]
+    assert not g.check_write("", {"jwt": tok}, {}, out_range)[0]
+    assert not g.check_write("", {"jwt": tok}, {}, wrong_cookie)[0]
+    keys = list(range(0x100, 0x110))
+    assert g.check_bulk("", {"jwt": tok}, {}, 7, keys, 0xBEEF)[0]
+    assert not g.check_bulk("", {"jwt": tok}, {}, 7, keys + [0x110],
+                            0xBEEF)[0]
+    assert not g.check_bulk("", {"jwt": tok}, {}, 8, keys, 0xBEEF)[0]
+    # a single-fid token can NOT bulk-write
+    from seaweedfs_tpu.security import gen_jwt_for_volume_server
+    single = gen_jwt_for_volume_server("sekrit", 60, in_range)
+    ok, why = g.check_bulk("", {"jwt": single}, {}, 7, [0x10F], 0xBEEF)
+    assert not ok and "range" in why
+    # expired range token (exp<=0 means "no expiry" like the reference,
+    # so mint the stale claims directly)
+    from seaweedfs_tpu.security.jwt import encode
+    stale = encode({"rng": f"7,{0x100:x},16,{0xBEEF:08x}",
+                    "exp": int(time.time()) - 10}, "sekrit")
+    assert not g.check_write("", {"jwt": stale}, {}, in_range)[0]
+    assert not g.check_bulk("", {"jwt": stale}, {}, 7, keys, 0xBEEF)[0]
+
+
+def test_submit_batch_with_signing_key(tmp_path):
+    """End to end with security ON: the master mints ONE range JWT per
+    lease, the volume server validates it once per frame, and the
+    replica hop re-mints its own range token."""
+    key = "bulk-test-key"
+    mport, mhttp = free_port(), free_port()
+    master = MasterServer(port=mport, http_port=mhttp,
+                          volume_size_limit_mb=64, pulse_seconds=0.3,
+                          guard=Guard(signing_key=key))
+    master.start()
+    port = free_port()
+    store = Store("127.0.0.1", port, "",
+                  [DiskLocation(str(tmp_path), max_volume_count=4)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                      grpc_port=free_port(), pulse_seconds=0.3,
+                      guard=Guard(signing_key=key))
+    vs.start()
+    mc = None
+    try:
+        wait_cluster_up(master, [vs])
+        mc = MasterClient(f"127.0.0.1:{mport}").start()
+        alloc = FidLeaseAllocator(mc, lease_count=64)
+        res = operation.submit_batch(
+            mc, [b"signed-%d" % i for i in range(50)], allocator=alloc)
+        assert len(res) == 50
+        lease, start, _ = alloc.take(1)
+        assert lease.auth, "lease carries a range token"
+        claims = decode_jwt(lease.auth, key)
+        assert "rng" in claims
+        assert operation.read(mc, res[7].fid) == b"signed-7"
+        # an unsigned bulk PUT is refused
+        a_vid = lease.vid
+        frame = bulk.pack_frame(a_vid, [(start, lease.cookie, b"x", 0)])
+        r = http_util.request("PUT", f"http://{vs.url}/bulk",
+                              body=frame, params={"vid": a_vid})
+        assert r.status == 401
+    finally:
+        if mc is not None:
+            mc.stop()
+        vs.stop()
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# http_util keep-alive pool hygiene (satellite)
+# ---------------------------------------------------------------------------
+
+def test_http_pool_age_and_idle_recycling(cluster):
+    _, servers, _ = cluster
+    url = f"http://{servers[0].url}/status"
+    from seaweedfs_tpu.stats import HTTP_POOL_REUSE
+    netloc = servers[0].url
+    http_util._drop(netloc)
+    assert http_util.get(url).ok
+    before = HTTP_POOL_REUSE.value()
+    assert http_util.get(url).ok  # second request reuses the socket
+    assert HTTP_POOL_REUSE.value() == before + 1
+    c1 = http_util._local.pool[netloc]
+    # age cap: a connection past max-age is recycled, not reused
+    old_age = http_util.POOL_MAX_AGE_S
+    http_util.POOL_MAX_AGE_S = 0.0
+    try:
+        assert http_util.get(url).ok
+        assert http_util._local.pool[netloc] is not c1, "aged conn reused"
+    finally:
+        http_util.POOL_MAX_AGE_S = old_age
+    # idle cap: same, keyed on last_used
+    c2 = http_util._local.pool[netloc]
+    old_idle = http_util.POOL_MAX_IDLE_S
+    http_util.POOL_MAX_IDLE_S = 0.0
+    try:
+        assert http_util.get(url).ok
+        assert http_util._local.pool[netloc] is not c2, "idle conn reused"
+    finally:
+        http_util.POOL_MAX_IDLE_S = old_idle
+
+
+def test_http_pool_conn_cap_evicts_lru(cluster):
+    master, servers, _ = cluster
+    # two real endpoints + a cap of 1: dialing the second must evict the
+    # first (LRU) instead of growing the pool
+    old_cap = http_util.POOL_MAX_CONNS
+    http_util.POOL_MAX_CONNS = 1
+    try:
+        http_util._drop(servers[0].url)
+        http_util._drop(servers[1].url)
+        assert http_util.get(f"http://{servers[0].url}/status").ok
+        assert http_util.get(f"http://{servers[1].url}/status").ok
+        pool = http_util._local.pool
+        assert servers[1].url in pool
+        assert servers[0].url not in pool, "cap exceeded: LRU not evicted"
+    finally:
+        http_util.POOL_MAX_CONNS = old_cap

@@ -163,9 +163,17 @@ def test_filer_replicate_logfile_queue(stack, tmp_path):
     proc = _spawn_verb(["filer.replicate", "-filer", fs.url,
                         "-queue", f"logfile:{qpath}",
                         "-sink", f"local:{mirror}"])
+    def _mirrored(path, want):
+        # the sink creates the file before streaming content into it:
+        # existence alone races the write — wait for the bytes
+        try:
+            return path.read_bytes() == want
+        except OSError:
+            return False
+
     try:
-        _wait(lambda: (mirror / "rep/a.txt").exists() and
-              (mirror / "rep/sub/b.txt").exists(), timeout=30,
+        _wait(lambda: _mirrored(mirror / "rep/a.txt", b"alpha") and
+              _mirrored(mirror / "rep/sub/b.txt", b"beta"), timeout=30,
               msg="mirror populated")  # child interpreter boot can be slow
               # on this 1-core box when the full suite runs alongside
         assert (mirror / "rep/a.txt").read_bytes() == b"alpha"
