@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest
+test: lint bench-ec bench-ingest bench-repair
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -60,6 +60,13 @@ bench-ec:
 # SeaweedFS_fid_leases_active gauge draining back to 0
 bench-ingest:
 	JAX_PLATFORMS=cpu python bench.py --ingest-only
+
+# seconds-long repair-traffic smoke: rebuild one lost data shard of the
+# same volume under plain RS and the piggybacked codec, assert the
+# piggyback path reads <= 0.7x the survivor bytes (via
+# SeaweedFS_repair_bytes_read_total) with a byte-identical result
+bench-repair:
+	JAX_PLATFORMS=cpu python bench.py --repair-only
 
 smoke:
 	python bench.py --smoke

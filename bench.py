@@ -500,6 +500,71 @@ def bench_ec_smoke(out: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Repair-traffic smoke (make bench-repair): rebuild ONE lost data shard
+# under both codecs on the same volume bytes and compare survivor bytes
+# read. Plain RS reads d full shards; the piggybacked codec's ranged plan
+# reads (d+|group|)/2 half-shard ranges — asserted <= 0.7x via the
+# SeaweedFS_repair_bytes_read_total counter, with the rebuilt shard
+# byte-identical to the original in both cases.
+# ---------------------------------------------------------------------------
+
+def bench_repair_smoke(out: dict) -> None:
+    from seaweedfs_tpu.ec import files as ecf
+    from seaweedfs_tpu.ec.encoder import encode_volume, rebuild_shards
+    from seaweedfs_tpu.ec.locate import EcGeometry
+    from seaweedfs_tpu.ops.coder import NumpyCoder
+    from seaweedfs_tpu.ops.piggyback import PiggybackCoder
+    from seaweedfs_tpu.stats import REPAIR_BYTES_READ
+
+    geo = EcGeometry(d=D, p=P, large_block=1 << 22, small_block=1 << 18)
+    # lost shard 1 sits in a size-3 piggyback group: plan = (10+3)/2 = 6.5
+    lost = 1
+    tmp = tempfile.mkdtemp(prefix="swtpu_bench_repair_")
+    try:
+        rng = np.random.default_rng(11)
+        size = 24 << 20
+        datp = os.path.join(tmp, "v.dat")
+        with open(datp, "wb") as f:
+            f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        ratios = {}
+        for codec, coder in (("rs", NumpyCoder(D, P)),
+                             ("piggyback", PiggybackCoder(D, P))):
+            base = os.path.join(tmp, codec)
+            encode_volume(datp, base, geo, coder)
+            shard_size = os.path.getsize(base + ecf.shard_ext(lost))
+            original = open(base + ecf.shard_ext(lost), "rb").read()
+            os.remove(base + ecf.shard_ext(lost))
+            before = REPAIR_BYTES_READ.value(codec)
+            stats: dict = {}
+            t0 = time.perf_counter()
+            rebuilt = rebuild_shards(base, geo, coder, stats=stats)
+            dt = time.perf_counter() - t0
+            assert rebuilt == [lost], rebuilt
+            rebuilt_bytes = open(base + ecf.shard_ext(lost), "rb").read()
+            assert rebuilt_bytes == original, \
+                f"{codec}: rebuilt shard not byte-identical"
+            read = REPAIR_BYTES_READ.value(codec) - before
+            assert read == stats["bytes_read"], (read, stats)
+            per_lost = read / shard_size
+            ratios[codec] = per_lost
+            out[f"repair_{codec}_bytes_read_per_lost_byte"] = round(
+                per_lost, 3)
+            out[f"repair_{codec}_rebuild_GBps"] = round(
+                shard_size / dt / 1e9, 3)
+            out[f"repair_{codec}_path"] = stats["path"]
+            log(f"repair smoke [{codec}]: {per_lost:.2f} bytes read per "
+                f"lost byte, {shard_size / dt / 1e9:.3f} GB/s rebuild "
+                f"({stats['path']})")
+        ratio = ratios["piggyback"] / ratios["rs"]
+        out["repair_piggyback_vs_rs"] = round(ratio, 3)
+        # the acceptance gate: piggybacked repair moves <= 0.7x the bytes
+        assert ratio <= 0.7, f"piggyback repair ratio {ratio} > 0.7"
+        out["bench_repair_smoke"] = "ok"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # Cluster write/read req/s (reference README.md:545,:571)
 # ---------------------------------------------------------------------------
 
@@ -945,6 +1010,11 @@ def main() -> None:
                          "bench-ingest): small bulk run on a separate-"
                          "process cluster, asserts zero errors and fid "
                          "leases draining to 0")
+    ap.add_argument("--repair-only", action="store_true",
+                    help="run only the repair-traffic smoke (make "
+                         "bench-repair): rebuild one lost shard under "
+                         "both codecs, assert piggyback reads <= 0.7x "
+                         "the plain-RS bytes and byte-identity")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -964,6 +1034,12 @@ def main() -> None:
         out_in: dict = {"metric": "bench_ingest_smoke"}
         bench_ingest_smoke(out_in)
         print(json.dumps(out_in))
+        return
+    if args.repair_only:
+        # pure host-side file repair: safe for make test's fast path
+        out_rp: dict = {"metric": "bench_repair_smoke"}
+        bench_repair_smoke(out_rp)
+        print(json.dumps(out_rp))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

@@ -53,6 +53,10 @@ def _add_master_flags(p):
                         "used by the health engine to derive k = n - parity "
                         "(0 = fork default 2; MUST match ec.encode's "
                         "-parityShards or /cluster/health mis-scores stripes)")
+    p.add_argument("-ecShards", default="",
+                   help="cluster EC geometry as 'd,p' (e.g. 14,2 fork / "
+                        "10,4 upstream); the p half feeds the health "
+                        "engine like -ecParityShards")
     _add_security_flags(p)
 
 
@@ -95,11 +99,35 @@ def _add_volume_flags(p):
     p.add_argument("-rack", default="")
     p.add_argument("-disk", default="hdd")
     p.add_argument("-coder", default="auto",
-                   help="erasure coder: auto|jax|native|numpy")
+                   help="erasure coder backend: auto|jax|native|numpy")
+    p.add_argument("-codec", default="rs",
+                   help="erasure codec for new encodes: rs | piggyback "
+                        "(repair-efficient piggybacked RS; rebuilds always "
+                        "follow each volume's .vif)")
+    p.add_argument("-ecShards", default="",
+                   help="default EC geometry as 'd,p' (e.g. 14,2 fork / "
+                        "10,4 upstream)")
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory|leveldb|sorted_file "
                         "(reference -index flag)")
     _add_security_flags(p)
+
+
+def _ec_parity(opt) -> "int | None":
+    """-ecShards d,p wins over the older -ecParityShards spelling."""
+    if getattr(opt, "ecShards", ""):
+        from .shell.ec_commands import parse_ec_shards
+        return parse_ec_shards(opt.ecShards)[1]
+    return opt.ecParityShards or None
+
+
+def _ec_geometry(opt):
+    if not getattr(opt, "ecShards", ""):
+        return None
+    from .ec.locate import EcGeometry
+    from .shell.ec_commands import parse_ec_shards
+    d, p = parse_ec_shards(opt.ecShards)
+    return EcGeometry(d=d, p=p)
 
 
 def run_master(argv):
@@ -133,7 +161,7 @@ def run_master(argv):
                       maintenance_interval_s=opt.maintenanceIntervalS or None,
                       maintenance_health_driven=(
                           opt.maintenanceHealthDriven == "on"),
-                      ec_parity_shards=opt.ecParityShards or None)
+                      ec_parity_shards=_ec_parity(opt))
     ms.admin_cron.repair_max_concurrent = opt.maintenanceMaxConcurrentRepairs
     ms.start()
     _wait_forever()
@@ -149,7 +177,8 @@ def run_volume(argv):
     store = Store(opt.ip, opt.port, f"{opt.ip}:{opt.port}",
                   [DiskLocation(opt.dir, opt.disk, opt.max,
                                 needle_map_kind=opt.index)],
-                  coder_name=opt.coder)
+                  coder_name=opt.coder, ec_codec=opt.codec,
+                  ec_geometry=_ec_geometry(opt))
     vs = VolumeServer(store, opt.mserver, ip=opt.ip, port=opt.port,
                       grpc_port=opt.grpcPort or None,
                       data_center=opt.dataCenter, rack=opt.rack,
@@ -170,6 +199,8 @@ def run_server(argv):
     p.add_argument("-dir", default="./data")
     p.add_argument("-max", type=int, default=8)
     p.add_argument("-coder", default="auto")
+    p.add_argument("-codec", default="rs",
+                   help="erasure codec for new encodes: rs | piggyback")
     p.add_argument("-filer", action="store_true")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true")
@@ -186,7 +217,8 @@ def run_server(argv):
     ms.start()
     store = Store(opt.ip, opt.volumePort, f"{opt.ip}:{opt.volumePort}",
                   [DiskLocation(opt.dir, "hdd", opt.max)],
-                  coder_name=opt.coder)
+                  coder_name=opt.coder, ec_codec=opt.codec,
+                  ec_geometry=_ec_geometry(opt))
     vs = VolumeServer(store, f"{opt.ip}:{opt.port}", ip=opt.ip,
                       port=opt.volumePort, guard=_make_guard(opt))
     vs.start()

@@ -56,36 +56,105 @@ def find_shards(base: str, n: int) -> dict[int, str]:
 def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
                    wanted: Sequence[int] | None = None,
                    chunk: int = DEFAULT_CHUNK, batch: int = DEFAULT_BATCH,
+                   shard_reader=None,
+                   remote_shards: Sequence[int] | None = None,
+                   stats: "dict | None" = None,
                    ) -> list[int]:
     """Recreate missing shard files from >= d survivors.
 
     Reference: RebuildEcFiles ec_encoder.go:61 / rebuildEcFiles :237-291.
-    Returns the shard ids rebuilt.
+    Survivors may live elsewhere: `shard_reader(sid, offset, length)`
+    (ec/volume.py contract -> VolumeEcShardRead) serves the ids listed in
+    `remote_shards` by RANGE, so a repair-efficient codec's plan fetches
+    byte ranges off the network instead of d full shards. Every survivor
+    byte consumed lands in SeaweedFS_repair_bytes_read_total{codec} and
+    in `stats` (bytes_read / bytes_written / codec / path). Returns the
+    shard ids rebuilt (always materialized locally under `base`).
     """
     from .. import tracing
-    present = find_shards(base, geo.n)
+    present_local = find_shards(base, geo.n)
+    # a shard the caller explicitly wants rebuilt is never a survivor,
+    # even if a stale holder list still claims a remote copy
+    remote = [s for s in (remote_shards or ())
+              if s not in present_local and shard_reader is not None
+              and (wanted is None or s not in set(wanted))]
+    present = set(present_local) | set(remote)
     missing = sorted(set(wanted) if wanted is not None
-                     else set(range(geo.n)) - set(present))
-    missing = [m for m in missing if m not in present]
+                     else set(range(geo.n)) - present)
+    missing = [m for m in missing if m not in present_local]
     if not missing:
         return []
-    with tracing.start_span(
-            "ec.rebuild", component="ec",
-            attrs={"base": os.path.basename(base), "missing": missing,
-                   "present": len(present), "coder": type(coder).__name__}):
-        return _rebuild_shards(base, geo, coder, present, missing, chunk,
-                               batch)
-
-
-def _rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
-                    present: dict[int, str], missing: list[int],
-                    chunk: int, batch: int) -> list[int]:
     if len(present) < geo.d:
         raise RuntimeError(
             f"cannot rebuild: only {len(present)} shards present, need {geo.d}")
+    shard_size = _shard_size(base, geo, present_local)
+    with tracing.start_span(
+            "ec.rebuild", component="ec",
+            attrs={"base": os.path.basename(base), "missing": missing,
+                   "present": len(present), "remote": len(remote),
+                   "coder": type(coder).__name__,
+                   "codec": coder.codec}) as sp:
+        from . import repair
+        counter = repair.RepairCounter(coder.codec)
+        readers, close = repair.make_readers(
+            base, present_local, shard_reader, remote, counter)
+        try:
+            path = _dispatch_rebuild(base, geo, coder, tuple(sorted(present)),
+                                     missing, readers, shard_size, chunk,
+                                     batch, counter)
+        finally:
+            close()
+        sp.set_attr("bytes_read", counter.bytes_read)
+        sp.set_attr("bytes_written", counter.bytes_written)
+        sp.set_attr("path", path)
+        if stats is not None:
+            stats.update(bytes_read=counter.bytes_read,
+                         bytes_written=counter.bytes_written,
+                         codec=coder.codec, path=path,
+                         shard_size=shard_size)
+        return missing
+
+
+def _shard_size(base: str, geo: EcGeometry,
+                present_local: dict[int, str]) -> int:
+    if present_local:
+        return os.path.getsize(next(iter(present_local.values())))
+    info = files.read_vif(base + ".vif")
+    dat_size = info.get("dat_size")
+    if dat_size is None:
+        raise RuntimeError(f"cannot size shards of {base}: no local "
+                           "survivor and no .vif")
+    return geo.shard_file_size(dat_size)
+
+
+def _dispatch_rebuild(base: str, geo: EcGeometry, coder: ErasureCoder,
+                      present: tuple, missing: list[int], readers: dict,
+                      shard_size: int, chunk: int, batch: int,
+                      counter) -> str:
+    """Pick the cheapest reconstruction the codec supports; returns the
+    path taken ("ranged" | "general" | "full") for stats/traces."""
+    from . import repair
+    plan = coder.repair_plan(present, tuple(missing), shard_size)
+    if plan is not None:
+        repair.rebuild_piggyback_single(base, coder, missing[0], readers,
+                                        shard_size, counter)
+        return "ranged"
+    if coder.codec == "piggyback":
+        repair.rebuild_piggyback_general(base, coder, present, missing,
+                                         readers, shard_size, counter)
+        return "general"
+    _rebuild_positional(base, geo, coder, present, missing, readers,
+                        shard_size, chunk, batch, counter)
+    return "full"
+
+
+def _rebuild_positional(base: str, geo: EcGeometry, coder: ErasureCoder,
+                        present: tuple, missing: list[int], readers: dict,
+                        shard_size: int, chunk: int, batch: int,
+                        counter) -> None:
+    """Plain-RS path: positional reconstruct over [batch, d, chunk] slabs
+    of the first d survivors (device-batched like encode)."""
     use = sorted(present)[:geo.d]
-    shard_size = os.path.getsize(present[use[0]])
-    survivors = [np.memmap(present[i], dtype=np.uint8, mode="r") for i in use]
     outs = {}
     for m in missing:
         p = base + files.shard_ext(m)
@@ -103,19 +172,21 @@ def _rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
         off, span, nb = ctx
         for k, m in enumerate(missing):
             outs[m][off:off + span] = rebuilt[:nb, k].reshape(-1)[:span]
+        counter.wrote(span * len(missing))
 
     for off in range(0, shard_size, chunk * batch):
         span = min(chunk * batch, shard_size - off)
         nb = (span + chunk - 1) // chunk
         arr = pipe.next_buffer()
         # vectorized survivor load: one strided copy per survivor shard
-        for r, mm in enumerate(survivors):
+        for r, sid in enumerate(use):
+            row = readers[sid](off, span)
             if span < nb * chunk:
                 padded = np.zeros(nb * chunk, dtype=np.uint8)
-                padded[:span] = mm[off:off + span]
+                padded[:span] = row
                 arr[:nb, r] = padded.reshape(nb, chunk)
             else:
-                arr[:nb, r] = np.asarray(mm[off:off + span]).reshape(nb, chunk)
+                arr[:nb, r] = row.reshape(nb, chunk)
         if nb < batch:
             arr[nb:] = 0
         EC_REBUILD_BYTES.inc(type(coder).__name__, amount=arr.nbytes)
@@ -124,7 +195,6 @@ def _rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
     pipe.flush()
     for o in outs.values():
         o.flush()
-    return missing
 
 
 def decode_volume(base: str, dat_out: str, geo: EcGeometry,

@@ -30,6 +30,16 @@ class EcGeometry:
     def n(self) -> int:
         return self.d + self.p
 
+    @classmethod
+    def from_vif(cls, info: dict,
+                 defaults: "EcGeometry | None" = None) -> "EcGeometry":
+        """Geometry from a .vif dict, absent/zero fields falling back to
+        `defaults` (one grammar for every .vif consumer)."""
+        d = defaults or cls()
+        return cls(info.get("d") or d.d, info.get("p") or d.p,
+                   info.get("large_block") or d.large_block,
+                   info.get("small_block") or d.small_block)
+
     def large_rows(self, dat_size: int) -> int:
         """Number of large rows (reference encodeDatFile loop :218-233)."""
         rows = 0

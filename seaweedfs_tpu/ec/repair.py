@@ -1,0 +1,332 @@
+"""Repair-traffic plumbing: byte-counted shard readers, the piggyback
+overlay, ranged/codec-aware rebuild paths, and degraded-interval
+reconstruction for piggybacked volumes.
+
+This module is the file-and-wire half of ops/piggyback.py: the coder
+owns the GF math and the repair *plan* (which byte ranges of which
+survivors), this module executes plans against local shard files and
+remote ranged fetches (`shard_reader` -> VolumeEcShardRead, which
+already takes offset/length), counts every survivor byte into
+`SeaweedFS_repair_bytes_read_total` / `_written_total`, and streams in
+bounded windows so a 30 GB stripe never needs d shards of RAM.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import numpy as np
+
+from ..ops.piggyback import PiggybackCoder
+from ..utils.log import logger
+from . import files
+
+log = logger("ec.repair")
+
+# streaming window for the windowed repair paths: big enough to amortize
+# per-call fetch overhead, small enough to keep d in-flight rows bounded
+REPAIR_WINDOW = 4 << 20
+
+# shard_reader(shard_id, offset, length) -> bytes (ec/volume.py contract)
+ShardReader = Callable[[int, int, int], bytes]
+
+
+class RepairCounter:
+    """bytes_read / bytes_written accounting for one repair, mirrored to
+    the codec-labelled repair counters as it accumulates."""
+
+    def __init__(self, codec: str):
+        self.codec = codec or "rs"
+        self.bytes_read = 0
+        self.bytes_written = 0
+
+    def read(self, n: int) -> None:
+        self.bytes_read += n
+        try:
+            from ..stats import REPAIR_BYTES_READ
+            REPAIR_BYTES_READ.inc(self.codec, amount=n)
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break repair)
+            pass
+
+    def wrote(self, n: int) -> None:
+        self.bytes_written += n
+        try:
+            from ..stats import REPAIR_BYTES_WRITTEN
+            REPAIR_BYTES_WRITTEN.inc(self.codec, amount=n)
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break repair)
+            pass
+
+
+def make_readers(base: str, present_local: "dict[int, str]",
+                 shard_reader: "ShardReader | None",
+                 remote_sids, counter: RepairCounter,
+                 ) -> "tuple[dict[int, Callable[[int, int], np.ndarray]], Callable[[], None]]":
+    """(readers, close): per-shard `read(offset, length) -> uint8 array`
+    over local files and ranged remote fetches, every byte counted."""
+    fds: dict[int, int] = {}
+
+    def local(sid: int):
+        def read(off: int, ln: int) -> np.ndarray:
+            buf = os.pread(fds[sid], ln, off)
+            if len(buf) != ln:
+                raise OSError(f"short read of shard {sid} at {off}")
+            counter.read(ln)
+            return np.frombuffer(buf, dtype=np.uint8)
+        return read
+
+    def remote(sid: int):
+        def read(off: int, ln: int) -> np.ndarray:
+            buf = shard_reader(sid, off, ln)
+            if len(buf) != ln:
+                raise OSError(f"short remote read of shard {sid} at {off}")
+            counter.read(ln)
+            return np.frombuffer(buf, dtype=np.uint8)
+        return read
+
+    readers: dict[int, Callable] = {}
+    for sid, path in present_local.items():
+        fds[sid] = os.open(path, os.O_RDONLY)
+        readers[sid] = local(sid)
+    for sid in remote_sids or ():
+        if sid not in readers and shard_reader is not None:
+            readers[sid] = remote(sid)
+
+    def close() -> None:
+        for fd in fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                log.debug("closing survivor fd under %s failed", base,
+                          exc_info=True)
+    return readers, close
+
+
+def _open_outputs(base: str, missing, shard_size: int) -> "dict[int, int]":
+    outs = {}
+    for m in missing:
+        p = base + files.shard_ext(m)
+        with open(p, "wb") as f:
+            f.truncate(shard_size)
+        outs[m] = os.open(p, os.O_RDWR)
+    return outs
+
+
+def _pwrite(fd: int, arr: np.ndarray, off: int) -> None:
+    mv = memoryview(np.ascontiguousarray(arr)).cast("B")
+    n = os.pwrite(fd, mv, off)
+    while n < len(mv):
+        mv = mv[n:]
+        off += n
+        n = os.pwrite(fd, mv, off)
+
+
+# ---------------------------------------------------------------------------
+# Hitchhiker single-data-shard repair: execute the coder's ranged plan.
+# ---------------------------------------------------------------------------
+
+def rebuild_piggyback_single(base: str, pb: PiggybackCoder, f: int,
+                             readers: dict, shard_size: int,
+                             counter: RepairCounter,
+                             window: int = REPAIR_WINDOW) -> None:
+    """Rebuild data shard f from byte ranges of survivors (the plan
+    ops/piggyback.py:repair_plan describes): (d-1) b-halves + parity 0's
+    b-half decode b_f; the piggybacked parity's b-half plus the group's
+    a-halves release a_f. Reads (d + |S_g|) / 2 shard-equivalents."""
+    d = pb.d
+    g, grp = pb.group_of(f)
+    half = shard_size // 2
+    present_b = tuple(sorted([i for i in range(d) if i != f] + [d]))
+    outs = _open_outputs(base, [f], shard_size)
+    try:
+        for w in range(0, half, window):
+            wl = min(window, half - w)
+            b_rows = np.stack([readers[s](half + w, wl) for s in present_b])
+            b_f = np.asarray(pb.inner.reconstruct(b_rows, present_b, (f,)),
+                             dtype=np.uint8)[0]
+            # full b substripe of the data shards, in id order
+            all_b = np.empty((d, wl), dtype=np.uint8)
+            for idx, s in enumerate(present_b[:-1]):
+                all_b[s] = b_rows[idx]
+            all_b[f] = b_f
+            p_g = np.asarray(pb.inner.reconstruct(
+                all_b, tuple(range(d)), (d + g,)), dtype=np.uint8)[0]
+            a_f = readers[d + g](half + w, wl) ^ p_g
+            for i in grp:
+                if i != f:
+                    a_f = a_f ^ readers[i](w, wl)
+            _pwrite(outs[f], a_f, w)
+            _pwrite(outs[f], b_f, half + w)
+            counter.wrote(2 * wl)
+    finally:
+        for fd in outs.values():
+            os.fsync(fd)
+            os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# General piggyback rebuild (multi-loss, parity loss): two streamed passes.
+# ---------------------------------------------------------------------------
+
+def rebuild_piggyback_general(base: str, pb: PiggybackCoder,
+                              present, missing, readers: dict,
+                              shard_size: int, counter: RepairCounter,
+                              window: int = REPAIR_WINDOW) -> None:
+    """Pass A rebuilds the a-halves (substripe a is plain RS over ALL
+    shards, piggybacked parities included); pass B purifies surviving
+    piggybacked parities with the now-complete a substripe, decodes the
+    b-halves, and re-applies the piggyback to rebuilt parities."""
+    d = pb.d
+    half = shard_size // 2
+    used = tuple(sorted(present))[:d]
+    missing = tuple(sorted(missing))
+    outs = _open_outputs(base, missing, shard_size)
+
+    def out_read(m: int, off: int, ln: int) -> np.ndarray:
+        buf = os.pread(outs[m], ln, off)
+        return np.frombuffer(buf, dtype=np.uint8)
+
+    try:
+        for w in range(0, half, window):  # pass A: a substripe
+            wl = min(window, half - w)
+            a_rows = np.stack([readers[s](w, wl) for s in used])
+            rec = np.asarray(pb.inner.reconstruct(a_rows, used, missing),
+                             dtype=np.uint8)
+            for wi, m in enumerate(missing):
+                _pwrite(outs[m], rec[wi], w)
+                counter.wrote(wl)
+        # which piggyback groups pass B must materialize: one per
+        # surviving piggybacked parity (to purify) or rebuilt one
+        need_g = sorted({s - d for s in used if s > d}
+                        | {m - d for m in missing if m > d})
+        # a group member may be missing WITHOUT being rebuilt here (the
+        # caller wanted only a parity): its a-half exists nowhere on
+        # disk, so decode it per-window from the survivors' a substripe
+        aux = tuple(sorted({i for g in need_g for i in pb.groups[g - 1]
+                            if i not in readers and i not in outs}))
+        for w in range(0, half, window):  # pass B: b substripe
+            wl = min(window, half - w)
+            b_rows = np.stack([readers[s](half + w, wl) for s in used])
+            aux_a = {}
+            if aux:
+                a_rows = np.stack([readers[s](w, wl) for s in used])
+                rec_a = np.asarray(pb.inner.reconstruct(a_rows, used, aux),
+                                   dtype=np.uint8)
+                aux_a = {i: rec_a[ai] for ai, i in enumerate(aux)}
+            xg = {}
+            for g in need_g:
+                x = np.zeros(wl, dtype=np.uint8)
+                for i in pb.groups[g - 1]:
+                    if i in aux_a:
+                        x = x ^ aux_a[i]
+                    elif i in readers:
+                        x = x ^ readers[i](w, wl)
+                    else:
+                        x = x ^ out_read(i, w, wl)
+                xg[g] = x
+            for idx, s in enumerate(used):
+                if s > d:
+                    b_rows[idx] ^= xg[s - d]
+            rec = np.asarray(pb.inner.reconstruct(b_rows, used, missing),
+                             dtype=np.uint8)
+            for wi, m in enumerate(missing):
+                row = rec[wi]
+                if m > d:
+                    row = row ^ xg[m - d]
+                _pwrite(outs[m], row, half + w)
+                counter.wrote(wl)
+    finally:
+        for fd in outs.values():
+            os.fsync(fd)
+            os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# Encode-side overlay: plain-RS shard files -> piggybacked parity files.
+# ---------------------------------------------------------------------------
+
+def apply_piggyback_overlay(out_base: str, pb: PiggybackCoder,
+                            shard_size: int,
+                            window: int = REPAIR_WINDOW) -> None:
+    """Fold the piggyback XORs into freshly written plain-RS parity
+    files (ec/stream.py encodes slabs with the inner coder — device
+    batching untouched — then seals through this overlay): for each
+    piggybacked parity g, parity_file[half:] ^= XOR of the group's data
+    files[:half]. Runs while the encode's page cache is hot."""
+    if shard_size == 0:
+        return
+    if shard_size % 2:
+        raise ValueError(f"piggyback needs an even shard size, got "
+                         f"{shard_size} (block sizes must be even)")
+    half = shard_size // 2
+    d = pb.d
+    for g, grp in enumerate(pb.groups, start=1):
+        if not grp:
+            continue
+        data_fds = [os.open(out_base + files.shard_ext(i), os.O_RDONLY)
+                    for i in grp]
+        pfd = os.open(out_base + files.shard_ext(d + g), os.O_RDWR)
+        try:
+            for w in range(0, half, window):
+                wl = min(window, half - w)
+                x = np.frombuffer(os.pread(pfd, wl, half + w),
+                                  dtype=np.uint8).copy()
+                for fd in data_fds:
+                    x ^= np.frombuffer(os.pread(fd, wl, w), dtype=np.uint8)
+                _pwrite(pfd, x, half + w)
+            os.fsync(pfd)
+        finally:
+            os.close(pfd)
+            for fd in data_fds:
+                os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# Degraded reads: reconstruct one interval of a lost data shard when the
+# gathered survivors include piggybacked parities.
+# ---------------------------------------------------------------------------
+
+def reconstruct_interval(pb: PiggybackCoder, gathered: "dict[int, np.ndarray]",
+                         f: int, offset: int, length: int, shard_size: int,
+                         fetch_pair, fetch_map=None) -> bytes:
+    """gathered: >= d survivors' bytes for [offset, offset+length) of
+    their shard files. Survivors from {0..d} (data + the unpiggybacked
+    parity) are positionally plain RS everywhere, and *every* shard is
+    positionally plain in the a-half — only b-half spans decoded through
+    a piggybacked parity need its piggyback stripped, which takes the
+    paired a-range: `fetch_pair(sid, off, ln) -> bytes` supplies it.
+    `fetch_map(fetch_pair, [(sid, off, ln), ...]) -> [bytes, ...]` lets
+    the caller fan the d paired fetches out concurrently (the degraded
+    p99 pays one RTT per shard otherwise); default is sequential."""
+    half = shard_size // 2
+    used = tuple(sorted(gathered))[: pb.d]
+    rows = np.stack([np.frombuffer(gathered[s], dtype=np.uint8)
+                     for s in used])
+    out = np.empty(length, dtype=np.uint8)
+    a_len = max(0, min(length, half - offset))
+    if a_len:  # a-half span: all shards positionally plain
+        rec = np.asarray(pb.inner.reconstruct(rows[:, :a_len], used, (f,)),
+                         dtype=np.uint8)
+        out[:a_len] = rec[0]
+    if a_len < length:  # b-half span
+        b_rows = rows[:, a_len:].copy()
+        pair_off = offset + a_len - half
+        pair_len = length - a_len
+        piggy_gs = sorted({s - pb.d for s in used if s > pb.d})
+        if piggy_gs:
+            reqs = [(s, pair_off, pair_len) for s in used]
+            if fetch_map is None:
+                rows_b = [fetch_pair(*r) for r in reqs]
+            else:
+                rows_b = fetch_map(fetch_pair, reqs)
+            pair = np.stack([np.frombuffer(r, dtype=np.uint8)
+                             for r in rows_b])
+            a_data = np.asarray(pb.inner.reconstruct(
+                pair, used, tuple(range(pb.d))), dtype=np.uint8)
+            for idx, s in enumerate(used):
+                if s > pb.d:
+                    b_rows[idx] ^= pb._xor_group(a_data, pb.groups[s - pb.d - 1])
+        rec = np.asarray(pb.inner.reconstruct(b_rows, used, (f,)),
+                         dtype=np.uint8)
+        out[a_len:] = rec[0]
+    return out.tobytes()

@@ -375,6 +375,10 @@ class _VolumePlan:
     finished: bool = False
     # (view4d [rows, d, nch, C], shard_base, rows, nch) per region
     regions: list[tuple[np.ndarray, int, int, int]] = field(default_factory=list)
+    # piggybacked codec (ops/piggyback.py) to seal with: slabs are encoded
+    # as plain RS by the inner coder (device batching untouched) and
+    # finish() folds the piggyback overlay in before the .vif seal
+    piggyback: "object | None" = None
     # iteration cursor: (region_idx, row, chunk)
     _pos: tuple[int, int, int] = (0, 0, 0)
     # source mapping ownership + outstanding writer-pool runs
@@ -513,12 +517,21 @@ class _VolumePlan:
         self._close_fds()
         self._release_source()
         geo = self.geo
+        codec = "rs"
+        if self.piggyback is not None:
+            # overlay BEFORE the .vif seal: a crash mid-overlay leaves
+            # unsealed (hence rebuildable-from-.dat) outputs, never a
+            # valid-looking .vif over half-piggybacked parities
+            from .repair import apply_piggyback_overlay
+            apply_piggyback_overlay(self.out_base, self.piggyback,
+                                    self.shard_size)
+            codec = self.piggyback.codec
         if self.idx_path and os.path.exists(self.idx_path):
             files.write_ecx_from_idx(self.idx_path, self.out_base + ".ecx")
         files.write_vif(self.out_base + ".vif", version=3,
                         dat_size=self.dat_size, d=geo.d, p=geo.p,
                         large_block=geo.large_block,
-                        small_block=geo.small_block)
+                        small_block=geo.small_block, codec=codec)
         self.finished = True
 
     def abort(self) -> None:
@@ -591,7 +604,12 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
     """
     assert coder.d == geo.d and coder.p == geo.p
     chunk = fit_chunk(geo, chunk)
-    if null_sink and coder.async_dispatch:
+    # a piggybacked codec encodes its slabs as plain RS through the inner
+    # backend (so the device pipeline below is codec-agnostic) and folds
+    # the piggyback overlay in at seal time (_VolumePlan.finish)
+    pb = coder if coder.codec == "piggyback" else None
+    slab_coder = coder.inner if pb is not None else coder
+    if null_sink and slab_coder.async_dispatch:
         raise ValueError("null_sink is a sync-coder measurement mode")
     if stats is None:
         stats = {}
@@ -601,15 +619,15 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
     with tracing.start_span(
             "ec.encode", component="ec",
             attrs={"volumes": len(jobs), "bytes": total,
-                   "coder": type(coder).__name__,
+                   "coder": type(coder).__name__, "codec": coder.codec,
                    "geometry": f"{geo.d}+{geo.p}"}) as sp:
-        if not coder.async_dispatch:
-            res = _encode_volumes_sync(jobs, geo, coder, chunk, batch,
+        if not slab_coder.async_dispatch:
+            res = _encode_volumes_sync(jobs, geo, slab_coder, chunk, batch,
                                        stats, null_sink=null_sink,
-                                       writers=writers)
+                                       writers=writers, pb=pb)
         else:
-            res = _encode_volumes_async(jobs, geo, coder, chunk, batch,
-                                        depth, stats, writers=writers)
+            res = _encode_volumes_async(jobs, geo, slab_coder, chunk, batch,
+                                        depth, stats, writers=writers, pb=pb)
         _publish_pipeline_stats(stats, sp)
         return res
 
@@ -647,6 +665,7 @@ def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,
                          chunk: int, batch: int, stats: "dict | None",
                          null_sink: bool = False,
                          writers: "int | None" = None,
+                         pb=None,
                          ) -> "dict[str, list[str]]":
     """Zero-copy streaming encode for synchronous host coders.
 
@@ -671,7 +690,8 @@ def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,
     created: list[_VolumePlan] = []
     try:
         for dat_path, out_base, idx_path in jobs:
-            plan = _VolumePlan(dat_path, out_base, idx_path, geo, chunk)
+            plan = _VolumePlan(dat_path, out_base, idx_path, geo, chunk,
+                               piggyback=pb)
             created.append(plan)
             out[dat_path] = [out_base + files.shard_ext(i)
                              for i in range(geo.n)]
@@ -756,13 +776,15 @@ def _encode_volumes_async(jobs, geo: EcGeometry, coder: ErasureCoder,
                           chunk: int, batch: int, depth: int,
                           stats: "dict | None",
                           writers: "int | None" = None,
+                          pb=None,
                           ) -> "dict[str, list[str]]":
 
     from ..stats import EC_ENCODE_BYTES
     out: dict[str, list[str]] = {}
     todo = deque()
     for dat_path, out_base, idx_path in jobs:
-        todo.append(_VolumePlan(dat_path, out_base, idx_path, geo, chunk))
+        todo.append(_VolumePlan(dat_path, out_base, idx_path, geo, chunk,
+                                piggyback=pb))
         out[dat_path] = [out_base + files.shard_ext(i) for i in range(geo.n)]
 
     d, p = geo.d, geo.p

@@ -88,13 +88,13 @@ class EcVolume:
         self.collection = collection
         info = files.read_vif(base + ".vif")
         if geo is None:
-            defaults = EcGeometry()
-            geo = EcGeometry(
-                d=info.get("d", defaults.d), p=info.get("p", defaults.p),
-                large_block=info.get("large_block", defaults.large_block),
-                small_block=info.get("small_block", defaults.small_block))
+            geo = EcGeometry.from_vif(info)
         self.geo = geo
         self.dat_size = info.get("dat_size", 0) or files.max_ecx_extent(base + ".ecx")
+        # codec the shards were sealed with (the .vif is the source of
+        # truth — rebuild/degraded reads must decode with the codec that
+        # encoded; pre-codec .vifs are plain RS by construction)
+        self.codec = info.get("codec", "rs")
         self.destroy_time = info.get("destroy_time", 0)  # fork TTL reap
         self.shards: dict[int, EcVolumeShard] = {}
         for i, p in sorted(self._scan_shards().items()):
@@ -105,6 +105,12 @@ class EcVolume:
         return {i: self.base + files.shard_ext(i)
                 for i in range(self.geo.n)
                 if os.path.exists(self.base + files.shard_ext(i))}
+
+    @property
+    def shard_size(self) -> int:
+        """Per-shard file size implied by the stripe geometry (repair
+        byte-costing; local shard files agree by construction)."""
+        return self.geo.shard_file_size(self.dat_size)
 
     @property
     def ecx_path(self) -> str:

@@ -18,10 +18,12 @@ Consumers:
 
 from .planner import (ACTION_EC_REBUILD, ACTION_EC_REMOUNT,
                       ACTION_REPLICATE, RepairItem, RepairPlan, build_plan)
-from .executor import RepairExecutor, make_remount_probe
+from .executor import (RepairExecutor, make_geometry_probe, make_probes,
+                       make_remount_probe)
 
 __all__ = [
     "ACTION_EC_REBUILD", "ACTION_EC_REMOUNT", "ACTION_REPLICATE",
     "RepairItem", "RepairPlan", "build_plan",
-    "RepairExecutor", "make_remount_probe",
+    "RepairExecutor", "make_geometry_probe", "make_probes",
+    "make_remount_probe",
 ]

@@ -45,40 +45,89 @@ log = logger("repair.executor")
 SKIP_COOLDOWN, SKIP_LOCK, SKIP_BUDGET = "cooldown", "lock", "budget"
 
 
-def make_remount_probe(env):
+class _InfoSweep:
+    """One VolumeEcShardsInfo sweep shared by the remount and geometry
+    probes: ONE topology snapshot for the whole plan (a node death
+    degrades many stripes at once) and per-(server, stripe) memoized
+    responses, so costing an item never re-issues the RPC its remount
+    probe just made while the admin lock is held."""
+
+    def __init__(self, env):
+        self.env = env
+        self._servers: list = []
+        self._memo: dict = {}
+
+    def servers(self) -> list:
+        if not self._servers:
+            self._servers.extend(self.env.collect_volume_servers())
+        return self._servers
+
+    def info(self, srv: dict, vid: int, collection: str):
+        """The server's VolumeEcShardsInfo response, or None (dead
+        server / not a holder) — memoized either way."""
+        from ..pb import volume_server_pb2 as vpb
+        from ..utils.rpc import Stub, VOLUME_SERVICE
+        key = (srv["id"], vid)
+        if key in self._memo:
+            return self._memo[key]
+        try:
+            resp = Stub(self.env.grpc_addr(srv["id"], srv["grpc_port"]),
+                        VOLUME_SERVICE).call(
+                "VolumeEcShardsInfo",
+                vpb.VolumeEcShardsInfoRequest(volume_id=vid,
+                                              collection=collection),
+                vpb.VolumeEcShardsInfoResponse, timeout=5)
+        except Exception:  # noqa: BLE001 — a dead server has no disk
+            resp = None
+        self._memo[key] = resp
+        return resp
+
+
+def make_probes(env) -> tuple:
+    """(probe_remountable, probe_geometry) over ONE shared info sweep —
+    what build_plan call sites should use."""
+    sweep = _InfoSweep(env)
+    return (make_remount_probe(env, sweep), make_geometry_probe(env, sweep))
+
+
+def make_remount_probe(env, sweep: "_InfoSweep | None" = None):
     """Planner probe: which of an EC volume's missing shards still exist
     ON DISK on live servers? Read-only — VolumeEcShardsInfo reports the
     shard files it can see (mounted or not); nothing is mounted, copied,
     or deleted, so `cluster.repair -dryRun` may run it freely."""
-    from ..pb import volume_server_pb2 as vpb
-    from ..utils.rpc import Stub, VOLUME_SERVICE
-
-    # one topology snapshot for the whole plan: a node death degrades
-    # many stripes at once and the planner probes per EC item — re-doing
-    # the master VolumeList RPC per item would serialize dozens of
-    # redundant calls inside the sweep while the admin lock is held
-    servers_cache: list = []
+    sweep = sweep or _InfoSweep(env)
 
     def probe(vid: int, missing: list[int], collection: str) -> dict:
-        if not servers_cache:
-            servers_cache.extend(env.collect_volume_servers())
         found: dict[str, list[int]] = {}
         claimed: set[int] = set()
-        for srv in servers_cache:
-            try:
-                info = Stub(env.grpc_addr(srv["id"], srv["grpc_port"]),
-                            VOLUME_SERVICE).call(
-                    "VolumeEcShardsInfo",
-                    vpb.VolumeEcShardsInfoRequest(volume_id=vid,
-                                                  collection=collection),
-                    vpb.VolumeEcShardsInfoResponse, timeout=5)
-            except Exception:  # noqa: BLE001 — a dead server has no disk
+        for srv in sweep.servers():
+            info = sweep.info(srv, vid, collection)
+            if info is None:
                 continue
             sids = sorted(set(info.local_shard_ids) & set(missing) - claimed)
             if sids:
                 found[srv["id"]] = sids
                 claimed.update(sids)
         return found
+
+    return probe
+
+
+def make_geometry_probe(env, sweep: "_InfoSweep | None" = None):
+    """Planner probe: a volume's sealed erasure geometry — codec, d, p,
+    shard_size — straight from a holder's .vif (VolumeEcShardsInfo).
+    Read-only; feeds the planner's codec-aware `bytes_moved` costing."""
+    sweep = sweep or _InfoSweep(env)
+
+    def probe(vid: int, collection: str) -> "dict | None":
+        for srv in sweep.servers():
+            info = sweep.info(srv, vid, collection)
+            if info is not None and info.data_shards:
+                return {"codec": info.codec or "rs",
+                        "d": info.data_shards, "p": info.parity_shards,
+                        "shard_size": info.shard_size,
+                        "dat_size": info.dat_size}
+        return None
 
     return probe
 
@@ -285,13 +334,16 @@ class RepairExecutor:
         return {"remounted": mounted, "errors": errs or None}
 
     def _do_ec_rebuild(self, it: RepairItem) -> dict:
-        """Delegate to the shell's ec.rebuild for one volume: gather the
-        surviving shards onto a holder, reconstruct, remount. The shell
-        command already handles settled-holder polling and per-shard
-        donor failover."""
+        """Delegate to the shell's ec.rebuild for one volume: reconstruct
+        on the best holder with ranged survivor fetches, remount. The
+        shell command already handles settled-holder polling; its
+        byte totals flow into the repair.done journal event so the
+        codec's repair-traffic win is visible at /debug/events."""
         from ..shell.ec_commands import cmd_ec_rebuild
-        cmd_ec_rebuild(self.env, ["-volumeId", str(it.vid)])
-        return {"shards": it.shard_ids}
+        res = cmd_ec_rebuild(self.env, ["-volumeId", str(it.vid)]) or {}
+        return {"shards": it.shard_ids,
+                "bytes_read": res.get("bytes_read", 0),
+                "bytes_written": res.get("bytes_written", 0)}
 
     def _do_replicate(self, it: RepairItem) -> dict:
         """Copy the volume from a healthy holder to `deficit` servers

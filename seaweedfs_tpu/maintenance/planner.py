@@ -65,6 +65,12 @@ class RepairItem:
     targets: list[str] = field(default_factory=list)   # landing node ids
     # ec.remount: node id -> shard ids found on that node's disk
     remount: dict[str, list[int]] = field(default_factory=dict)
+    # network cost of this repair in survivor/copy bytes (0 = free, as a
+    # remount is; -1 = unknown, no geometry probe reached the volume).
+    # Codec-aware: a piggybacked stripe's single-data-shard rebuild costs
+    # (d+|group|)/2 half-shards where plain RS costs d full shards.
+    bytes_moved: int = -1
+    repair_codec: str = ""
 
     @property
     def key(self) -> tuple[str, int]:
@@ -73,16 +79,19 @@ class RepairItem:
         return (self.kind, self.vid)
 
     def describe(self) -> str:
+        cost = (f" (~{self.bytes_moved:,} B moved)"
+                if self.bytes_moved > 0 else "")
         if self.action == ACTION_EC_REMOUNT:
             where = ", ".join(f"{n}:{sids}" for n, sids in
                               sorted(self.remount.items()))
             return (f"{self.action} ec volume {self.vid} "
                     f"shards on disk at {where}")
         if self.action == ACTION_EC_REBUILD:
+            codec = f" [{self.repair_codec}]" if self.repair_codec else ""
             return (f"{self.action} ec volume {self.vid} "
-                    f"missing shards {self.shard_ids}")
+                    f"missing shards {self.shard_ids}{codec}{cost}")
         return (f"{self.action} volume {self.vid} "
-                f"x{self.deficit} {self.sources[:1]} -> {self.targets}")
+                f"x{self.deficit} {self.sources[:1]} -> {self.targets}{cost}")
 
     def to_dict(self) -> dict:
         return {"action": self.action, "kind": self.kind, "vid": self.vid,
@@ -90,7 +99,9 @@ class RepairItem:
                 "distance_to_data_loss": self.distance,
                 "shard_ids": list(self.shard_ids), "deficit": self.deficit,
                 "sources": list(self.sources), "targets": list(self.targets),
-                "remount": {n: list(s) for n, s in self.remount.items()}}
+                "remount": {n: list(s) for n, s in self.remount.items()},
+                "bytes_moved": self.bytes_moved,
+                "repair_codec": self.repair_codec}
 
 
 @dataclass
@@ -123,9 +134,13 @@ class RepairPlan:
 
 
 def _sort_key(it: RepairItem):
+    # ties break by network cost, cheapest first (the warehouse-cluster
+    # ordering: most-at-risk, then least repair traffic); unknown cost
+    # (-1) sorts after every known cost rather than before
+    cost = it.bytes_moved if it.bytes_moved >= 0 else float("inf")
     return (it.distance, -_RANK[it.severity],
             0 if it.kind == "ec" else 1,
-            _ACTION_ORDER.get(it.action, 9), it.vid)
+            _ACTION_ORDER.get(it.action, 9), cost, it.vid)
 
 
 def _pick_replica_targets(report: dict, holders: list[str],
@@ -146,7 +161,32 @@ def _pick_replica_targets(report: dict, holders: list[str],
     return ranked[:deficit]
 
 
-def build_plan(report: dict, probe_remountable=None) -> RepairPlan:
+def _ec_rebuild_cost(probe_geometry, vid: int, collection: str,
+                     missing: "list[int]") -> tuple[int, str]:
+    """(bytes the rebuild must read, codec) — codec-aware via the
+    volume's sealed geometry. (-1, "") when no probe reached it."""
+    if probe_geometry is None:
+        return -1, ""
+    try:
+        g = probe_geometry(vid, collection)
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        log.warning("geometry probe for ec %s failed: %s", vid, e)
+        return -1, ""
+    if not g or not g.get("shard_size") or not g.get("d") or not g.get("p"):
+        return -1, (g or {}).get("codec", "")
+    codec = g.get("codec") or "rs"
+    try:
+        from ..ops.coder import repair_read_bytes
+        return (repair_read_bytes(codec, g["d"], g["p"], missing,
+                                  g["shard_size"]), codec)
+    except Exception as e:  # noqa: BLE001 — a malformed .vif must cost
+        log.warning("repair cost for ec %s (codec %s, %s+%s) failed: %s",
+                    vid, codec, g.get("d"), g.get("p"), e)
+        return -1, codec  # ...one stripe its estimate, not the whole plan
+
+
+def build_plan(report: dict, probe_remountable=None,
+               probe_geometry=None) -> RepairPlan:
     """Derive the repair plan from a health report (master/health.py
     evaluate() / HealthEngine.scan() / GET /cluster/health — all three
     produce the same shape).
@@ -156,6 +196,12 @@ def build_plan(report: dict, probe_remountable=None) -> RepairPlan:
     exist ON DISK on live holders (executor.make_remount_probe wires it
     to VolumeEcShardsInfo). Shards it finds become `ec.remount` items;
     the remainder become `ec.rebuild`.
+
+    `probe_geometry(vid, collection) -> {codec, d, p, shard_size}` is
+    equally optional/read-only (executor.make_geometry_probe): with it,
+    every item carries its network cost in `bytes_moved` — computed with
+    the volume's sealed codec, so a piggybacked stripe's cheaper
+    reconstruction is what gets costed and ordered.
     """
     from ..utils import retry
 
@@ -193,28 +239,35 @@ def build_plan(report: dict, probe_remountable=None) -> RepairPlan:
                     action=ACTION_EC_REMOUNT, kind="ec", vid=it["id"],
                     collection=it.get("collection", ""), severity=sev,
                     distance=it["distance_to_data_loss"],
-                    shard_ids=remountable, remount=remount))
+                    shard_ids=remountable, remount=remount,
+                    bytes_moved=0))  # mount-back moves no shard bytes
             rebuild = [s for s in missing if s not in remountable]
             if rebuild:
                 # donors are the surviving shard holders; the executor
                 # resolves them live (holder sets drift between plan and
                 # execution as heartbeats land)
+                cost, codec = _ec_rebuild_cost(
+                    probe_geometry, it["id"], it.get("collection", ""),
+                    rebuild)
                 items.append(RepairItem(
                     action=ACTION_EC_REBUILD, kind="ec", vid=it["id"],
                     collection=it.get("collection", ""), severity=sev,
                     distance=it["distance_to_data_loss"],
-                    shard_ids=rebuild))
+                    shard_ids=rebuild, bytes_moved=cost,
+                    repair_codec=codec))
         elif kind == "volume":
             deficit = it.get("replica_deficit", 0)
             if not deficit:
                 continue
             holders = sorted(it.get("holders", ()))
+            size = it.get("size")  # absent (pre-size reports) != zero
             items.append(RepairItem(
                 action=ACTION_REPLICATE, kind="volume", vid=it["id"],
                 collection=it.get("collection", ""), severity=sev,
                 distance=it["distance_to_data_loss"], deficit=deficit,
                 sources=retry.order_by_breaker(holders),
-                targets=_pick_replica_targets(report, holders, deficit)))
+                targets=_pick_replica_targets(report, holders, deficit),
+                bytes_moved=(size * deficit if size is not None else -1)))
         # node/disk items (stale heartbeats, full disks) are operator
         # signals, not volume repairs — the plan leaves them to alerts
     items.sort(key=_sort_key)

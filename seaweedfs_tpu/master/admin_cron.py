@@ -214,9 +214,10 @@ class AdminCron:
         """planner -> executor over this sweep's health report. ONE
         executor lives across sweeps so failed repairs keep cooling
         instead of being retried every 17 minutes at full rate."""
-        from ..maintenance import (RepairExecutor, build_plan,
-                                   make_remount_probe)
-        plan = build_plan(report, probe_remountable=make_remount_probe(env))
+        from ..maintenance import RepairExecutor, build_plan, make_probes
+        remount_probe, geometry_probe = make_probes(env)
+        plan = build_plan(report, probe_remountable=remount_probe,
+                          probe_geometry=geometry_probe)
         if self._repair_exec is None:
             self._repair_exec = RepairExecutor(
                 env, max_concurrent=self.repair_max_concurrent,

@@ -130,6 +130,7 @@ def evaluate(snapshot: dict, parity: int = DEFAULT_PARITY_SHARDS,
                 "replicas_expected": v["expected"],
                 "replica_deficit": deficit,
                 "read_only": bool(v.get("read_only")), "full": full,
+                "size": v.get("size", 0),
                 "holders": sorted(v.get("holders", ())),
             })
 

@@ -28,6 +28,10 @@ class ErasureCoder(abc.ABC):
     #: np.asarray (device coders); the streaming pipeline double-buffers
     #: those and takes a zero-copy synchronous fast path for the rest.
     async_dispatch = False
+    #: Erasure codec this coder implements — persisted into the .vif seal
+    #: so rebuild always decodes with the codec that encoded. Plain RS
+    #: coders differ only in compute backend; ops/piggyback.py overrides.
+    codec = "rs"
 
     def __init__(self, d: int, p: int):
         if d <= 0 or p <= 0 or d + p > 256:
@@ -44,6 +48,16 @@ class ErasureCoder(abc.ABC):
     def reconstruct(self, survivors: np.ndarray, present: tuple[int, ...],
                     wanted: tuple[int, ...]) -> np.ndarray:
         """survivors [..., d, L] = shards sorted(present)[:d] -> [..., |wanted|, L]."""
+
+    def repair_plan(self, present: "tuple[int, ...]",
+                    wanted: "tuple[int, ...]", shard_size: int,
+                    ) -> "list[tuple[int, int, int]] | None":
+        """Byte ranges [(shard_id, offset, length), ...] of survivors
+        sufficient to rebuild `wanted`, or None when nothing beats the
+        trivial plan (read d full survivors). Plain RS has no sub-shard
+        structure, so the base answer is always None; repair-efficient
+        codecs (ops/piggyback.py) override."""
+        return None
 
     def verify(self, shards: np.ndarray) -> bool:
         """shards [..., n, L]: recompute parity from data rows and compare."""
@@ -139,6 +153,8 @@ def get_coder(name: str, d: int, p: int) -> ErasureCoder:
             from . import native  # noqa: F401 — registers "native"
         elif name == "mesh":
             from ..parallel import pipeline  # noqa: F401 — registers "mesh"
+        elif name == "piggyback":
+            from . import piggyback  # noqa: F401 — registers "piggyback"
     try:
         cls = _REGISTRY[name]
     except KeyError:
@@ -148,3 +164,17 @@ def get_coder(name: str, d: int, p: int) -> ErasureCoder:
 
 def register_coder(name: str, cls) -> None:
     _REGISTRY[name] = cls
+
+
+def repair_read_bytes(codec: str, d: int, p: int, missing, shard_size: int,
+                      ) -> int:
+    """Survivor bytes a rebuild of `missing` must read under `codec` —
+    the repair planner's byte-costing primitive. Uses the numpy-backed
+    coder purely for plan geometry (no data touches it)."""
+    missing = sorted(set(missing))
+    coder = get_coder("piggyback" if codec == "piggyback" else "numpy", d, p)
+    present = tuple(i for i in range(d + p) if i not in missing)
+    plan = coder.repair_plan(present, tuple(missing), shard_size)
+    if plan is None:
+        return d * shard_size
+    return sum(ln for _, _, ln in plan)

@@ -1078,6 +1078,7 @@ class VolumeServer:
             if ev is None:
                 raise KeyError(f"shard {shard_id} unreachable")
             geo = ev.geo
+            piggybacked = ev.codec == "piggyback"
             gathered: dict[int, bytes] = {}
             remote_sids = []
             for sid in range(geo.n):
@@ -1089,14 +1090,25 @@ class VolumeServer:
                 elif local is None:
                     remote_sids.append(sid)
             sp.set_attr("local_shards", len(gathered))
-            if len(gathered) < geo.d and remote_sids:
+            # piggybacked volumes: shards 0..d (data + the unpiggybacked
+            # parity) decode positionally anywhere, so fetch those first
+            # and touch piggybacked parities only when the plain set
+            # cannot reach d (they need a paired a-range fetch to strip)
+            if piggybacked:
+                waves = [[s for s in remote_sids if s <= geo.d],
+                         [s for s in remote_sids if s > geo.d]]
+            else:
+                waves = [remote_sids]
+            for wave in waves:
+                if len(gathered) >= geo.d or not wave:
+                    continue
                 import concurrent.futures as cf
                 import contextvars
                 # copy_context per submit: the pool threads' fetch spans
                 # must land under THIS reconstruct span, not as orphan
                 # roots (ThreadPoolExecutor does not propagate contextvars)
                 futs = {}
-                for sid in remote_sids:
+                for sid in wave:
                     ctx = contextvars.copy_context()
                     futs[self._ec_read_pool.submit(
                         ctx.run, self._fetch_remote_shard, vid, sid,
@@ -1134,14 +1146,89 @@ class VolumeServer:
             import numpy as np
 
             present = tuple(sorted(gathered))[:geo.d]
+            coder = self.store.coder(geo.d, geo.p, codec=ev.codec)
+            from ..stats import DEGRADED_EC_READS
+            if piggybacked and any(s > geo.d for s in present):
+                # a piggybacked parity is load-bearing: strip its
+                # piggyback with the paired a-range (ec/repair.py)
+                from ..ec import repair as ec_repair
+
+                def fetch_pair(sid: int, off: int, ln: int) -> bytes:
+                    local = ev.shards.get(sid)
+                    if local is not None:
+                        return local.read_at(off, ln)
+                    return self._fetch_range_or_raise(vid, sid, off, ln,
+                                                      locs.get(sid, []))
+                def fetch_map(fn, reqs):
+                    # same fan-out discipline as the gather waves above:
+                    # one serial RTT per paired range would stack onto
+                    # the degraded p99 (copy_context keeps fetch spans
+                    # under this reconstruct span)
+                    import contextvars
+                    futs = [self._ec_read_pool.submit(
+                        contextvars.copy_context().run, fn, *r)
+                        for r in reqs]
+                    return [f.result() for f in futs]
+                sp.set_attr("piggyback_strip", True)
+                out_b = ec_repair.reconstruct_interval(
+                    coder, {s: gathered[s] for s in present}, shard_id,
+                    offset, length, ev.shard_size, fetch_pair,
+                    fetch_map=fetch_map)
+                DEGRADED_EC_READS.inc()
+                return out_b
+            inner = coder.inner if piggybacked else coder
             sl = np.stack([np.frombuffer(gathered[s], dtype=np.uint8)
                            for s in present])
-            coder = self.store.coder(geo.d, geo.p)
-            out = np.asarray(coder.reconstruct(sl, present, (shard_id,)))
-            from ..stats import DEGRADED_EC_READS
+            out = np.asarray(inner.reconstruct(sl, present, (shard_id,)))
             DEGRADED_EC_READS.inc()
             return out[0].tobytes()
         return reader
+
+    def _make_repair_reader(self, vid: int):
+        """(shard_reader, remote_sids) for a rebuild on THIS server:
+        survivors that live elsewhere are fetched by RANGE through
+        VolumeEcShardRead, so a repair-efficient codec's plan moves only
+        its byte ranges instead of whole gathered shard files.
+
+        The read-path location cache is BYPASSED: its freshest tier is
+        still 11 s, and a rebuild planned against a pre-failure holder
+        set would count the lost shard among its survivors. Admin
+        rebuilds are rare; a master round-trip is the right price."""
+        locs = self._lookup_ec_shards_master(vid)
+        if locs is None:
+            # master unreachable: serve the stale cache entry directly
+            # (going through _lookup_ec_shards would re-ask the master we
+            # just saw fail — a second full lookup timeout per rebuild)
+            with self._ec_loc_lock:
+                ent = self._ec_loc_cache.get(vid)
+            locs = ent[0] if ent is not None else {}
+        else:
+            now = time.monotonic()
+            with self._ec_loc_lock:
+                self._ec_loc_cache[vid] = (locs, now, False)
+        me = f"{self.ip}:{self.grpc_port}"
+        peers = {sid: [a for a in addrs if a != me]
+                 for sid, addrs in locs.items()}
+        remote = sorted(sid for sid, addrs in peers.items() if addrs)
+
+        def reader(sid: int, offset: int, length: int) -> bytes:
+            return self._fetch_range_or_raise(vid, sid, offset, length,
+                                              peers.get(sid, []))
+        return reader, remote
+
+    def _fetch_range_or_raise(self, vid: int, sid: int, offset: int,
+                              length: int, holders: "list[str]") -> bytes:
+        """One ranged fetch with the shared fallback discipline: healthy
+        holders first, then circuit-open ones as a last resort (latency
+        beats failing a repair or a recoverable read), else OSError."""
+        data = self._fetch_remote_shard(vid, sid, offset, length, holders)
+        if data is None:
+            data = self._fetch_remote_shard(vid, sid, offset, length,
+                                            holders, include_open=True)
+        if data is None:
+            raise OSError(f"shard {vid}.{sid} range [{offset}, +{length}) "
+                          "unreachable")
+        return data
 
     # shard-location cache staleness tiers (store_ec.go:256-267): complete
     # location sets refresh every 37 min, incomplete every 7 min, and a
@@ -1474,6 +1561,46 @@ class VolumeServer:
             return resp
 
         # ---- EC RPC set ----
+        def _ensure_vif(vid: int, collection: str,
+                        base: "str | None" = None) -> "str | None":
+            """A rebuild decodes with the codec/geometry sealed in the
+            .vif — make sure one exists at `base`, pulling the tiny
+            sidecar from any peer holder when this server's copy is
+            gone (e.g. bases written before source-volume deletes
+            learned to spare it)."""
+            if base is None:
+                ev = store.find_ec_volume(vid)
+                if ev is not None:
+                    base = ev.base
+                else:
+                    for loc in store.locations:
+                        cand = loc.base_name(collection, vid)
+                        if os.path.exists(cand + ".ecx"):
+                            base = cand
+                            break
+            if base is None or os.path.exists(base + ".vif"):
+                return base
+            me = f"{vs.ip}:{vs.grpc_port}"
+            locs = vs._lookup_ec_shards(vid, failed=True)
+            for addr in sorted({a for addrs in locs.values()
+                                for a in addrs if a != me}):
+                try:
+                    src = Stub(addr, VOLUME_SERVICE)
+                    parts = [r.file_content for r in src.call_stream(
+                        "CopyFile",
+                        vpb.CopyFileRequest(volume_id=vid,
+                                            collection=collection,
+                                            ext=".vif", is_ec_volume=True),
+                        vpb.CopyFileResponse)]
+                except Exception:  # noqa: BLE001 — peer may lack it too
+                    continue
+                if any(parts):
+                    with open(base + ".vif", "wb") as f:
+                        for pc in parts:
+                            f.write(pc)
+                    return base
+            return base
+
         @svc.unary("VolumeEcShardsGenerate", vpb.VolumeEcShardsGenerateRequest,
                    vpb.VolumeEcShardsGenerateResponse)
         def ec_generate(req, context):
@@ -1486,7 +1613,8 @@ class VolumeServer:
                 store.generate_ec_shards(req.volume_id, req.collection,
                                          req.data_shards or None,
                                          req.parity_shards or None,
-                                         stats=stats)
+                                         stats=stats,
+                                         codec=req.codec or None)
             except Exception as e:  # noqa: BLE001
                 events.emit("ec.encode.finish", severity=events.ERROR,
                             vid=req.volume_id, node=vs.url, ok=False,
@@ -1509,7 +1637,7 @@ class VolumeServer:
                 done = store.generate_ec_shards_batch(
                     list(req.volume_ids), req.collection,
                     req.data_shards or None, req.parity_shards or None,
-                    stats=stats)
+                    stats=stats, codec=req.codec or None)
             except Exception as e:  # noqa: BLE001
                 events.emit("ec.encode.finish", severity=events.ERROR,
                             node=vs.url, ok=False,
@@ -1522,7 +1650,8 @@ class VolumeServer:
             return vpb.VolumeEcShardsGenerateBatchResponse(
                 encoded_volume_ids=done,
                 data_shards=req.data_shards or store.ec_geometry.d,
-                parity_shards=req.parity_shards or store.ec_geometry.p)
+                parity_shards=req.parity_shards or store.ec_geometry.p,
+                codec=req.codec or store.ec_codec)
 
         @svc.unary("VolumeEcShardsInfo", vpb.VolumeEcShardsInfoRequest,
                    vpb.VolumeEcShardsInfoResponse)
@@ -1544,16 +1673,21 @@ class VolumeServer:
                 return vpb.VolumeEcShardsInfoResponse(
                     data_shards=ev.geo.d, parity_shards=ev.geo.p,
                     dat_size=ev.dat_size or 0,
+                    codec=ev.codec, shard_size=ev.shard_size,
                     local_shard_ids=sorted(set(ev.shards)
                                            | set(on_disk(ev.base))))
             for loc in store.locations:
                 base = loc.base_name(req.collection, req.volume_id)
                 if os.path.exists(base + ".vif"):
                     info = ec_files.read_vif(base + ".vif")
+                    geo = EcGeometry.from_vif(info, store.ec_geometry)
                     return vpb.VolumeEcShardsInfoResponse(
                         data_shards=info.get("d", 0),
                         parity_shards=info.get("p", 0),
                         dat_size=info.get("dat_size", 0),
+                        codec=info.get("codec", "rs"),
+                        shard_size=geo.shard_file_size(
+                            info.get("dat_size", 0)),
                         local_shard_ids=on_disk(base))
             raise KeyError(f"ec volume {req.volume_id} not found")
 
@@ -1565,9 +1699,15 @@ class VolumeServer:
             events.emit("ec.rebuild.start", vid=req.volume_id,
                         collection=req.collection, node=vs.url)
             t0 = time.perf_counter()
+            stats: dict = {}
             try:
+                reader, remote = vs._make_repair_reader(req.volume_id)
+                _ensure_vif(req.volume_id, req.collection)
                 rebuilt = store.rebuild_ec_shards(req.volume_id,
-                                                  req.collection)
+                                                  req.collection,
+                                                  shard_reader=reader,
+                                                  remote_shards=remote,
+                                                  stats=stats)
             except Exception as e:  # noqa: BLE001
                 events.emit("ec.rebuild.finish", severity=events.ERROR,
                             vid=req.volume_id, node=vs.url, ok=False,
@@ -1575,9 +1715,16 @@ class VolumeServer:
                 raise
             events.emit("ec.rebuild.finish", vid=req.volume_id, node=vs.url,
                         ok=True, rebuilt_shard_ids=list(rebuilt),
+                        codec=stats.get("codec", "rs"),
+                        repair_path=stats.get("path"),
+                        bytes_read=stats.get("bytes_read", 0),
+                        bytes_written=stats.get("bytes_written", 0),
                         duration_ms=round((time.perf_counter() - t0) * 1e3, 1))
             vs.flush_heartbeat()
-            return vpb.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+            return vpb.VolumeEcShardsRebuildResponse(
+                rebuilt_shard_ids=rebuilt,
+                bytes_read=stats.get("bytes_read", 0),
+                bytes_written=stats.get("bytes_written", 0))
 
         @svc.unary("VolumeEcShardsCopy", vpb.VolumeEcShardsCopyRequest,
                    vpb.VolumeEcShardsCopyResponse)
@@ -1626,40 +1773,23 @@ class VolumeServer:
         def ec_copy_by_rebuild(req, context):
             loc = store._location_for(None)
             base = loc.base_name(req.collection, req.volume_id)
-            # admin rebuild wants FRESH holders, not read-path cache tiers
-            shard_locs = vs._lookup_ec_shards(req.volume_id, failed=True)
-            info = {}
-            gathered = 0
-            geo = store.ec_geometry
-            for sid, addrs in sorted(shard_locs.items()):
-                if gathered >= geo.d:
-                    break
-                if os.path.exists(base + ec_files.shard_ext(sid)):
-                    gathered += 1
-                    continue
-                for addr in addrs:  # addrs are gRPC addresses
-                    if addr == f"{vs.ip}:{vs.grpc_port}":
-                        continue
-                    try:
-                        src = Stub(addr, VOLUME_SERVICE)
-                        parts = [r.file_content for r in src.call_stream(
-                            "CopyFile",
-                            vpb.CopyFileRequest(volume_id=req.volume_id,
-                                                collection=req.collection,
-                                                ext=ec_files.shard_ext(sid),
-                                                is_ec_volume=True),
-                            vpb.CopyFileResponse)]
-                        with open(base + ec_files.shard_ext(sid), "wb") as f:
-                            for pc in parts:
-                                f.write(pc)
-                        gathered += 1
-                        break
-                    except Exception:  # noqa: BLE001
-                        continue
-            rebuilt = rebuild_shards(base, geo, store.coder(geo.d, geo.p),
-                                     wanted=list(req.shard_ids))
+            # the tiny .vif sidecar still copies whole (it carries the
+            # codec + geometry the rebuild must decode with); survivor
+            # DATA moves only as the ranged fetches the plan asks for
+            _ensure_vif(req.volume_id, req.collection, base)
+            info = ec_files.read_vif(base + ".vif")
+            geo = EcGeometry.from_vif(info, store.ec_geometry)
+            reader, remote = vs._make_repair_reader(req.volume_id)
+            stats: dict = {}
+            rebuilt = rebuild_shards(
+                base, geo,
+                store.coder(geo.d, geo.p, codec=info.get("codec", "rs")),
+                wanted=list(req.shard_ids), shard_reader=reader,
+                remote_shards=remote, stats=stats)
             return vpb.VolumeEcShardsCopyByRebuildResponse(
-                rebuilt_shard_ids=rebuilt)
+                rebuilt_shard_ids=rebuilt,
+                bytes_read=stats.get("bytes_read", 0),
+                bytes_written=stats.get("bytes_written", 0))
 
         @svc.unary("VolumeEcShardsMount", vpb.VolumeEcShardsMountRequest,
                    vpb.VolumeEcShardsMountResponse)

@@ -21,6 +21,19 @@ def _stub(env: CommandEnv, srv: dict) -> Stub:
     return Stub(env.grpc_addr(srv["id"], srv["grpc_port"]), VOLUME_SERVICE)
 
 
+def parse_ec_shards(spec: str) -> tuple[int, int]:
+    """'d,p' -> (d, p); the one grammar every -ecShards flag shares."""
+    try:
+        d_s, p_s = spec.split(",")
+        d, p = int(d_s), int(p_s)
+    except ValueError:
+        raise ValueError(f"-ecShards wants 'd,p' (e.g. 10,4), got {spec!r}"
+                         ) from None
+    if d <= 0 or p <= 0 or d + p > 256:
+        raise ValueError(f"invalid RS geometry ({d},{p})")
+    return d, p
+
+
 def _ec_holders(env: CommandEnv, vid: int) -> dict[int, list[dict]]:
     """shard id -> servers holding it."""
     out: dict[int, list[dict]] = {}
@@ -67,7 +80,9 @@ def balanced_ec_distribution(servers: list[dict], n_shards: int) -> list[dict]:
 
 @command("ec.encode",
          "-volumeId N | -collection C|'*' [-fullPercent 95] "
-         "[-sourceDiskType ssd]: erasure-code volumes and spread shards",
+         "[-sourceDiskType ssd] [-ecShards d,p] [-codec rs|piggyback]: "
+         "erasure-code volumes and spread shards (geometry defaults to the "
+         "server's -ecShards; fork 14+2 and upstream 10+4 both just work)",
          needs_lock=True)
 def cmd_ec_encode(env: CommandEnv, args):
     p = argparse.ArgumentParser(prog="ec.encode")
@@ -77,7 +92,15 @@ def cmd_ec_encode(env: CommandEnv, args):
     p.add_argument("-sourceDiskType", default="")
     p.add_argument("-dataShards", type=int, default=0)
     p.add_argument("-parityShards", type=int, default=0)
+    p.add_argument("-ecShards", default="",
+                   help="geometry as 'd,p' (e.g. 14,2 or 10,4); shorthand "
+                        "for -dataShards/-parityShards")
+    p.add_argument("-codec", default="",
+                   help="erasure codec: rs | piggyback (repair-efficient; "
+                        "blank = server default)")
     opt = p.parse_args(args)
+    if opt.ecShards:
+        opt.dataShards, opt.parityShards = parse_ec_shards(opt.ecShards)
 
     limit = env.mc.volume_list().volume_size_limit_mb * (1 << 20)
     targets = []  # (vid, collection, srv)
@@ -137,11 +160,14 @@ def _encode_on_server(env: CommandEnv, srv: dict,
                         vpb.VolumeEcShardsGenerateBatchRequest(
                             volume_ids=vids, collection=collection,
                             data_shards=opt.dataShards,
-                            parity_shards=opt.parityShards),
+                            parity_shards=opt.parityShards,
+                            codec=getattr(opt, "codec", "")),
                         vpb.VolumeEcShardsGenerateBatchResponse,
                         timeout=3600 * len(vids))
         done = list(gen.encoded_volume_ids)
         d, p = gen.data_shards, gen.parity_shards
+        if gen.codec:
+            env.println(f"    codec {gen.codec} RS({d},{p})")
     except Exception as e:  # noqa: BLE001
         env.println(f"    batch generate failed on {srv['id']}: {e}")
     for vid in frozen:
@@ -205,6 +231,13 @@ def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
 @command("ec.rebuild", "[-volumeId N] [-byRebuild]: restore missing ec shards",
          needs_lock=True)
 def cmd_ec_rebuild(env: CommandEnv, args):
+    """Rebuild runs ON a holder; remote survivors stream in by RANGE
+    (VolumeEcShardRead) following the volume's codec repair plan — a
+    piggybacked stripe moves ~(d+|group|)/2 half-shards for a single
+    data-shard loss where the old gather-then-rebuild flow copied d
+    full shard files before reconstructing anything. Returns
+    {rebuilt, bytes_read, bytes_written} so callers (cluster.repair)
+    can journal the traffic."""
     p = argparse.ArgumentParser(prog="ec.rebuild")
     p.add_argument("-volumeId", type=int, default=0)
     p.add_argument("-byRebuild", action="store_true",
@@ -218,7 +251,7 @@ def cmd_ec_rebuild(env: CommandEnv, args):
                 if opt.volumeId and s.id != opt.volumeId:
                     continue
                 vols.setdefault(s.id, (s.collection, {}))
-    rebuilt_total = 0
+    summary = {"rebuilt": 0, "bytes_read": 0, "bytes_written": 0}
     for vid, (collection, _) in sorted(vols.items()):
         holders = _settled_ec_holders(env, vid)
         if not holders:
@@ -240,32 +273,38 @@ def cmd_ec_rebuild(env: CommandEnv, args):
                 vpb.VolumeEcShardsCopyByRebuildRequest(
                     volume_id=vid, collection=collection, shard_ids=missing),
                 vpb.VolumeEcShardsCopyByRebuildResponse, timeout=3600)
-            _stub(env, target).call(
+            host = target
+        else:
+            # default: rebuild on the holder with the most local shards
+            # (fewest remote ranges to pull); deterministic on ties
+            counts: dict[str, list] = {}
+            for _sid, hs in holders.items():
+                for h in hs:
+                    counts.setdefault(h["id"], [0, h])
+                    counts[h["id"]][0] += 1
+            host = sorted(counts.items(),
+                          key=lambda kv: (-kv[1][0], kv[0]))[0][1][1]
+            resp = _stub(env, host).call(
+                "VolumeEcShardsRebuild",
+                vpb.VolumeEcShardsRebuildRequest(volume_id=vid,
+                                                 collection=collection),
+                vpb.VolumeEcShardsRebuildResponse, timeout=3600)
+        if resp.rebuilt_shard_ids:
+            _stub(env, host).call(
                 "VolumeEcShardsMount",
-                vpb.VolumeEcShardsMountRequest(volume_id=vid,
-                                               collection=collection,
-                                               shard_ids=list(resp.rebuilt_shard_ids)),
+                vpb.VolumeEcShardsMountRequest(
+                    volume_id=vid, collection=collection,
+                    shard_ids=list(resp.rebuilt_shard_ids)),
                 vpb.VolumeEcShardsMountResponse)
-            rebuilt_total += len(resp.rebuilt_shard_ids)
-            continue
-        # default: gather shards onto one holder, rebuild there, respread
-        host = any_srv
-        host_stub = _stub(env, host)
-        host_sids = [s for s, hs in holders.items()
-                     if any(h["id"] == host["id"] for h in hs)]
-        fetch = [s for s in have if s not in host_sids]
-        _gather_shards(env, host_stub, vid, collection, fetch, holders)
-        resp = host_stub.call(
-            "VolumeEcShardsRebuild",
-            vpb.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection),
-            vpb.VolumeEcShardsRebuildResponse, timeout=3600)
-        host_stub.call(
-            "VolumeEcShardsMount",
-            vpb.VolumeEcShardsMountRequest(volume_id=vid, collection=collection,
-                                           shard_ids=list(resp.rebuilt_shard_ids)),
-            vpb.VolumeEcShardsMountResponse)
-        rebuilt_total += len(resp.rebuilt_shard_ids)
-    env.println(f"rebuilt {rebuilt_total} shards")
+        env.println(f"    rebuilt {sorted(resp.rebuilt_shard_ids)} on "
+                    f"{host['id']}: {resp.bytes_read} B read / "
+                    f"{resp.bytes_written} B written")
+        summary["rebuilt"] += len(resp.rebuilt_shard_ids)
+        summary["bytes_read"] += resp.bytes_read
+        summary["bytes_written"] += resp.bytes_written
+    env.println(f"rebuilt {summary['rebuilt']} shards "
+                f"({summary['bytes_read']} survivor bytes read)")
+    return summary
 
 
 def _gather_shards(env: CommandEnv, host_stub: Stub, vid: int, collection: str,

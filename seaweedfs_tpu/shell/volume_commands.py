@@ -255,7 +255,7 @@ def cmd_cluster_repair(env: CommandEnv, args):
     shape cluster.check established."""
     import time as _time
 
-    from ..maintenance import RepairExecutor, build_plan, make_remount_probe
+    from ..maintenance import RepairExecutor, build_plan, make_probes
     from ..master.health import _RANK
     from .health_util import fetch_or_compute_health
 
@@ -275,7 +275,9 @@ def cmd_cluster_repair(env: CommandEnv, args):
     opt = p.parse_args(args)
 
     report = fetch_or_compute_health(env, opt.url)
-    plan = build_plan(report, probe_remountable=make_remount_probe(env))
+    remount_probe, geometry_probe = make_probes(env)
+    plan = build_plan(report, probe_remountable=remount_probe,
+                      probe_geometry=geometry_probe)
     plan.render(env.println)
 
     def check_verdict(verdict):

@@ -371,6 +371,18 @@ REPAIRS_TOTAL = _counter(
     "SeaweedFS_repairs_total",
     "repair executions by action and result (ok/error/skipped)",
     ("action", "result"))
+# Repair traffic in BYTES, per codec — the warehouse-cluster metric the
+# piggybacked code exists to move: a single-data-shard rebuild under
+# codec "piggyback" reads ~(d+|group|)/2 half-shards where plain "rs"
+# reads d full shards. bench-repair asserts the ratio; operators graph
+# read-bytes-per-written-byte to see the codec win in production.
+REPAIR_BYTES_READ = _counter(
+    "SeaweedFS_repair_bytes_read_total",
+    "survivor bytes read (local + ranged remote) to execute repairs",
+    ("codec",))
+REPAIR_BYTES_WRITTEN = _counter(
+    "SeaweedFS_repair_bytes_written_total",
+    "shard bytes written by repairs", ("codec",))
 # Batched ingest plane (fid-range leases + bulk PUT): outstanding leases
 # on the master (a drained system reads 0 — the bench-ingest smoke
 # asserts it), the per-frame batching the /bulk handler actually sees

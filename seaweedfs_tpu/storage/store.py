@@ -30,20 +30,22 @@ class Store:
     def __init__(self, ip: str, port: int, public_url: str,
                  locations: list[DiskLocation],
                  ec_geometry: EcGeometry | None = None,
-                 coder_name: str = "auto"):
+                 coder_name: str = "auto", ec_codec: str = "rs"):
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.locations = locations
         self.ec_geometry = ec_geometry or EcGeometry()
         self.coder_name = coder_name
+        # erasure CODEC for new encodes ("rs" | "piggyback") — orthogonal
+        # to coder_name, which picks the compute backend. Reads/rebuilds
+        # always follow the codec sealed in each volume's .vif.
+        self.ec_codec = ec_codec or "rs"
         for loc in locations:
             loc.load_existing()
 
     # -- coder selection (the pluggable north-star seam) --------------------
-    def coder(self, d: int | None = None, p: int | None = None) -> ErasureCoder:
-        d = d or self.ec_geometry.d
-        p = p or self.ec_geometry.p
+    def _backend_name(self) -> str:
         name = self.coder_name
         if name == "auto":
             try:
@@ -51,6 +53,20 @@ class Store:
                 name = "jax"
             except Exception:  # noqa: BLE001
                 name = "numpy"
+        return name
+
+    def coder(self, d: int | None = None, p: int | None = None,
+              codec: str | None = None) -> ErasureCoder:
+        d = d or self.ec_geometry.d
+        p = p or self.ec_geometry.p
+        codec = codec or self.ec_codec
+        name = self._backend_name()
+        if codec == "piggyback":
+            from ..ops.piggyback import PiggybackCoder
+            try:
+                return PiggybackCoder(d, p, backend=name)
+            except Exception:  # noqa: BLE001
+                return PiggybackCoder(d, p, backend="numpy")
         try:
             return get_coder(name, d, p)
         except Exception:  # noqa: BLE001
@@ -195,7 +211,8 @@ class Store:
     # -- EC operations (reference volume_grpc_erasure_coding.go) -----------
     def generate_ec_shards(self, vid: int, collection: str = "",
                            d: int | None = None, p: int | None = None,
-                           stats: "dict | None" = None) -> str:
+                           stats: "dict | None" = None,
+                           codec: str | None = None) -> str:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
@@ -204,13 +221,15 @@ class Store:
                          self.ec_geometry.small_block)
         v.sync()
         base = v.file_name()
-        encode_volume(base + ".dat", base, geo, self.coder(geo.d, geo.p),
+        encode_volume(base + ".dat", base, geo,
+                      self.coder(geo.d, geo.p, codec=codec),
                       idx_path=base + ".idx", stats=stats)
         return base
 
     def generate_ec_shards_batch(self, vids: "list[int]", collection: str = "",
                                  d: int | None = None, p: int | None = None,
                                  stats: "dict | None" = None,
+                                 codec: str | None = None,
                                  ) -> "list[int]":
         """Encode many local volumes through ONE shared device stream.
 
@@ -236,7 +255,8 @@ class Store:
             jobs.append((base + ".dat", base, base + ".idx"))
             done.append(vid)
         if jobs:
-            stream.encode_volumes(jobs, geo, self.coder(geo.d, geo.p),
+            stream.encode_volumes(jobs, geo,
+                                  self.coder(geo.d, geo.p, codec=codec),
                                   stats=stats)
         return done
 
@@ -280,7 +300,15 @@ class Store:
                     ev.close()
             return
 
-    def rebuild_ec_shards(self, vid: int, collection: str = "") -> list[int]:
+    def rebuild_ec_shards(self, vid: int, collection: str = "",
+                          shard_reader=None,
+                          remote_shards: "list[int] | None" = None,
+                          stats: "dict | None" = None) -> list[int]:
+        """Rebuild missing shards locally, decoding with the codec the
+        .vif seal says encoded them. Survivors not on this disk are
+        fetched by RANGE through `shard_reader` (the volume server wires
+        it to VolumeEcShardRead), so a repair-efficient codec moves only
+        its plan's byte ranges instead of d full shards."""
         ev = self.find_ec_volume(vid)
         base = ev.base if ev else None
         if base is None:
@@ -292,13 +320,13 @@ class Store:
         if base is None:
             raise KeyError(f"no ec files for volume {vid}")
         info = ec_files.read_vif(base + ".vif")
-        geo = EcGeometry(info.get("d", self.ec_geometry.d),
-                         info.get("p", self.ec_geometry.p),
-                         info.get("large_block", self.ec_geometry.large_block),
-                         info.get("small_block", self.ec_geometry.small_block))
+        geo = EcGeometry.from_vif(info, self.ec_geometry)
         if ev:
             ev.close()
-        rebuilt = rebuild_shards(base, geo, self.coder(geo.d, geo.p))
+        coder = self.coder(geo.d, geo.p, codec=info.get("codec", "rs"))
+        rebuilt = rebuild_shards(base, geo, coder,
+                                 shard_reader=shard_reader,
+                                 remote_shards=remote_shards, stats=stats)
         if ev:
             for loc in self.locations:
                 if loc.ec_volumes.get(vid) is ev:
@@ -312,7 +340,7 @@ class Store:
             raise KeyError(f"no ec volume {vid}")
         base = ev.base
         geo = ev.geo
-        coder = self.coder(geo.d, geo.p)
+        coder = self.coder(geo.d, geo.p, codec=ev.codec)
         decode_volume(base, base + ".dat", geo, coder)
         if os.path.exists(base + ".ecx"):
             ec_files.write_idx_from_ecx(base + ".ecx", base + ".ecj", base + ".idx")

@@ -467,7 +467,19 @@ class Volume:
             except Exception as e:  # noqa: BLE001
                 log.warning("delete remote copy of volume %d: %s",
                             self.id, e)
-        for ext in (".dat", ".idx", ".vif"):
-            p = self.file_name() + ext
+        # the .vif is shared with an EC conversion of this volume: after
+        # VolumeEcShardsGenerate it carries the stripe's codec + geometry
+        # and belongs to the shard set, so deleting the source volume
+        # must leave it (rebuild decodes with the codec that encoded)
+        from ..ec import files as ec_files
+        base = self.file_name()
+        vif = self._read_vif()
+        n = (vif.get("d") or 0) + (vif.get("p") or 0)
+        has_ec = (os.path.exists(base + ".ecx")
+                  or any(os.path.exists(base + ec_files.shard_ext(i))
+                         for i in range(max(32, n))))
+        exts = (".dat", ".idx") if has_ec else (".dat", ".idx", ".vif")
+        for ext in exts:
+            p = base + ext
             if os.path.exists(p):
                 os.remove(p)

@@ -100,8 +100,10 @@ def cluster(tmp_path_factory):
         return p
 
     mport = free_port()
+    # parity 3 matches the RS(4,3) piggybacked stripe the node-death
+    # repair schedule encodes (no other schedule creates EC volumes)
     master = MasterServer(port=mport, volume_size_limit_mb=64,
-                          pulse_seconds=0.3)
+                          pulse_seconds=0.3, ec_parity_shards=3)
     master.start()
     servers = []
     for i in range(3):
@@ -492,14 +494,20 @@ def test_bulk_ingest_schedule(cluster):
 
 
 def test_repair_loop_converges_after_node_death(cluster):
-    """The self-healing schedule: a node holding a replica dies FOR GOOD
-    (no failpoint, no resurrection) and the master's health-driven
-    repair loop — the exact sweep the AdminCron runs on its interval —
-    restores full redundancy with no operator-issued ec.rebuild /
-    volume.fix.replication. Runs LAST: it permanently removes a server
+    """The self-healing schedule: a node holding a replica AND one shard
+    of a piggybacked RS(4,3) stripe dies FOR GOOD (no failpoint, no
+    resurrection) and the master's health-driven repair loop — the exact
+    sweep the AdminCron runs on its interval — restores full redundancy
+    with no operator-issued ec.rebuild / volume.fix.replication. The
+    rebuilt shard must be byte-identical to the lost one and the
+    repair-traffic counters must have moved (and moved LESS than a plain
+    d-full-shard read would). Runs LAST: it permanently removes a server
     from the shared cluster."""
+    import numpy as np
     from conftest import wait_until
+    from seaweedfs_tpu.ec import files as ec_files
     from seaweedfs_tpu.ops import events
+    from seaweedfs_tpu.stats import REPAIR_BYTES_READ, REPAIR_BYTES_WRITTEN
 
     master, servers, mc = cluster
     wait_until(lambda: len(master.topo.nodes) >= 3, timeout=15,
@@ -513,6 +521,63 @@ def test_repair_loop_converges_after_node_death(cluster):
     victim = next(vs for vs in servers
                   if f"127.0.0.1:{vs.port}" in
                   {n.id for n in master.topo.lookup(vid)})
+
+    # -- a piggybacked RS(4,3) stripe with shard 3 on the victim ------------
+    ec_payloads = {}
+    rng = np.random.default_rng(23)
+    for _ in range(15):
+        data = rng.integers(0, 256, int(rng.integers(600, 7000)),
+                            dtype=np.uint8).tobytes()
+        r = operation.submit(mc, data, collection="cec")
+        ec_payloads[r.fid] = data
+    ec_vid = int(next(iter(ec_payloads)).split(",")[0])
+    src_vs = next(vs for vs in servers
+                  if vs.store.find_volume(ec_vid) is not None)
+    src = Stub(f"127.0.0.1:{src_vs.grpc_port}", VOLUME_SERVICE)
+    src.call("VolumeMarkReadonly",
+             vpb.VolumeMarkReadonlyRequest(volume_id=ec_vid),
+             vpb.VolumeMarkReadonlyResponse)
+    src.call("VolumeEcShardsGenerate",
+             vpb.VolumeEcShardsGenerateRequest(
+                 volume_id=ec_vid, collection="cec", data_shards=4,
+                 parity_shards=3, codec="piggyback"),
+             vpb.VolumeEcShardsGenerateResponse, timeout=120)
+    rest = [vs for vs in servers if vs is not victim]
+    want = {victim: [3], rest[0]: [0, 1, 2], rest[1]: [4, 5, 6]}
+    for vs, sids in want.items():
+        if vs is not src_vs:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=ec_vid, collection="cec", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True,
+                    copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{src_vs.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=ec_vid,
+                                           collection="cec",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    src_base = src_vs.store.find_ec_volume(ec_vid).base
+    drop = sorted(set(range(7)) - set(want[src_vs]))
+    src.call("VolumeEcShardsUnmount",
+             vpb.VolumeEcShardsUnmountRequest(volume_id=ec_vid,
+                                              shard_ids=drop),
+             vpb.VolumeEcShardsUnmountResponse)
+    for sid in drop:
+        os.remove(src_base + ec_files.shard_ext(sid))
+    src.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=ec_vid),
+             vpb.VolumeDeleteResponse)
+    wait_until(lambda: sorted(master.topo.lookup_ec(ec_vid)) ==
+               list(range(7)), timeout=20, msg="all 7 ec shards registered")
+    lost_shard = open(
+        victim.store.find_ec_volume(ec_vid).base + ec_files.shard_ext(3),
+        "rb").read()
+    read_before = REPAIR_BYTES_READ.value("piggyback")
+    written_before = REPAIR_BYTES_WRITTEN.value("piggyback")
+
     victim.stop()
     wait_until(lambda: f"127.0.0.1:{victim.port}" not in master.topo.nodes,
                timeout=15, msg="victim dropped from topology")
@@ -527,10 +592,45 @@ def test_repair_loop_converges_after_node_death(cluster):
     assert "health-driven repair" in master.admin_cron.last_output
 
     wait_until(lambda: master.health.scan()["verdict"] == "OK",
-               timeout=20, msg="health verdict converges to OK "
+               timeout=30, msg="health verdict converges to OK "
                                "with no operator repair")
-    kinds = [e["type"] for e in
-             events.JOURNAL.snapshot(since=since, etype="repair")]
+    repair_evs = events.JOURNAL.snapshot(since=since, etype="repair")
+    kinds = [e["type"] for e in repair_evs]
     assert "repair.plan" in kinds and "repair.done" in kinds
     assert operation.read(mc, res.fid) == payload
     assert len(master.topo.lookup(vid)) == 2
+
+    # -- the EC half of the heal: byte-identity + repair traffic ------------
+    wait_until(lambda: sorted(master.topo.lookup_ec(ec_vid)) ==
+               list(range(7)), timeout=20,
+               msg="all 7 ec shards re-registered post-heal")
+    rebuilt = None
+    for vs in rest:
+        ev = vs.store.find_ec_volume(ec_vid)
+        if ev is not None and os.path.exists(ev.base + ec_files.shard_ext(3)):
+            rebuilt = open(ev.base + ec_files.shard_ext(3), "rb").read()
+            break
+    assert rebuilt is not None, "rebuilt shard 3 not found on any survivor"
+    assert rebuilt == lost_shard, "rebuilt shard 3 not byte-identical"
+    # repair_bytes counters moved, and the SUCCESSFUL attempt moved LESS
+    # than a plain-RS d-full-shard read: shard 3's piggyback group in
+    # RS(4,3) has 2 members, so the ranged plan reads (4+2)/2 = 3
+    # shard-equivalents. The cumulative counter delta may include an
+    # aborted earlier attempt under chaos timing, so the per-attempt
+    # bound comes from the repair.done journal event.
+    shard_size = len(lost_shard)
+    read_delta = REPAIR_BYTES_READ.value("piggyback") - read_before
+    written_delta = REPAIR_BYTES_WRITTEN.value("piggyback") - written_before
+    assert read_delta > 0 and written_delta >= shard_size
+    ec_done = [e for e in repair_evs if e["type"] == "repair.done"
+               and e["attrs"].get("action") == "ec.rebuild"
+               and e["attrs"].get("vid") == ec_vid]
+    assert ec_done, "no repair.done for the EC rebuild"
+    done_read = ec_done[-1]["attrs"]["bytes_read"]
+    assert 0 < done_read < 4 * shard_size, \
+        f"ranged repair read {done_read} B, plain RS would read " \
+        f"{4 * shard_size} B"
+    assert read_delta >= done_read
+    # payloads still served from the healed stripe
+    for fid, data in list(ec_payloads.items())[:5]:
+        assert operation.read(mc, fid) == data
