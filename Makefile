@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair
+test: lint bench-ec bench-ingest bench-repair bench-read
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -67,6 +67,14 @@ bench-ingest:
 # SeaweedFS_repair_bytes_read_total) with a byte-identical result
 bench-repair:
 	JAX_PLATFORMS=cpu python bench.py --repair-only
+
+# seconds-long read-path smoke on a separate-process cluster: Zipfian
+# per-needle GETs vs framed /bulk-read on the same topology, asserting
+# bulk >= 3x per-needle needles/s, warm read-cache hit ratio >= 0.5,
+# and a non-negative cache bytes gauge; also records the per-stage GET
+# breakdown (resolve/lock/pread/serialize)
+bench-read:
+	JAX_PLATFORMS=cpu python bench.py --read-only
 
 smoke:
 	python bench.py --smoke

@@ -849,6 +849,167 @@ def bench_ingest_smoke(out: dict) -> None:
         _stop_procs_cluster(procs, tmp)
 
 
+def _read_stage_breakdown(out: dict, prefix: str = "read_stage_") -> None:
+    """Per-stage GET breakdown on an in-process volume — the stages the
+    seqlock read protocol actually executes (resolve the index entry,
+    pread the record, parse/serialize the needle) plus the volume-lock
+    acquisition cost the OLD read path paid per GET and the new one
+    skips. Replaces the single opaque breakdown_get_us number."""
+    import tempfile as _tf
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.needle import record_size_from_header
+    from seaweedfs_tpu.storage.volume import Volume
+
+    tmp = _tf.mkdtemp(prefix="swtpu_bench_readstage_")
+    try:
+        v = Volume(tmp, "", 1)
+        payload = os.urandom(1024)
+        keys = list(range(1, 1001))
+        for k in keys:
+            v.write_needle(Needle(id=k, cookie=7, data=payload))
+
+        def per_op(n, fn):
+            t0 = time.perf_counter()
+            for i in range(n):
+                fn(i)
+            return round((time.perf_counter() - t0) / n * 1e6, 2)
+
+        nk = len(keys)
+        out[prefix + "resolve_us"] = per_op(
+            4000, lambda i: v.nm.get(keys[i % nk]))
+
+        def lock_cycle(_i):
+            v._lock.acquire()
+            v._lock.release()
+        out[prefix + "lock_us"] = per_op(4000, lock_cycle)
+        nv = v.nm.get(keys[0])
+        rec_len = record_size_from_header(nv.size)
+        out[prefix + "pread_us"] = per_op(
+            4000, lambda i: os.pread(
+                v._fileno, rec_len, v.nm.get(keys[i % nk]).offset))
+        buf = os.pread(v._fileno, rec_len, nv.offset)
+        out[prefix + "serialize_us"] = per_op(
+            4000, lambda i: Needle.from_bytes(buf))
+        out[prefix + "total_us"] = per_op(
+            4000, lambda i: v.read_needle(keys[i % nk], cookie=7))
+        v.close()
+        log(f"GET stage breakdown (us): "
+            f"resolve {out[prefix + 'resolve_us']}, "
+            f"lock {out[prefix + 'lock_us']}, "
+            f"pread {out[prefix + 'pread_us']}, "
+            f"serialize {out[prefix + 'serialize_us']}, "
+            f"total {out[prefix + 'total_us']}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_read_smoke(out: dict) -> None:
+    """`make bench-read`: the read-path data plane at smoke scale on a
+    separate-process topology — a Zipfian workload read back per-needle
+    and through framed /bulk-read, asserting bulk GET >= 3x the
+    per-needle needles/s on the SAME topology and a warm read-cache hit
+    ratio >= 0.5 (the ISSUE-9 acceptance gates), plus the per-stage GET
+    breakdown on an in-process volume."""
+    import threading
+
+    import numpy as _np
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+
+    procs, tmp, mport, mhttp, vport = _spawn_procs_cluster(
+        "swtpu_bench_read_", volume_size_mb=64, vol_max=16)
+    try:
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        mc.wait_connected()
+        n_files, conc = 2000, 4
+        payloads = [b"r%06d-" % i + b"x" * 1000 for i in range(n_files)]
+        res = operation.submit_batch(mc, payloads, collection="benchread")
+        assert len(res) == n_files
+        fids = [r.fid for r in res]
+        # both phases draw keys from the same Zipfian law, so the warm
+        # hot set (the acceptance gate) builds up naturally as they run
+        errors = [0]
+
+        def run_phase(per_thread, op):
+            def worker(seed):
+                wrng = _np.random.default_rng(seed)
+                for k in range(per_thread):
+                    try:
+                        op(wrng)
+                    except Exception:  # noqa: BLE001
+                        errors[0] += 1
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=worker, args=(1000 + s,))
+                  for s in range(conc)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return time.perf_counter() - t0
+
+        def one_read(wrng):
+            i = (int(wrng.zipf(1.2)) - 1) % n_files
+            data = operation.read(mc, fids[i])
+            assert data == payloads[i]
+
+        batch = 256
+
+        def one_bulk(wrng):
+            idxs = ((_np.asarray(wrng.zipf(1.2, batch)) - 1)
+                    % n_files).tolist()
+            got = operation.read_batch(mc, [fids[i] for i in idxs])
+            for j, i in enumerate(idxs):
+                assert got[j] == payloads[i]
+
+        reads_per_thread = 300
+        dt = run_phase(reads_per_thread, one_read)
+        per_needle_rps = reads_per_thread * conc / dt
+        batches_per_thread = 4
+        bulk_dt = run_phase(batches_per_thread, one_bulk)
+        bulk_rps = batches_per_thread * conc * batch / bulk_dt
+        assert errors[0] == 0, f"read smoke saw {errors[0]} errors"
+        out["procs_read_rps"] = round(per_needle_rps, 1)
+        out["procs_bulk_read_rps"] = round(bulk_rps, 1)
+        out["procs_bulk_read_batch"] = batch
+        ratio = bulk_rps / per_needle_rps
+        out["procs_bulk_read_vs_read"] = round(ratio, 2)
+        log(f"read smoke: per-needle {per_needle_rps:.0f} needles/s, "
+            f"bulk {bulk_rps:.0f} needles/s ({ratio:.1f}x)")
+        # the acceptance gate: framed bulk GET >= 3x per-needle GET
+        assert ratio >= 3.0, \
+            f"bulk GET only {ratio:.2f}x per-needle GET (gate: 3x)"
+
+        def metric(port: int, name: str) -> float:
+            body = http_util.get(f"http://127.0.0.1:{port}/metrics",
+                                 timeout=2).content.decode()
+            for line in body.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[-1])
+            return 0.0
+
+        hits = metric(vport, "SeaweedFS_read_cache_hits_total")
+        misses = metric(vport, "SeaweedFS_read_cache_misses_total")
+        hit_ratio = hits / max(1.0, hits + misses)
+        out["read_cache_hit_ratio"] = round(hit_ratio, 3)
+        out["read_cache_hits"] = int(hits)
+        out["read_cache_misses"] = int(misses)
+        cache_bytes = metric(vport, "SeaweedFS_read_cache_bytes")
+        assert cache_bytes >= 0, f"cache bytes gauge negative: {cache_bytes}"
+        log(f"read cache: {int(hits)} hits / {int(misses)} misses "
+            f"(ratio {hit_ratio:.2f}), {int(cache_bytes)} bytes resident")
+        # warm Zipfian workload must live in the cache (acceptance)
+        assert hit_ratio >= 0.5, \
+            f"warm Zipfian hit ratio {hit_ratio:.2f} < 0.5"
+        mc.stop()
+        _read_stage_breakdown(out)
+        out["bench_read_smoke"] = "ok"
+    finally:
+        _stop_procs_cluster(procs, tmp)
+
+
 def bench_cluster(out: dict, n_files: int, conc: int) -> None:
     import socket
 
@@ -927,8 +1088,14 @@ def bench_cluster(out: dict, n_files: int, conc: int) -> None:
             f"{pre[i].location.url}/{pre[i].fid}", payload,
             jwt=pre[i].auth))
         fids = [a.fid for a in pre]
-        out["breakdown_get_us"] = per_op(
+        # e2e GET protocol cost, plus the per-stage storage breakdown
+        # (resolve/lock/pread/serialize) that replaces the old opaque
+        # single breakdown_get_us number — the delta between e2e and
+        # stage-total is the HTTP/protocol tax the bulk-read frame and
+        # hot-needle cache exist to amortize
+        out["breakdown_get_e2e_us"] = per_op(
             400, lambda i: operation.read(mc, fids[i % len(fids)]))
+        _read_stage_breakdown(out, prefix="breakdown_get_")
         store2 = vs.store
         vid0, key0, _ = parse_file_id(fids[0])
         out["breakdown_store_write_us"] = per_op(400, lambda i: store2.write_needle(
@@ -1015,6 +1182,11 @@ def main() -> None:
                          "bench-repair): rebuild one lost shard under "
                          "both codecs, assert piggyback reads <= 0.7x "
                          "the plain-RS bytes and byte-identity")
+    ap.add_argument("--read-only", action="store_true", dest="read_only",
+                    help="run only the read-path smoke (make bench-read): "
+                         "Zipfian per-needle vs framed bulk GET on a "
+                         "separate-process cluster, asserts bulk >= 3x "
+                         "and warm cache hit ratio >= 0.5")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -1040,6 +1212,12 @@ def main() -> None:
         out_rp: dict = {"metric": "bench_repair_smoke"}
         bench_repair_smoke(out_rp)
         print(json.dumps(out_rp))
+        return
+    if args.read_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_rd: dict = {"metric": "bench_read_smoke"}
+        bench_read_smoke(out_rd)
+        print(json.dumps(out_rd))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

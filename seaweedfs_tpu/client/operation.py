@@ -25,6 +25,10 @@ from .master_client import FidLeaseAllocator, MasterClient
 # megabytes, not the whole batch.
 BULK_MAX_FRAME_NEEDLES = env_int("SWTPU_BULK_FRAME_NEEDLES", 1024)
 BULK_MAX_FRAME_BYTES = env_int("SWTPU_BULK_FRAME_BYTES", 8 << 20)
+# Keys per bulk-GET frame (read_batch): response frames are bounded by
+# the needles themselves, so the cap only bounds the per-frame blast
+# radius of a retry.
+BULK_READ_FRAME_NEEDLES = env_int("SWTPU_BULK_READ_NEEDLES", 1024)
 
 
 @dataclass
@@ -326,6 +330,137 @@ def _read(mc: MasterClient, fid: str, jwt: str = "") -> bytes:
     if all_404 or isinstance(last_err, KeyError):
         raise KeyError(fid) if all_404 else last_err
     raise RuntimeError(f"read {fid} failed: {last_err}")
+
+
+def read_batch(mc: MasterClient, fids: "list[str]", jwt: str = "",
+               ) -> "list[bytes | None]":
+    """Bulk GET: fetch many blobs with one framed /bulk-read round-trip
+    per (vid, frame) instead of one HTTP GET per fid — the read-side
+    mirror of submit_batch. Fids are grouped by vid client-side and
+    each group ships as "SWBR" request frames (storage/bulk.py) of up
+    to SWTPU_BULK_READ_NEEDLES keys; the volume server resolves a whole
+    frame in one index pass and streams the needles back in a single
+    length-prefixed response.
+
+    Returns payload bytes per fid, aligned with the input (None = not
+    found / deleted — per-needle statuses ride the frame, so misses
+    don't fail the batch). Transport failures AND per-needle
+    READ_ERROR statuses (bad sector, crc mismatch on one holder) retry
+    across replica holders breaker-ordered, with one refreshed-lookup
+    pass when a holder 404s the volume (moved/evacuated) — the same
+    fallback discipline as read(); an error that persists on every
+    holder raises instead of masquerading as not-found. Needles the
+    server's per-frame byte budget couldn't carry (READ_OVERFLOW) are
+    transparently re-fetched per-needle. Gzip-flagged needles are
+    decompressed so the result matches read() byte-for-byte.
+
+    `jwt` scope: read tokens are per-fid, and the volume server admits
+    a frame only if the token covers EVERY fid in it — on clusters with
+    read signing enabled, bulk reads are for whitelisted callers (or
+    single-fid frames); per-fid-token clients use read()."""
+    if not fids:
+        return []
+    results: "list[bytes | None]" = [None] * len(fids)
+    by_vid: "dict[int, list[tuple[int, int, int]]]" = {}
+    for i, fid in enumerate(fids):
+        vid, key, cookie = parse_file_id(fid)
+        by_vid.setdefault(vid, []).append((i, key, cookie))
+    with tracing.start_span("client.read_batch", component="client",
+                            attrs={"needles": len(fids),
+                                   "vids": len(by_vid)}) as sp:
+        frames = 0
+        for vid, items in by_vid.items():
+            for at in range(0, len(items), BULK_READ_FRAME_NEEDLES):
+                _read_one_frame(mc, vid,
+                                items[at:at + BULK_READ_FRAME_NEEDLES],
+                                results, jwt)
+                frames += 1
+        sp.set_attr("frames", frames)
+    return results
+
+
+def _read_one_frame(mc: MasterClient, vid: int,
+                    items: "list[tuple[int, int, int]]",
+                    results: "list[bytes | None]", jwt: str) -> None:
+    """One bulk-read frame against vid's replica set: healthy holders
+    first (breaker ordering), a refreshed lookup when every holder
+    404s/fails (stale location after a move), per-needle statuses
+    decoded into `results`."""
+    from ..storage import bulk as bulk_frame
+
+    failpoints.check("client.bulk.read")
+    frame = bulk_frame.pack_read_request(vid, [(k, c) for _, k, c in items])
+    params: dict = {"vid": vid}
+    if jwt:
+        params["jwt"] = jwt
+    last_err: "Exception | None" = None
+    for attempt in range(2):
+        try:
+            locs = mc.lookup(vid) if attempt == 0 else mc.refresh_lookup(vid)
+        except KeyError:
+            raise  # master says the volume is gone: authoritative
+        urls = [loc["public_url"] or loc["url"] for loc in locs]
+        for i, url in enumerate(retry.order_by_breaker(urls)):
+            try:
+                r = http_util.request(
+                    "POST", f"http://{url}/bulk-read", body=frame,
+                    params=params, fail_fast_open=i < len(urls) - 1)
+                if r.status == 404:
+                    # this holder no longer serves the vid — try the
+                    # next, then a refreshed lookup
+                    last_err = RuntimeError(f"HTTP 404 from {url}")
+                    continue
+                if not r.ok:
+                    raise RuntimeError(f"bulk read from {url}: HTTP "
+                                       f"{r.status} {r.content[:200]!r}")
+                rvid, res = bulk_frame.unpack_read_response(r.content)
+                if rvid != vid or len(res) != len(items):
+                    raise RuntimeError(
+                        f"bulk read from {url}: frame mismatch "
+                        f"(vid {rvid}, {len(res)} results)")
+                errored = []
+                overflow = []
+                for (idx, key, _cookie), rr in zip(items, res):
+                    if rr.key != key:
+                        raise RuntimeError(
+                            f"bulk read from {url}: result for "
+                            f"{rr.key:x}, wanted {key:x}")
+                    if rr.status == bulk_frame.READ_OK:
+                        data = bytes(rr.data)
+                        if rr.flags & 0x01:
+                            data = _gzip.decompress(data)
+                        results[idx] = data
+                    elif rr.status == bulk_frame.READ_OVERFLOW:
+                        overflow.append((idx, key, _cookie))
+                    elif rr.status == bulk_frame.READ_ERROR:
+                        errored.append(key)
+                    else:
+                        results[idx] = None  # definitive not-found
+                if errored:
+                    # an IO/crc failure on THIS holder is not evidence
+                    # about the needle — another replica may hold intact
+                    # bytes; retry the frame there instead of reporting
+                    # corruption as "deleted"
+                    last_err = RuntimeError(
+                        f"bulk read from {url}: {len(errored)} needle "
+                        f"errors (e.g. {errored[0]:x})")
+                    continue
+                for idx, key, cookie in overflow:
+                    # the server's frame byte-budget couldn't carry it:
+                    # fetch the large needle through the per-needle path
+                    # (which also resolves existence — an overflow slot
+                    # the server didn't probe may turn out deleted)
+                    try:
+                        results[idx] = _read(mc, file_id(vid, key, cookie),
+                                             jwt=jwt)
+                    except KeyError:
+                        results[idx] = None
+                return
+            except retry.BreakerOpenError as e:
+                last_err = e  # a skip, not evidence about the holders
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+    raise RuntimeError(f"bulk read vid {vid} failed: {last_err}")
 
 
 def delete(mc: MasterClient, fid: str) -> bool:

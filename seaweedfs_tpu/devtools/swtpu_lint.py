@@ -36,6 +36,11 @@ Rules (suppress per line with `# swtpu-lint: disable=<rule>[,<rule>]`):
   executor-no-context  run_in_executor / pool.submit without
                        contextvars.copy_context() — the active trace
                        span (tracing/) silently drops across the hop
+  pread-under-lock     os.pread/os.preadv while holding a lock — the
+                       seqlock read path (storage/volume.py) exists so
+                       reads never queue behind a writer's fsync; a
+                       pread inside a critical section re-serializes
+                       every reader behind that lock's writers
 
 Output: human `path:line:col: rule: message` lines, or `--json` for the
 machine-readable document CI consumes. Exit 0 = clean, 1 = findings,
@@ -62,6 +67,9 @@ RULES: dict[str, str] = {
     "thread-no-join": "non-daemon Thread with no join on any stop path",
     "md5-fips": "hashlib.md5 without usedforsecurity=False",
     "executor-no-context": "executor hop without contextvars.copy_context()",
+    "pread-under-lock": "blocking os.pread inside a `with <lock>:` block "
+                        "(the lock-free read path must not serialize "
+                        "behind writers)",
     "parse-error": "file does not parse",
 }
 
@@ -91,6 +99,11 @@ _NET_CALLS = {
     "retry.retry_call", "retry_call",
 }
 _FILE_CALLS = {"open", "io.open"}
+# positioned reads: the seqlock GET path's primitive. Local file I/O in
+# general is allowed under per-volume locks (see io-under-lock), but a
+# pread specifically marks a LOCK-FREE read path — one issued while
+# holding a lock means reads re-serialize behind writers again.
+_PREAD_CALLS = {"os.pread", "os.preadv"}
 
 
 @dataclass
@@ -263,6 +276,12 @@ class _FileLinter(ast.NodeVisitor):
                        f"{blocking_kind} ({name or 'Stub().call'}) while "
                        f"holding {self._lock_stack[-1]!r}; narrow the "
                        "critical section to the shared-state mutation")
+        if name in _PREAD_CALLS and self._lock_stack:
+            self._emit(node, "pread-under-lock",
+                       f"{name} while holding {self._lock_stack[-1]!r}; "
+                       "the seqlock read protocol preads OUTSIDE the "
+                       "volume lock (resolve, pread, post-validate) so "
+                       "reads never queue behind an fsync")
 
         if name == "hashlib.md5" and not any(
                 kw.arg == "usedforsecurity" for kw in node.keywords):

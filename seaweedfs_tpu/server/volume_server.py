@@ -104,6 +104,17 @@ class VolumeServer:
         from concurrent.futures import ThreadPoolExecutor
         self._ec_read_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="ec-degraded-read")
+        # read-path data plane: the hot-needle cache (segmented LRU,
+        # storage/read_cache.py; SWTPU_READ_CACHE_MB=0 disables) and the
+        # pool GET/bulk-GET storage reads run on. With the seqlock read
+        # protocol (storage/volume.py) these threads read in PARALLEL —
+        # no GET ever queues behind a writer's fsync on the volume lock.
+        from ..storage import read_cache as read_cache_mod
+        from ..utils.env import env_int
+        self.read_cache = read_cache_mod.default_cache()
+        self._read_pool = ThreadPoolExecutor(
+            max_workers=max(1, env_int("SWTPU_READ_THREADS", 8)),
+            thread_name_prefix=f"vs-read-{port}")
 
     @property
     def url(self) -> str:
@@ -153,6 +164,9 @@ class VolumeServer:
         if self._grpc:
             self._grpc.stop(grace=0.5)
         self._ec_read_pool.shutdown(wait=False, cancel_futures=True)
+        self._read_pool.shutdown(wait=False, cancel_futures=True)
+        if self.read_cache is not None:
+            self.read_cache.clear()
         self.store.close()
 
     # -- heartbeat (reference volume_grpc_client_to_master.go) ---------------
@@ -471,10 +485,41 @@ class VolumeServer:
                     VOLUME_REQUEST_SECONDS.observe(
                         "bulk", value=time.perf_counter() - t0)
 
+        async def handle_bulk_read(request: fastweb.Request):
+            # bulk.read mirrors bulk.put: its own request kind on the
+            # dashboards, one span the per-needle resolution hangs under
+            t0 = time.perf_counter()
+            status = 500
+            with tracing.start_span(
+                    "bulk.read", component="volume",
+                    child_of=tracing.extract(request.headers),
+                    attrs={"server": self.url,
+                           "bytes": len(request.body or b"")}) as sp:
+                try:
+                    try:
+                        resp = await self._handle_bulk_read(request, sp)
+                    except KeyError as e:
+                        resp = json_response({"error": str(e)}, status=404)
+                    except PermissionError as e:
+                        resp = json_response({"error": str(e)}, status=403)
+                    except Exception as e:  # noqa: BLE001
+                        log.error("bulk-read http error: %s", e)
+                        resp = json_response({"error": str(e)}, status=500)
+                    status = resp.status
+                    return resp
+                finally:
+                    sp.set_attr("status", status)
+                    if status >= 500:
+                        sp.set_error(f"HTTP {status}")
+                    VOLUME_REQUEST_COUNTER.inc("bulk-read", str(status))
+                    VOLUME_REQUEST_SECONDS.observe(
+                        "bulk-read", value=time.perf_counter() - t0)
+
         app = fastweb.FastApp()
         app.route("/status", status)
         app.route("/ui", status_ui)
         app.route("/bulk", handle_bulk)
+        app.route("/bulk-read", handle_bulk_read)
         app.route("/metrics", metrics)
         # pprof-style triggers (reference -debug.port net/http/pprof)
         app.route("/debug/profile", debug_profile)
@@ -784,6 +829,127 @@ class VolumeServer:
             vid, lo, max(keys) - lo + 1, cookie)
         return "&jwt=" + urllib.parse.quote(tok)
 
+    # -- bulk read data plane (read-side mirror of /bulk, ISSUE 9) ----------
+    async def _handle_bulk_read(self, request, sp):
+        """One framed bulk GET: the client names a vid + (key, cookie)
+        list ("SWBR"), the server resolves the whole batch in one index
+        pass over the lock-free read path and streams every found
+        needle back in a single length-prefixed frame ("SWBG") with a
+        per-needle status for misses/deleted — the read-side mirror of
+        the /bulk ingest plane, amortizing the per-GET HTTP protocol
+        N-fold. Hot needles come out of the read cache without touching
+        the volume file at all."""
+        from ..utils.fastweb import Response, json_response
+
+        if request.method not in ("POST", "PUT"):
+            return json_response({"error": "method not allowed"}, status=405)
+        # chaos arm: the volume server dying mid-bulk-read — the client
+        # fails over to a replica holder
+        failpoints.check("volume.bulk.read")
+        from ..storage import bulk as bulk_frame
+        try:
+            vid, pairs = bulk_frame.unpack_read_request(request.body or b"")
+        except bulk_frame.FrameError as e:
+            return json_response({"error": str(e)}, status=400)
+        q_vid = request.query.get("vid", "")
+        try:
+            if q_vid and int(q_vid) != vid:
+                return json_response(
+                    {"error": f"query vid {q_vid} != frame vid {vid}"},
+                    status=400)
+        except ValueError:
+            return json_response({"error": f"bad vid {q_vid!r}"},
+                                 status=400)
+        sp.set_attr("vid", vid)
+        sp.set_attr("needles", len(pairs))
+        if self.guard is not None:
+            # read tokens are per-fid: the frame is admitted only if the
+            # caller is whitelisted or its token covers EVERY fid in the
+            # frame — the exact scoping the per-needle GET enforces, so
+            # /bulk-read can never widen one fid's token into a
+            # read-everything pass (check_read short-circuits before any
+            # decode when read security is off)
+            from ..storage.types import file_id as _file_id
+            for key, cookie in pairs:
+                ok, why = self.guard.check_read(
+                    request.remote or "", request.query, request.headers,
+                    _file_id(vid, key, cookie))
+                if not ok:
+                    return json_response({"error": why}, status=401)
+        if (self.store.find_volume(vid) is None
+                and self.store.find_ec_volume(vid) is None):
+            # no proxy hop for frames: the client fans out by vid and
+            # fails over to replica holders itself
+            return json_response({"error": f"volume {vid} not local"},
+                                 status=404)
+        import asyncio
+        import contextvars
+        loop = asyncio.get_running_loop()
+        ctx = contextvars.copy_context()
+        body, hits = await loop.run_in_executor(
+            self._read_pool, ctx.run, self._bulk_read_frame, vid, pairs)
+        sp.set_attr("cache_hits", hits)
+        from ..stats import BULK_READ_NEEDLES
+        BULK_READ_NEEDLES.observe(value=len(pairs))
+        return Response(body, content_type="application/octet-stream")
+
+    def _bulk_read_frame(self, vid: int,
+                         pairs: "list[tuple[int, int]]",
+                         ) -> "tuple[bytes, int]":
+        """Resolve one bulk-read frame (runs on the read pool): cache
+        hits first, then ONE batched storage pass for the misses, cache
+        fills on the way out. A per-frame byte budget
+        (SWTPU_BULK_READ_FRAME_BYTES, 32 MB) bounds what one frame can
+        materialize — found needles past it come back READ_OVERFLOW
+        unread and the client re-fetches them per-needle, so a frame of
+        large objects can't OOM the server across read-pool threads.
+        Returns (response_frame, cache_hits)."""
+        from ..storage import bulk as bulk_frame
+        from ..storage.needle import FLAG_GZIP
+        from ..utils.env import env_int
+
+        budget = env_int("SWTPU_BULK_READ_FRAME_BYTES", 32 << 20)
+        cache = (self.read_cache
+                 if self.store.find_volume(vid) is not None else None)
+        results: "list[tuple[int, int, int, int, bytes] | None]" = \
+            [None] * len(pairs)
+        misses: "list[int]" = []
+        hits = 0
+        used = 0
+        epoch = cache.epoch(vid) if cache is not None else None
+        for i, (key, cookie) in enumerate(pairs):
+            n = cache.get(vid, key, cookie) if cache is not None else None
+            if n is not None:
+                # hits consume the frame budget too: the response join
+                # is the allocation the budget bounds, and a frame
+                # naming hot keys (or one key repeatedly) must not
+                # assemble more than the cap
+                if used >= budget:
+                    results[i] = (key, cookie, bulk_frame.READ_OVERFLOW,
+                                  0, b"")
+                    continue
+                hits += 1
+                used += len(n.data)
+                results[i] = (key, cookie, bulk_frame.READ_OK,
+                              FLAG_GZIP if n.is_gzipped else 0, n.data)
+            else:
+                misses.append(i)
+        if misses:
+            got = self.store.read_needles_bulk(
+                vid, [pairs[i] for i in misses],
+                shard_reader=self._make_shard_reader(vid),
+                byte_budget=max(0, budget - used))
+            for i, (st, n) in zip(misses, got):
+                key, cookie = pairs[i]
+                if st == bulk_frame.READ_OK:
+                    results[i] = (key, cookie, st,
+                                  FLAG_GZIP if n.is_gzipped else 0, n.data)
+                    if cache is not None:
+                        cache.put(vid, key, n, epoch=epoch)
+                else:
+                    results[i] = (key, cookie, st, 0, b"")
+        return bulk_frame.pack_read_response(vid, results), hits
+
     def _lookup_replicas_cached(self, vid: int) -> list[str]:
         """Replica sets move only on evacuate/rebalance; a short-TTL cache
         keeps the per-write master round-trip off the hot path."""
@@ -807,7 +973,55 @@ class VolumeServer:
             log.warning("replica lookup vid=%d failed: %s", vid, e)
         return []
 
+    @staticmethod
+    def _parse_range(value: "str | None"):
+        """One single-range `bytes=` spec, or None for absent / invalid /
+        multi-range (those serve the full body, per RFC 7233's allowance
+        to ignore unsupported Range headers). Returns ("suffix", n) |
+        ("from", start) | ("range", start, last)."""
+        if not value or not value.startswith("bytes="):
+            return None
+        spec = value[len("bytes="):].strip()
+        if "," in spec:
+            return None
+        first, sep, last = spec.partition("-")
+        if not sep:
+            return None
+        first, last = first.strip(), last.strip()
+        try:
+            if not first:
+                n = int(last)
+                return ("suffix", n) if n > 0 else None
+            start = int(first)
+            if start < 0:
+                return None
+            if not last:
+                return ("from", start)
+            stop = int(last)
+            return ("range", start, stop) if stop >= start else None
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _resolve_range(spec, size: int) -> "tuple[int, int] | None":
+        """[start, stop) byte window of `spec` over a `size`-byte body,
+        or None when unsatisfiable (RFC 7233: start past the end)."""
+        if spec[0] == "suffix":
+            if size == 0:
+                return None
+            return max(0, size - spec[1]), size
+        start = spec[1]
+        if start >= size:
+            return None
+        if spec[0] == "from":
+            return start, size
+        return start, min(spec[2] + 1, size)
+
     async def _handle_read(self, request):
+        import asyncio
+        import contextvars
+
+        from .. import tracing
         from ..utils.fastweb import Response, json_response
 
         fid = request.path.lstrip("/")
@@ -818,9 +1032,36 @@ class VolumeServer:
             if not ok:
                 return json_response({"error": why}, status=401)
         vid, key, cookie = parse_file_id(fid)
+        # hot-needle cache sits in front of the storage read for LOCAL
+        # plain volumes only: EC/degraded and proxied reads stream
+        # uncached (their bytes still flow through the identical
+        # serve/Range logic below, so the response is path-invariant)
+        cache = self.read_cache
+        cacheable = (cache is not None
+                     and self.store.find_volume(vid) is not None)
+        n = None
+        epoch = None
+        if cacheable:
+            n = cache.get(vid, key, cookie)
+            sp = tracing.current_span()
+            if sp is not None:
+                sp.set_attr("cache", "hit" if n is not None else "miss")
         try:
-            n = self.store.read_needle(vid, key, cookie=cookie,
-                                       shard_reader=self._make_shard_reader(vid))
+            if n is None:
+                if cacheable:
+                    # epoch BEFORE the storage read: a mutation landing
+                    # in between invalidates this fill (read_cache.put)
+                    epoch = cache.epoch(vid)
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                # storage read off-loop on the parallel read pool: the
+                # seqlock read path never touches the volume lock, so
+                # concurrent GETs proceed while a writer fsyncs
+                n = await loop.run_in_executor(
+                    self._read_pool, ctx.run, self._store_read,
+                    vid, key, cookie)
+                if epoch is not None:
+                    cache.put(vid, key, n, epoch=epoch)
         except KeyError:
             if (self.store.find_volume(vid) is not None
                     or self.store.find_ec_volume(vid) is not None):
@@ -863,8 +1104,17 @@ class VolumeServer:
         if ext:
             from ..images import should_resize
             w, h, mode, do_resize = should_resize(ext, request.query)
+        # Range semantics are computed on the FINAL identity bytes this
+        # handler assembled, after the gzip/resize decisions — so the
+        # answer is byte-identical whether the needle came from the
+        # cache, a lock-free volume pread, or a degraded EC reconstruct.
+        # A ranged read of a gzip needle serves identity (sliced
+        # compressed bytes would be useless to a client).
+        rng_spec = None if do_resize else self._parse_range(
+            request.headers.get("Range"))
         gzip_ok = "gzip" in (request.headers.get("Accept-Encoding") or "")
-        if n.is_gzipped and (do_resize or not gzip_ok):
+        if n.is_gzipped and (do_resize or rng_spec is not None
+                             or not gzip_ok):
             import gzip as _gz
             body = _gz.decompress(body)
         elif n.is_gzipped:
@@ -876,9 +1126,28 @@ class VolumeServer:
                 # plain read path serves stored bytes untouched
                 body = fix_jpeg_orientation(body)
             body = resized(ext, body, w, h, mode)
-        return Response(body, headers=headers or None,
+        status = 200
+        if rng_spec is not None:
+            window = self._resolve_range(rng_spec, len(body))
+            if window is None:
+                return Response(
+                    b"", status=416,
+                    headers={"Content-Range": f"bytes */{len(body)}"},
+                    content_type="application/octet-stream")
+            start, stop = window
+            headers["Content-Range"] = \
+                f"bytes {start}-{stop - 1}/{len(body)}"
+            body = body[start:stop]
+            status = 206
+        return Response(body, status=status, headers=headers or None,
                         content_type=(n.mime.decode() if n.mime else
                                       "application/octet-stream"))
+
+    def _store_read(self, vid: int, key: int, cookie: "int | None"):
+        """Blocking storage read (runs on the read pool)."""
+        return self.store.read_needle(
+            vid, key, cookie=cookie,
+            shard_reader=self._make_shard_reader(vid))
 
     async def _read_remote(self, request, fid: str, vid: int):
         from ..utils.fastweb import Redirect, Response, json_response
@@ -905,17 +1174,36 @@ class VolumeServer:
         timeout = aiohttp.ClientTimeout(
             total=retry.READ_POLICY.attempt_timeout)
         from .. import tracing
-        async with aiohttp.ClientSession(timeout=timeout) as sess:
+        # the Range header must survive the proxy hop (and its
+        # Content-Range/-Encoding must survive the way back) or ranged
+        # reads would silently widen to full bodies on proxied volumes
+        fwd = {}
+        for h in ("Range", "Accept-Encoding"):
+            val = request.headers.get(h)
+            if val:
+                fwd[h] = val
+        # skip aiohttp's default Accept-Encoding — only the CLIENT's own
+        # header may reach the origin, or a gzip-stored needle comes back
+        # compressed to a caller that never advertised gzip (with
+        # auto_decompress off, nobody would decompress it)
+        async with aiohttp.ClientSession(
+                timeout=timeout, auto_decompress=False,
+                skip_auto_headers=("Accept-Encoding",)) as sess:
             last_err: Exception | None = None
             for peer in peers:
                 br = retry.breaker(peer)
                 try:
                     async with sess.get(f"http://{peer}/{fid}{suffix}",
-                                        headers=tracing.inject(None)) as r:
+                                        headers=tracing.inject(fwd)) as r:
                         body = await r.read()
                         br.record_success()
+                        back = {}
+                        for h in ("Content-Range", "Content-Encoding",
+                                  "Content-Disposition"):
+                            if h in r.headers:
+                                back[h] = r.headers[h]
                         return Response(
-                            body, status=r.status,
+                            body, status=r.status, headers=back or None,
                             content_type=(r.content_type
                                           or "application/octet-stream"))
                 except Exception as e:  # noqa: BLE001

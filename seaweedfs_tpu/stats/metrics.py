@@ -398,6 +398,29 @@ BULK_PUT_NEEDLES = _histogram(
 HTTP_POOL_REUSE = _counter(
     "SeaweedFS_http_pool_reuse_total",
     "client HTTP requests served over a reused keep-alive connection")
+# Read-path data plane (hot-needle cache + framed bulk GET): cache
+# effectiveness (hit ratio = hits / (hits + misses)), eviction churn,
+# resident bytes (delta-accounted so several caches in one process
+# compose and the gauge can't scrape negative), and the per-frame
+# batching the /bulk-read handler sees. GET latency exemplars live on
+# SeaweedFS_volumeServer_request_seconds{type="get"}; the cache-status
+# span attr links a traced GET to its hit/miss outcome.
+READ_CACHE_HITS = _counter(
+    "SeaweedFS_read_cache_hits_total",
+    "volume-server reads served from the hot-needle cache")
+READ_CACHE_MISSES = _counter(
+    "SeaweedFS_read_cache_misses_total",
+    "volume-server cache lookups that fell through to storage")
+READ_CACHE_EVICTIONS = _counter(
+    "SeaweedFS_read_cache_evictions_total",
+    "needles evicted from the hot-needle cache to make room")
+READ_CACHE_BYTES = _gauge(
+    "SeaweedFS_read_cache_bytes",
+    "bytes resident in hot-needle read caches")
+BULK_READ_NEEDLES = _histogram(
+    "SeaweedFS_bulk_read_needles",
+    "needles per bulk-GET frame answered by the volume server",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
 
 
 def scrape_payload(accept: str = "") -> tuple[str, str]:

@@ -34,28 +34,42 @@ class NeedleValue:
 
 
 class CompactMap:
-    """id -> (offset/8 stored, size) with numpy sorted base + dict overlay."""
+    """id -> (offset/8 stored, size) with numpy sorted base + dict overlay.
+
+    Concurrency contract: writers (set/delete/_merge) are serialized by
+    the volume lock, but the seqlock read path calls get() with NO lock.
+    The three base arrays therefore live in ONE tuple attribute swapped
+    atomically (a single STORE_ATTR): a reader snapshots `self._base`
+    once and indexes a consistent (keys, offsets, sizes) triple. Storing
+    them as three attributes would let a reader interleave between the
+    stores and index the new keys against the old offsets — a wrong (or
+    out-of-range) record for a perfectly healthy needle. Order matters
+    in _merge too: the new base is published BEFORE the overlay clears,
+    so a lock-free get() always finds a key in at least one of them.
+    """
 
     MERGE_THRESHOLD = 65536
 
+    _EMPTY = (np.empty(0, dtype=np.uint64), np.empty(0, dtype=np.uint32),
+              np.empty(0, dtype=np.uint32))
+
     def __init__(self) -> None:
-        self._keys = np.empty(0, dtype=np.uint64)
-        self._offsets = np.empty(0, dtype=np.uint32)
-        self._sizes = np.empty(0, dtype=np.uint32)
+        self._base: "tuple[np.ndarray, np.ndarray, np.ndarray]" = self._EMPTY
         self._overlay: dict[int, tuple[int, int]] = {}
 
     def __len__(self) -> int:
         # approximate live count: base + overlay (minus overlap, ignored)
-        return int(self._keys.size) + len(self._overlay)
+        return int(self._base[0].size) + len(self._overlay)
 
     def _merge(self) -> None:
         if not self._overlay:
             return
+        bkeys, boffs, bsizes = self._base
         ok = np.fromiter(self._overlay.keys(), dtype=np.uint64, count=len(self._overlay))
         ov = np.array(list(self._overlay.values()), dtype=np.uint32).reshape(-1, 2)
-        keys = np.concatenate([self._keys, ok])
-        offsets = np.concatenate([self._offsets, ov[:, 0]])
-        sizes = np.concatenate([self._sizes, ov[:, 1]])
+        keys = np.concatenate([bkeys, ok])
+        offsets = np.concatenate([boffs, ov[:, 0]])
+        sizes = np.concatenate([bsizes, ov[:, 1]])
         # stable sort; later (overlay) entries win on duplicates
         order = np.argsort(keys, kind="stable")
         keys, offsets, sizes = keys[order], offsets[order], sizes[order]
@@ -63,7 +77,8 @@ class CompactMap:
             last = np.ones(keys.size, dtype=bool)
             last[:-1] = keys[:-1] != keys[1:]
             keys, offsets, sizes = keys[last], offsets[last], sizes[last]
-        self._keys, self._offsets, self._sizes = keys, offsets, sizes
+        # publish the new base BEFORE dropping the overlay (see class doc)
+        self._base = (keys, offsets, sizes)
         self._overlay.clear()
 
     def set(self, key: int, stored_offset: int, size: int) -> None:
@@ -80,27 +95,31 @@ class CompactMap:
 
     def get(self, key: int) -> NeedleValue | None:
         v = self._overlay.get(key)
-        if v is None and self._keys.size:
-            i = int(np.searchsorted(self._keys, np.uint64(key)))
-            if i < self._keys.size and int(self._keys[i]) == key:
-                v = (int(self._offsets[i]), int(self._sizes[i]))
+        if v is None:
+            keys, offsets, sizes = self._base  # one atomic snapshot
+            if keys.size:
+                i = int(np.searchsorted(keys, np.uint64(key)))
+                if i < keys.size and int(keys[i]) == key:
+                    v = (int(offsets[i]), int(sizes[i]))
         if v is None or t.is_tombstone(v[1]):
             return None
         return NeedleValue(key, t.stored_to_offset(v[0]), v[1])
 
     def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
         self._merge()
-        for i in range(self._keys.size):
-            sz = int(self._sizes[i])
+        keys, offsets, sizes = self._base
+        for i in range(keys.size):
+            sz = int(sizes[i])
             if not t.is_tombstone(sz):
-                fn(NeedleValue(int(self._keys[i]), t.stored_to_offset(int(self._offsets[i])), sz))
+                fn(NeedleValue(int(keys[i]), t.stored_to_offset(int(offsets[i])), sz))
 
     def items_arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Sorted live (keys, stored_offsets, sizes) — feeds the EC .ecx writer
         and device batch pipelines without per-entry Python overhead."""
         self._merge()
-        live = ~np.equal(self._sizes, np.uint32(t.TOMBSTONE_SIZE))
-        return self._keys[live], self._offsets[live], self._sizes[live]
+        keys, offsets, sizes = self._base
+        live = ~np.equal(sizes, np.uint32(t.TOMBSTONE_SIZE))
+        return keys[live], offsets[live], sizes[live]
 
 
 class NeedleMap:

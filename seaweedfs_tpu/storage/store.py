@@ -21,7 +21,7 @@ from ..utils.log import logger
 from . import types as t
 from .disk_location import DiskLocation
 from .needle import Needle
-from .volume import Volume
+from .volume import Volume, VolumeClosedError
 
 log = logger("store")
 
@@ -192,14 +192,79 @@ class Store:
     def read_needle(self, vid: int, needle_id: int, cookie: int | None = None,
                     shard_reader=None) -> Needle:
         failpoints.check("store.read")  # delay = slow disk; error = bad disk
-        v = self.find_volume(vid)
-        if v is not None:
-            return v.read_needle(needle_id, cookie=cookie)
+        for v in self._read_volumes(vid):
+            try:
+                return v.read_needle(needle_id, cookie=cookie)
+            except VolumeClosedError:
+                continue  # retry through the refreshed mapping
         ev = self.find_ec_volume(vid)
         if ev is not None:
             return ev.read_needle(needle_id, cookie=cookie,
                                   shard_reader=shard_reader)
         raise KeyError(f"volume {vid} not found")
+
+    def read_needles_bulk(self, vid: int, pairs: "list[tuple[int, int]]",
+                          shard_reader=None,
+                          byte_budget: "int | None" = None):
+        """Bulk-GET storage path: resolve + read a whole (key, cookie)
+        batch through the lock-free read protocol (volume.read_needles).
+        EC volumes answer per needle (each read may take the degraded
+        reconstruct path). `byte_budget` bounds materialized payload
+        bytes — past it, found needles report READ_OVERFLOW unread.
+        Returns [(status, Needle | None)]."""
+        failpoints.check("store.read")
+        from .bulk import (READ_ERROR, READ_NOT_FOUND, READ_OK,
+                           READ_OVERFLOW)
+        for v in self._read_volumes(vid):
+            try:
+                return v.read_needles(pairs, byte_budget=byte_budget)
+            except VolumeClosedError:
+                continue
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"volume {vid} not found")
+        out = []
+        used = 0
+        for key, cookie in pairs:
+            if byte_budget is not None and used >= byte_budget:
+                out.append((READ_OVERFLOW, None))
+                continue
+            try:
+                n = ev.read_needle(key, cookie=cookie,
+                                   shard_reader=shard_reader)
+                used += len(n.data)
+                out.append((READ_OK, n))
+            except KeyError:
+                out.append((READ_NOT_FOUND, None))
+            except Exception as e:  # noqa: BLE001 — per-needle status
+                log.debug("bulk ec read %d/%x: %s", vid, key, e)
+                out.append((READ_ERROR, None))
+        return out
+
+    def _read_volumes(self, vid: int):
+        """Volume objects to try for a read: the current mapping, then
+        — if a lock-free read lost the race against a vacuum-commit /
+        remount swap (VolumeClosedError) — the refreshed mapping, until
+        the swap window passes. The mapping is re-consulted IMMEDIATELY
+        after a failure (the replacement volume usually landed while the
+        failed read was in flight); the sleep only covers the case where
+        the old closed object is still mapped mid-swap. The deadline
+        bounds BOTH branches — back-to-back swaps of a hot volume must
+        not spin a read past the window."""
+        deadline = time.monotonic() + 1.0
+        last = None
+        while True:
+            if time.monotonic() > deadline:
+                raise VolumeClosedError(
+                    f"volume {vid} kept closing under reads")
+            v = self.find_volume(vid)
+            if v is None:
+                return
+            if v is not last:
+                last = v
+                yield v
+                continue  # consumer failed on a fresh object: re-check now
+            time.sleep(0.01)  # swap in flight: the new mapping lands soon
 
     def delete_needle(self, vid: int, needle_id: int) -> bool:
         failpoints.check("store.delete")  # bad disk on the tombstone path

@@ -112,4 +112,9 @@ def commit_compact(vol: Volume) -> Volume:
         vol.close()
         os.replace(cpd, base + ".dat")
         os.replace(cpx, base + ".idx")
+    # every live needle moved to a new offset: the whole volume's cached
+    # entries are stale (close() already invalidated; this covers the
+    # swap explicitly so the coherence story reads at the chokepoint)
+    from .read_cache import invalidate_volume
+    invalidate_volume(vid)
     return Volume(dirname, collection, vid, create_if_missing=False)

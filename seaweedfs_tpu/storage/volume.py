@@ -12,6 +12,7 @@ import os
 import threading
 
 from . import types as t
+from . import read_cache
 from ..utils import failpoints
 from ..utils.log import logger
 from .needle import Needle, record_size_from_header
@@ -19,6 +20,12 @@ from .needle_map import NeedleMap, idx_entries_numpy
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 
 log = logger("volume")
+
+
+class VolumeClosedError(OSError):
+    """A lock-free read raced this volume's close (vacuum commit swap,
+    unmount). The volume OBJECT is dead but the volume usually is not —
+    the store retries once through its fresh mapping."""
 
 
 def iter_records(f, start: int, end: int):
@@ -71,6 +78,14 @@ class Volume:
         self.last_append_at_ns = 0
         self._nm_kind = needle_map_kind
         self._lock = threading.RLock()
+        # seqlock read-path state: reads pread() the .dat WITHOUT the
+        # volume lock, validating against the commit watermark (bytes
+        # flushed to the OS before their index entries published) and
+        # the closed flag (set BEFORE the fd is released, so a reused
+        # fd number can never masquerade as this volume's data)
+        self._closed = False
+        self._fileno = -1
+        self._commit_offset = SUPER_BLOCK_SIZE
 
         base = self.file_name()
         self.dat_path = base + ".dat"
@@ -94,6 +109,7 @@ class Volume:
             with open(self.dat_path, "wb") as f:
                 f.write(self.super_block.to_bytes())
         self._dat = open(self.dat_path, "r+b")
+        self._fileno = self._dat.fileno()
         self.super_block = SuperBlock.from_bytes(self._dat.read(SUPER_BLOCK_SIZE))
         self.nm = NeedleMap(self.idx_path, needle_map_kind)
         self._check_integrity()
@@ -122,6 +138,7 @@ class Volume:
         self.nm = NeedleMap(self.idx_path, self._nm_kind)
         self.read_only = True
         self._append_offset = self._dat.size
+        self._commit_offset = self._append_offset
 
     # -- naming ------------------------------------------------------------
     def file_name(self) -> str:
@@ -168,6 +185,7 @@ class Volume:
             for key in list(self._keys_past(end)):
                 self.nm.delete(key)
         self._append_offset = max(end, SUPER_BLOCK_SIZE)
+        self._commit_offset = self._append_offset
 
     def _keys_past(self, end: int):
         keys, offs, sizes = self.nm.map.items_arrays()
@@ -285,6 +303,7 @@ class Volume:
         """Append raw record bytes (from tail/incremental copy) and replay
         them into the needle map. Returns records applied."""
         import struct
+        touched: "list[int]" = []
         with self._lock:
             if self.read_only:
                 raise PermissionError(f"volume {self.id} is read-only")
@@ -294,6 +313,11 @@ class Volume:
             self._dat.seek(start)
             self._dat.write(raw)
             self._append_offset = start + len(raw)
+            # flush before any index replay publishes the new records
+            # (seqlock read path); the torn-tail branch re-anchors the
+            # watermark after its truncate
+            self._dat.flush()
+            self._commit_offset = self._append_offset
             applied = 0
             pos = 0
             while pos + t.NEEDLE_HEADER_SIZE <= len(raw):
@@ -304,6 +328,7 @@ class Volume:
                     self._append_offset = start + pos
                     self._dat.seek(self._append_offset)
                     self._dat.truncate()
+                    self._commit_offset = self._append_offset
                     break
                 if t.is_tombstone(nsize):
                     self.nm.delete(nid)
@@ -312,9 +337,12 @@ class Volume:
                     ts = struct.unpack_from(
                         "<Q", raw, pos + t.NEEDLE_HEADER_SIZE + nsize + 4)[0]
                     self.last_append_at_ns = ts
+                touched.append(nid)
                 pos += rec_len
                 applied += 1
-            return applied
+        # tail replay mutates through the chokepoint (batched)
+        read_cache.invalidate_keys(self.id, touched)
+        return applied
 
     # -- write path (reference volume_write.go:119 writeNeedle2) -----------
     def write_needle(self, n: Needle) -> int:
@@ -331,9 +359,16 @@ class Volume:
             # reopen-time _check_integrity heal is driven by this
             self._dat.write(failpoints.torn("volume.write.torn", rec))
             self._append_offset = off + len(rec)
+            # publish order (seqlock read path): bytes reach the OS
+            # BEFORE the index entry appears and the commit watermark
+            # advances — a lock-free pread that resolved this key is
+            # guaranteed to see the record, not the write buffer's hole
+            self._dat.flush()
+            self._commit_offset = self._append_offset
             self.nm.put(n.id, off, self._body_size(rec))
             self.last_append_at_ns = n.append_at_ns
-            return off
+        read_cache.invalidate(self.id, n.id)  # overwrite coherence
+        return off
 
     def write_needles(self, needles: "list[Needle]",
                       sync: bool = True) -> "list[int]":
@@ -369,15 +404,21 @@ class Volume:
             # to the last whole record on reopen
             self._dat.write(failpoints.torn("volume.write.torn", buf))
             self._append_offset = off
+            # flush BEFORE the batched index publish (seqlock read
+            # path), then fsync for the frame's durability ack
+            self._dat.flush()
+            self._commit_offset = self._append_offset
             self.nm.put_many([(n.id, o, self._body_size(rec))
                               for n, o, rec in zip(needles, offs, recs)])
             self.last_append_at_ns = needles[-1].append_at_ns
             if sync:
-                self._dat.flush()
                 if self.remote_spec is None:
                     os.fsync(self._dat.fileno())
                 self.nm.flush()
-            return offs
+        # bulk-frame appends share the one chokepoint, batched: one
+        # epoch bump + one lock pass instead of 2N on the ingest ack
+        read_cache.invalidate_keys(self.id, [n.id for n in needles])
+        return offs
 
     @staticmethod
     def _body_size(rec: bytes) -> int:
@@ -395,24 +436,110 @@ class Volume:
             self._dat.seek(self._append_offset)
             self._dat.write(rec)
             self._append_offset += len(rec)
-            return self.nm.delete(needle_id)
+            deleted = self.nm.delete(needle_id)
+        # after the map hides the needle: a racing fill that read the
+        # live bytes snapshotted a pre-bump epoch and gets rejected
+        read_cache.invalidate(self.id, needle_id)
+        return deleted
 
-    # -- read path (reference volume_read.go) ------------------------------
+    # -- read path (reference volume_read.go; lock-free — see below) -------
     def read_needle(self, needle_id: int, cookie: int | None = None,
                     verify_crc: bool = True) -> Needle:
-        with self._lock:
-            nv = self.nm.get(needle_id)
-            if nv is None:
-                raise KeyError(f"needle {needle_id:x} not found in volume {self.id}")
-            rec_len = record_size_from_header(nv.size)
-            self._dat.seek(nv.offset)
-            buf = self._dat.read(rec_len)
+        buf = self._read_record(needle_id)
         n = Needle.from_bytes(buf, verify_crc=verify_crc)
         if n.id != needle_id:
-            raise ValueError(f"needle id mismatch at offset {nv.offset}")
+            raise ValueError(f"needle id mismatch for {needle_id:x} "
+                             f"in volume {self.id}")
         if cookie is not None and n.cookie != cookie:
             raise PermissionError(f"cookie mismatch for needle {needle_id:x}")
         return n
+
+    def _read_record(self, needle_id: int) -> bytes:
+        """One needle record's bytes, seqlock-style: index snapshot ->
+        pread -> post-read validation. Concurrent GETs never queue
+        behind a writer's fsync.
+
+        Safety argument: the .dat is append-only between compactions —
+        a record's bytes at a published (offset, size) are immutable
+        for this Volume object's lifetime (overwrites append NEW
+        records; deletes append tombstones; compaction swaps in a NEW
+        Volume). The index publishes an entry only AFTER its bytes were
+        flushed to the OS (write_needle/write_needles ordering), so a
+        pread of a resolved entry under the commit watermark always
+        finds whole bytes. The only hazard left is the fd dying under
+        us (vacuum commit / unmount closes this object): `_closed` is
+        set BEFORE the fd is released, so checking it AFTER the pread
+        proves the fd was ours for the read's whole duration — a reused
+        fd number can never leak another file's bytes past validation.
+        Any validation failure falls back to the locked path, which
+        raises VolumeClosedError for the store to retry on its fresh
+        volume mapping."""
+        if self.remote_spec is None and not self._closed:
+            nv = self.nm.get(needle_id)  # index snapshot (GIL-atomic)
+            if nv is None:
+                raise KeyError(f"needle {needle_id:x} not found in "
+                               f"volume {self.id}")
+            rec_len = record_size_from_header(nv.size)
+            if nv.offset + rec_len <= self._commit_offset:
+                try:
+                    buf = os.pread(self._fileno, rec_len, nv.offset)
+                except OSError:
+                    buf = b""  # racing close: take the locked path
+                if len(buf) == rec_len and not self._closed:
+                    return buf
+        with self._lock:
+            if self._closed or self._dat.closed:
+                raise VolumeClosedError(
+                    f"volume {self.id} closed mid-read")
+            nv = self.nm.get(needle_id)
+            if nv is None:
+                raise KeyError(f"needle {needle_id:x} not found in "
+                               f"volume {self.id}")
+            rec_len = record_size_from_header(nv.size)
+            self._dat.seek(nv.offset)
+            return self._dat.read(rec_len)
+
+    def read_needles(self, pairs: "list[tuple[int, int | None]]",
+                     verify_crc: bool = True,
+                     byte_budget: "int | None" = None,
+                     ) -> "list[tuple[int, Needle | None]]":
+        """Bulk-GET storage path: resolve and read a whole batch of
+        (key, cookie) pairs through the lock-free read protocol — one
+        index pass, zero volume-lock acquisitions on the fast path (the
+        locked fallback only fires on a racing close/remote volume).
+        Returns [(status, needle)] aligned with `pairs`; statuses are
+        storage/bulk.py's READ_OK / READ_NOT_FOUND / READ_ERROR /
+        READ_OVERFLOW. `byte_budget` bounds the bytes MATERIALIZED for
+        one response frame: once served payloads exceed it, remaining
+        found needles come back READ_OVERFLOW without being read at all
+        (the client re-fetches those per-needle) — a frame of large
+        needles must not allocate gigabytes server-side.
+        VolumeClosedError propagates whole — the store retries the
+        batch against its fresh volume mapping."""
+        from .bulk import (READ_ERROR, READ_NOT_FOUND, READ_OK,
+                           READ_OVERFLOW)
+        out: "list[tuple[int, Needle | None]]" = []
+        used = 0
+        for key, cookie in pairs:
+            if byte_budget is not None and used >= byte_budget:
+                # still resolve: a miss must report NOT_FOUND, not ask
+                # the client to chase a needle that does not exist
+                out.append((READ_NOT_FOUND if self.nm.get(key) is None
+                            else READ_OVERFLOW, None))
+                continue
+            try:
+                n = self.read_needle(key, cookie=cookie,
+                                     verify_crc=verify_crc)
+                used += len(n.data)
+                out.append((READ_OK, n))
+            except KeyError:
+                out.append((READ_NOT_FOUND, None))
+            except VolumeClosedError:
+                raise
+            except (PermissionError, ValueError, OSError) as e:
+                log.debug("bulk read %d/%x: %s", self.id, key, e)
+                out.append((READ_ERROR, None))
+        return out
 
     def read_raw(self, offset: int, length: int) -> bytes:
         with self._lock:
@@ -441,6 +568,7 @@ class Volume:
     def sync(self) -> None:
         with self._lock:
             self._dat.flush()
+            self._commit_offset = self._append_offset
             if self.remote_spec is None:
                 os.fsync(self._dat.fileno())
             self.nm.flush()
@@ -449,11 +577,16 @@ class Volume:
         with self._lock:
             if self._dat.closed:
                 return
+            # order matters for the lock-free readers: the closed flag
+            # must be visible BEFORE the fd is released (their post-read
+            # validation checks it after pread)
+            self._closed = True
             try:
                 self._dat.flush()
             finally:
                 self._dat.close()
                 self.nm.close()
+        read_cache.invalidate_volume(self.id)
 
     def destroy(self) -> None:
         self.close()

@@ -493,6 +493,266 @@ def test_bulk_ingest_schedule(cluster):
                timeout=30, msg=f"{ctx}: health verdict returns to OK")
 
 
+def test_read_storm_schedule(cluster):
+    """The read-path coherence schedule (ISSUE 9): hammer threads read a
+    hot key set (per-needle GETs + framed /bulk-read) while mutator
+    threads overwrite and delete those same keys, a bulk-ingest stream
+    keeps the fsync churn up, and the hot volumes get vacuumed
+    mid-storm — all with read-path faults armed. Invariants:
+
+      * NO STALE BYTE through the hot-needle cache: immediately after an
+        ACKED overwrite the same fid reads back the NEW bytes, and after
+        an acked delete it 404s (the mutator verifies sequentially, so a
+        stale cache entry anywhere fails loud);
+      * hammered reads only ever observe bytes from the fid's write
+        history (never torn/garbage), through GET and /bulk-read both;
+      * breakers re-close once the faults clear.
+
+    Runs before the repair-loop test (which removes a server for good);
+    `make chaos` runs this under SWTPU_LOCKCHECK=1 and the session
+    fixture asserts zero lock-order cycles."""
+    from conftest import wait_until
+
+    master, servers, mc = cluster
+    seed = BASE_SEED + 9999
+    rng = random.Random(seed)
+    failpoints.seed(seed)
+    ctx = f"read-storm seed={seed} (SWTPU_CHAOS_SEED={BASE_SEED})"
+    wait_until(lambda: len(master.topo.nodes) >= 3, timeout=15,
+               msg=f"{ctx}: all nodes registered before the window")
+
+    # -- seed the hot set ---------------------------------------------------
+    # Each fid has ONE owning mutator (hot list partitioned below), so
+    # the sequential read-after-ack verifications can't race another
+    # mutation of the same fid. Deletes are restricted to single-copy
+    # fids: the delete fan-out to replicas is best-effort mid-faults
+    # (store_replicate semantics), so mid-storm read-after-delete is
+    # only a sound assertion where the local tombstone IS the truth.
+    n_hot = 24
+    history: dict[str, set] = {}       # fid -> every byte-string ever acked
+    latest: dict[str, bytes] = {}      # fid -> last ACKED value
+    deletable: set = set()             # fids where a 404 is legal
+    quarantine: set = set()            # indeterminate outcomes: no asserts
+    replicated: set = set()            # fids with a second copy
+    ledger_lock = threading.Lock()
+    hot: list = []
+    for i in range(n_hot):
+        payload = b"hot-%03d-" % i + rng.randbytes(rng.randint(200, 3000))
+        res = operation.submit(mc, payload,
+                               replication="001" if i % 3 == 0 else "")
+        hot.append(res.fid)
+        if i % 3 == 0:
+            replicated.add(res.fid)
+        history[res.fid] = {payload}
+        latest[res.fid] = payload
+    hot_vids = sorted({int(f.split(",")[0]) for f in hot})
+
+    stop = threading.Event()
+    violations: list = []
+
+    def _overwrite(wrng, fid) -> None:
+        payload = b"ow-" + wrng.randbytes(wrng.randint(100, 3000))
+        with ledger_lock:
+            history[fid].add(payload)  # possible from the op's start
+        try:
+            url = mc.lookup_file_id(fid)[0].split("://", 1)[-1]
+            operation.upload(url, payload, jwt=mc.lookup_file_id_jwt(fid))
+        except Exception:  # noqa: BLE001 — indeterminate
+            with ledger_lock:
+                quarantine.add(fid)
+            return
+        with ledger_lock:
+            latest[fid] = payload
+            quarantine.discard(fid)
+        # THE cache-coherence assertion: a read started strictly after
+        # the acked overwrite must return the new bytes on every path
+        # (this thread owns the fid, so no other mutation can race it)
+        try:
+            got = operation.read(mc, fid)
+            if got != payload:
+                violations.append((fid, "stale read-after-overwrite",
+                                   len(got), len(payload)))
+            bg = operation.read_batch(mc, [fid])[0]
+            if bg != payload:
+                violations.append((fid, "stale bulk read-after-overwrite"))
+        except KeyError:
+            violations.append((fid, "404 right after acked overwrite"))
+        except Exception:  # noqa: BLE001 — transport flake under faults
+            pass
+
+    def _delete_and_rewrite(wrng, fid) -> None:
+        with ledger_lock:
+            deletable.add(fid)
+        try:
+            ok = operation.delete(mc, fid)
+        except Exception:  # noqa: BLE001
+            ok = None
+        if not ok:
+            with ledger_lock:
+                quarantine.add(fid)
+            return
+        try:
+            operation.read(mc, fid)
+            violations.append((fid, "read-after-delete served bytes"))
+        except (KeyError, RuntimeError):
+            pass  # 404 — what an acked delete must produce
+        try:
+            if operation.read_batch(mc, [fid])[0] is not None:
+                violations.append((fid,
+                                   "bulk read-after-delete served bytes"))
+        except Exception:  # noqa: BLE001 — transport flake under faults
+            pass
+        # resurrect the fid so the hot set stays hot
+        payload = b"rw-" + wrng.randbytes(wrng.randint(100, 2000))
+        with ledger_lock:
+            history[fid].add(payload)
+        try:
+            url = mc.lookup_file_id(fid)[0].split("://", 1)[-1]
+            operation.upload(url, payload, jwt=mc.lookup_file_id_jwt(fid))
+            with ledger_lock:
+                latest[fid] = payload
+                quarantine.discard(fid)
+        except Exception:  # noqa: BLE001
+            with ledger_lock:
+                quarantine.add(fid)
+
+    def mutator(wseed: int, mine: list) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            fid = wrng.choice(mine)
+            if fid in replicated or wrng.random() < 0.6:
+                _overwrite(wrng, fid)
+            else:
+                _delete_and_rewrite(wrng, fid)
+
+    def hammer(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            # zipf-ish: mostly the first few keys, occasionally any
+            idx = wrng.randrange(6) if wrng.random() < 0.7 \
+                else wrng.randrange(n_hot)
+            fid = hot[idx]
+            use_bulk = wrng.random() < 0.3
+            try:
+                if use_bulk:
+                    sample = [hot[wrng.randrange(n_hot)] for _ in range(8)]
+                    got = operation.read_batch(mc, sample)
+                    pairs = list(zip(sample, got))
+                else:
+                    pairs = [(fid, operation.read(mc, fid))]
+            except (KeyError, RuntimeError):
+                with ledger_lock:
+                    legal = fid in deletable or fid in quarantine
+                if not legal and not use_bulk:
+                    violations.append((fid, "404 for never-deleted fid"))
+                continue
+            except Exception:  # noqa: BLE001 — transport flake under faults
+                continue
+            with ledger_lock:
+                for f, data in pairs:
+                    if f in quarantine:
+                        continue
+                    if data is None:
+                        if f not in deletable:
+                            violations.append((f, "bulk miss, never deleted"))
+                    elif data not in history[f]:
+                        violations.append((f, "bytes outside write history",
+                                           len(data)))
+
+    def ingest_stream(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            payloads = [wrng.randbytes(wrng.randint(100, 2000))
+                        for _ in range(32)]
+            try:
+                operation.submit_batch(mc, payloads, collection="storm",
+                                       retries=4)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- light read-path faults: the storm must survive them ----------------
+    for site, spec in [
+            ("store.read", f"pct:{rng.randint(5, 15)}:delay:0.02"),
+            ("http.request", f"pct:{rng.randint(2, 6)}:error:chaos")]:
+        failpoints.configure(site, spec)
+        print(f"[chaos] {ctx}: armed {site}={spec}")
+
+    threads = ([threading.Thread(target=mutator, daemon=True,
+                                 args=(rng.randrange(1 << 30), hot[m::2]))
+                for m in range(2)]  # disjoint fid ownership per mutator
+               + [threading.Thread(target=hammer, daemon=True,
+                                   args=(rng.randrange(1 << 30),))
+                  for _ in range(3)]
+               + [threading.Thread(target=ingest_stream, daemon=True,
+                                   args=(rng.randrange(1 << 30),))])
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(WINDOW_S / 2)
+        # vacuum the hot volumes MID-STORM: compaction rewrites every
+        # offset, so a missed invalidation would serve garbage right here
+        for vid in hot_vids:
+            for vs in servers:
+                if vs.store.find_volume(vid) is None:
+                    continue
+                stub = Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE)
+                stub.call("VacuumVolumeCompact",
+                          vpb.VacuumVolumeCompactRequest(volume_id=vid),
+                          vpb.VacuumVolumeCompactResponse, timeout=60)
+                stub.call("VacuumVolumeCommit",
+                          vpb.VacuumVolumeCommitRequest(volume_id=vid),
+                          vpb.VacuumVolumeCommitResponse, timeout=60)
+        print(f"[chaos] {ctx}: vacuumed vids {hot_vids} mid-storm")
+        time.sleep(WINDOW_S / 2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), \
+            f"{ctx}: storm thread hung past the window"
+    finally:
+        stop.set()
+        failpoints.clear_all()
+
+    assert not violations, f"{ctx}: coherence violations: {violations[:8]}"
+
+    # -- converge: every non-quarantined fid reads its last acked bytes ----
+    stale = []
+    for fid in hot:
+        if fid in quarantine:
+            continue
+        try:
+            if operation.read(mc, fid) != latest[fid]:
+                stale.append(fid)
+        except Exception as e:  # noqa: BLE001
+            stale.append(f"{fid} ({e!r})")
+        try:
+            if operation.read_batch(mc, [fid])[0] != latest[fid]:
+                stale.append(fid + " (bulk)")
+        except Exception as e:  # noqa: BLE001
+            stale.append(f"{fid} (bulk: {e!r})")
+    assert not stale, f"{ctx}: post-storm stale reads: {stale}"
+    n_q = len(quarantine)
+    print(f"[chaos] {ctx}: {n_hot - n_q}/{n_hot} hot fids verified "
+          f"({n_q} quarantined)")
+    assert n_hot - n_q >= n_hot // 2, \
+        f"{ctx}: too many indeterminate fids — schedule too brutal"
+
+    # -- breakers re-close ---------------------------------------------------
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        open_peers = [p for p, s in retry.all_breakers().items()
+                      if s != retry.CLOSED]
+        if not open_peers:
+            break
+        for p in open_peers:
+            retry.breaker(p).cooldown = min(retry.breaker(p).cooldown, 0.5)
+            _probe_peer(p)
+        time.sleep(0.2)
+    still_open = {p: s for p, s in retry.all_breakers().items()
+                  if s != retry.CLOSED}
+    assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+
 def test_repair_loop_converges_after_node_death(cluster):
     """The self-healing schedule: a node holding a replica AND one shard
     of a piggybacked RS(4,3) stripe dies FOR GOOD (no failpoint, no

@@ -88,6 +88,37 @@ def test_rule_io_under_lock_rpc(tmp_path):
     assert _rules(fs) == {"io-under-lock"}
 
 
+def test_rule_pread_under_lock(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import os
+        import threading
+        _lock = threading.Lock()
+        def read_record(fd, off, ln):
+            with _lock:
+                return os.pread(fd, ln, off)
+        """)
+    assert _rules(fs) == {"pread-under-lock"}
+
+
+def test_rule_pread_outside_lock_not_flagged(tmp_path):
+    # the seqlock shape: resolve under no lock, pread outside any
+    # critical section — plain file reads under a lock stay allowed
+    fs = _lint_src(tmp_path, """\
+        import os
+        import threading
+        _lock = threading.Lock()
+        def read_record(fd, off, ln):
+            with _lock:
+                committed = off + ln
+            return os.pread(fd, ln, off) if committed else b""
+        def locked_buffered_read(f, off, ln):
+            with _lock:
+                f.seek(off)
+                return f.read(ln)
+        """)
+    assert fs == []
+
+
 def test_rule_wallclock_duration(tmp_path):
     fs = _lint_src(tmp_path, """\
         import time
