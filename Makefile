@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -75,6 +75,14 @@ bench-repair:
 # breakdown (resolve/lock/pread/serialize)
 bench-read:
 	JAX_PLATFORMS=cpu python bench.py --read-only
+
+# seconds-long large-object data plane smoke on separate-process filer
+# daemons: windowed chunk fan-out must beat the serial window >= 2x on a
+# multi-chunk PUT (byte/ETag-identical), and a 256 MB streamed PUT+GET
+# must grow the filer's peak RSS by less than half the object size;
+# records filer_put_MBps / s3_get_cold_MBps in the artifact
+bench-filer:
+	JAX_PLATFORMS=cpu python bench.py --filer-only
 
 smoke:
 	python bench.py --smoke

@@ -849,6 +849,269 @@ def bench_ingest_smoke(out: dict) -> None:
         _stop_procs_cluster(procs, tmp)
 
 
+def _filer_http_put(port: int, path: str, src_file: str, size: int,
+                    expect_status: int = 201,
+                    method: str = "POST") -> float:
+    """Stream a file body into the filer/S3 over HTTP (http.client
+    streams file objects in small blocks — the bench process never
+    materializes the object either). Returns seconds."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300,
+                                      blocksize=1 << 20)
+    try:
+        with open(src_file, "rb") as f:
+            t0 = time.perf_counter()
+            conn.request(method, path, body=f,
+                         headers={"Content-Length": str(size)})
+            resp = conn.getresponse()
+            body = resp.read()
+            dt = time.perf_counter() - t0
+        assert resp.status == expect_status, (resp.status, body[:200])
+        return dt
+    finally:
+        conn.close()
+
+
+def _filer_http_get(port: int, path: str, expect_md5: "str | None" = None,
+                    host_hdr: "dict | None" = None) -> "tuple[float, int]":
+    """Stream a GET, discarding windows as they arrive. Returns
+    (seconds, bytes); verifies content md5 when given."""
+    import hashlib
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+    try:
+        t0 = time.perf_counter()
+        conn.request("GET", path, headers=host_hdr or {})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.status
+        h = hashlib.md5(usedforsecurity=False)
+        n = 0
+        while True:
+            block = resp.read(1 << 20)
+            if not block:
+                break
+            h.update(block)
+            n += len(block)
+        dt = time.perf_counter() - t0
+        if expect_md5 is not None:
+            assert h.hexdigest() == expect_md5, "GET bytes corrupted"
+        return dt, n
+    finally:
+        conn.close()
+
+
+def _vm_rss_kb(pid: int) -> int:
+    """Current RSS (VmRSS, kB) of a live process. (VmHWM would be the
+    natural peak metric, but sandboxed kernels omit it — the bench
+    samples VmRSS at ~100 Hz instead, which cannot miss an
+    object-sized buffer held across a multi-second transfer.)"""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return -1
+
+
+class _RssWatch:
+    """Max-RSS sampler for one pid over a with-block."""
+
+    def __init__(self, pid: int):
+        import threading
+        self.pid = pid
+        self.peak = -1
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            rss = _vm_rss_kb(self.pid)
+            if rss > self.peak:
+                self.peak = rss
+            self._stop.wait(0.01)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        return False
+
+
+def bench_filer_smoke(out: dict) -> None:
+    """`make bench-filer`: the large-object data plane smoke on a
+    separate-process topology (master + volume + filer daemons). Gates:
+
+      * windowed chunk fan-out (SWTPU_FILER_UPLOAD_CONC=4) moves a
+        multi-chunk PUT >= 2x faster than the serial window (conc=1) on
+        the same topology, byte/ETag-identical;
+      * a 256 MB streamed PUT + GET grows the filer's peak RSS by less
+        than HALF the object size (the O(chunk x conc) memory bound);
+      * the new chunk-fetch histogram moved (cold GET fan-out ran).
+
+    Records filer_put_MBps / s3_get_cold_MBps in the artifact."""
+    import hashlib
+    import subprocess
+    import socket
+
+    from seaweedfs_tpu.client import http_util
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    # the volume child arms a deterministic 100 ms store.write delay —
+    # a slow-disk model (queued-fsync-class latency) that makes the
+    # gate reproducible on noisy shared boxes where real journal
+    # commits swing 5-50 ms run to run; overlapping exactly this
+    # per-chunk latency is the windowed fan-out's job
+    procs, tmp, mport, mhttp, vport = _spawn_procs_cluster(
+        "swtpu_bench_filer_", volume_size_mb=64, vol_max=32,
+        extra_env={"SWTPU_FAILPOINTS": "store.write=delay:0.1"})
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    # the filer daemons run with cwd=tmp (their meta logs land there,
+    # not in the repo), so the package must come via PYTHONPATH
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    fser_port, fpar_port, s3_port = free_port(), free_port(), free_port()
+    filer_procs = []
+    try:
+        # two filer daemons on the same blob cluster: serial window vs
+        # the fan-out (8 slots); the parallel one embeds the S3 gateway
+        # and runs a small chunk cache so a 256 MB GET is genuinely cold
+        for port, conc, extra in (
+                (fser_port, "1", []),
+                (fpar_port, "8", ["-s3", "-s3Port", str(s3_port)])):
+            e = dict(env)
+            e["SWTPU_FILER_UPLOAD_CONC"] = conc
+            filer_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "seaweedfs_tpu", "filer",
+                 "-master", f"127.0.0.1:{mport}", "-port", str(port),
+                 "-grpcPort", str(free_port()), "-store", "memory",
+                 "-maxMB", "2", "-chunkCacheMB", "16"] + extra,
+                cwd=tmp, env=e,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 45
+        for port in (fser_port, fpar_port):
+            while True:
+                try:
+                    if http_util.get(f"http://127.0.0.1:{port}/__status__",
+                                     timeout=1).ok:
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                if time.time() > deadline:
+                    raise RuntimeError("filer daemons failed to start")
+                time.sleep(0.25)
+
+        # -- gate 1: parallel window >= 2x serial on a 16 MB object ------
+        obj_mb = 16
+        payload = np.random.default_rng(11).integers(
+            0, 256, obj_mb << 20, dtype=np.uint8).tobytes()
+        md5 = hashlib.md5(payload, usedforsecurity=False).hexdigest()
+        src = os.path.join(tmp, "bench_obj.bin")
+        with open(src, "wb") as f:
+            f.write(payload)
+        del payload
+        # warmup both (connection pools, first-assign growth costs)
+        for port in (fser_port, fpar_port):
+            _filer_http_put(port, "/bench/warm.bin", src, obj_mb << 20)
+        serial_ts, par_ts = [], []
+        for i in range(3):  # interleaved: fair share of box noise
+            serial_ts.append(_filer_http_put(
+                fser_port, f"/bench/s{i}.bin", src, obj_mb << 20))
+            par_ts.append(_filer_http_put(
+                fpar_port, f"/bench/p{i}.bin", src, obj_mb << 20))
+        # best-of-3 on BOTH sides: each run's floor is its steady-state
+        # capability; medians let one co-tenant CPU burst fail the gate
+        t_serial = min(serial_ts)
+        t_par = min(par_ts)
+        out["filer_put_serial_MBps"] = round(obj_mb / t_serial, 1)
+        out["filer_put_MBps"] = round(obj_mb / t_par, 1)
+        out["filer_put_parallel_vs_serial"] = round(t_serial / t_par, 2)
+        # byte/ETag parity across the two windows
+        dt, n = _filer_http_get(fser_port, "/bench/s0.bin", expect_md5=md5)
+        dt, n = _filer_http_get(fpar_port, "/bench/p0.bin", expect_md5=md5)
+        assert n == obj_mb << 20
+        log(f"filer PUT {obj_mb}MB (100ms slow-disk model): serial "
+            f"{out['filer_put_serial_MBps']} MB/s, fan-out "
+            f"{out['filer_put_MBps']} MB/s "
+            f"({out['filer_put_parallel_vs_serial']}x)")
+        assert out["filer_put_parallel_vs_serial"] >= 2.0, \
+            f"windowed fan-out only {out['filer_put_parallel_vs_serial']}x"
+
+        # -- gate 2: 256 MB streamed PUT+GET, filer peak RSS < 128 MB ----
+        big_mb = 256
+        big = os.path.join(tmp, "big_obj.bin")
+        h = hashlib.md5(usedforsecurity=False)
+        rng = np.random.default_rng(13)
+        with open(big, "wb") as f:
+            for _ in range(big_mb // 8):
+                block = rng.integers(0, 256, 8 << 20,
+                                     dtype=np.uint8).tobytes()
+                h.update(block)
+                f.write(block)
+        big_md5 = h.hexdigest()
+        fpid = filer_procs[1].pid
+        base_rss = _vm_rss_kb(fpid)
+        assert base_rss > 0, "VmRSS unreadable for the filer daemon"
+        # the 256 MB object goes in AND out through the embedded S3
+        # gateway: streamed PUT (chunked ingest), then a cold-ish GET
+        # (16 MB chunk cache on a 256 MB object: >90% of chunks fetch
+        # cold, fanned out by the read windows)
+        http_util.request("PUT", f"http://127.0.0.1:{s3_port}/bench")
+        with _RssWatch(fpid) as watch:
+            t_put = _filer_http_put(s3_port, "/bench/big.bin", big,
+                                    big_mb << 20, expect_status=200,
+                                    method="PUT")
+            out["filer_put_256mb_MBps"] = round(big_mb / t_put, 1)
+            t_get, n = _filer_http_get(s3_port, "/bench/big.bin",
+                                       expect_md5=big_md5)
+        assert n == big_mb << 20
+        out["s3_get_cold_MBps"] = round(big_mb / t_get, 1)
+        out["filer_rss_base_mb"] = round(base_rss / 1024, 1)
+        out["filer_rss_peak_mb"] = round(watch.peak / 1024, 1)
+        grew = (watch.peak - base_rss) / 1024
+        out["filer_rss_grew_mb"] = round(grew, 1)
+        log(f"256MB streamed PUT {out['filer_put_256mb_MBps']} MB/s, "
+            f"S3 cold GET {out['s3_get_cold_MBps']} MB/s, filer RSS "
+            f"grew {out['filer_rss_grew_mb']} MB (cap {big_mb // 2})")
+        assert grew < big_mb / 2, \
+            f"filer RSS grew {grew:.0f} MB on a {big_mb} MB object"
+
+        # -- the fetch histogram proves the cold fan-out ran -------------
+        body = http_util.get(f"http://127.0.0.1:{fpar_port}/__metrics__",
+                             timeout=5).content.decode()
+        fetches = 0.0
+        for line in body.splitlines():
+            if line.startswith("SeaweedFS_filer_chunk_fetch_seconds_count"):
+                fetches = float(line.split()[-1])
+        out["filer_chunk_fetches"] = int(fetches)
+        assert fetches >= big_mb // 2 / 2, \
+            f"fetch histogram barely moved: {fetches}"
+        out["bench_filer_smoke"] = "ok"
+    finally:
+        for p in filer_procs:
+            p.terminate()
+        for p in filer_procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        _stop_procs_cluster(procs, tmp)
+
+
 def _read_stage_breakdown(out: dict, prefix: str = "read_stage_") -> None:
     """Per-stage GET breakdown on an in-process volume — the stages the
     seqlock read protocol actually executes (resolve the index entry,
@@ -1187,6 +1450,12 @@ def main() -> None:
                          "Zipfian per-needle vs framed bulk GET on a "
                          "separate-process cluster, asserts bulk >= 3x "
                          "and warm cache hit ratio >= 0.5")
+    ap.add_argument("--filer-only", action="store_true", dest="filer_only",
+                    help="run only the large-object data plane smoke "
+                         "(make bench-filer): separate-process filer "
+                         "daemons, asserts parallel chunk fan-out >= 2x "
+                         "serial PUT and a 256 MB streamed PUT+GET grows "
+                         "filer RSS < half the object")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -1218,6 +1487,12 @@ def main() -> None:
         out_rd: dict = {"metric": "bench_read_smoke"}
         bench_read_smoke(out_rd)
         print(json.dumps(out_rd))
+        return
+    if args.filer_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_fl: dict = {"metric": "bench_filer_smoke"}
+        bench_filer_smoke(out_fl)
+        print(json.dumps(out_fl))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

@@ -334,6 +334,10 @@ def run_filer(argv):
                    help="in-memory chunk-cache bound on the read path")
     p.add_argument("-chunkCacheDir", default="",
                    help="optional disk tier for the chunk cache")
+    p.add_argument("-s3", action="store_true",
+                   help="embed an S3 gateway over this filer "
+                        "(reference `weed filer -s3`)")
+    p.add_argument("-s3Port", type=int, default=8333)
     opt = p.parse_args(argv)
     store = opt.store
     if not store:
@@ -354,15 +358,20 @@ def run_filer(argv):
     meta_log = ("./filer-meta.log"
                 if opt.port == 8888 and os.path.exists("./filer-meta.log")
                 else f"./filer-meta-{opt.port}.log")
-    FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
-                grpc_port=opt.grpcPort or None,
-                meta_log_path=meta_log,
-                collection=opt.collection, replication=opt.replication,
-                chunk_size_mb=opt.maxMB,
-                encrypt_data=opt.encryptVolumeData,
-                meta_aggregate=not opt.noPeerMeta,
-                chunk_cache_mb=opt.chunkCacheMB,
-                chunk_cache_dir=opt.chunkCacheDir or None).start()
+    fs = FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
+                     grpc_port=opt.grpcPort or None,
+                     meta_log_path=meta_log,
+                     collection=opt.collection, replication=opt.replication,
+                     chunk_size_mb=opt.maxMB,
+                     encrypt_data=opt.encryptVolumeData,
+                     meta_aggregate=not opt.noPeerMeta,
+                     chunk_cache_mb=opt.chunkCacheMB,
+                     chunk_cache_dir=opt.chunkCacheDir or None).start()
+    if opt.s3:
+        # embedded gateway rides the in-process filer: S3 GET/PUT go
+        # through the streaming large-object data plane directly
+        from .s3.s3_server import S3Gateway
+        S3Gateway(fs, ip=opt.ip, port=opt.s3Port).start()
     _wait_forever()
 
 

@@ -339,27 +339,32 @@ class MasterClient:
     def assign(self, count: int = 1, collection: str = "",
                replication: str = "", ttl: str = "",
                disk_type: str = "",
-               deadline: float | None = None) -> pb.AssignResponse:
+               deadline: float | None = None,
+               writable_count: int = 0) -> pb.AssignResponse:
         """`deadline` (time.monotonic() value) lets an ENCLOSING retry
         envelope (submit, filer _save_blob) bound this call's quorum
         sweeps too, so nested envelopes share one wall-clock budget
-        instead of stacking multiplicatively."""
+        instead of stacking multiplicatively. `writable_count` asks the
+        master to keep that many volumes writable (reference
+        writableVolumeCount) so concurrent uploads spread across volume
+        locks instead of serializing on one fsync queue."""
         from .. import tracing
         with tracing.start_span("client.assign", component="client",
                                 attrs={"collection": collection}) as sp:
             resp = self._assign(count, collection, replication, ttl,
-                                disk_type, deadline)
+                                disk_type, deadline, writable_count)
             sp.set_attr("fid", resp.fid)
             sp.set_attr("master", self.leader)
             return resp
 
     def _assign(self, count: int, collection: str, replication: str,
                 ttl: str, disk_type: str,
-                deadline: float | None) -> pb.AssignResponse:
+                deadline: float | None,
+                writable_count: int = 0) -> pb.AssignResponse:
         if self.http_address and time.monotonic() >= self._http_assign_retry_at:
             try:
                 return self._assign_http(count, collection, replication, ttl,
-                                         disk_type)
+                                         disk_type, writable_count)
             except _HttpAssignRejected as e:
                 # the master answered and refused (grow failed, quota, …):
                 # authoritative — gRPC would say the same, and the HTTP
@@ -375,7 +380,8 @@ class MasterClient:
                             "for 15s", self.http_address, e)
         req = pb.AssignRequest(
             count=count, collection=collection, replication=replication,
-            ttl=ttl, disk_type=disk_type)
+            ttl=ttl, disk_type=disk_type,
+            writable_volume_count=writable_count)
         # leader hints can be stale right after a failover — fall back
         # through the whole quorum rather than pinning a dead address
         # (reference masterclient round-robin + leader redirect), ordered
@@ -436,7 +442,8 @@ class MasterClient:
         raise RuntimeError(f"assign: no reachable leader ({last_err})")
 
     def _assign_http(self, count: int, collection: str, replication: str,
-                     ttl: str, disk_type: str = "") -> pb.AssignResponse:
+                     ttl: str, disk_type: str = "",
+                     writable_count: int = 0) -> pb.AssignResponse:
         """Keep-alive /dir/assign (reference master HTTP API
         master_server_handlers.go:46 dirAssignHandler)."""
         from . import http_util
@@ -449,6 +456,8 @@ class MasterClient:
             params["ttl"] = ttl
         if disk_type:
             params["disk_type"] = disk_type
+        if writable_count:
+            params["writableVolumeCount"] = writable_count
         r = http_util.get(f"http://{self.http_address}/dir/assign",
                           params=params, timeout=5)
         try:

@@ -42,19 +42,21 @@ class UploadResult:
 
 def upload(url: str, data: bytes, name: str = "", mime: str = "",
            gzip_if_worthwhile: bool = True, ttl: str = "",
-           jwt: str = "") -> dict:
+           jwt: str = "", fsync: bool = False) -> dict:
     """PUT one blob to a volume server (reference upload_content.go:151).
-    `jwt` is the single-fid write token the master minted on Assign."""
+    `jwt` is the single-fid write token the master minted on Assign;
+    `fsync` asks the volume server to fsync before acking (reference
+    UploadOption.Fsync — a filer path rule's fsync flag lands here)."""
     with tracing.start_span("client.upload", component="client",
                             attrs={"url": url, "bytes": len(data)}):
         return _upload(url, data, name=name, mime=mime,
                        gzip_if_worthwhile=gzip_if_worthwhile, ttl=ttl,
-                       jwt=jwt)
+                       jwt=jwt, fsync=fsync)
 
 
 def _upload(url: str, data: bytes, name: str = "", mime: str = "",
             gzip_if_worthwhile: bool = True, ttl: str = "",
-            jwt: str = "") -> dict:
+            jwt: str = "", fsync: bool = False) -> dict:
     failpoints.check("client.upload")
     body = data
     gzipped = False
@@ -68,6 +70,8 @@ def _upload(url: str, data: bytes, name: str = "", mime: str = "",
     params = {"ttl": ttl} if ttl else {}
     if jwt:
         params["jwt"] = jwt
+    if fsync:
+        params["fsync"] = "true"
     if name:
         part_headers = {"Content-Encoding": "gzip"} if gzipped else {}
         mp_body, ctype = http_util.multipart_body(

@@ -16,8 +16,10 @@ reads, and the remote FilerClient all share these types.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
@@ -26,32 +28,78 @@ from ..utils.log import logger
 log = logger("chunk-cache")
 
 
-def assemble_window(chunks, offset: int, size: int, fetch) -> bytes:
-    """Assemble [offset, offset+size) of a chunked file.
+def iter_windows(chunks, offset: int, size: int, fetch, fetch_many=None,
+                 prefetch=None, window_views: int = 4):
+    """Yield [offset, offset+size) of a chunked file as a sequence of
+    byte windows of up to `window_views` resolved ChunkViews each.
 
-    `fetch(fid, upcoming)` returns the chunk's stored bytes (a ReaderCache
-    read; `upcoming` are prefetch hints). The one implementation behind
-    both the filer server's and the remote client's read paths: views in
-    this window hint at their successors, and when the window covers the
-    request tail the file's chunks beyond it are hinted so a sequential
-    reader's next request finds them warm."""
+    `fetch(fid, upcoming)` returns one chunk's stored bytes (a
+    ReaderCache read; `upcoming` are prefetch hints). With `fetch_many`
+    (ReaderCache.read_many) each window's blobs are gathered
+    CONCURRENTLY — cold chunks fan out on the reader pool with
+    single-flight dedup — and `prefetch` (ReaderCache.prefetch) is
+    kicked for the NEXT window before this window's gather, so the cold
+    fan-out overlaps the caller writing the current window out. Peak
+    memory is O(window_views x chunk_size), never O(size).
+
+    Windows tile the request exactly (gaps between visible intervals
+    yield zeros, like a sparse read), so concatenating them is
+    byte-identical to `assemble_window` — which is implemented on top of
+    this generator."""
     from .chunks import read_views
 
-    buf = bytearray(size)
     views = list(read_views(chunks, offset, size))
-    beyond = [c.file_id for c in chunks if c.offset >= offset + size][:4]
-    for i, v in enumerate(views):
-        upcoming = [w.file_id for w in views[i + 1:i + 3]] or beyond
-        blob = fetch(v.file_id, upcoming)
-        if v.cipher_key:
-            # lazy: cipher needs the optional `cryptography` package —
-            # plaintext reads must work without it installed
-            from ..security.cipher import decrypt
-            blob = decrypt(blob, v.cipher_key)
-        part = blob[v.chunk_offset:v.chunk_offset + v.size]
-        at = v.logical_offset - offset
-        buf[at:at + len(part)] = part
-    return bytes(buf)
+    end = offset + size
+    beyond = [c.file_id for c in chunks if c.offset >= end][:4]
+    if not views:
+        if size > 0:
+            yield bytes(size)
+        return
+    windows = [views[i:i + window_views]
+               for i in range(0, len(views), window_views)]
+    cur = offset
+    for w, wviews in enumerate(windows):
+        nxt = ([v.file_id for v in windows[w + 1]]
+               if w + 1 < len(windows) else beyond)
+        blobs = (fetch_many([v.file_id for v in wviews])
+                 if fetch_many is not None else {})
+        # prefetch the NEXT window only after this one's gather: the
+        # shared reader pool is FIFO, and enqueuing w+1 first would put
+        # window w's cold fetches BEHIND it (doubled time-to-first-byte
+        # on every cold read). Kicked here, the prefetch overlaps the
+        # caller consuming/writing window w instead.
+        if prefetch is not None:
+            for fid in nxt:
+                prefetch(fid)
+        wend = (wviews[-1].logical_offset + wviews[-1].size
+                if w + 1 < len(windows) else end)
+        buf = bytearray(wend - cur)
+        for i, v in enumerate(wviews):
+            blob = blobs.get(v.file_id)
+            if blob is None:
+                upcoming = [x.file_id for x in wviews[i + 1:i + 3]] or nxt
+                blob = fetch(v.file_id, upcoming)
+            if v.cipher_key:
+                # lazy: cipher needs the optional `cryptography` package —
+                # plaintext reads must work without it installed
+                from ..security.cipher import decrypt
+                blob = decrypt(blob, v.cipher_key)
+            part = blob[v.chunk_offset:v.chunk_offset + v.size]
+            at = v.logical_offset - cur
+            buf[at:at + len(part)] = part
+        yield bytes(buf)
+        cur = wend
+
+
+def assemble_window(chunks, offset: int, size: int, fetch,
+                    fetch_many=None) -> bytes:
+    """Assemble [offset, offset+size) of a chunked file in one buffer.
+
+    The one implementation behind both the filer server's and the remote
+    client's read paths; `fetch_many` turns each window's cold fetches
+    into a concurrent fan-out (see iter_windows)."""
+    return b"".join(iter_windows(chunks, offset, size, fetch,
+                                 fetch_many=fetch_many))
 
 
 class ChunkCache:
@@ -216,7 +264,7 @@ class ReaderCache:
     """
 
     def __init__(self, fetch, cache: ChunkCache,
-                 prefetch_depth: int = 2, workers: int = 2):
+                 prefetch_depth: int = 2, workers: int = 4):
         self.fetch = fetch
         self.cache = cache
         self.prefetch_depth = prefetch_depth
@@ -233,6 +281,50 @@ class ReaderCache:
             for nxt in upcoming[: self.prefetch_depth]:
                 self._maybe_prefetch(nxt)
         return data
+
+    def read_many(self, fids: "list[str]") -> "dict[str, bytes]":
+        """Gather many fids CONCURRENTLY: cache hits answer inline, every
+        cold fid fans out on the pool — a concurrent reader of the same
+        fid joins the same single-flight download. The read-side window
+        fan-out (iter_windows) rides this; a flight failure falls back to
+        one direct fetch so a dead prefetch can't poison the window."""
+        out: "dict[str, bytes]" = {}
+        flights: "list[tuple[str, Future]]" = []
+        for fid in dict.fromkeys(fids):
+            data = self.cache.get(fid)
+            if data is not None:
+                out[fid] = data
+                continue
+            with self._lock:
+                fut = self._inflight.get(fid)
+                if fut is None:
+                    fut = Future()
+                    self._inflight[fid] = fut
+                    ctx = contextvars.copy_context()
+                    self._pool.submit(ctx.run, self._run_flight, fid, fut)
+            flights.append((fid, fut))
+        for fid, fut in flights:
+            try:
+                out[fid] = fut.result()
+            except Exception:  # noqa: BLE001 — flight owner failed: retry
+                out[fid] = self._fetch_direct(fid)
+        return out
+
+    def prefetch(self, fid: str) -> None:
+        """Schedule a background fill if the fid is neither cached nor
+        already in flight (the next-window hint of the read fan-out)."""
+        self._maybe_prefetch(fid)
+
+    def _timed_fetch(self, fid: str) -> bytes:
+        from ..stats import FILER_CHUNK_FETCH_SECONDS, FILER_INFLIGHT_CHUNKS
+        FILER_INFLIGHT_CHUNKS.add("fetch", amount=1)
+        t0 = time.perf_counter()
+        try:
+            return self.fetch(fid)
+        finally:
+            FILER_INFLIGHT_CHUNKS.add("fetch", amount=-1)
+            FILER_CHUNK_FETCH_SECONDS.observe(
+                value=time.perf_counter() - t0)
 
     def _fetch_once(self, fid: str) -> bytes:
         with self._lock:
@@ -251,7 +343,7 @@ class ReaderCache:
                 # on our own rather than inheriting its error
                 return self._fetch_direct(fid)
         try:
-            data = self.fetch(fid)
+            data = self._timed_fetch(fid)
             self.cache.put(fid, data)
             fut.set_result(data)
             return data
@@ -263,9 +355,22 @@ class ReaderCache:
                 self._inflight.pop(fid, None)
 
     def _fetch_direct(self, fid: str) -> bytes:
-        data = self.fetch(fid)
+        data = self._timed_fetch(fid)
         self.cache.put(fid, data)
         return data
+
+    def _run_flight(self, fid: str, fut: Future) -> None:
+        try:
+            data = self._timed_fetch(fid)
+            self.cache.put(fid, data)
+            fut.set_result(data)
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+            # a failed flight must not poison later reads (or warn at GC)
+            fut.exception()
+        finally:
+            with self._lock:
+                self._inflight.pop(fid, None)
 
     def _maybe_prefetch(self, fid: str) -> None:
         if self.cache.contains(fid):
@@ -275,21 +380,8 @@ class ReaderCache:
                 return
             fut = Future()
             self._inflight[fid] = fut
-
-        def run():
-            try:
-                data = self.fetch(fid)
-                self.cache.put(fid, data)
-                fut.set_result(data)
-            except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
-                # a failed prefetch must not poison later reads
-                fut.exception()
-            finally:
-                with self._lock:
-                    self._inflight.pop(fid, None)
-
-        self._pool.submit(run)
+        ctx = contextvars.copy_context()
+        self._pool.submit(ctx.run, self._run_flight, fid, fut)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -54,6 +54,7 @@ class Filer:
         self.mutation_hooks: list = []
         self._dir_lock = threading.RLock()  # _ensure_parents recurses
         self._hardlink_lock = threading.Lock()  # KV counter RMW atomicity
+        self._chunkref_lock = threading.Lock()  # shared-chunk RMW atomicity
 
     # -- CRUD ---------------------------------------------------------------
     def create_entry(self, directory: str, entry: fpb.Entry,
@@ -279,11 +280,17 @@ class Filer:
             if children and not is_recursive:
                 raise OSError(f"{path} is a non-empty folder")
             self._delete_subtree(path, is_delete_data)
-        elif entry.hard_link_id:
-            self._unlink_shared(entry, is_delete_data)
-        elif is_delete_data:
-            self._delete_entry_chunks(entry)
-        self.store.delete_entry(directory, name)
+            self.store.delete_entry(directory, name)
+        else:
+            # entry FIRST, chunks SECOND: copy-by-reference re-checks the
+            # source entry after adopting refcounts — entry-still-present
+            # must imply no release has started, or a racing delete can
+            # free blobs the copy just adopted
+            self.store.delete_entry(directory, name)
+            if entry.hard_link_id:
+                self._unlink_shared(entry, is_delete_data)
+            elif is_delete_data:
+                self._delete_entry_chunks(entry)
         self._notify(directory, entry, None, delete_chunks=is_delete_data,
                      from_other_cluster=from_other_cluster,
                      signatures=signatures)
@@ -293,23 +300,82 @@ class Filer:
             cpath = join_path(path, child.name)
             if child.is_directory:
                 self._delete_subtree(cpath, is_delete_data)
-            elif is_delete_data:
-                self._delete_entry_chunks(child)
+            else:
+                # same entry-first ordering as delete_entry: a child
+                # still visible in the store must imply its chunk
+                # release hasn't started (copy-by-reference re-checks
+                # the source after adopting refcounts)
+                self.store.delete_entry(path, child.name)
+                if child.hard_link_id:
+                    self._unlink_shared(child, is_delete_data)
+                elif is_delete_data:
+                    self._delete_entry_chunks(child)
         self.store.delete_folder_children(path)
 
     def _delete_entry_chunks(self, entry: fpb.Entry) -> None:
         fids = [c.file_id for c in entry.chunks if c.file_id]
-        if fids:
-            self.chunk_deleter(fids)
+        dead = self._release_chunks(fids)
+        if dead:
+            self.chunk_deleter(dead)
 
     def _gc_replaced_chunks(self, old: fpb.Entry, new: fpb.Entry) -> None:
         """Delete chunks referenced by old but not by new (filechunks.go
         MinusChunks)."""
         keep = {c.file_id for c in new.chunks}
-        dead = [c.file_id for c in old.chunks
-                if c.file_id and c.file_id not in keep]
+        dropped = [c.file_id for c in old.chunks
+                   if c.file_id and c.file_id not in keep]
+        dead = self._release_chunks(dropped)
         if dead:
             self.chunk_deleter(dead)
+
+    # -- shared-chunk refcounts (S3 server-side copy-by-reference) ----------
+    # A copied object clones the source's FileChunk list instead of moving
+    # bytes through the gateway; the blobs are then owned by MORE than one
+    # entry, and the naive fid GC above would delete the copy's data when
+    # the source dies. The KV record counts EXTRA references beyond the
+    # first (absent record = sole owner); every GC path consumes a
+    # reference before physically deleting.
+    _CHUNKREF_PREFIX = b"chunkref/"
+
+    def adopt_chunks(self, fids: "list[str]") -> None:
+        """One more entry now references these fids (call BEFORE the
+        cloning entry is created, so a crash leaks a refcount — harmless
+        — rather than double-freeing a live chunk)."""
+        with self._chunkref_lock:
+            for fid in fids:
+                if not fid:
+                    continue
+                key = self._CHUNKREF_PREFIX + fid.encode()
+                raw = self.store.kv_get(key)
+                n = int(raw) if raw else 0
+                self.store.kv_put(key, str(n + 1).encode())
+
+    def release_chunks(self, fids: "list[str]") -> None:
+        """Drop one reference per fid and physically delete the ones
+        whose last reference went (the rollback half of adopt_chunks)."""
+        dead = self._release_chunks(fids)
+        if dead:
+            self.chunk_deleter(dead)
+
+    def _release_chunks(self, fids: "list[str]") -> "list[str]":
+        """Consume one reference per fid; returns the fids now safe to
+        physically delete (no surviving cloned entry references them)."""
+        dead: "list[str]" = []
+        with self._chunkref_lock:
+            for fid in fids:
+                if not fid:
+                    continue
+                key = self._CHUNKREF_PREFIX + fid.encode()
+                raw = self.store.kv_get(key)
+                n = int(raw) if raw else 0
+                if n <= 0:
+                    dead.append(fid)
+                else:
+                    # the empty value is the store's deletion idiom
+                    # (see _unlink_shared)
+                    self.store.kv_put(key, str(n - 1).encode()
+                                      if n > 1 else b"")
+        return dead
 
     # -- rename (reference filer_rename.go / AtomicRenameEntry) -------------
     def rename(self, old_dir: str, old_name: str, new_dir: str,

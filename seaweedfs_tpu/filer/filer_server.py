@@ -1,7 +1,12 @@
 """Filer daemon: HTTP namespace API + gRPC service + metadata subscription.
 
 Reference: weed/server/filer_server.go, filer_server_handlers_write_autochunk.go:26
-(autoChunk upload loop), filer_server_handlers_read.go (range reads),
+(autoChunk — re-designed here as a STREAMING windowed fan-out: the body
+is chunked as it arrives and up to SWTPU_FILER_UPLOAD_CONC chunk
+uploads ride in flight, so peak memory is O(chunk_size x conc) and a
+multi-chunk PUT overlaps its per-chunk upload latency),
+filer_server_handlers_read.go (range reads — served window-by-window
+through the reader pool's cold-fetch fan-out, see chunk_cache.py),
 filer_grpc_server.go (entry RPCs), filer_grpc_server_sub_meta.go
 (SubscribeMetadata). Data chunks are stored in the blob cluster via
 assign+upload; only metadata lives here.
@@ -9,12 +14,14 @@ assign+upload; only metadata lives here.
 
 from __future__ import annotations
 
+import contextvars
 import hashlib
 import json
 import mimetypes
 import threading
 import time
 import urllib.parse
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
 from ..client import operation
 from ..client.master_client import MasterClient
@@ -74,12 +81,32 @@ class FilerServer:
         # reads through this filer), and FUSE reads (reference
         # util/chunk_cache + filer/reader_cache behind every read)
         from .chunk_cache import ChunkCache, ReaderCache
+        from ..utils.env import env_int
         self.chunk_cache = ChunkCache(
             mem_limit_bytes=chunk_cache_mb << 20,
             disk_dir=chunk_cache_dir,
             disk_limit_bytes=chunk_cache_disk_mb << 20)
+        # large-object data plane knobs: how many chunk uploads ride in
+        # flight per filer (the write window — also the streaming-ingest
+        # memory bound, O(chunk_size x conc)), how many cold fetches fan
+        # out on the reader pool, and how many chunk views per streamed
+        # GET window
+        self.upload_conc = max(1, env_int("SWTPU_FILER_UPLOAD_CONC", 4))
+        self.fetch_conc = max(1, env_int("SWTPU_FILER_FETCH_CONC", 4))
+        self.read_window_views = max(1, env_int("SWTPU_FILER_READ_WINDOW",
+                                                4))
         self.reader_cache = ReaderCache(self._fetch_blob_upstream,
-                                        self.chunk_cache)
+                                        self.chunk_cache,
+                                        workers=self.fetch_conc)
+        self._upload_pool = ThreadPoolExecutor(
+            max_workers=self.upload_conc,
+            thread_name_prefix=f"chunk-upload-{port}")
+        # streaming-ingest writers get their own pool: they block on the
+        # relay queue, and parking them on the loop's default executor
+        # (where the relay puts run) could starve the puts that feed them
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=max(4, self.upload_conc),
+            thread_name_prefix=f"stream-write-{port}")
         self._stop = threading.Event()
         self._grpc = None
         self._http_thread = None
@@ -133,6 +160,8 @@ class FilerServer:
         if self._grpc:
             self._grpc.stop(grace=0.5)
         self.reader_cache.close()  # drop prefetch workers
+        self._upload_pool.shutdown(wait=False, cancel_futures=True)
+        self._stream_pool.shutdown(wait=False, cancel_futures=True)
         self.mc.stop()
         self.filer.close()
 
@@ -170,30 +199,50 @@ class FilerServer:
             log.warning("filer.conf reload failed: %s", e)
 
     def _storage_rule(self, path: str):
-        """(collection, replication, ttl, disk_type) for a path, falling
-        back to the server-wide defaults (filer_conf.go MatchStorageRule)."""
+        """(collection, replication, ttl, disk_type, fsync) for a path,
+        falling back to the server-wide defaults (filer_conf.go
+        MatchStorageRule). fsync=True makes every chunk upload under the
+        prefix durable before its ack (?fsync=true on the volume PUT)."""
         rule = self.conf.match(path) if path else None
         if rule is None:
-            return self.collection, self.replication, "", ""
+            return self.collection, self.replication, "", "", False
         return (rule.collection or self.collection,
                 rule.replication or self.replication,
-                rule.ttl, rule.disk_type)
+                rule.ttl, rule.disk_type, rule.fsync)
 
     # -- chunk IO helpers ----------------------------------------------------
     def _save_blob(self, data: bytes, ttl: str = "",
-                   path: str = "") -> fpb.FileChunk:
+                   path: str = "", queued_at: "float | None" = None
+                   ) -> fpb.FileChunk:
         from .. import tracing
-        with tracing.start_span("filer.blob.write", component="filer",
-                                attrs={"bytes": len(data),
-                                       "path": path}) as sp:
-            chunk = self._save_blob_inner(data, ttl, path)
-            sp.set_attr("fid", chunk.file_id)
-            return chunk
+        from ..stats import (FILER_CHUNK_UPLOAD_SECONDS,
+                             FILER_INFLIGHT_CHUNKS)
+        FILER_INFLIGHT_CHUNKS.add("upload", amount=1)
+        t0 = time.perf_counter()
+        try:
+            with tracing.start_span("filer.blob.write", component="filer",
+                                    attrs={"bytes": len(data),
+                                           "path": path}) as sp:
+                if queued_at is not None:
+                    # window-pool wait: how long the chunk sat behind the
+                    # SWTPU_FILER_UPLOAD_CONC fan-out before its upload
+                    # started
+                    sp.set_attr("queued_s", round(t0 - queued_at, 6))
+                chunk = self._save_blob_inner(data, ttl, path)
+                sp.set_attr("fid", chunk.file_id)
+                sp.set_attr("upload_s",
+                            round(time.perf_counter() - t0, 6))
+                return chunk
+        finally:
+            FILER_INFLIGHT_CHUNKS.add("upload", amount=-1)
+            FILER_CHUNK_UPLOAD_SECONDS.observe(
+                value=time.perf_counter() - t0)
 
     def _save_blob_inner(self, data: bytes, ttl: str,
                          path: str) -> fpb.FileChunk:
         from ..utils import failpoints, retry
-        collection, replication, rule_ttl, disk = self._storage_rule(path)
+        collection, replication, rule_ttl, disk, fsync = \
+            self._storage_rule(path)
         cipher_key = b""
         logical = len(data)
         if self.encrypt_data:
@@ -207,14 +256,17 @@ class FilerServer:
             # a failed upload retries with a FRESH assign: the first
             # target may be the transiently-dead node (filer→volume hop);
             # the enclosing envelope's wall clock bounds the assign
-            # sweeps too, so nested envelopes share one budget
+            # sweeps too, so nested envelopes share one budget.
+            # writable_count keeps one writable volume per upload-window
+            # slot so the windowed fan-out spreads across volume locks
             a = self.mc.assign(collection=collection,
                                replication=replication, ttl=ttl or rule_ttl,
-                               disk_type=disk, deadline=stop_at)
+                               disk_type=disk, deadline=stop_at,
+                               writable_count=self.upload_conc)
             target = a.location.public_url or a.location.url
             res = operation.upload(f"{target}/{a.fid}", data,
                                    gzip_if_worthwhile=False, ttl=ttl,
-                                   jwt=a.auth)
+                                   jwt=a.auth, fsync=fsync)
             return a, res
 
         a, res = retry.retry_call(assign_and_upload, op="filer.blob.write",
@@ -236,13 +288,17 @@ class FilerServer:
         from .. import tracing
         from ..utils import failpoints
         with tracing.start_span("filer.blob.read", component="filer",
-                                attrs={"fid": fid}):
+                                attrs={"fid": fid}) as sp:
+            t0 = time.perf_counter()
             failpoints.check("filer.blob.read")
             # operation.read carries the retry/breaker envelope; the
             # corrupt site models a bad wire so CRC-style invariants can
             # be drilled
-            return failpoints.corrupt("filer.blob.read.data",
+            data = failpoints.corrupt("filer.blob.read.data",
                                       operation.read(self.mc, fid))
+            sp.set_attr("bytes", len(data))
+            sp.set_attr("fetch_s", round(time.perf_counter() - t0, 6))
+            return data
 
     def _fetch_blob(self, fid: str, upcoming: "list[str] | None" = None
                     ) -> bytes:
@@ -250,53 +306,162 @@ class FilerServer:
 
     def read_entry_bytes(self, entry: fpb.Entry, offset: int = 0,
                          size: int | None = None) -> bytes:
+        return b"".join(self.read_entry_windows(entry, offset, size))
+
+    def read_entry_windows(self, entry: fpb.Entry, offset: int = 0,
+                           size: int | None = None):
+        """Yield [offset, offset+size) of the entry window-by-window:
+        each window's cold chunks fan out CONCURRENTLY on the reader
+        pool and the next window prefetches while the caller writes the
+        current one out, so a 1 GB GET never materializes 1 GB.
+        read_entry_bytes is the one-buffer join of this generator, so
+        the buffered and streamed paths cannot diverge."""
         if entry.content:
             data = bytes(entry.content)
-            return data[offset:offset + size if size is not None else None]
+            yield data[offset:offset + size if size is not None else None]
+            return
         if not entry.chunks and entry.extended.get("remote"):
             # uncached remote-mounted entry: stream straight from the
             # remote store (reference filer read_remote.go)
             from ..remote import read_remote
-            return read_remote(entry, offset, size)
+            yield read_remote(entry, offset, size)
+            return
         chunks = self.filer.data_chunks(entry, self._fetch_blob)
         fsize = max(total_size(chunks), entry.attributes.file_size)
         if size is None:
             size = fsize - offset
         size = max(0, min(size, fsize - offset))
-        from .chunk_cache import assemble_window
-        return assemble_window(chunks, offset, size, self._fetch_blob)
+        from .chunk_cache import iter_windows
+        yield from iter_windows(chunks, offset, size, self._fetch_blob,
+                                fetch_many=self.reader_cache.read_many,
+                                prefetch=self.reader_cache.prefetch,
+                                window_views=self.read_window_views)
+
+    def _save_chunks_windowed(self, pieces, ttl: str,
+                              path: str) -> list[fpb.FileChunk]:
+        """Upload (offset, bytes) pieces with up to SWTPU_FILER_UPLOAD_CONC
+        in flight on the shared pool. Pieces are pulled lazily — a slot
+        must free before the next piece is drawn, so a streaming source
+        is back-pressured and peak memory stays O(chunk_size x conc).
+        The first hard failure (each upload already carries the
+        per-chunk retry/breaker envelope) cancels the window, deletes
+        every chunk that landed, and surfaces the error; no orphan
+        needles outlive a failed write. Returns chunks in offset order —
+        byte-identical metadata to the old serial loop."""
+        chunks: list[fpb.FileChunk] = []
+        inflight: dict = {}  # future -> offset
+        it = iter(pieces)
+        try:
+            while True:
+                while len(inflight) < self.upload_conc:
+                    nxt = next(it, None)
+                    if nxt is None:
+                        break
+                    off, piece = nxt
+                    ctx = contextvars.copy_context()
+                    fut = self._upload_pool.submit(
+                        ctx.run, self._save_blob, piece, ttl, path,
+                        time.perf_counter())
+                    inflight[fut] = off
+                if not inflight:
+                    break
+                done, _ = wait(inflight, return_when=FIRST_COMPLETED)
+                for fut in done:
+                    off = inflight.pop(fut)
+                    c = fut.result()
+                    c.offset = off
+                    chunks.append(c)
+        except BaseException:
+            # reap the window: a cancelled-pending future never uploaded;
+            # an in-flight one may still land — wait, then delete all
+            for fut in inflight:
+                fut.cancel()
+            if inflight:
+                wait(list(inflight))
+            landed = [c.file_id for c in chunks]
+            for fut in inflight:
+                if not fut.cancelled() and fut.exception() is None:
+                    landed.append(fut.result().file_id)
+            if landed:
+                self._delete_chunks(landed)
+            raise
+        chunks.sort(key=lambda c: c.offset)
+        return chunks
 
     def write_file(self, path: str, data: bytes, mime: str = "",
                    ttl_sec: int = 0, mode: int = 0o644,
                    signatures: list[int] | None = None) -> fpb.Entry:
-        """Auto-chunking write (reference doPostAutoChunk). `signatures`
-        carries replication origins for sync loop prevention."""
+        """Auto-chunking write (reference doPostAutoChunk), chunk uploads
+        fanned out on the write window. `signatures` carries replication
+        origins for sync loop prevention."""
+        return self.write_file_stream(path, (data,), mime=mime,
+                                      ttl_sec=ttl_sec, mode=mode,
+                                      signatures=signatures)
+
+    def write_file_stream(self, path: str, blocks, mime: str = "",
+                          ttl_sec: int = 0, mode: int = 0o644,
+                          signatures: list[int] | None = None) -> fpb.Entry:
+        """Streaming auto-chunking write: `blocks` is an iterable of byte
+        pieces (any sizes — repacked into chunk_size chunks as they
+        arrive), uploaded through the windowed fan-out so peak memory is
+        O(chunk_size x SWTPU_FILER_UPLOAD_CONC), not O(object). The md5
+        fingerprint / ETag / chunk list are byte-identical to the
+        buffered write_file (which is now a one-block call of this)."""
         directory, name = split_path(path)
-        collection, replication, rule_ttl, _disk = self._storage_rule(path)
+        collection, replication, rule_ttl, _disk, _fsync = \
+            self._storage_rule(path)
         if not ttl_sec and rule_ttl:
             # a path rule's ttl applies to entry expiry AND needle ttl
             from ..storage.types import TTL
             ttl_sec = TTL.parse(rule_ttl).seconds
-        chunks: list[fpb.FileChunk] = []
-        md5 = hashlib.md5(data, usedforsecurity=False)  # content fingerprint
-        for off in range(0, len(data), self.chunk_size):
-            piece = data[off:off + self.chunk_size]
-            c = self._save_blob(piece, ttl=f"{ttl_sec}s" if ttl_sec else "",
-                                path=path)
-            c.offset = off
-            chunks.append(c)
-        chunks = maybe_manifestize(
-            chunks, lambda d: self._save_blob(d, path=path))
-        entry = fpb.Entry(name=name)
-        entry.chunks.extend(chunks)
-        a = entry.attributes
-        a.file_size = len(data)
-        a.mime = mime or mimetypes.guess_type(name)[0] or ""
-        a.file_mode = mode
-        a.ttl_sec = ttl_sec
-        a.md5 = md5.digest()
-        a.collection, a.replication = collection, replication
-        self.filer.create_entry(directory, entry, signatures=signatures)
+        md5 = hashlib.md5(usedforsecurity=False)  # content fingerprint
+        total = 0
+
+        def chunked():
+            nonlocal total
+            buf = bytearray()
+            off = 0
+            for block in blocks:
+                if not block:
+                    continue
+                md5.update(block)
+                total += len(block)
+                buf += block
+                while len(buf) >= self.chunk_size:
+                    piece = bytes(buf[:self.chunk_size])
+                    del buf[:self.chunk_size]
+                    yield off, piece
+                    off += len(piece)
+            if buf:
+                yield off, bytes(buf)
+
+        ttl = f"{ttl_sec}s" if ttl_sec else ""
+        chunks = self._save_chunks_windowed(chunked(), ttl, path)
+        data_fids = [c.file_id for c in chunks if c.file_id]
+        try:
+            chunks = maybe_manifestize(
+                chunks, lambda d: self._save_blob(d, path=path))
+            entry = fpb.Entry(name=name)
+            entry.chunks.extend(chunks)
+            a = entry.attributes
+            a.file_size = total
+            a.mime = mime or mimetypes.guess_type(name)[0] or ""
+            a.file_mode = mode
+            a.ttl_sec = ttl_sec
+            a.md5 = md5.digest()
+            a.collection, a.replication = collection, replication
+            self.filer.create_entry(directory, entry, signatures=signatures)
+        except BaseException:
+            # the window landed but the object never became visible
+            # (manifest upload or entry create failed): the no-orphan
+            # guarantee covers this tail too — every DATA fid plus any
+            # manifest blob that got saved (post-manifestize `chunks`
+            # no longer lists the folded data fids, so keep both sets)
+            landed = set(data_fids)
+            landed.update(c.file_id for c in chunks if c.file_id)
+            if landed:
+                self._delete_chunks(sorted(landed))
+            raise
         return entry
 
     # -- HTTP ---------------------------------------------------------------
@@ -439,6 +604,94 @@ class FilerServer:
         path = urllib.parse.unquote(request.path)
         return path.rstrip("/") or "/"
 
+    async def stream_write(self, content, path: str, mime: str = "",
+                           ttl_sec: int = 0, observer=None, finalize=None):
+        """Bridge an aiohttp body stream into write_file_stream on a
+        worker thread with BOUNDED buffering: the loop side reads at most
+        chunk_size at a time and blocks (off-loop) while the small relay
+        queue is full, so a busy upload window back-pressures the client
+        socket and peak memory stays O(chunk_size x conc) for any body
+        size. `observer(piece)` sees every piece as it arrives (e.g. an
+        incremental sha256); `finalize()` runs after the last byte but
+        BEFORE the entry is committed — raising there aborts the write
+        and the already-landed chunks are deleted, never published."""
+        import asyncio
+        import queue
+
+        loop = asyncio.get_running_loop()
+        q: "queue.Queue" = queue.Queue(maxsize=2)
+
+        def gen():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+
+        ctx = contextvars.copy_context()
+        writer = loop.run_in_executor(
+            self._stream_pool, ctx.run, self.write_file_stream, path,
+            gen(), mime, ttl_sec)
+
+        def put_while_alive(item) -> bool:
+            # never block the event loop OR hang on a dead writer: poll
+            # the queue with a short timeout until the writer exits
+            while not writer.done():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        async def relay(item) -> bool:
+            try:
+                q.put_nowait(item)  # fast path: no executor hop
+                return True
+            except queue.Full:
+                rctx = contextvars.copy_context()
+                return await loop.run_in_executor(None, rctx.run,
+                                                  put_while_alive, item)
+
+        try:
+            # coalesce the socket's small reads into whole chunks before
+            # relaying: one queue item + at most one executor hop per
+            # CHUNK, not per 64 KiB network burst
+            buf = bytearray()
+            eof = False
+            while not eof:
+                piece = await content.read(self.chunk_size - len(buf))
+                if piece:
+                    if observer is not None:
+                        observer(piece)
+                    buf += piece
+                else:
+                    eof = True
+                if buf and (eof or len(buf) >= self.chunk_size):
+                    if not await relay(bytes(buf)):
+                        break  # writer died; its error surfaces below
+                    buf.clear()
+            if finalize is not None and not writer.done():
+                finalize()
+            await relay(None)
+        except BaseException as e:
+            # source died mid-body (client disconnect, digest mismatch):
+            # poison the writer so it aborts + deletes landed chunks,
+            # then reap the thread before re-raising
+            err = e if isinstance(e, Exception) else OSError(
+                "upload aborted")
+            await relay(err)
+            try:
+                await writer
+            except BaseException as we:  # noqa: BLE001
+                # expected: the poison we just fed it — the original
+                # error is the one the client should see
+                log.debug("stream writer for %s reaped: %r", path, we)
+            raise
+        return await writer
+
     async def _h_write(self, request):
         import asyncio
 
@@ -447,6 +700,7 @@ class FilerServer:
         path = self._req_path(request)
         is_dir_target = request.path.endswith("/") and path != "/"
         mime = ""
+        ttl_sec = _parse_ttl_sec(request.query.get("ttl", ""))
         if request.content_type and request.content_type.startswith("multipart/"):
             reader = await request.multipart()
             data = b""
@@ -456,14 +710,17 @@ class FilerServer:
                 if part.filename and (is_dir_target or path == "/"):
                     path = join_path(path, part.filename)
                 break
+            entry = await asyncio.to_thread(self.write_file, path, data,
+                                            mime, ttl_sec)
         else:
-            data = await request.read()
             ct = request.content_type or ""
             if ct and ct not in ("application/octet-stream",):
                 mime = ct
-        ttl_sec = _parse_ttl_sec(request.query.get("ttl", ""))
-        entry = await asyncio.to_thread(self.write_file, path, data, mime,
-                                        ttl_sec)
+            # streaming ingest: the body is chunked AS IT ARRIVES and the
+            # chunks fan out on the upload window — a multi-GB PUT holds
+            # O(chunk_size x conc), never the whole object
+            entry = await self.stream_write(request.content, path, mime,
+                                            ttl_sec)
         return web.json_response(
             {"name": entry.name, "size": entry.attributes.file_size},
             status=201)
@@ -510,9 +767,51 @@ class FilerServer:
         if request.method == "HEAD":
             headers["Content-Length"] = str(fsize)
             return web.Response(status=200, headers=headers)
-        data = await asyncio.to_thread(self.read_entry_bytes, entry, offset,
-                                       stop - offset)
-        return web.Response(body=data, status=status, headers=headers)
+        length = stop - offset
+        if length <= self.chunk_size or not entry.chunks:
+            # small/inline reads: one buffer, one write
+            data = await asyncio.to_thread(self.read_entry_bytes, entry,
+                                           offset, length)
+            return web.Response(body=data, status=status, headers=headers)
+        # large objects stream window-by-window: each window's cold
+        # chunks fan out on the reader pool while the previous window is
+        # on the wire — the response never materializes the object
+        return await self.stream_entry(request, entry, offset, length,
+                                       status, headers)
+
+    async def stream_entry(self, request, entry, offset: int, length: int,
+                           status: int, headers: dict):
+        import asyncio
+
+        from aiohttp import web
+
+        resp = web.StreamResponse(status=status, headers=headers)
+        resp.content_length = length
+        await resp.prepare(request)
+        it = self.read_entry_windows(entry, offset, length)
+        try:
+            while True:
+                win = await asyncio.to_thread(next, it, None)
+                if win is None:
+                    break
+                await resp.write(win)
+            await resp.write_eof()
+        except Exception as e:  # noqa: BLE001
+            # headers are on the wire: the only honest signal left is a
+            # short body (Content-Length mismatch) — close the transport
+            log.warning("streamed read %s aborted: %r", request.path, e)
+            if request.transport is not None:
+                request.transport.close()
+        finally:
+            try:
+                it.close()
+            except ValueError:
+                # a cancelled handler (client disconnect) can land here
+                # while the to_thread worker is still inside next(it) —
+                # the generator is "already executing" and will be
+                # reaped by GC when that fetch returns
+                pass
+        return resp
 
     async def _h_delete(self, request):
         import asyncio
@@ -614,7 +913,7 @@ class FilerServer:
                    fpb.AssignVolumeResponse)
         def assign(req, ctx):
             try:
-                collection, replication, rule_ttl, disk = \
+                collection, replication, rule_ttl, disk, _fsync = \
                     self._storage_rule(req.path)
                 collection = req.collection or collection
                 replication = req.replication or replication

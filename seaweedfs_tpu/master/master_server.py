@@ -49,6 +49,10 @@ class MasterServer:
         self.topo = Topology(volume_size_limit_mb * 1024 * 1024)
         self.layouts = LayoutRegistry(self.topo)
         self.growth = VolumeGrowth(self.topo, allocate_fn=self._allocate_volume)
+        # per-layout cooldown after a failed writableVolumeCount grow
+        # (monotonic deadline); without it every assign on a full
+        # cluster re-runs a doomed topology-wide allocation sweep
+        self._want_growth_backoff: dict[tuple, float] = {}
         self.sequencer = (SnowflakeSequencer() if sequencer == "snowflake"
                           else MemorySequencer())
         self.default_replication = default_replication
@@ -300,12 +304,20 @@ class MasterServer:
                     "master.assign", component="master",
                     child_of=tracing.extract(req.headers),
                     attrs={"collection": q.get("collection", "")}) as sp:
-                areq = pb.AssignRequest(
-                    count=int(q.get("count", 1)),
-                    collection=q.get("collection", ""),
-                    replication=q.get("replication", ""),
-                    ttl=q.get("ttl", ""),
-                    disk_type=q.get("disk_type", ""))
+                try:
+                    areq = pb.AssignRequest(
+                        count=int(q.get("count", 1)),
+                        collection=q.get("collection", ""),
+                        replication=q.get("replication", ""),
+                        ttl=q.get("ttl", ""),
+                        disk_type=q.get("disk_type", ""),
+                        writable_volume_count=int(
+                            q.get("writableVolumeCount", 0)))
+                except ValueError as e:
+                    # malformed numerics are a deterministic client
+                    # error, not a retryable 500
+                    return json_response({"error": f"bad assign: {e}"},
+                                         status=400)
                 # executor dispatches carry the contextvars context so
                 # the growth path's AllocateVolume RPCs inherit this
                 # span's trace instead of starting orphan roots
@@ -812,6 +824,7 @@ class MasterServer:
 
     # -- assign --------------------------------------------------------------
     NEEDS_GROWTH = "__needs_growth__"  # internal redispatch sentinel
+    _WANT_GROWTH_COOLDOWN_S = 30.0  # failed writableVolumeCount grows
 
     def do_assign(self, req: pb.AssignRequest,
                   allow_growth: bool = True) -> pb.AssignResponse:
@@ -835,6 +848,12 @@ class MasterServer:
                                   req.replication or self.default_replication,
                                   req.ttl, req.disk_type or "hdd")
         layout.ensure_correct_writables()
+        want = req.writable_volume_count
+        lkey = (req.collection, req.replication or self.default_replication,
+                req.ttl, req.disk_type or "hdd")
+        if want and layout.active_count() < want and \
+                time.monotonic() >= self._want_growth_backoff.get(lkey, 0.0):
+            return True
         return layout.pick_for_write() is None
 
     def _do_assign(self, req: pb.AssignRequest,
@@ -849,7 +868,19 @@ class MasterServer:
         layout = self.layouts.get(req.collection, replication, req.ttl, disk_type)
         layout.ensure_correct_writables()
         vid = layout.pick_for_write()
-        if vid is None:
+        # writableVolumeCount (reference assign grow option): the caller
+        # wants AT LEAST that many writable volumes so concurrent chunk
+        # uploads — the filer's windowed fan-out — spread across volume
+        # locks instead of serializing on one fsync queue. A cluster
+        # that can't host `want` would otherwise pay a doomed
+        # topology-wide growth sweep on EVERY assign: failures back off
+        # per layout for _WANT_GROWTH_COOLDOWN_S.
+        want = req.writable_volume_count or 0
+        lkey = (req.collection, replication, req.ttl, disk_type)
+        if want and vid is not None and \
+                time.monotonic() < self._want_growth_backoff.get(lkey, 0.0):
+            want = 0  # recent unsatisfiable ask: serve from what exists
+        if vid is None or (want and layout.active_count() < want):
             if not allow_growth:
                 # caller (the inline event-loop path) must re-dispatch to
                 # a thread: growth is seconds, not microseconds
@@ -859,9 +890,20 @@ class MasterServer:
                     collection=req.collection, replication=replication,
                     ttl=req.ttl, disk_type=disk_type,
                     preferred_dc=req.data_center, preferred_rack=req.rack,
-                    count=max(1, req.writable_volume_count or 1)))
+                    count=max(1, want - layout.active_count())))
             except Exception as e:  # noqa: BLE001
-                return pb.AssignResponse(error=f"grow failed: {e}")
+                if vid is not None:
+                    # best-effort spread: the cluster can't host `want`
+                    # writables (disks full), but a writable volume
+                    # exists — serve the assign rather than failing it,
+                    # and stop re-asking for a while
+                    self._want_growth_backoff[lkey] = \
+                        time.monotonic() + self._WANT_GROWTH_COOLDOWN_S
+                    log.warning("writable-count growth to %d failed "
+                                "(backing off %.0fs): %s", want,
+                                self._WANT_GROWTH_COOLDOWN_S, e)
+                else:
+                    return pb.AssignResponse(error=f"grow failed: {e}")
             if self.raft is not None:
                 # replicate the new MaxVolumeId before handing out fids
                 # (reference raft FSM, raft_server.go:53); a failed

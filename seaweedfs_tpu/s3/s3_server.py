@@ -254,6 +254,8 @@ class S3Gateway:
         })
 
     def _apply_cors(self, request, resp) -> None:
+        if getattr(resp, "prepared", False):
+            return  # streamed response: headers already on the wire
         if request.headers.get("Origin") and self.allowed_origins:
             resp.headers.setdefault("Access-Control-Allow-Origin",
                                     self.allowed_origins)
@@ -269,6 +271,40 @@ class S3Gateway:
             return ACTION_READ
         return ACTION_WRITE
 
+    def _stream_put_ok(self, request, bucket: str, key: str,
+                       q: dict) -> bool:
+        """True when this PUT can stream through the filer's chunked
+        fan-out instead of buffering the whole body: a plain object/part
+        upload, large enough to span chunks, whose auth scheme can be
+        verified from headers (SigV4's signature covers the DECLARED
+        x-amz-content-sha256; the body digest is checked incrementally
+        and a mismatch aborts before the entry commits). aws-chunked
+        framing and V2 Content-MD5 still need the buffered decoder."""
+        if request.method != "PUT" or not bucket or not key \
+                or key.endswith("/"):
+            return False
+        if not hasattr(self.fs, "stream_write"):  # remote-filer gateway
+            return False
+        if request.headers.get("x-amz-copy-source"):
+            return False
+        if any(k in q for k in ("acl", "tagging", "retention",
+                                "legal-hold")):
+            return False
+        from .chunked import STREAMING_PAYLOAD, STREAMING_UNSIGNED
+        sha = request.headers.get("x-amz-content-sha256", "")
+        if sha in (STREAMING_PAYLOAD, STREAMING_UNSIGNED) or \
+                "aws-chunked" in request.headers.get("content-encoding", ""):
+            return False
+        auth_hdr = request.headers.get("Authorization", "")
+        if auth_hdr.startswith("AWS ") or (
+                "Signature" in q and "AWSAccessKeyId" in q):
+            return False  # legacy V2: Content-MD5 precheck needs the body
+        try:
+            length = int(request.headers.get("Content-Length", ""))
+        except ValueError:
+            return False
+        return length > getattr(self.fs, "chunk_size", 4 << 20)
+
     async def _route(self, request):
         path = urllib.parse.unquote(request.path)
         parts = path.lstrip("/").split("/", 1)
@@ -277,6 +313,9 @@ class S3Gateway:
         q = dict(request.query)
         action = self._classify_action(request.method, q, bucket, key)
         with self.breaker.acquire(action, bucket):
+            if self._stream_put_ok(request, bucket, key, q):
+                self._authorize(request, bucket, key, q, None, action)
+                return await self._put_streaming(request, bucket, key, q)
             body = await request.read()
             # browser post-policy uploads carry their signature IN the
             # form; post_policy_upload authorizes from the policy fields
@@ -360,7 +399,10 @@ class S3Gateway:
                 request.method, urllib.parse.unquote(request.path),
                 dict(request.query), headers)
         else:
-            if payload_hash not in ("UNSIGNED-PAYLOAD", STREAMING_UNSIGNED):
+            if payload_hash not in ("UNSIGNED-PAYLOAD", STREAMING_UNSIGNED) \
+                    and body is not None:
+                # body=None = streaming PUT: the digest is verified
+                # incrementally by _put_streaming before the entry commits
                 actual = hashlib.sha256(body).hexdigest()
                 if actual != payload_hash:
                     raise S3Error("XAmzContentSHA256Mismatch",
@@ -479,7 +521,7 @@ class S3Gateway:
                               "ObjectLock configuration", 404)
             if "uploadId" in q:
                 return self.list_parts(bucket, key, q)
-            return self.get_object(bucket, key, request)
+            return await self.get_object(bucket, key, request)
         if m == "DELETE":
             if "uploadId" in q:
                 return self.abort_multipart(bucket, key, q["uploadId"])
@@ -842,6 +884,52 @@ class S3Gateway:
         return web.Response(status=200,
                             headers={"ETag": f'"{entry.attributes.md5.hex()}"'})
 
+    async def _put_streaming(self, request, bucket, key, q):
+        """Large-object PutObject/UploadPart: the body is chunked AS IT
+        ARRIVES and fanned out on the filer's upload window, so a
+        multi-GB PUT holds O(chunk_size x concurrency) — never the whole
+        object. A signed payload's sha256 is computed incrementally;
+        a mismatch aborts BEFORE the entry is committed and the landed
+        chunks are deleted (no partial object is ever visible)."""
+        from aiohttp import web
+
+        self._require_bucket(bucket)
+        self._check_quota(bucket)
+        sha = request.headers.get("x-amz-content-sha256", "")
+        hasher = (hashlib.sha256()
+                  if sha and sha != "UNSIGNED-PAYLOAD" else None)
+
+        def finalize():
+            if hasher is not None and hasher.hexdigest() != sha:
+                raise S3Error(
+                    "XAmzContentSHA256Mismatch",
+                    "The provided 'x-amz-content-sha256' header does not "
+                    "match what was computed.", 400)
+
+        observer = hasher.update if hasher is not None else None
+        if "partNumber" in q and "uploadId" in q:
+            upload_id = q["uploadId"]
+            self._find_upload(bucket, upload_id)
+            part = int(q["partNumber"])
+            path = f"{self._upload_dir(bucket, upload_id)}/{part:05d}.part"
+            entry = await self.fs.stream_write(
+                request.content, path, observer=observer, finalize=finalize)
+            return web.Response(status=200, headers={
+                "ETag": f'"{entry.attributes.md5.hex()}"'})
+        acl = self._canned_acl(request)
+        attrs = {k.lower(): v.encode()
+                 for k, v in _user_meta(request.headers).items()}
+        if acl:
+            attrs["acl"] = acl.encode()
+        path = self._object_path(bucket, key)
+        entry = await self.fs.stream_write(
+            request.content, path, mime=request.content_type or "",
+            observer=observer, finalize=finalize)
+        d, _n = split_path(path)
+        self._merge_extended(d, entry, attrs)
+        return web.Response(status=200, headers={
+            "ETag": f'"{entry.attributes.md5.hex()}"'})
+
     def _resolve_copy_source(self, src: str, request):
         """(src_bucket, src_key, entry) for an x-amz-copy-source value.
         Enforces READ on the SOURCE bucket — without this, write access
@@ -859,6 +947,119 @@ class S3Gateway:
         if entry is None:
             raise ErrNoSuchKey(sk)
         return sb, sk, entry
+
+    def _can_copy_by_reference(self, entry) -> bool:
+        """Server-side copy moves zero object bytes when the source is a
+        plain chunked entry and the backing filer supports shared-chunk
+        refcounts (the in-process FilerServer; a remote-filer gateway
+        falls back to data copy)."""
+        return bool(entry.chunks) and not entry.content \
+            and hasattr(getattr(self.fs, "filer", None), "adopt_chunks")
+
+    def _create_cloned_entry(self, dst_path: str, chunks, file_size: int,
+                             md5_digest: bytes, mime: str,
+                             extended: "dict[str, bytes]",
+                             adopted: "list[str]") -> fpb.Entry:
+        """Create an entry over an already-cloned chunk list: bump the
+        shared-chunk refcounts FIRST (a crash between the two leaks a
+        count — harmless — instead of double-freeing a live chunk), roll
+        them back if the create fails."""
+        d, n = split_path(dst_path)
+        new = fpb.Entry(name=n)
+        for c in chunks:
+            nc = new.chunks.add()
+            nc.CopyFrom(c)
+        a = new.attributes
+        a.file_size = file_size
+        a.mime = mime
+        a.file_mode = 0o644
+        a.md5 = md5_digest
+        for k, v in extended.items():
+            new.extended[k] = v
+        adopted = [f for f in adopted if f]
+        if adopted:
+            self.fs.filer.adopt_chunks(adopted)
+        try:
+            self.fs.filer.create_entry(d, new)
+        except BaseException:
+            if adopted:
+                self.fs.filer.release_chunks(adopted)
+            raise
+        return new
+
+    def _verify_copy_source_alive(self, sb: str, sk: str,
+                                  dst_path: str) -> None:
+        """Close the copy/delete race: the refcounts were adopted, so if
+        the source entry STILL exists, any later delete observes them
+        and spares the shared blobs (the filer deletes the entry before
+        releasing chunks). If it's gone, a delete may have released —
+        and possibly freed — the blobs before our adoption: undo the
+        clone and answer NoSuchKey like a copy that lost the race
+        outright."""
+        sd, sn = split_path(self._object_path(sb, sk))
+        if self.fs.filer.find_entry(sd, sn) is not None:
+            return
+        dd, dn = split_path(dst_path)
+        try:
+            # the clone's own data-delete consumes the adopted counts in
+            # EITHER interleaving: if the source's release beat the
+            # adoption (blobs already freed) it just zeroes the stray
+            # counts; if the adoption won, it drops the last reference
+            # and frees the now-unreferenced blobs
+            self.fs.filer.delete_entry(dd, dn, is_delete_data=True)
+        except Exception as e:  # noqa: BLE001 — undo is best-effort
+            log.warning("copy-race cleanup of %s: %s", dst_path, e)
+        raise ErrNoSuchKey(sk)
+
+    def _clone_chunk_range(self, entry, lo: int, size: int,
+                           dst_path: str):
+        """(chunks, adopted_fids) covering [lo, lo+size) of the source.
+        Visible intervals that span a chunk's WHOLE blob clone by
+        reference with rebased offsets; sub-chunk head/tail slices (and
+        partially-overwritten chunks) fall back to data copy — a
+        FileChunk cannot address a mid-blob range. Manifest chunks are
+        resolved first: their nested offsets are absolute and cannot be
+        rebased wholesale."""
+        from ..filer.chunks import resolve_chunks
+        chunks = self.fs.filer.data_chunks(entry, self.fs._fetch_blob)
+        out: "list[fpb.FileChunk]" = []
+        adopted: "list[str]" = []
+        hi = lo + size
+        try:
+            for s, e, c in resolve_chunks(chunks):
+                if e <= lo or s >= hi:
+                    continue
+                if s >= lo and e <= hi and s == c.offset \
+                        and e == c.offset + c.size:
+                    nc = fpb.FileChunk()
+                    nc.CopyFrom(c)
+                    nc.offset = s - lo
+                    out.append(nc)
+                    adopted.append(c.file_id)
+                else:
+                    ov_lo, ov_hi = max(s, lo), min(e, hi)
+                    data = self.fs.read_entry_bytes(entry, ov_lo,
+                                                    ov_hi - ov_lo)
+                    nc = self.fs._save_blob(data, path=dst_path)
+                    nc.offset = ov_lo - lo
+                    out.append(nc)
+        except BaseException:
+            self._drop_copied_slices(out, adopted)
+            raise
+        return out, adopted
+
+    def _drop_copied_slices(self, chunks, adopted: "list[str]") -> None:
+        """Delete the DATA-COPIED slice blobs of a failed clone (the
+        by-reference fids roll back via refcounts; slices are brand-new
+        needles nothing else references)."""
+        shared = set(adopted)
+        copied = [c.file_id for c in chunks
+                  if c.file_id and c.file_id not in shared]
+        if copied:
+            try:
+                self.fs.filer.chunk_deleter(copied)
+            except Exception as e:  # noqa: BLE001 — cleanup best-effort
+                log.warning("slice cleanup %s: %s", copied, e)
 
     def copy_object(self, bucket, key, src, acl: str | None = None,
                     request=None):
@@ -888,7 +1089,6 @@ class S3Gateway:
             raise S3Error("PreconditionFailed",
                           "At least one of the pre-conditions you "
                           "specified did not hold", 412)
-        data = self.fs.read_entry_bytes(entry)
         if directive == "REPLACE":
             mime = (hdrs.get("Content-Type") or hdrs.get("content-type")
                     or entry.attributes.mime)
@@ -899,16 +1099,37 @@ class S3Gateway:
                      if k.startswith(("x-amz-meta-", TAG_PREFIX))}
         if acl:
             attrs["acl"] = acl.encode()
-        new = self.fs.write_file(self._object_path(bucket, key), data,
-                                 mime=mime)
-        dd, _n = split_path(self._object_path(bucket, key))
-        self._merge_extended(dd, new, attrs)
+        dst_path = self._object_path(bucket, key)
+        if self._can_copy_by_reference(entry):
+            # zero-copy: clone the chunk list (offsets unchanged for a
+            # whole-object copy, so manifest chunks clone too) and bump
+            # the shared-chunk refcounts — deleting the source later
+            # must not GC the copy's data
+            if entry.extended.get("s3-etag"):
+                attrs = dict(attrs)
+                attrs["s3-etag"] = bytes(entry.extended["s3-etag"])
+            same = sb == bucket and sk == key
+            new = self._create_cloned_entry(
+                dst_path, list(entry.chunks),
+                entry.attributes.file_size or total_size(entry.chunks),
+                bytes(entry.attributes.md5), mime, attrs,
+                # copy-onto-itself replaces the entry: the GC's
+                # keep-set already protects the shared fids, a bump
+                # here would leak them forever
+                [] if same else [c.file_id for c in entry.chunks])
+            if not same:
+                self._verify_copy_source_alive(sb, sk, dst_path)
+        else:
+            data = self.fs.read_entry_bytes(entry)
+            new = self.fs.write_file(dst_path, data, mime=mime)
+            dd, _n = split_path(dst_path)
+            self._merge_extended(dd, new, attrs)
         root = ET.Element("CopyObjectResult")
-        ET.SubElement(root, "ETag").text = f'"{new.attributes.md5.hex()}"'
+        ET.SubElement(root, "ETag").text = f'"{_entry_etag(new)}"'
         ET.SubElement(root, "LastModified").text = _iso(new.attributes.mtime)
         return _xml_response(root)
 
-    def get_object(self, bucket, key, request):
+    async def get_object(self, bucket, key, request):
         from aiohttp import web
 
         self._require_bucket(bucket)
@@ -985,8 +1206,21 @@ class S3Gateway:
         if request.method == "HEAD":
             headers["Content-Length"] = str(fsize)
             return web.Response(status=200, headers=headers)
-        data = self.fs.read_entry_bytes(entry, offset, stop - offset)
-        return web.Response(body=data, status=status, headers=headers)
+        length = stop - offset
+        if not hasattr(self.fs, "stream_entry") or not entry.chunks \
+                or length <= getattr(self.fs, "chunk_size", 4 << 20):
+            data = self.fs.read_entry_bytes(entry, offset, length)
+            return web.Response(body=data, status=status, headers=headers)
+        # large objects stream window-by-window through the filer's read
+        # fan-out: a 1 GB GET never materializes 1 GB in the gateway.
+        # CORS lands pre-prepare — a StreamResponse's headers are on the
+        # wire before dispatch() gets the response back
+        if request.headers.get("Origin") and self.allowed_origins:
+            headers.setdefault("Access-Control-Allow-Origin",
+                               self.allowed_origins)
+            headers.setdefault("Access-Control-Expose-Headers", "*")
+        return await self.fs.stream_entry(request, entry, offset, length,
+                                          status, headers)
 
     def delete_object(self, bucket, key):
         from aiohttp import web
@@ -1226,15 +1460,18 @@ class S3Gateway:
                          request=None):
         """UploadPartCopy (reference CopyObjectPartHandler,
         s3api_server.go:165): the part's bytes come from an existing
-        object, optionally a byte range (fetched as a slice — a ranged
-        copy out of a huge object must not materialize the whole
-        source)."""
+        object, optionally a byte range. Copy is by FileChunk REFERENCE
+        at chunk granularity — whole chunks inside the range clone with
+        rebased offsets and a refcount bump, only sub-chunk head/tail
+        slices move bytes; a part copy out of a huge object moves (at
+        most) two chunks of data through the gateway."""
         self._check_quota(bucket)
         self._require_bucket(bucket)
         upload_id = q["uploadId"]
         self._find_upload(bucket, upload_id)
         _sb, _sk, entry = self._resolve_copy_source(src, request)
         size = entry.attributes.file_size or total_size(entry.chunks)
+        lo, plen = 0, size
         if src_range:
             m = src_range.removeprefix("bytes=")
             lo_s, _, hi_s = m.partition("-")
@@ -1248,12 +1485,44 @@ class S3Gateway:
                 raise S3Error("InvalidRange",
                               "The requested range is not satisfiable",
                               416)
-            data = self.fs.read_entry_bytes(entry, lo, hi - lo + 1)
-        else:
-            data = self.fs.read_entry_bytes(entry)
+            plen = hi - lo + 1
         part = int(q["partNumber"])
         path = f"{self._upload_dir(bucket, upload_id)}/{part:05d}.part"
-        new = self.fs.write_file(path, data)
+        if not self._can_copy_by_reference(entry):
+            data = self.fs.read_entry_bytes(entry, lo, plen)
+            new = self.fs.write_file(path, data)
+        else:
+            whole = lo == 0 and plen == size
+            if whole and not any(c.is_chunk_manifest
+                                 for c in entry.chunks):
+                chunks = list(entry.chunks)
+                adopted = [c.file_id for c in entry.chunks]
+            else:
+                # complete_multipart rebases part-chunk offsets, which a
+                # manifest chunk cannot survive (nested offsets are
+                # absolute) — resolve through the range cloner instead
+                chunks, adopted = self._clone_chunk_range(entry, lo, plen,
+                                                          path)
+            if whole and entry.attributes.md5:
+                digest = bytes(entry.attributes.md5)
+            else:
+                # the part's bytes never pass through the gateway, so no
+                # content md5 exists; a deterministic surrogate keeps the
+                # CopyPartResult ETag, the stored part entry, and the
+                # complete-time ETag check mutually consistent
+                digest = hashlib.md5(
+                    f"{_entry_etag(entry)}:{lo}:{plen}".encode(),
+                    usedforsecurity=False).digest()
+            try:
+                new = self._create_cloned_entry(path, chunks, plen,
+                                                digest, "", {}, adopted)
+            except BaseException:
+                # adopted fids rolled back inside; the data-copied
+                # slices are ours to delete
+                self._drop_copied_slices(chunks, adopted)
+                raise
+            if adopted:
+                self._verify_copy_source_alive(_sb, _sk, path)
         root = ET.Element("CopyPartResult")
         ET.SubElement(root, "ETag").text = f'"{new.attributes.md5.hex()}"'
         ET.SubElement(root, "LastModified").text = _iso(new.attributes.mtime)

@@ -563,22 +563,45 @@ class VolumeServer:
             if not ok:
                 return json_response({"error": why}, status=401)
         vid, key, cookie = parse_file_id(fid)
-        data, name, mime, gzipped = self._read_body(request)
         is_replicate = request.query.get("type") == "replicate"
-        n = Needle(id=key, cookie=cookie, data=data, name=name, mime=mime,
-                   is_gzipped=gzipped,
-                   ttl=TTL.parse(request.query.get("ttl")))
-        self.store.write_needle(vid, n)
+        ttl = TTL.parse(request.query.get("ttl"))
+        # ?fsync=true (reference UploadOption.Fsync, fed by a filer path
+        # rule's fsync flag): this ack stands on a real fsync
+        fsync = request.query.get("fsync") in ("true", "1")
+
+        # body parse + needle serialization + the store write run
+        # OFF-LOOP in one executor hop (contextvars carried): a multi-MB
+        # chunk PUT is milliseconds of memcpy/crc (plus an fsync wait
+        # when durable), and the filer's windowed upload fan-out sends
+        # several at once — on-loop they serialized behind each other
+        # and every other request
+        def parse_and_write():
+            data, name, mime, gzipped = self._read_body(request)
+            n = Needle(id=key, cookie=cookie, data=data, name=name,
+                       mime=mime, is_gzipped=gzipped, ttl=ttl)
+            self.store.write_needle(vid, n, sync=fsync)
+            return data, name, mime, gzipped, n
+
+        import asyncio
+        import contextvars
+        ctx = contextvars.copy_context()
+        loop = asyncio.get_running_loop()
+        data, name, mime, gzipped, n = await loop.run_in_executor(
+            None, ctx.run, parse_and_write)
         if not is_replicate:
-            await self._replicate(fid, data, name, mime, gzipped)
+            await self._replicate(fid, data, name, mime, gzipped,
+                                  fsync=fsync)
         return json_response({"name": name.decode(errors="replace"),
                               "size": len(data),
                               "eTag": f"{n.checksum:x}"}, status=201)
 
     async def _replicate(self, fid: str, data: bytes, name: bytes,
-                         mime: bytes, gzipped: bool) -> None:
+                         mime: bytes, gzipped: bool,
+                         fsync: bool = False) -> None:
         """Synchronous fan-out to replica peers (store_replicate.go:25),
-        preserving the needle attributes (name/mime/gzip flag)."""
+        preserving the needle attributes (name/mime/gzip flag) and the
+        durability mode (a ?fsync=true write is fsync'd on EVERY
+        replica, or the ack overstates what a crash can keep)."""
         vid = int(fid.split(",")[0])
         # single-copy volumes need no peer lookup at all: the superblock
         # carries the xyz placement, and '000' means this write is final
@@ -597,6 +620,8 @@ class VolumeServer:
 
         async def send_one(sess, peer):
             url = f"http://{peer}/{fid}?type=replicate"
+            if fsync:
+                url += "&fsync=true"
             if name:
                 url += "&" + urllib.parse.urlencode(
                     {"name": name.decode(errors="replace")})

@@ -292,6 +292,28 @@ FILER_REQUEST_COUNTER = _counter(
     "SeaweedFS_filer_request_total", "filer requests", ("type",))
 FILER_REQUEST_SECONDS = _histogram(
     "SeaweedFS_filer_request_seconds", "filer request latency", ("type",))
+# Large-object data plane (filer/S3 streaming pipeline): per-chunk blob
+# upload/fetch latency through the windowed fan-out, and how many chunk
+# ops are in flight right now. upload ≈ assign+volume PUT under the
+# retry envelope; fetch ≈ volume GET on a ReaderCache miss. A wide
+# upload histogram with a full inflight gauge means the window
+# (SWTPU_FILER_UPLOAD_CONC) is the bottleneck; a narrow one with low
+# throughput means the volume tier is. Exemplar-linked to the
+# filer.blob.* spans via the shared Histogram plumbing.
+FILER_CHUNK_UPLOAD_SECONDS = _histogram(
+    "SeaweedFS_filer_chunk_upload_seconds",
+    "per-chunk blob upload latency on the filer large-object write path",
+    buckets=(0.001, 0.005, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0))
+FILER_CHUNK_FETCH_SECONDS = _histogram(
+    "SeaweedFS_filer_chunk_fetch_seconds",
+    "per-chunk blob fetch latency on the filer large-object read path",
+    buckets=(0.001, 0.005, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0))
+FILER_INFLIGHT_CHUNKS = _gauge(
+    "SeaweedFS_filer_inflight_chunks",
+    "chunk operations currently in flight through the filer data plane",
+    ("op",))
 S3_REQUEST_COUNTER = _counter(
     "SeaweedFS_s3_request_total", "s3 requests", ("type", "code", "bucket"))
 S3_REQUEST_SECONDS = _histogram(

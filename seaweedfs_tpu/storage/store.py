@@ -173,11 +173,15 @@ class Store:
         v.read_only = read_only
 
     # -- data path ----------------------------------------------------------
-    def write_needle(self, vid: int, n: Needle) -> int:
+    def write_needle(self, vid: int, n: Needle, sync: bool = False) -> int:
+        # slow/failing disk on the single-needle write path (the chaos
+        # read-storm's store.read twin; bench-filer arms delay here to
+        # model a slow disk deterministically)
+        failpoints.check("store.write")
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        return v.write_needle(n)
+        return v.write_needle(n, sync=sync)
 
     def write_needles_bulk(self, vid: int, needles: "list[Needle]",
                            ) -> "list[int]":

@@ -345,7 +345,10 @@ class Volume:
         return applied
 
     # -- write path (reference volume_write.go:119 writeNeedle2) -----------
-    def write_needle(self, n: Needle) -> int:
+    def write_needle(self, n: Needle, sync: bool = False) -> int:
+        """`sync=True` is the durable single-needle write (the upload's
+        ?fsync=true param, fed by a filer path rule's fsync flag): the
+        ack stands on an fsync, like every bulk-frame ack."""
         with self._lock:
             if self.read_only:
                 raise PermissionError(f"volume {self.id} is read-only")
@@ -367,6 +370,10 @@ class Volume:
             self._commit_offset = self._append_offset
             self.nm.put(n.id, off, self._body_size(rec))
             self.last_append_at_ns = n.append_at_ns
+            if sync:
+                if self.remote_spec is None:
+                    os.fsync(self._dat.fileno())
+                self.nm.flush()
         read_cache.invalidate(self.id, n.id)  # overwrite coherence
         return off
 

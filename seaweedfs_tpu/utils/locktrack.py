@@ -43,18 +43,27 @@ too — their orderings stay in the graph so a mixed ours/stdlib cycle is
 still caught, but a cycle purely inside library internals is their
 bug report, not ours.
 
-Known gap (ROADMAP): asyncio locks are not wrapped — single-threaded
-cooperative scheduling can still deadlock across awaits.
+asyncio locks participate too: `install()` additionally patches
+`asyncio.Lock` / `asyncio.Condition` with tracking proxies. Nodes are
+lock instances (an asyncio lock is inherently bound to one event loop,
+so the graph is naturally keyed per loop); held stacks are per-TASK
+rather than per-thread — task A holding Lock X across an await while
+task B holds Y and awaits X forms exactly the ABBA edges the thread
+proxies record, which is how single-threaded cooperative scheduling
+deadlocks. Cycles that mix thread locks and asyncio locks land in the
+same global graph and the same reporter.
 """
 
 from __future__ import annotations
 
+import asyncio
 import atexit
 import os
 import sys
 import threading
 import time
 import traceback
+import weakref
 import _thread
 
 from .env import env_float as _env_float
@@ -63,6 +72,8 @@ from .env import env_int as _env_int
 _ORIG_LOCK = threading.Lock
 _ORIG_RLOCK = threading.RLock
 _ORIG_CONDITION = threading.Condition
+_ORIG_ASYNC_LOCK = asyncio.Lock
+_ORIG_ASYNC_CONDITION = asyncio.Condition
 
 _STACK_DEPTH = 6  # frames kept per acquisition site
 # locks created under this root are "ours" for finding attribution
@@ -115,7 +126,8 @@ _tls = threading.local()
 
 
 def _held_stack() -> list:
-    """This thread's stack of (lock_id, name, t_acquired, site)."""
+    """This thread's stack of (lock_id, name, t_acquired, site, tag) —
+    tag is the owning task id for sync locks acquired inside a task."""
     held = getattr(_tls, "held", None)
     if held is None:
         held = _tls.held = []
@@ -175,55 +187,125 @@ def _purge_orphans(held: list) -> None:
             i -= 1
 
 
+_async_held: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_async_held_guard = _thread.allocate_lock()
+
+
+def _async_stack(create: bool = True) -> "list | None":
+    """The CURRENT TASK's stack of held asyncio locks (None outside a
+    task). The per-thread stack cannot serve here: every task on a loop
+    shares one thread, but each holds locks independently across
+    awaits."""
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:
+        task = None
+    if task is None:
+        return None
+    with _async_held_guard:
+        held = _async_held.get(task)
+        if held is None and create:
+            held = _async_held[task] = []
+    return held
+
+
+def _current_task_id() -> "int | None":
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:
+        return None
+    return id(task) if task is not None else None
+
+
 def _record_acquired(lock_id: int, name: str) -> None:
     """Called with the real lock already held (success path only)."""
     held = _held_stack()
     _purge_orphans(held)
+    # a sync lock taken while THIS task holds an asyncio lock orders
+    # after it (same execution flow, different stack); the acquisition
+    # is tagged with the owning task so the reverse direction can tell
+    # this task's sync locks from another task's held-across-an-await
+    _note_acquired(held, lock_id, name,
+                   cross_held=_async_stack(create=False),
+                   tag=_current_task_id())
+
+
+def _add_edge(prev_id: int, prev_name: str, lock_id: int,
+              name: str) -> None:
+    """Record ordering edge prev -> this; closing a reverse path that
+    touches one of OUR locks is the cycle finding."""
+    key = (prev_id, lock_id)
+    with _state.guard:
+        ent = _state.edges.get(key)
+        if ent is not None:
+            ent["count"] += 1
+            return
+        # new edge: before adding prev -> this, check whether the
+        # REVERSE ordering is already on record — that is the cycle
+        path = _path_exists(lock_id, prev_id)
+        _state.edges[key] = {
+            "from": prev_name, "to": name, "count": 1,
+            "stack": _stack(),
+        }
+        _state.adj.setdefault(prev_id, set()).add(lock_id)
+        if path is not None and any(n in _state.own for n in path):
+            # path is this-lock -> ... -> prev; the new edge
+            # prev -> this closes the loop. Cycles entirely
+            # inside stdlib/third-party locks are not reported
+            # (we can't fix them); one repo lock in the loop is
+            # enough to make it ours.
+            names = [_state.names.get(n, "?") for n in path]
+            ckey = tuple(sorted(set(names)))
+            if ckey not in _state._cycle_keys:
+                _state._cycle_keys.add(ckey)
+                rev = _state.edges.get((path[0], path[1])
+                                       if len(path) > 1 else key)
+                _state.cycles.append({
+                    "locks": names,
+                    "thread": threading.current_thread().name,
+                    "stack": _stack(),
+                    "reverse_stack": (rev or {}).get("stack", []),
+                })
+
+
+def _note_acquired(held: list, lock_id: int, name: str,
+                   cross_held: "list | None" = None,
+                   tag: "int | None" = None) -> None:
+    """Edge recording against an explicit held stack (per-thread for
+    threading locks, per-task for asyncio locks — one shared graph).
+    `cross_held` is the OTHER domain's stack for the same execution
+    flow: a sync lock taken inside a task that holds an asyncio lock
+    (or vice versa) is a real ordering, even though the two live on
+    different stacks. `tag` rides the held entry (the owning task id
+    for sync locks acquired inside a task) so cross-domain consumers
+    can filter out locks that belong to a DIFFERENT task."""
     t_now = time.monotonic()
     if held:
-        prev_id, prev_name = held[-1][0], held[-1][1]
-        key = (prev_id, lock_id)
-        with _state.guard:
-            ent = _state.edges.get(key)
-            if ent is not None:
-                ent["count"] += 1
-            else:
-                # new edge: before adding prev -> this, check whether the
-                # REVERSE ordering is already on record — that is the cycle
-                path = _path_exists(lock_id, prev_id)
-                _state.edges[key] = {
-                    "from": prev_name, "to": name, "count": 1,
-                    "stack": _stack(),
-                }
-                _state.adj.setdefault(prev_id, set()).add(lock_id)
-                if path is not None and any(n in _state.own
-                                            for n in path):
-                    # path is this-lock -> ... -> prev; the new edge
-                    # prev -> this closes the loop. Cycles entirely
-                    # inside stdlib/third-party locks are not reported
-                    # (we can't fix them); one repo lock in the loop is
-                    # enough to make it ours.
-                    names = [_state.names.get(n, "?") for n in path]
-                    ckey = tuple(sorted(set(names)))
-                    if ckey not in _state._cycle_keys:
-                        _state._cycle_keys.add(ckey)
-                        rev = _state.edges.get((path[0], path[1])
-                                               if len(path) > 1 else key)
-                        _state.cycles.append({
-                            "locks": names,
-                            "thread": threading.current_thread().name,
-                            "stack": _stack(),
-                            "reverse_stack": (rev or {}).get("stack", []),
-                        })
-    held.append((lock_id, name, t_now, _site()))
+        _add_edge(held[-1][0], held[-1][1], lock_id, name)
+    if cross_held:
+        prev_id, prev_name = cross_held[-1][0], cross_held[-1][1]
+        if prev_id != lock_id and not (held and held[-1][0] == prev_id):
+            _add_edge(prev_id, prev_name, lock_id, name)
+    held.append((lock_id, name, t_now, _site(), tag))
 
 
 def _record_released(lock_id: int) -> None:
     held = _held_stack()
     _purge_orphans(held)
+    if _note_released(held, lock_id):
+        return
+    # not held by this thread: a handoff release — flag it so the
+    # acquiring thread clears its stale entry at its next lock op
+    with _state.guard:
+        _state.orphans[lock_id] = _state.orphans.get(lock_id, 0) + 1
+
+
+def _note_released(held: list, lock_id: int) -> bool:
+    """Pop the lock from an explicit held stack; False when this stack
+    never saw the acquisition (thread handoff / foreign-task release)."""
     for i in range(len(held) - 1, -1, -1):
         if held[i][0] == lock_id:
-            _, name, t_acq, site = held.pop(i)
+            _, name, t_acq, site, _tag = held.pop(i)
             dt = time.monotonic() - t_acq
             if dt > _state.hold_threshold_s and lock_id in _state.own:
                 key = (name, site)
@@ -240,11 +322,32 @@ def _record_released(lock_id: int) -> None:
                             if (h["lock"], h["site"]) == key:
                                 h["held_ms"] = max(h["held_ms"],
                                                    round(dt * 1e3, 1))
-            return
-    # not held by this thread: a handoff release — flag it so the
-    # acquiring thread clears its stale entry at its next lock op
+            return True
+    return False
+
+
+def _creator_is_ours() -> bool:
+    """Was the lock constructed from repo code (vs library internals)?"""
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    return f is not None and f.f_code.co_filename.startswith(_PKG_ROOT)
+
+
+def _register_node(name: str, own: bool) -> "tuple[int, bool]":
+    """Allot a graph node. The key is a serial, not id(): a collected
+    lock's id gets recycled and would inherit the dead lock's history."""
     with _state.guard:
-        _state.orphans[lock_id] = _state.orphans.get(lock_id, 0) + 1
+        _state.locks_created += 1
+        node_id = _state.locks_created
+        tracked = _state.locks_created <= _state.max_locks
+        if tracked:
+            _state.names[node_id] = name
+            if own:
+                _state.own.add(node_id)
+        else:
+            _state.locks_dropped += 1
+    return node_id, tracked
 
 
 class TrackedLock:
@@ -259,23 +362,8 @@ class TrackedLock:
                              f"@{_site(2)}"
         # an explicit name or a creation site inside the package makes
         # findings about this lock OURS to report (vs library internals)
-        f = sys._getframe(1)
-        while f is not None and f.f_code.co_filename == __file__:
-            f = f.f_back
-        own = name is not None or (
-            f is not None and f.f_code.co_filename.startswith(_PKG_ROOT))
-        with _state.guard:
-            _state.locks_created += 1
-            # node key is a serial, not id(): a collected lock's id gets
-            # recycled and would inherit the dead lock's graph history
-            self._id = _state.locks_created
-            self._tracked = _state.locks_created <= _state.max_locks
-            if self._tracked:
-                _state.names[self._id] = self._name
-                if own:
-                    _state.own.add(self._id)
-            else:
-                _state.locks_dropped += 1
+        self._id, self._tracked = _register_node(
+            self._name, name is not None or _creator_is_ours())
 
     # -- depth bookkeeping for reentrant proxies ------------------------------
     def _depth_map(self) -> dict:
@@ -367,6 +455,66 @@ class TrackedLock:
         self.acquire()
 
 
+class TrackedAsyncLock:
+    """Drop-in `asyncio.Lock` proxy feeding the same order graph.
+
+    An asyncio lock is bound to one event loop, so graph nodes stay
+    naturally loop-scoped; acquisition order is tracked per TASK — the
+    cooperative-scheduling deadlock is task A holding X across an await
+    while task B holds Y and awaits X, and those are exactly the edges a
+    per-task held stack records. Supports the `threading.Condition`-free
+    subset asyncio.Condition drives (acquire/release/locked)."""
+
+    __slots__ = ("_lock", "_name", "_id", "_tracked")
+
+    def __init__(self, name: str | None = None):
+        self._lock = _ORIG_ASYNC_LOCK()
+        self._name = name or f"asyncio.Lock@{_site(2)}"
+        self._id, self._tracked = _register_node(
+            self._name, name is not None or _creator_is_ours())
+
+    async def acquire(self):
+        got = await self._lock.acquire()
+        if got and self._tracked:
+            held = _async_stack()
+            if held is not None:
+                # only sync locks THIS task acquired are predecessors:
+                # a lock another task holds across an await sits on the
+                # same thread stack but belongs to a different flow —
+                # borrowing it would fabricate ordering edges (and
+                # phantom deadlock findings)
+                tid = _current_task_id()
+                mine = [e for e in _held_stack() if e[4] == tid]
+                _note_acquired(held, self._id, self._name,
+                               cross_held=mine)
+        return got
+
+    def release(self):
+        if self._tracked:
+            held = _async_stack()
+            if held is not None:
+                # a release from a task that never acquired (legal for
+                # asyncio.Lock) records nothing — no cross-task orphan
+                # machinery needed, the acquirer's entry dies with its
+                # task's weakref
+                _note_released(held, self._id)
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    async def __aenter__(self):
+        await self.acquire()
+        return None  # asyncio.Lock's contract: aenter yields None
+
+    async def __aexit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover
+        return f"<TrackedAsyncLock {self._name}>"
+
+
 def Lock(name: str | None = None) -> TrackedLock:
     return TrackedLock(reentrant=False, name=name)
 
@@ -379,12 +527,32 @@ def Condition(lock=None):
     return _ORIG_CONDITION(lock if lock is not None else RLock())
 
 
+class TrackedAsyncCondition(_ORIG_ASYNC_CONDITION):
+    """asyncio.Condition over a tracked default lock. A real subclass —
+    not a factory — so `isinstance(c, asyncio.Condition)` and
+    `class X(asyncio.Condition)` keep working while the patch is live
+    (the threading patch never had that hazard because threading.Lock
+    is already a factory function in CPython; asyncio.Lock is a
+    class). The base duck-types its lock (delegates acquire/release/
+    locked), so the tracked proxy slots straight in."""
+
+    def __init__(self, lock=None):
+        super().__init__(lock if lock is not None else TrackedAsyncLock())
+
+
+# patched in as asyncio.Lock must stay class-like for the same reason;
+# TrackedAsyncLock already accepts the optional name kwarg
+AsyncLock = TrackedAsyncLock
+AsyncCondition = TrackedAsyncCondition
+
+
 _installed = False
 
 
 def install() -> bool:
-    """Patch threading.Lock/RLock/Condition with the tracking proxies.
-    Everything constructed afterwards — including Event/Queue internals —
+    """Patch threading.Lock/RLock/Condition AND asyncio.Lock/Condition
+    with the tracking proxies. Everything constructed afterwards —
+    including Event/Queue internals and aiohttp handler coordination —
     participates. Idempotent; returns whether the patch is active."""
     global _installed
     if _installed:
@@ -393,6 +561,10 @@ def install() -> bool:
     threading.Lock = Lock
     threading.RLock = RLock
     threading.Condition = Condition
+    asyncio.Lock = AsyncLock
+    asyncio.locks.Lock = AsyncLock
+    asyncio.Condition = AsyncCondition
+    asyncio.locks.Condition = AsyncCondition
     atexit.register(_exit_report)
     return True
 
@@ -407,6 +579,10 @@ def uninstall() -> None:
     threading.Lock = _ORIG_LOCK
     threading.RLock = _ORIG_RLOCK
     threading.Condition = _ORIG_CONDITION
+    asyncio.Lock = _ORIG_ASYNC_LOCK
+    asyncio.locks.Lock = _ORIG_ASYNC_LOCK
+    asyncio.Condition = _ORIG_ASYNC_CONDITION
+    asyncio.locks.Condition = _ORIG_ASYNC_CONDITION
     try:
         atexit.unregister(_exit_report)
     except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (shutdown best-effort)

@@ -670,10 +670,65 @@ def test_read_storm_schedule(cluster):
             except Exception:  # noqa: BLE001
                 pass
 
+    # -- large-object lane (ISSUE 10): one streamer writes 8-chunk
+    # objects through a live filer's windowed fan-out and reads them
+    # back window-by-window while filer.blob.* faults fire. Invariants:
+    # an ACKED entry always reads back byte-identical (both paths), and
+    # a FAILED write never leaves a partial-window entry visible.
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    filer = FilerServer(f"127.0.0.1:{master.port}", store_spec="memory",
+                        port=_free_port(), grpc_port=_free_port())
+    filer.start()
+    filer.chunk_size = 4096  # 8-chunk objects at ~32 KiB: fast windows
+    lo_acked: dict[str, bytes] = {}  # name -> acked bytes
+    lo_violations: list = []
+
+    def lo_streamer(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        n = 0
+        while not stop.is_set():
+            n += 1
+            name = f"obj-{n}.bin"
+            data = wrng.randbytes(8 * filer.chunk_size
+                                  - wrng.randrange(4096))
+            blocks = [data[i:i + 1000] for i in range(0, len(data), 1000)]
+            try:
+                filer.write_file_stream(f"/storm/{name}", blocks)
+            except Exception:  # noqa: BLE001 — injected write fault
+                if filer.filer.find_entry("/storm", name) is not None:
+                    lo_violations.append((name, "partial entry visible "
+                                                "after failed write"))
+                continue
+            lo_acked[name] = data
+            entry = filer.filer.find_entry("/storm", name)
+            if entry is None:
+                lo_violations.append((name, "acked entry missing"))
+                continue
+            for _attempt in range(4):
+                try:
+                    got = b"".join(filer.read_entry_windows(entry))
+                except Exception:  # noqa: BLE001 — injected read fault
+                    time.sleep(0.05)
+                    continue
+                if got != data:
+                    lo_violations.append((name, "acked bytes differ",
+                                          len(got)))
+                break
+
     # -- light read-path faults: the storm must survive them ----------------
     for site, spec in [
             ("store.read", f"pct:{rng.randint(5, 15)}:delay:0.02"),
-            ("http.request", f"pct:{rng.randint(2, 6)}:error:chaos")]:
+            ("http.request", f"pct:{rng.randint(2, 6)}:error:chaos"),
+            ("filer.blob.write", f"pct:{rng.randint(4, 10)}:error:chaos"),
+            ("filer.blob.read", f"pct:{rng.randint(4, 10)}:error:chaos")]:
         failpoints.configure(site, spec)
         print(f"[chaos] {ctx}: armed {site}={spec}")
 
@@ -684,6 +739,8 @@ def test_read_storm_schedule(cluster):
                                    args=(rng.randrange(1 << 30),))
                   for _ in range(3)]
                + [threading.Thread(target=ingest_stream, daemon=True,
+                                   args=(rng.randrange(1 << 30),))]
+               + [threading.Thread(target=lo_streamer, daemon=True,
                                    args=(rng.randrange(1 << 30),))])
     try:
         for t in threads:
@@ -714,6 +771,30 @@ def test_read_storm_schedule(cluster):
         failpoints.clear_all()
 
     assert not violations, f"{ctx}: coherence violations: {violations[:8]}"
+
+    # -- large-object converge: faults are clear, every acked object
+    # must read back byte-identical on BOTH paths; failed writes left
+    # no partial-window entries (asserted live above)
+    try:
+        assert not lo_violations, \
+            f"{ctx}: large-object violations: {lo_violations[:8]}"
+        assert lo_acked, f"{ctx}: no large object survived the lane"
+        lo_stale = []
+        for name, data in lo_acked.items():
+            entry = filer.filer.find_entry("/storm", name)
+            try:
+                if entry is None or \
+                        filer.read_entry_bytes(entry) != data or \
+                        b"".join(filer.read_entry_windows(entry)) != data:
+                    lo_stale.append(name)
+            except Exception as e:  # noqa: BLE001
+                lo_stale.append(f"{name} ({e!r})")
+        assert not lo_stale, \
+            f"{ctx}: post-storm large-object mismatches: {lo_stale[:8]}"
+        print(f"[chaos] {ctx}: large-object lane verified "
+              f"{len(lo_acked)} acked objects byte-identical")
+    finally:
+        filer.stop()
 
     # -- converge: every non-quarantined fid reads its last acked bytes ----
     stale = []

@@ -471,6 +471,160 @@ def test_cross_thread_handoff_no_false_edges(lt):
     assert lt.debug_locks_payload({"edges": "1"})["edge_list"] == []
 
 
+def test_asyncio_abba_cycle_reported(lt):
+    """asyncio.Lock ordering cycles across TASKS land in the same graph
+    (the ROADMAP asyncio-locktrack item): task-scoped held stacks catch
+    the hold-X-across-an-await-then-take-Y / reverse pattern that
+    single-threaded cooperative scheduling can still deadlock on."""
+    import asyncio
+
+    a = lt.AsyncLock(name="aio-A")
+    b = lt.AsyncLock(name="aio-B")
+
+    async def order(x, y):
+        async with x:
+            await asyncio.sleep(0)  # hold across a suspension point
+            async with y:
+                pass
+
+    async def main():
+        await asyncio.gather(order(a, b))
+        await asyncio.gather(order(b, a))
+
+    asyncio.run(main())
+    rep = lt.findings()
+    assert len(rep["cycles"]) == 1
+    assert set(rep["cycles"][0]["locks"]) == {"aio-A", "aio-B"}
+
+
+def test_asyncio_consistent_order_not_reported(lt):
+    import asyncio
+
+    a = lt.AsyncLock(name="aio-C")
+    b = lt.AsyncLock(name="aio-D")
+
+    async def main():
+        for _ in range(3):
+            async with a:
+                async with b:
+                    pass
+
+    asyncio.run(main())
+    assert lt.findings()["cycles"] == []
+
+
+def test_asyncio_tasks_do_not_share_held_stacks(lt):
+    """Two tasks interleaving on ONE thread must not fabricate ordering
+    edges between each other's locks (the per-thread stack would)."""
+    import asyncio
+
+    a = lt.AsyncLock(name="iso-A")
+    b = lt.AsyncLock(name="iso-B")
+
+    async def hold(lock, gate, release):
+        async with lock:
+            gate.set()
+            await release.wait()
+
+    async def main():
+        g1, r1 = asyncio.Event(), asyncio.Event()
+        g2, r2 = asyncio.Event(), asyncio.Event()
+        t1 = asyncio.ensure_future(hold(a, g1, r1))
+        await g1.wait()
+        t2 = asyncio.ensure_future(hold(b, g2, r2))
+        await g2.wait()  # both held simultaneously, DIFFERENT tasks
+        r1.set()
+        r2.set()
+        await asyncio.gather(t1, t2)
+
+    asyncio.run(main())
+    rep = lt.findings()
+    assert rep["cycles"] == []
+    assert rep["edges"] == 0  # no cross-task ordering was invented
+
+
+def test_sync_lock_held_across_await_not_borrowed(lt):
+    """A threading lock task A holds ACROSS an await must not become a
+    predecessor of another task's asyncio acquisitions — borrowing the
+    loop thread's stack wholesale would fabricate ordering edges."""
+    import asyncio
+
+    t_lock = lt.Lock(name="xd-T")
+    a_lock = lt.AsyncLock(name="xd-A")
+
+    async def holder(gate, release):
+        t_lock.acquire()
+        gate.set()
+        await release.wait()  # legal: only stalls the loop if contended
+        t_lock.release()
+
+    async def other(gate, release):
+        await gate.wait()
+        async with a_lock:  # t_lock is on the thread stack, NOT ours
+            pass
+        release.set()
+
+    async def main():
+        g, r = asyncio.Event(), asyncio.Event()
+        await asyncio.gather(holder(g, r), other(g, r))
+
+    asyncio.run(main())
+    assert lt.findings()["edges"] == 0
+
+
+def test_asyncio_condition_and_mixed_cycle(lt):
+    """asyncio.Condition works through the proxy, and a cycle mixing a
+    THREAD lock with an ASYNC lock is still one global-graph finding."""
+    import asyncio
+
+    t_lock = lt.Lock(name="mix-thread")
+    a_lock = lt.AsyncLock(name="mix-async")
+
+    async def cond_roundtrip():
+        c = lt.AsyncCondition()
+        async with c:
+            c.notify_all()
+
+    async def async_then_thread():
+        async with a_lock:
+            with t_lock:
+                pass
+
+    async def thread_then_async():
+        with t_lock:
+            async with a_lock:
+                pass
+
+    asyncio.run(cond_roundtrip())
+    asyncio.run(async_then_thread())
+    asyncio.run(thread_then_async())
+    rep = lt.findings()
+    assert len(rep["cycles"]) == 1
+    assert set(rep["cycles"][0]["locks"]) == {"mix-thread", "mix-async"}
+
+
+def test_asyncio_install_patches_factories(lt):
+    import asyncio
+
+    locktrack.install()
+    try:
+        assert asyncio.Lock is locktrack.AsyncLock
+        lock = asyncio.Lock()
+        assert isinstance(lock, locktrack.TrackedAsyncLock)
+
+        async def use():
+            async with lock:
+                pass
+            c = asyncio.Condition()
+            async with c:
+                pass
+
+        asyncio.run(use())
+    finally:
+        locktrack.uninstall()
+    assert asyncio.Lock is not locktrack.AsyncLock
+
+
 def test_external_only_cycle_not_reported(lt):
     """Unnamed locks created outside the package (stdlib/third-party
     internals once install() patches the factories) contribute edges

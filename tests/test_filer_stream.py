@@ -1,0 +1,463 @@
+"""Large-object data plane tests (ISSUE 10): streamed-vs-buffered GET
+equality matrix (plain/Range/gzip/cipher), parallel-vs-serial write_file
+parity (chunks, ETag, md5, manifestize threshold), mid-stream failure
+hygiene (no orphan entry, landed chunks deleted), S3 streaming PUT/GET
+and copy-by-chunk-reference with shared-chunk refcounts."""
+
+import hashlib
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.pb import filer_pb2 as fpb
+
+from test_cluster import cluster, free_port  # noqa: F401  (reuse fixture)
+
+
+@pytest.fixture(scope="module")
+def filer_server(cluster, tmp_path_factory):  # noqa: F811
+    master, servers, mc = cluster
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+
+    fs = FilerServer(f"127.0.0.1:{master.port}", store_spec="memory",
+                     port=free_port(), grpc_port=free_port(),
+                     meta_log_path=str(tmp_path_factory.mktemp("flst")
+                                       / "meta.log"),
+                     chunk_size_mb=1)
+    fs.start()
+    from conftest import wait_http_up
+    wait_http_up(f"http://{fs.url}/__status__")
+    yield fs
+    fs.stop()
+
+
+@pytest.fixture(scope="module")
+def s3(filer_server):
+    from seaweedfs_tpu.s3.s3_server import S3Gateway
+
+    gw = S3Gateway(filer_server, port=free_port()).start()
+    base = f"http://{gw.url}"
+    from conftest import wait_http_up
+    wait_http_up(base)
+    yield gw, base
+    gw.stop()
+
+
+def _payload(n, seed=0):
+    # deterministic, compressible-ish but not trivial
+    return bytes((i * 31 + seed) & 0xFF for i in range(n))
+
+
+# -- equality matrix: streamed vs buffered ----------------------------------
+
+def test_streamed_get_equals_buffered(filer_server):
+    data = _payload(3 * (1 << 20) + 12345)  # 4 chunks, ragged tail
+    entry = filer_server.write_file("/stream/eq.bin", data)
+    assert len(entry.chunks) == 4
+    # whole object
+    assert b"".join(filer_server.read_entry_windows(entry)) == data
+    assert filer_server.read_entry_bytes(entry) == data
+    # range matrix: chunk-aligned, straddling, sub-chunk, tail, suffix
+    for off, size in ((0, len(data)), (1 << 20, 1 << 20),
+                      ((1 << 20) - 7, 2048), (5, 1), (len(data) - 99, 99),
+                      (0, 17), (2 * (1 << 20) + 3, (1 << 20) + 100)):
+        want = data[off:off + size]
+        assert filer_server.read_entry_bytes(entry, off, size) == want
+        assert b"".join(
+            filer_server.read_entry_windows(entry, off, size)) == want
+
+
+def test_http_get_streams_large_objects(filer_server):
+    data = _payload(5 * (1 << 20) + 3, seed=1)
+    url = f"http://{filer_server.url}/stream/http.bin"
+    r = requests.post(url, data=data, timeout=30)
+    assert r.status_code == 201
+    got = requests.get(url, timeout=30)
+    assert got.content == data
+    assert int(got.headers["Content-Length"]) == len(data)
+    # Range across a chunk boundary answers byte-identically (206)
+    rng = requests.get(url, headers={"Range": "bytes=1048000-3097000"},
+                       timeout=30)
+    assert rng.status_code == 206
+    assert rng.content == data[1048000:3097001]
+
+
+def test_sparse_file_windows_zero_fill(filer_server):
+    """Gaps between visible chunk intervals must stream as zeros — the
+    buffered path's bytearray(size) behavior, window-tiled."""
+    data = _payload(1 << 20, seed=2)
+    entry = filer_server.write_file("/stream/sparse.bin", data)
+    # logical size says 3 MiB but only chunk 0 exists: tail is a hole
+    entry.attributes.file_size = 3 << 20
+    want = data + bytes((3 << 20) - len(data))
+    assert filer_server.read_entry_bytes(entry) == want
+    assert b"".join(filer_server.read_entry_windows(entry)) == want
+
+
+def test_gzip_chunk_equality(filer_server, cluster):  # noqa: F811
+    """A chunk stored gzip-compressed on the volume server (external
+    writers do this) decompresses identically on both read paths."""
+    from seaweedfs_tpu.client import operation
+
+    master, servers, mc = cluster
+    blob = b"A" * 300_000 + b"B" * 300_000  # compresses well
+    a = mc.assign()
+    operation.upload(f"{a.location.url}/{a.fid}", blob, name="gz.txt",
+                     gzip_if_worthwhile=True, jwt=a.auth)
+    entry = fpb.Entry(name="gz.bin")
+    c = entry.chunks.add()
+    c.file_id, c.offset, c.size = a.fid, 0, len(blob)
+    c.modified_ts_ns = time.time_ns()
+    entry.attributes.file_size = len(blob)
+    filer_server.filer.create_entry("/stream", entry)
+    assert filer_server.read_entry_bytes(entry) == blob
+    assert b"".join(filer_server.read_entry_windows(entry)) == blob
+    assert filer_server.read_entry_bytes(entry, 299_990, 20) == \
+        blob[299_990:300_010]
+
+
+def test_cipher_chunk_equality(filer_server):
+    """Encrypted chunks decrypt identically window-by-window."""
+    pytest.importorskip("cryptography")
+    from seaweedfs_tpu.security.cipher import encrypt
+
+    filer_server.encrypt_data = True
+    try:
+        data = _payload(2 * (1 << 20) + 777, seed=3)
+        entry = filer_server.write_file("/stream/ciph.bin", data)
+        assert all(c.cipher_key for c in entry.chunks)
+        assert filer_server.read_entry_bytes(entry) == data
+        assert b"".join(filer_server.read_entry_windows(entry)) == data
+        assert b"".join(filer_server.read_entry_windows(
+            entry, 1 << 20, 4096)) == data[1 << 20:(1 << 20) + 4096]
+    finally:
+        filer_server.encrypt_data = False
+
+
+# -- parallel vs serial write parity ----------------------------------------
+
+def test_parallel_write_matches_serial(filer_server):
+    data = _payload(4 * (1 << 20) + 999, seed=4)
+    old_conc = filer_server.upload_conc
+    try:
+        filer_server.upload_conc = 1
+        serial = filer_server.write_file("/stream/ser.bin", data)
+        filer_server.upload_conc = 4
+        par = filer_server.write_file("/stream/par.bin", data)
+    finally:
+        filer_server.upload_conc = old_conc
+    assert bytes(par.attributes.md5) == bytes(serial.attributes.md5)
+    assert par.attributes.md5 == hashlib.md5(data).digest()
+    assert par.attributes.file_size == serial.attributes.file_size
+    assert len(par.chunks) == len(serial.chunks) == 5
+    assert [c.offset for c in par.chunks] == \
+        [c.offset for c in serial.chunks]
+    assert [c.size for c in par.chunks] == [c.size for c in serial.chunks]
+    assert filer_server.read_entry_bytes(par) == data
+
+
+def test_write_file_stream_repacks_blocks(filer_server):
+    """Arbitrary source block sizes repack into identical chunking."""
+    data = _payload(2 * (1 << 20) + 100, seed=5)
+    whole = filer_server.write_file("/stream/whole.bin", data)
+    blocks = [data[i:i + 70_001] for i in range(0, len(data), 70_001)]
+    streamed = filer_server.write_file_stream("/stream/blocks.bin", blocks)
+    assert bytes(streamed.attributes.md5) == bytes(whole.attributes.md5)
+    assert [(c.offset, c.size) for c in streamed.chunks] == \
+        [(c.offset, c.size) for c in whole.chunks]
+    assert filer_server.read_entry_bytes(streamed) == data
+
+
+def test_manifestize_threshold_parity(filer_server):
+    """>MANIFEST_BATCH chunks still fold into manifest chunks through
+    the windowed fan-out, and the object reads back whole."""
+    from seaweedfs_tpu.filer.chunks import MANIFEST_BATCH
+
+    old = filer_server.chunk_size
+    filer_server.chunk_size = 256  # tiny chunks: many uploads, fast
+    try:
+        n = (MANIFEST_BATCH + 50) * 256
+        data = _payload(n, seed=6)
+        entry = filer_server.write_file("/stream/mani.bin", data)
+        assert any(c.is_chunk_manifest for c in entry.chunks)
+        assert len(entry.chunks) < MANIFEST_BATCH + 51
+        assert filer_server.read_entry_bytes(entry) == data
+        assert b"".join(filer_server.read_entry_windows(entry)) == data
+    finally:
+        filer_server.chunk_size = old
+
+
+def test_http_streaming_put_bounded_queue(filer_server):
+    """A body far larger than chunk_size lands through the streaming
+    ingest path (the handler never calls request.read())."""
+    data = _payload(6 * (1 << 20), seed=7)
+    url = f"http://{filer_server.url}/stream/bigput.bin"
+
+    def gen():
+        for i in range(0, len(data), 64 << 10):
+            yield data[i:i + (64 << 10)]
+
+    r = requests.post(url, data=gen(), timeout=60)  # chunked encoding
+    assert r.status_code == 201, r.text
+    entry = filer_server.filer.find_entry("/stream", "bigput.bin")
+    assert entry.attributes.file_size == len(data)
+    assert len(entry.chunks) == 6
+    assert entry.attributes.md5 == hashlib.md5(data).digest()
+    assert requests.get(url, timeout=30).content == data
+
+
+# -- failure hygiene ---------------------------------------------------------
+
+def test_midstream_failure_no_orphan_entry_and_chunks_deleted(filer_server):
+    """An upload that dies mid-window must leave NO entry and delete
+    every chunk that already landed."""
+    landed, deleted = [], []
+    real_inner = filer_server._save_blob_inner
+    real_delete = filer_server._delete_chunks
+    calls = {"n": 0}
+    lock = threading.Lock()
+
+    def failing_inner(data, ttl, path):
+        with lock:
+            calls["n"] += 1
+            my = calls["n"]
+        if my == 3:
+            raise IOError("injected mid-stream upload failure")
+        c = real_inner(data, ttl, path)
+        with lock:
+            landed.append(c.file_id)
+        return c
+
+    filer_server._save_blob_inner = failing_inner
+    filer_server._delete_chunks = lambda fids: deleted.extend(fids)
+    try:
+        data = _payload(5 * (1 << 20), seed=8)
+        with pytest.raises(IOError, match="injected"):
+            filer_server.write_file("/stream/fail.bin", data)
+    finally:
+        filer_server._save_blob_inner = real_inner
+        filer_server._delete_chunks = real_delete
+    assert filer_server.filer.find_entry("/stream", "fail.bin") is None
+    # every chunk that landed was handed to the deleter — no orphans
+    assert set(landed) == set(deleted)
+    assert calls["n"] >= 3
+
+
+def test_entry_create_failure_deletes_landed_chunks(filer_server,
+                                                    monkeypatch):
+    """The no-orphan guarantee covers the tail too: when every chunk
+    lands but the ENTRY create fails, the landed chunks are deleted."""
+    deleted = []
+    monkeypatch.setattr(filer_server, "_delete_chunks",
+                        lambda fids: deleted.extend(fids))
+
+    def boom(*a, **kw):
+        raise OSError("metadata store down")
+
+    monkeypatch.setattr(filer_server.filer, "create_entry", boom)
+    data = _payload(3 << 20, seed=21)
+    with pytest.raises(OSError, match="metadata store down"):
+        filer_server.write_file("/stream/tail.bin", data)
+    assert len(deleted) == 3  # every landed chunk handed to the deleter
+
+
+def test_http_put_failure_returns_500_no_entry(filer_server):
+    from seaweedfs_tpu.utils import failpoints
+
+    failpoints.configure("filer.blob.write", "error")
+    try:
+        r = requests.post(f"http://{filer_server.url}/stream/fp.bin",
+                          data=_payload(3 << 20, seed=9), timeout=60)
+        assert r.status_code == 500
+    finally:
+        failpoints.clear("filer.blob.write")
+    assert filer_server.filer.find_entry("/stream", "fp.bin") is None
+
+
+def test_fsync_path_rule_plumbs_to_volume_put(filer_server, monkeypatch):
+    """A filer.conf rule with fsync=true rides every chunk upload as
+    ?fsync=true and the volume server fsyncs that write before acking
+    (the previously-dead PathRule.fsync knob, now end-to-end)."""
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.filer.filer_conf import PathRule
+
+    filer_server.conf.upsert(PathRule(location_prefix="/durable/",
+                                      fsync=True))
+    seen = []
+    real = operation.upload
+
+    def spy(url, data, **kw):
+        seen.append(kw.get("fsync", False))
+        return real(url, data, **kw)
+
+    monkeypatch.setattr(operation, "upload", spy)
+    try:
+        data = _payload(2 * (1 << 20), seed=20)
+        entry = filer_server.write_file("/durable/d.bin", data)
+        assert filer_server.read_entry_bytes(entry) == data
+        assert seen and all(seen)  # every chunk upload asked for fsync
+        seen.clear()
+        filer_server.write_file("/stream/nd.bin", data)
+        assert seen and not any(seen)  # other prefixes stay async
+    finally:
+        filer_server.conf.delete("/durable/")
+
+
+def test_volume_write_needle_sync_fsyncs(tmp_path, monkeypatch):
+    import os as _os
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 7)
+    calls = []
+    real_fsync = _os.fsync
+    monkeypatch.setattr(_os, "fsync", lambda fd: (calls.append(fd),
+                                                  real_fsync(fd))[1])
+    v.write_needle(Needle(id=1, cookie=1, data=b"async"), sync=False)
+    assert not calls
+    v.write_needle(Needle(id=2, cookie=1, data=b"durable"), sync=True)
+    assert calls
+    v.close()
+
+
+# -- instrumentation ---------------------------------------------------------
+
+def test_chunk_plane_metrics_move_and_drain(filer_server):
+    from seaweedfs_tpu.stats import (FILER_CHUNK_FETCH_SECONDS,
+                                     FILER_CHUNK_UPLOAD_SECONDS,
+                                     FILER_INFLIGHT_CHUNKS)
+
+    up0 = FILER_CHUNK_UPLOAD_SECONDS._totals.get((), 0)
+    data = _payload(2 << 20, seed=10)
+    entry = filer_server.write_file("/stream/metrics.bin", data)
+    assert FILER_CHUNK_UPLOAD_SECONDS._totals.get((), 0) >= up0 + 2
+    # cold fetch (bypass caches) moves the fetch histogram
+    filer_server.chunk_cache._mem.clear()
+    filer_server.chunk_cache._mem_bytes = 0
+    f0 = FILER_CHUNK_FETCH_SECONDS._totals.get((), 0)
+    assert filer_server.read_entry_bytes(entry) == data
+    assert FILER_CHUNK_FETCH_SECONDS._totals.get((), 0) >= f0 + 2
+    # the inflight gauge drains back to zero on both ops
+    assert FILER_INFLIGHT_CHUNKS.value("upload") == 0
+    assert FILER_INFLIGHT_CHUNKS.value("fetch") == 0
+
+
+# -- S3: streaming PUT/GET ---------------------------------------------------
+
+def test_s3_streaming_put_and_get(s3):
+    gw, base = s3
+    requests.put(f"{base}/strm", timeout=10)
+    data = _payload(5 * (1 << 20) + 17, seed=11)
+    r = requests.put(f"{base}/strm/big.bin", data=data, timeout=60)
+    assert r.status_code == 200
+    assert r.headers["ETag"] == f'"{hashlib.md5(data).hexdigest()}"'
+    got = requests.get(f"{base}/strm/big.bin", timeout=60)
+    assert got.content == data
+    rng = requests.get(f"{base}/strm/big.bin",
+                       headers={"Range": "bytes=1048570-4194310"},
+                       timeout=30)
+    assert rng.status_code == 206
+    assert rng.content == data[1048570:4194311]
+
+
+def test_s3_streaming_put_sha_mismatch_aborts(s3, filer_server):
+    """A wrong x-amz-content-sha256 on a streamed PUT aborts BEFORE the
+    entry commits (incremental digest check)."""
+    gw, base = s3
+    requests.put(f"{base}/strm", timeout=10)
+    data = _payload(3 << 20, seed=12)
+    r = requests.put(f"{base}/strm/bad.bin", data=data,
+                     headers={"x-amz-content-sha256": "0" * 64},
+                     timeout=60)
+    assert r.status_code == 400
+    assert "XAmzContentSHA256Mismatch" in r.text
+    assert filer_server.filer.find_entry("/buckets/strm", "bad.bin") is None
+
+
+# -- S3: copy by chunk reference ---------------------------------------------
+
+def test_s3_copy_object_by_reference(s3, filer_server):
+    gw, base = s3
+    requests.put(f"{base}/cref", timeout=10)
+    data = _payload(3 * (1 << 20) + 5, seed=13)
+    requests.put(f"{base}/cref/src.bin", data=data, timeout=60)
+    src = filer_server.filer.find_entry("/buckets/cref", "src.bin")
+    r = requests.put(f"{base}/cref/dst.bin",
+                     headers={"x-amz-copy-source": "/cref/src.bin"},
+                     timeout=30)
+    assert r.status_code == 200 and "<CopyObjectResult>" in r.text
+    dst = filer_server.filer.find_entry("/buckets/cref", "dst.bin")
+    # zero bytes moved: the copy references the SAME blobs
+    assert [c.file_id for c in dst.chunks] == \
+        [c.file_id for c in src.chunks]
+    assert bytes(dst.attributes.md5) == bytes(src.attributes.md5)
+    # the source's deletion must NOT GC the copy's shared chunks
+    requests.delete(f"{base}/cref/src.bin", timeout=10)
+    time.sleep(0.3)  # chunk GC is async — give a wrong delete time to land
+    got = requests.get(f"{base}/cref/dst.bin", timeout=30)
+    assert got.content == data
+    # ... and deleting the last reference actually frees the blobs
+    deleted = []
+    real = filer_server.filer.chunk_deleter
+    filer_server.filer.chunk_deleter = lambda fids: deleted.extend(fids)
+    try:
+        requests.delete(f"{base}/cref/dst.bin", timeout=10)
+    finally:
+        filer_server.filer.chunk_deleter = real
+    assert set(deleted) == {c.file_id for c in dst.chunks}
+
+
+def test_s3_upload_part_copy_by_reference(s3, filer_server):
+    gw, base = s3
+    requests.put(f"{base}/pref", timeout=10)
+    data = _payload(4 << 20, seed=14)  # 4 chunks of 1 MiB
+    requests.put(f"{base}/pref/src.bin", data=data, timeout=60)
+    src = filer_server.filer.find_entry("/buckets/pref", "src.bin")
+    src_fids = {c.file_id for c in src.chunks}
+    r = requests.post(f"{base}/pref/dst.bin?uploads", timeout=10)
+    upload_id = r.text.split("<UploadId>")[1].split("<")[0]
+    # chunk-aligned range: pure reference clone, no data chunk created
+    r = requests.put(
+        f"{base}/pref/dst.bin?partNumber=1&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/pref/src.bin",
+                 "x-amz-copy-source-range":
+                     f"bytes={1 << 20}-{(3 << 20) - 1}"},
+        timeout=30)
+    assert r.status_code == 200, r.text
+    updir = f"/buckets/pref/.uploads/{upload_id}"
+    part = filer_server.filer.find_entry(updir, "00001.part")
+    assert [c.file_id for c in part.chunks] == \
+        [c.file_id for c in src.chunks[1:3]]
+    assert [c.offset for c in part.chunks] == [0, 1 << 20]
+    # sub-chunk range: head/tail fall back to data copy, middle refs
+    r = requests.put(
+        f"{base}/pref/dst.bin?partNumber=2&uploadId={upload_id}",
+        headers={"x-amz-copy-source": "/pref/src.bin",
+                 "x-amz-copy-source-range":
+                     f"bytes=100-{(2 << 20) + 99}"},
+        timeout=30)
+    assert r.status_code == 200, r.text
+    part2 = filer_server.filer.find_entry(updir, "00002.part")
+    ref2 = [c.file_id for c in part2.chunks if c.file_id in src_fids]
+    new2 = [c.file_id for c in part2.chunks if c.file_id not in src_fids]
+    assert ref2 == [src.chunks[1].file_id]  # the one whole chunk inside
+    assert len(new2) == 2  # sub-chunk head + tail moved as data
+    xml = ("<CompleteMultipartUpload>"
+           "<Part><PartNumber>1</PartNumber></Part>"
+           "<Part><PartNumber>2</PartNumber></Part>"
+           "</CompleteMultipartUpload>")
+    r = requests.post(f"{base}/pref/dst.bin?uploadId={upload_id}",
+                      data=xml, timeout=10)
+    assert r.status_code == 200, r.text
+    got = requests.get(f"{base}/pref/dst.bin", timeout=60)
+    want = data[1 << 20:3 << 20] + data[100:(2 << 20) + 100]
+    assert got.content == want
+    # source delete leaves the completed object intact (refcounts)
+    requests.delete(f"{base}/pref/src.bin", timeout=10)
+    time.sleep(0.3)
+    assert requests.get(f"{base}/pref/dst.bin",
+                        timeout=60).content == want
