@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -83,6 +83,16 @@ bench-read:
 # records filer_put_MBps / s3_get_cold_MBps in the artifact
 bench-filer:
 	JAX_PLATFORMS=cpu python bench.py --filer-only
+
+# multi-tenant isolation gate on a separate-process cluster: an
+# antagonist tenant saturates bulk ingest + bulk GET while a
+# maintenance-class storm runs; the victim tenant's paced read p99 must
+# stay <= 3x its solo p99 and its goodput >= 50% of solo with QoS on,
+# the SAME schedule must violate that bound with the policy
+# hot-disabled, and shed requests answer 503 + Retry-After counted in
+# SeaweedFS_qos_requests_total{tenant,outcome="shed"}
+bench-qos:
+	JAX_PLATFORMS=cpu python bench.py --qos-only
 
 smoke:
 	python bench.py --smoke

@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import shutil
 import statistics
 import sys
@@ -652,7 +653,8 @@ def bench_s3(out: dict, obj_mb: int = 24) -> None:
 
 
 def _spawn_procs_cluster(tmp_prefix: str, volume_size_mb: int,
-                         vol_max: int, extra_env: "dict | None" = None):
+                         vol_max: int, extra_env: "dict | None" = None,
+                         extra_volume_args: "list | None" = None):
     """Separate-process master + volume pair (CPU-only children), waited
     until both answer HTTP. Returns (procs, tmp, mport, mhttp, vport);
     tear down with _stop_procs_cluster(procs, tmp)."""
@@ -687,7 +689,8 @@ def _spawn_procs_cluster(tmp_prefix: str, volume_size_mb: int,
             [sys.executable, "-m", "seaweedfs_tpu", "volume",
              "-port", str(vport), "-grpcPort", str(vgrpc),
              "-mserver", f"127.0.0.1:{mport}", "-dir", tmp,
-             "-max", str(vol_max), "-coder", "numpy"],
+             "-max", str(vol_max), "-coder", "numpy"]
+            + list(extra_volume_args or []),
             cwd=repo_root, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
         deadline = time.time() + 45
@@ -1273,6 +1276,277 @@ def bench_read_smoke(out: dict) -> None:
         _stop_procs_cluster(procs, tmp)
 
 
+_QOS_BENCH_POLICY = {
+    # victim: unthrottled, heavy WFQ weight — its latency is the gate
+    # antag: tight rate + byte buckets (its bulk frames are 64 KB
+    # needles; 4 MB/s admits well under one 8 MB frame per second)
+    # maintenance class: capped rps AND it yields to queued foreground
+    "classes": {"interactive": {"max_wait_s": 2.0},
+                "ingest": {"max_wait_s": 2.0},
+                "maintenance": {"max_wait_s": 2.0, "rps": 3}},
+    "default": {"weight": 10},
+    "tenants": {"victim": {"weight": 100},
+                "antag": {"weight": 10, "rps": 10, "burst": 4,
+                          "bytes_per_s": "2MB", "burst_bytes": "4MB"}},
+}
+
+
+def bench_qos_smoke(out: dict) -> None:
+    """`make bench-qos`: the multi-tenant isolation gate on a separate-
+    process topology. A victim tenant issues paced interactive reads
+    while an antagonist tenant saturates bulk ingest + framed bulk GET
+    and a maintenance-class storm hammers reads — the ISSUE-12
+    acceptance: with QoS ON the victim's read p99 stays <= 3x its solo
+    p99 and its goodput >= 50% of its solo rate; hot-disabling the
+    policy (POST /debug/qos) on the SAME cluster and re-running the
+    SAME schedule must demonstrably violate that bound; shed requests
+    answer 503 + Retry-After and are counted per-tenant. A
+    deterministic 10 ms store.read delay (the bench-filer trick) models
+    the disk so the baseline doesn't float with the host."""
+    import threading
+
+    from seaweedfs_tpu import qos as qos_mod
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+
+    policy_path = os.path.join(tempfile.mkdtemp(prefix="swtpu_qospol_"),
+                               "policy.json")
+    with open(policy_path, "w", encoding="utf-8") as f:
+        json.dump({**_QOS_BENCH_POLICY, "enabled": False}, f)
+    procs, tmp, mport, mhttp, vport = _spawn_procs_cluster(
+        "swtpu_bench_qos_", volume_size_mb=96, vol_max=24,
+        # cache off: victim reads must pay the deterministic disk delay
+        # every time, or the contended phases measure cache luck
+        extra_env={"SWTPU_READ_CACHE_MB": "0"},
+        # the policy FILE is attached (mtime hot-reload path) but holds
+        # a disabled doc at spawn so the fixture data loads unthrottled;
+        # the bench enables enforcement via POST /debug/qos — the same
+        # hot-retune path an operator uses mid-incident
+        extra_volume_args=["-qosPolicy", policy_path])
+    stop_antag = threading.Event()
+    antag_threads: "list[threading.Thread]" = []
+    try:
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        mc.wait_connected()
+        # -- data: small victim needles, LARGE antagonist needles (the
+        # antagonist's 8 MB response frames are what saturate the loop
+        # and read pool with QoS off)
+        victim_payloads = [b"v%05d-" % i + b"x" * 2000 for i in range(200)]
+        victim_fids = [r.fid for r in operation.submit_batch(
+            mc, victim_payloads, collection="victim")]
+        antag_payloads = [b"a%05d-" % i + b"y" * 32768 for i in range(512)]
+        antag_fids = [r.fid for r in operation.submit_batch(
+            mc, antag_payloads, collection="antag")]
+        # deterministic slow disk: every store read costs 20 ms
+        http_util.get(f"http://127.0.0.1:{vport}/debug/failpoints",
+                      params={"name": "store.read",
+                              "spec": "pct:100:delay:0.02"})
+        # fixtures are in: switch enforcement ON (hot retune over HTTP)
+        r = http_util.post(f"http://127.0.0.1:{vport}/debug/qos",
+                           body=json.dumps(_QOS_BENCH_POLICY).encode())
+        assert r.ok, r.status
+
+        # -- victim: paced open-loop reads through a small worker pool;
+        # falling behind the pace (because every read is stuck behind
+        # antagonist frames) is exactly the goodput loss we measure
+        def victim_phase(duration_s: float, pace_s: float) -> dict:
+            n = int(duration_s / pace_s)
+            lat: "list[float]" = []
+            errors = [0]
+            lock = threading.Lock()
+            idx = [0]
+            t0 = time.monotonic()
+
+            def worker(seed: int) -> None:
+                rng = random.Random(seed)
+                while True:
+                    with lock:
+                        i = idx[0]
+                        if i >= n:
+                            return
+                        idx[0] += 1
+                    delay = t0 + i * pace_s - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    f = rng.randrange(len(victim_fids))
+                    s = time.monotonic()
+                    try:
+                        data = operation.read(mc, victim_fids[f])
+                        assert data == victim_payloads[f]
+                    except Exception:  # noqa: BLE001
+                        errors[0] += 1
+                        continue
+                    with lock:
+                        lat.append(time.monotonic() - s)
+
+            ts = [threading.Thread(target=worker, args=(1000 + s,))
+                  for s in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall = time.monotonic() - t0
+            lat.sort()
+            return {"n": n, "ok": len(lat), "errors": errors[0],
+                    "goodput_rps": len(lat) / wall,
+                    "p50_ms": (lat[len(lat) // 2] * 1e3) if lat else 0.0,
+                    "p99_ms": (lat[int(len(lat) * 0.99)] * 1e3)
+                    if lat else float("inf")}
+
+        # -- the antagonist schedule: bulk ingest + bulk GET + a
+        # maintenance-class read storm, all free-running until stopped
+        def antag_bulk_reader(seed: int) -> None:
+            rng = random.Random(seed)
+            while not stop_antag.is_set():
+                idxs = [rng.randrange(len(antag_fids)) for _ in range(128)]
+                try:
+                    operation.read_batch(mc, [antag_fids[i] for i in idxs])
+                except Exception:  # noqa: BLE001 — sheds/timeouts expected
+                    stop_antag.wait(0.05)
+
+        def antag_bulk_writer(seed: int) -> None:
+            rng = random.Random(seed)
+            while not stop_antag.is_set():
+                frames = [b"w" * 32768 for _ in range(32)]
+                try:
+                    operation.submit_batch(mc, frames, collection="antag")
+                except Exception:  # noqa: BLE001
+                    stop_antag.wait(0.05)
+                rng.random()
+
+        def maintenance_storm(seed: int) -> None:
+            rng = random.Random(seed)
+            with qos_mod.tagged(qos_mod.CLASS_MAINTENANCE):
+                while not stop_antag.is_set():
+                    i = rng.randrange(len(antag_fids))
+                    try:
+                        operation.read(mc, antag_fids[i])
+                    except Exception:  # noqa: BLE001
+                        stop_antag.wait(0.05)
+
+        def start_antagonists() -> None:
+            for i in range(10):
+                antag_threads.append(threading.Thread(
+                    target=antag_bulk_reader, args=(2000 + i,)))
+            for i in range(2):
+                antag_threads.append(threading.Thread(
+                    target=antag_bulk_writer, args=(3000 + i,)))
+            for i in range(6):
+                antag_threads.append(threading.Thread(
+                    target=maintenance_storm, args=(4000 + i,)))
+            for t in antag_threads:
+                t.start()
+
+        def stop_antagonists() -> None:
+            stop_antag.set()
+            for t in antag_threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in antag_threads), \
+                "antagonist thread hung"
+            antag_threads.clear()
+            stop_antag.clear()
+
+        pace_s, window_s = 1 / 20.0, 8.0
+        solo = victim_phase(4.0, pace_s)
+        log(f"qos solo: p99 {solo['p99_ms']:.1f} ms, "
+            f"{solo['goodput_rps']:.1f} reads/s")
+        assert solo["ok"] > 0 and solo["errors"] == 0, solo
+
+        start_antagonists()
+        time.sleep(1.0)  # let the storm ramp before measuring
+        qos_on = victim_phase(window_s, pace_s)
+        # while the storm still runs: shed probe — a burst of antag-
+        # tenant reads must see 503 + Retry-After (real-S3 SlowDown
+        # semantics at the volume tier)
+        shed_hits = []
+
+        def shed_probe(seed: int) -> None:
+            rng = random.Random(seed)
+            for _ in range(6):
+                r = http_util.get(
+                    f"http://127.0.0.1:{vport}/"
+                    f"{antag_fids[rng.randrange(len(antag_fids))]}",
+                    timeout=10)
+                if r.status == 503 and r.headers.get("retry-after"):
+                    shed_hits.append(r.headers.get("retry-after"))
+        probes = [threading.Thread(target=shed_probe, args=(5000 + i,))
+                  for i in range(3)]
+        for t in probes:
+            t.start()
+        for t in probes:
+            t.join()
+        stop_antagonists()
+        log(f"qos ON:   p99 {qos_on['p99_ms']:.1f} ms, "
+            f"{qos_on['goodput_rps']:.1f} reads/s, "
+            f"{len(shed_hits)} shed probes saw Retry-After")
+
+        def metric_sum(name: str, *must_contain: str) -> float:
+            body = http_util.get(f"http://127.0.0.1:{vport}/metrics",
+                                 timeout=5).content.decode()
+            total = 0.0
+            for line in body.splitlines():
+                if line.startswith(name) and \
+                        all(m in line for m in must_contain):
+                    total += float(line.split()[-1])
+            return total
+
+        shed_antag = metric_sum("SeaweedFS_qos_requests_total",
+                                'tenant="antag"', 'outcome="shed"')
+        # hot-disable the policy on the SAME cluster, re-run the SAME
+        # storm: the bound must now break (that delta IS the isolation
+        # win this plane exists for)
+        r = http_util.post(f"http://127.0.0.1:{vport}/debug/qos",
+                           body=json.dumps({"enabled": False}).encode())
+        assert r.ok, r.status
+        start_antagonists()
+        time.sleep(1.0)
+        qos_off = victim_phase(window_s, pace_s)
+        stop_antagonists()
+        log(f"qos OFF:  p99 {qos_off['p99_ms']:.1f} ms, "
+            f"{qos_off['goodput_rps']:.1f} reads/s")
+
+        out["qos_solo_p99_ms"] = round(solo["p99_ms"], 1)
+        out["qos_on_p99_ms"] = round(qos_on["p99_ms"], 1)
+        out["qos_off_p99_ms"] = round(qos_off["p99_ms"], 1)
+        out["qos_solo_goodput_rps"] = round(solo["goodput_rps"], 1)
+        out["qos_on_goodput_rps"] = round(qos_on["goodput_rps"], 1)
+        out["qos_off_goodput_rps"] = round(qos_off["goodput_rps"], 1)
+        out["qos_shed_probe_hits"] = len(shed_hits)
+        out["qos_antag_sheds"] = int(shed_antag)
+        out["qos_topology"] = (
+            "separate-process master+volume, -qosPolicy file, 20 ms "
+            "deterministic store.read delay, read cache off; antagonist "
+            "= 10 bulk-GET (128x32KB frames) + 2 bulk-PUT + 6 "
+            "maintenance-tagged readers; victim = 20 paced reads/s")
+        # -- the acceptance gates -------------------------------------
+        p99_bound = 3.0 * solo["p99_ms"]
+        goodput_bound = 0.5 * solo["goodput_rps"]
+        assert qos_on["p99_ms"] <= p99_bound, (
+            f"QoS ON: victim p99 {qos_on['p99_ms']:.1f} ms > 3x solo "
+            f"({p99_bound:.1f} ms) — isolation failed")
+        assert qos_on["goodput_rps"] >= goodput_bound, (
+            f"QoS ON: victim goodput {qos_on['goodput_rps']:.1f}/s < "
+            f"half solo ({goodput_bound:.1f}/s) — isolation failed")
+        assert (qos_off["p99_ms"] > p99_bound
+                or qos_off["goodput_rps"] < goodput_bound), (
+            "QoS OFF phase stayed within the bound "
+            f"(p99 {qos_off['p99_ms']:.1f} ms vs {p99_bound:.1f}, "
+            f"goodput {qos_off['goodput_rps']:.1f} vs "
+            f"{goodput_bound:.1f}) — the schedule isn't adversarial "
+            "enough to prove the plane does anything")
+        assert shed_hits, "no shed probe saw a 503 with Retry-After"
+        assert shed_antag > 0, "no per-tenant shed counted for 'antag'"
+        mc.stop()
+        out["bench_qos_smoke"] = "ok"
+    finally:
+        stop_antag.set()
+        for t in antag_threads:
+            t.join(timeout=10)
+        _stop_procs_cluster(procs, tmp)
+        shutil.rmtree(os.path.dirname(policy_path), ignore_errors=True)
+
+
 def bench_cluster(out: dict, n_files: int, conc: int) -> None:
     import socket
 
@@ -1456,6 +1730,13 @@ def main() -> None:
                          "daemons, asserts parallel chunk fan-out >= 2x "
                          "serial PUT and a 256 MB streamed PUT+GET grows "
                          "filer RSS < half the object")
+    ap.add_argument("--qos-only", action="store_true", dest="qos_only",
+                    help="run only the multi-tenant isolation smoke "
+                         "(make bench-qos): antagonist bulk traffic + "
+                         "maintenance storm vs a paced victim tenant; "
+                         "victim p99 <= 3x solo and goodput >= 50% with "
+                         "QoS on, bound demonstrably violated with QoS "
+                         "hot-disabled, sheds answer 503 + Retry-After")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -1493,6 +1774,12 @@ def main() -> None:
         out_fl: dict = {"metric": "bench_filer_smoke"}
         bench_filer_smoke(out_fl)
         print(json.dumps(out_fl))
+        return
+    if args.qos_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_q: dict = {"metric": "bench_qos_smoke"}
+        bench_qos_smoke(out_q)
+        print(json.dumps(out_q))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

@@ -110,6 +110,10 @@ def _add_volume_flags(p):
     p.add_argument("-index", default="memory",
                    help="needle map kind: memory|leveldb|sorted_file "
                         "(reference -index flag)")
+    p.add_argument("-qosPolicy", default="",
+                   help="multi-tenant QoS policy JSON file (tenant = "
+                        "collection); hot-reloaded on mtime change, "
+                        "retunable via POST /debug/qos")
     _add_security_flags(p)
 
 
@@ -182,7 +186,8 @@ def run_volume(argv):
     vs = VolumeServer(store, opt.mserver, ip=opt.ip, port=opt.port,
                       grpc_port=opt.grpcPort or None,
                       data_center=opt.dataCenter, rack=opt.rack,
-                      guard=_make_guard(opt))
+                      guard=_make_guard(opt),
+                      qos_policy=opt.qosPolicy or None)
     vs.start()
     _wait_forever()
 
@@ -253,7 +258,8 @@ def run_server(argv):
 
 def run_shell(argv):
     from .shell import (ec_commands, fs_commands,  # noqa: F401 (register)
-                        mq_commands, remote_commands, volume_commands)
+                        mq_commands, qos_commands, remote_commands,
+                        volume_commands)
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")
@@ -408,6 +414,13 @@ def run_s3_standalone(argv):
             print("s3: circuit breaker loaded from filer "
                   "/etc/s3/circuit_breaker.json", file=sys.stderr)
 
+    def _load_qos_policy():
+        entry = fc.filer.find_entry("/etc/qos", "policy.json")
+        if entry is not None:
+            gw.qos.load(_json.loads(fc.read_entry_bytes(entry)))
+            print("s3: qos policy loaded from filer "
+                  "/etc/qos/policy.json", file=sys.stderr)
+
     # cluster config lives in the filer and hot-reloads on change
     # (reference auth_credentials_subscribe.go + s3api_circuit_breaker.go);
     # each loader fails independently so a bad identity file can't leave
@@ -422,6 +435,10 @@ def run_s3_standalone(argv):
             _load_circuit_breaker()
         except Exception as e:  # noqa: BLE001
             print(f"s3: circuit breaker {stage}: {e}", file=sys.stderr)
+        try:
+            _load_qos_policy()
+        except Exception as e:  # noqa: BLE001
+            print(f"s3: qos policy {stage}: {e}", file=sys.stderr)
 
     _load_all("load")
 

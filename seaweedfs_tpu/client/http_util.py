@@ -238,6 +238,13 @@ def request(method: str, url: str, body: bytes | None = None,
     traceparent = tracing.injectable()
     if traceparent:
         head += f"{tracing.TRACEPARENT_HEADER}: {traceparent}\r\n"
+    # QoS class tag: a maintenance-tagged flow (repair executor,
+    # replication catch-up) announces itself so enforcement points
+    # schedule it behind foreground work; untagged adds nothing
+    from .. import qos as _qos
+    qos_class = _qos.injectable()
+    if qos_class:
+        head += f"{_qos.QOS_HEADER}: {qos_class}\r\n"
     if body or method in ("POST", "PUT"):
         head += f"Content-Length: {len(body)}\r\n"
     req_bytes = head.encode("latin1") + b"\r\n" + body

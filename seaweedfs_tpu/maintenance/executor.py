@@ -255,11 +255,17 @@ class RepairExecutor:
 
     def _run_item(self, it: RepairItem, summary: dict,
                   lock: threading.Lock) -> None:
-        from .. import tracing
+        from .. import qos, tracing
         from ..ops import events
-        with tracing.start_span(f"repair.{it.action}", component="repair",
-                                attrs={"vid": it.vid,
-                                       "severity": it.severity}) as sp:
+        # repair traffic is maintenance-class AT THE SOURCE: the tag
+        # rides every HTTP header / gRPC metadata hop below (shard
+        # fetches, volume copies, replica writes), so enforcement
+        # points anywhere in the cluster schedule this work BEHIND
+        # foreground reads and ingest instead of beside them
+        with qos.tagged(qos.CLASS_MAINTENANCE), tracing.start_span(
+                f"repair.{it.action}", component="repair",
+                attrs={"vid": it.vid,
+                       "severity": it.severity}) as sp:
             events.emit("repair.start", action=it.action, kind=it.kind,
                         vid=it.vid, severity=it.severity,
                         distance=it.distance)

@@ -78,12 +78,26 @@ class S3Gateway:
     def __init__(self, filer_server, ip: str = "127.0.0.1", port: int = 8333,
                  iam_config: dict | None = None,
                  circuit_breaker: dict | None = None,
+                 qos_policy: "dict | str | None" = None,
                  allowed_origins: str = "*"):
+        from ..qos import QosScheduler
         from .circuit_breaker import CircuitBreaker
         self.fs = filer_server  # in-process FilerServer
         self.ip, self.port = ip, port
         self.iam = IdentityAccessManagement(iam_config)
         self.breaker = CircuitBreaker(circuit_breaker)
+        # multi-tenant QoS (qos/): tenant = the request's access key
+        # (falling back to the bucket for anonymous traffic), classes
+        # from the verb. The breaker's in-flight count/byte caps and
+        # the scheduler's rate/fairness decisions fold into ONE
+        # admission path in _route, both answering 503 SlowDown +
+        # Retry-After. Policy doc hot-reloads from the filer at
+        # /etc/qos/policy.json (standalone gateway) or via load().
+        self.qos = QosScheduler(name=f"s3-{port}")
+        if isinstance(qos_policy, str) and qos_policy:
+            self.qos.attach_file(qos_policy)
+        elif qos_policy:
+            self.qos.load(qos_policy)
         self.allowed_origins = allowed_origins
         self._stop = threading.Event()
         self._http_thread: threading.Thread | None = None
@@ -104,6 +118,7 @@ class S3Gateway:
 
     def stop(self) -> None:
         self._stop.set()
+        self.qos.close()
 
     # -- HTTP plumbing -------------------------------------------------------
     def _run_http(self) -> None:
@@ -200,6 +215,17 @@ class S3Gateway:
             return web.json_response(
                 locktrack.debug_locks_payload(dict(request.query)))
 
+        async def debug_qos(request):
+            # live scheduler dump, operator-gated like the other
+            # /debug surfaces (per-tenant counters are operator data).
+            # Retunes land via the /etc/qos/policy.json watcher (or
+            # qos.load() for the embedded gateway), not this endpoint —
+            # the gate is deliberately GET-only.
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            return web.json_response(self.qos.debug_payload())
+
         async def debug_profile(request):
             # pprof-style sampler (utils/profiling.py), operator-gated
             # like /debug/traces (stacks leak paths and peer addresses);
@@ -230,6 +256,7 @@ class S3Gateway:
             app.router.add_route("*", "/debug/traces", debug_traces)
             app.router.add_route("*", "/debug/events", debug_events)
             app.router.add_route("*", "/debug/locks", debug_locks)
+            app.router.add_route("*", "/debug/qos", debug_qos)
             app.router.add_route("*", "/debug/profile", debug_profile)
             app.router.add_route("*", "/metrics", metrics)
             app.router.add_route("*", "/{tail:.*}", dispatch)
@@ -305,34 +332,99 @@ class S3Gateway:
             return False
         return length > getattr(self.fs, "chunk_size", 4 << 20)
 
+    @staticmethod
+    def _qos_tenant(request, bucket: str) -> str:
+        """Tenant identity at the gateway: the request's ACCESS KEY,
+        parsed cheaply from whichever auth form it arrived in (SigV4
+        Credential scope, presigned X-Amz-Credential, legacy V2 header
+        or query). Verification happens later in _authorize — for
+        throttle accounting a forged key id only picks whose bucket the
+        forger drains. Anonymous traffic falls back to the bucket name
+        (the owner's resource is what it competes for)."""
+        auth = request.headers.get("Authorization", "")
+        if "Credential=" in auth:  # AWS4-HMAC-SHA256 ... Credential=AK/...
+            return auth.split("Credential=", 1)[1].split("/", 1)[0]
+        if auth.startswith("AWS "):  # V2: "AWS AKID:signature"
+            return auth[4:].split(":", 1)[0]
+        q = request.query
+        cred = q.get("X-Amz-Credential", "")
+        if cred:
+            return urllib.parse.unquote(cred).split("/", 1)[0]
+        if q.get("AWSAccessKeyId"):
+            return q["AWSAccessKeyId"]
+        return bucket or "anonymous"
+
     async def _route(self, request):
+        from .. import qos as qos_mod
         path = urllib.parse.unquote(request.path)
         parts = path.lstrip("/").split("/", 1)
         bucket = parts[0]
         key = parts[1] if len(parts) > 1 else ""
         q = dict(request.query)
         action = self._classify_action(request.method, q, bucket, key)
-        with self.breaker.acquire(action, bucket):
-            if self._stream_put_ok(request, bucket, key, q):
-                self._authorize(request, bucket, key, q, None, action)
-                return await self._put_streaming(request, bucket, key, q)
-            body = await request.read()
-            # browser post-policy uploads carry their signature IN the
-            # form; post_policy_upload authorizes from the policy fields
-            is_post_policy = (request.method == "POST" and bucket and not key
-                              and "delete" not in q
-                              and request.content_type.startswith(
-                                  "multipart/form-data"))
-            if not is_post_policy:
-                seed_ctx = self._authorize(request, bucket, key, q, body,
-                                           action)
-                body = self._maybe_decode_chunked(request, body, seed_ctx)
+        try:
+            nbytes = int(request.headers.get("Content-Length") or 0)
+        except ValueError:
+            nbytes = 0
+        # ONE admission decision: the QoS scheduler's rate/fairness/
+        # priority verdict, then the breaker's in-flight count+byte
+        # caps. Either refusal is a 503 SlowDown + Retry-After.
+        grant = None
+        if self.qos.enabled:
+            is_read = request.method in ("GET", "HEAD")
+            klass = qos_mod.class_from_headers(
+                request.headers,
+                qos_mod.CLASS_INTERACTIVE if is_read
+                else qos_mod.CLASS_INGEST)
+            try:
+                grant = await self.qos.admit(
+                    self._qos_tenant(request, bucket), klass, cost=nbytes)
+            except qos_mod.QosShed as e:
+                from .circuit_breaker import ErrTooManyRequests
+                raise ErrTooManyRequests(
+                    int(e.retry_after_header)) from None
+        try:
+            with self.breaker.acquire(action, bucket, nbytes):
+                resp = await self._route_admitted(request, bucket, key, q,
+                                                  action)
+                if grant is not None and request.method == "GET":
+                    body = getattr(resp, "body", None)
+                    if body:
+                        grant.charge(len(body))
+                    else:
+                        # streamed large-object GETs carry no .body —
+                        # charge the declared length, or the biggest
+                        # reads would be exactly the ones that bypass
+                        # every byte-rate limit
+                        length = getattr(resp, "content_length", None)
+                        if length:
+                            grant.charge(int(length))
+                return resp
+        finally:
+            if grant is not None:
+                grant.release()
 
-            if not bucket:
-                return self.list_buckets()
-            if not key:
-                return await self._route_bucket(request, bucket, q, body)
-            return await self._route_object(request, bucket, key, q, body)
+    async def _route_admitted(self, request, bucket, key, q, action):
+        if self._stream_put_ok(request, bucket, key, q):
+            self._authorize(request, bucket, key, q, None, action)
+            return await self._put_streaming(request, bucket, key, q)
+        body = await request.read()
+        # browser post-policy uploads carry their signature IN the
+        # form; post_policy_upload authorizes from the policy fields
+        is_post_policy = (request.method == "POST" and bucket and not key
+                          and "delete" not in q
+                          and request.content_type.startswith(
+                              "multipart/form-data"))
+        if not is_post_policy:
+            seed_ctx = self._authorize(request, bucket, key, q, body,
+                                       action)
+            body = self._maybe_decode_chunked(request, body, seed_ctx)
+
+        if not bucket:
+            return self.list_buckets()
+        if not key:
+            return await self._route_bucket(request, bucket, q, body)
+        return await self._route_object(request, bucket, key, q, body)
 
     def _maybe_decode_chunked(self, request, body, seed_ctx):
         """Strip + verify aws-chunked framing on streaming-signed uploads
@@ -1775,4 +1867,11 @@ def _error_response(e: S3Error, resource: str):
     ET.SubElement(root, "Code").text = e.code
     ET.SubElement(root, "Message").text = e.message
     ET.SubElement(root, "Resource").text = resource
-    return _xml_response(root, e.status)
+    resp = _xml_response(root, e.status)
+    if e.status == 503:
+        # SlowDown answers carry Retry-After (the qos scheduler's
+        # bucket ETA when admission refused; 1s for plain breaker
+        # trips) so SDK backoff has a server-provided hint
+        resp.headers["Retry-After"] = str(
+            getattr(e, "retry_after_s", None) or 1)
+    return resp

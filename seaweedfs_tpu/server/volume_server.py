@@ -31,6 +31,23 @@ from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 log = logger("volume")
 
 
+def _maintenance_tagged(fn):
+    """Tag a gRPC handler's whole execution maintenance-class: these
+    RPCs exist ONLY as repair/replication/rebalance machinery, so their
+    nested reads (ranged survivor fetches, CopyFile pulls from peers)
+    inherit the tag and yield to foreground work wherever they land —
+    even when an operator drives them by hand from the shell."""
+    import functools
+
+    from .. import qos as qos_mod
+
+    @functools.wraps(fn)
+    def wrapped(req, context):
+        with qos_mod.tagged(qos_mod.CLASS_MAINTENANCE):
+            return fn(req, context)
+    return wrapped
+
+
 def _ec_stage_fields(stats: dict) -> dict:
     """ec.encode.finish event fields from an encode pipeline stats dict:
     the fill/dispatch/drain/write stage split plus the overlap fraction, so
@@ -54,7 +71,8 @@ class VolumeServer:
                  data_center: str = "", rack: str = "",
                  pulse_seconds: float = 2.0, read_mode: str = "proxy",
                  guard=None, metrics_gateway: str = "",
-                 metrics_interval_s: int = 15):
+                 metrics_interval_s: int = 15,
+                 qos_policy: "dict | str | None" = None):
         self.store = store
         # optional push-gateway loop (reference -metricsPort push config);
         # started in start(), joined in stop() via the PushLoop handle
@@ -115,6 +133,18 @@ class VolumeServer:
         self._read_pool = ThreadPoolExecutor(
             max_workers=max(1, env_int("SWTPU_READ_THREADS", 8)),
             thread_name_prefix=f"vs-read-{port}")
+        # multi-tenant QoS plane (qos/): tenant = collection, classes
+        # interactive (GET) > ingest (PUT/DELETE) > maintenance (tagged
+        # repair/rebuild/copy traffic). A dict is a policy document; a
+        # string is a policy FILE hot-reloaded on mtime change
+        # (-qosPolicy); None/empty = admission disabled (zero-cost
+        # pass-through). Live state at /debug/qos, retune via POST.
+        from ..qos import QosScheduler
+        self.qos = QosScheduler(name=f"volume-{port}")
+        if isinstance(qos_policy, str) and qos_policy:
+            self.qos.attach_file(qos_policy)
+        elif qos_policy:
+            self.qos.load(qos_policy)
 
     @property
     def url(self) -> str:
@@ -165,6 +195,7 @@ class VolumeServer:
             self._grpc.stop(grace=0.5)
         self._ec_read_pool.shutdown(wait=False, cancel_futures=True)
         self._read_pool.shutdown(wait=False, cancel_futures=True)
+        self.qos.close()
         if self.read_cache is not None:
             self.read_cache.clear()
         self.store.close()
@@ -342,6 +373,34 @@ class VolumeServer:
                     attrs={"fid": request.path.lstrip("/"),
                            "server": self.url}) as sp:
                 try:
+                    # QoS admission: tenant = the fid's collection,
+                    # class from the verb unless the hop is tagged
+                    # (maintenance repair reads, class-inheriting
+                    # replica hops). Reads post-charge their response
+                    # bytes; replica hops charge but never shed.
+                    grant, qos_token = None, None
+                    if self.qos.enabled:
+                        from .. import qos as qos_mod
+                        is_read = request.method in ("GET", "HEAD")
+                        klass = qos_mod.class_from_headers(
+                            request.headers,
+                            qos_mod.CLASS_INTERACTIVE if is_read
+                            else qos_mod.CLASS_INGEST)
+                        try:
+                            grant = await self.qos.admit(
+                                self._qos_tenant_of_path(request.path),
+                                klass,
+                                cost=len(request.body or b""),
+                                no_shed=request.query.get("type")
+                                == "replicate")
+                        except qos_mod.QosShed as e:
+                            status = 503
+                            sp.set_attr("qos", "shed")
+                            return self._qos_shed_response(e)
+                        sp.set_attr("qos_class", klass)
+                        # the handler (and its replication fan-out)
+                        # inherits the admitted class
+                        qos_token = qos_mod.set_class(klass)
                     try:
                         if request.method in ("POST", "PUT"):
                             resp = await self._handle_write(request)
@@ -364,8 +423,16 @@ class VolumeServer:
                         log.error("http error: %s", e)
                         resp = json_response({"error": str(e)}, status=500)
                     status = resp.status
+                    if grant is not None and request.method in \
+                            ("GET", "HEAD") and resp.body:
+                        grant.charge(len(resp.body))
                     return resp
                 finally:
+                    if qos_token is not None:
+                        from .. import qos as qos_mod
+                        qos_mod.reset_class(qos_token)
+                    if grant is not None:
+                        grant.release()
                     sp.set_attr("status", status)
                     if status >= 500:
                         sp.set_error(f"HTTP {status}")
@@ -392,6 +459,34 @@ class VolumeServer:
             from ..utils import locktrack
             return json_response(
                 locktrack.debug_locks_payload(request.query))
+
+        def debug_qos(request):
+            """GET dumps live scheduler state (buckets, queues, per-
+            tenant counters); POST with a JSON policy document hot-
+            reloads it (the operator retune path the S3 breaker's
+            config reload established); GET ?reload=1 re-reads the
+            attached -qosPolicy file immediately. On a guarded cluster
+            the MUTATING forms demand write admission (whitelist/basic
+            auth/any valid cluster jwt) — a throttled tenant must not
+            be able to switch its own throttle off."""
+            if (request.method == "POST" or request.query.get("reload")) \
+                    and self.guard is not None:
+                ok, why = self.guard.check_write(request.remote or "",
+                                                 request.query,
+                                                 request.headers)
+                if not ok:
+                    return json_response({"error": why}, status=401)
+            if request.method == "POST":
+                try:
+                    doc = json.loads(request.body or b"{}")
+                    self.qos.load(doc)
+                except (ValueError, TypeError) as e:
+                    return json_response({"error": str(e)}, status=400)
+                return json_response({"ok": True,
+                                      "enabled": self.qos.enabled})
+            if request.query.get("reload"):
+                self.qos._reload_file(initial=True)
+            return json_response(self.qos.debug_payload())
 
         async def debug_profile(request):
             import contextvars
@@ -466,6 +561,24 @@ class VolumeServer:
                     attrs={"server": self.url,
                            "bytes": len(request.body or b"")}) as sp:
                 try:
+                    grant, qos_token = None, None
+                    if self.qos.enabled:
+                        from .. import qos as qos_mod
+                        klass = qos_mod.class_from_headers(
+                            request.headers, qos_mod.CLASS_INGEST)
+                        try:
+                            grant = await self.qos.admit(
+                                self._qos_tenant_of_query(request.query),
+                                klass,
+                                cost=len(request.body or b""),
+                                no_shed=request.query.get("type")
+                                == "replicate")
+                        except qos_mod.QosShed as e:
+                            status = 503
+                            sp.set_attr("qos", "shed")
+                            return self._qos_shed_response(e)
+                        sp.set_attr("qos_class", klass)
+                        qos_token = qos_mod.set_class(klass)
                     try:
                         resp = await self._handle_bulk(request, sp)
                     except KeyError as e:
@@ -478,6 +591,11 @@ class VolumeServer:
                     status = resp.status
                     return resp
                 finally:
+                    if qos_token is not None:
+                        from .. import qos as qos_mod
+                        qos_mod.reset_class(qos_token)
+                    if grant is not None:
+                        grant.release()
                     sp.set_attr("status", status)
                     if status >= 500:
                         sp.set_error(f"HTTP {status}")
@@ -496,6 +614,21 @@ class VolumeServer:
                     attrs={"server": self.url,
                            "bytes": len(request.body or b"")}) as sp:
                 try:
+                    grant, qos_token = None, None
+                    if self.qos.enabled:
+                        from .. import qos as qos_mod
+                        klass = qos_mod.class_from_headers(
+                            request.headers, qos_mod.CLASS_INTERACTIVE)
+                        try:
+                            grant = await self.qos.admit(
+                                self._qos_tenant_of_query(request.query),
+                                klass)
+                        except qos_mod.QosShed as e:
+                            status = 503
+                            sp.set_attr("qos", "shed")
+                            return self._qos_shed_response(e)
+                        sp.set_attr("qos_class", klass)
+                        qos_token = qos_mod.set_class(klass)
                     try:
                         resp = await self._handle_bulk_read(request, sp)
                     except KeyError as e:
@@ -506,8 +639,17 @@ class VolumeServer:
                         log.error("bulk-read http error: %s", e)
                         resp = json_response({"error": str(e)}, status=500)
                     status = resp.status
+                    if grant is not None and resp.body:
+                        # the assembled frame is the byte cost of a bulk
+                        # read — charged once known
+                        grant.charge(len(resp.body))
                     return resp
                 finally:
+                    if qos_token is not None:
+                        from .. import qos as qos_mod
+                        qos_mod.reset_class(qos_token)
+                    if grant is not None:
+                        grant.release()
                     sp.set_attr("status", status)
                     if status >= 500:
                         sp.set_error(f"HTTP {status}")
@@ -528,9 +670,46 @@ class VolumeServer:
         app.route("/debug/traces", debug_traces)
         app.route("/debug/events", debug_events)
         app.route("/debug/locks", debug_locks)
+        app.route("/debug/qos", debug_qos)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
                                client_max_size=256 << 20, logger=log)
+
+    # -- QoS helpers ---------------------------------------------------------
+    def _qos_tenant(self, vid: int) -> str:
+        """Tenant identity at the volume tier: the vid's collection
+        ('default' for the unnamed collection and unknown vids)."""
+        v = self.store.find_volume(vid)
+        if v is None:
+            ev = self.store.find_ec_volume(vid)
+            return (ev.collection or "default") if ev is not None \
+                else "default"
+        return v.collection or "default"
+
+    def _qos_tenant_of_path(self, path: str) -> str:
+        try:
+            vid = int(path.lstrip("/").split(",", 1)[0])
+        except ValueError:
+            return "default"
+        return self._qos_tenant(vid)
+
+    def _qos_tenant_of_query(self, query: dict) -> str:
+        try:
+            vid = int(query.get("vid", ""))
+        except ValueError:
+            return "default"
+        return self._qos_tenant(vid)
+
+    @staticmethod
+    def _qos_shed_response(e):
+        """503 + Retry-After, the volume-tier mirror of S3's SlowDown:
+        the client (or SDK) backs off for the bucket's ETA."""
+        from ..utils.fastweb import Response
+        return Response(
+            json.dumps({"error": str(e), "qos": "shed",
+                        "retryAfterSeconds": e.retry_after_header}).encode(),
+            status=503, content_type="application/json",
+            headers={"Retry-After": e.retry_after_header})
 
     def _read_body(self, request):
         ct = request.headers.get("Content-Type") or ""
@@ -626,8 +805,10 @@ class VolumeServer:
                 url += "&" + urllib.parse.urlencode(
                     {"name": name.decode(errors="replace")})
             url += self._peer_jwt_param(fid)
-            async with sess.post(url, data=data,
-                                 headers=tracing.inject(headers)) as r:
+            from .. import qos as qos_mod
+            async with sess.post(
+                    url, data=data,
+                    headers=qos_mod.inject(tracing.inject(headers))) as r:
                 return r.status
 
         await self._fan_out_to_peers(
@@ -830,8 +1011,11 @@ class VolumeServer:
             url_tail += "&ttl=" + urllib.parse.quote(ttl_str)
 
         async def send_one(sess, peer):
+            from .. import qos as qos_mod
             async with sess.put(f"http://{peer}/bulk?vid={vid}{url_tail}",
-                                data=body, headers=tracing.inject({})) as r:
+                                data=body,
+                                headers=qos_mod.inject(
+                                    tracing.inject({}))) as r:
                 return r.status
 
         await self._fan_out_to_peers(
@@ -2006,6 +2190,7 @@ class VolumeServer:
 
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
                    vpb.VolumeEcShardsRebuildResponse)
+        @_maintenance_tagged
         def ec_rebuild(req, context):
             from ..ops import events
             failpoints.check("ec.rebuild")
@@ -2041,6 +2226,7 @@ class VolumeServer:
 
         @svc.unary("VolumeEcShardsCopy", vpb.VolumeEcShardsCopyRequest,
                    vpb.VolumeEcShardsCopyResponse)
+        @_maintenance_tagged
         def ec_copy(req, context):
             """Pull shard files FROM source_data_node to this server.
             All of a volume's shard files stay in ONE location: prefer
@@ -2083,6 +2269,7 @@ class VolumeServer:
         @svc.unary("VolumeEcShardsCopyByRebuild",
                    vpb.VolumeEcShardsCopyByRebuildRequest,
                    vpb.VolumeEcShardsCopyByRebuildResponse)
+        @_maintenance_tagged
         def ec_copy_by_rebuild(req, context):
             loc = store._location_for(None)
             base = loc.base_name(req.collection, req.volume_id)
@@ -2180,16 +2367,31 @@ class VolumeServer:
             sh = ev.shards.get(req.shard_id)
             if sh is None:
                 context.abort(5, f"shard {req.shard_id} not on this server")
-            remaining = req.size
-            offset = req.offset
-            while remaining > 0:
-                chunk = min(remaining, 1 << 20)
-                data = sh.read_at(offset, chunk)
-                if not data:
-                    break
-                yield vpb.VolumeEcShardReadResponse(data=data)
-                offset += len(data)
-                remaining -= len(data)
+            # a maintenance-tagged survivor read (repair plans pulling
+            # ranged fetches) admits through the QoS plane and YIELDS
+            # to queued foreground work; untagged shard reads are the
+            # degraded-read data path and stay admission-free
+            from .. import qos as qos_mod
+            grant = None
+            if vs.qos.enabled and \
+                    qos_mod.current_class() == qos_mod.CLASS_MAINTENANCE:
+                grant = vs.qos.admit_sync(
+                    ev.collection or "default",
+                    qos_mod.CLASS_MAINTENANCE, cost=req.size)
+            try:
+                remaining = req.size
+                offset = req.offset
+                while remaining > 0:
+                    chunk = min(remaining, 1 << 20)
+                    data = sh.read_at(offset, chunk)
+                    if not data:
+                        break
+                    yield vpb.VolumeEcShardReadResponse(data=data)
+                    offset += len(data)
+                    remaining -= len(data)
+            finally:
+                if grant is not None:
+                    grant.release()
 
         @svc.unary("VolumeEcBlobDelete", vpb.VolumeEcBlobDeleteRequest,
                    vpb.VolumeEcBlobDeleteResponse)
@@ -2208,6 +2410,7 @@ class VolumeServer:
             return vpb.VolumeEcShardsToVolumeResponse()
 
         @svc.unary("VolumeCopy", vpb.VolumeCopyRequest, vpb.VolumeCopyResponse)
+        @_maintenance_tagged
         def volume_copy(req, context):
             """Pull a whole volume (.dat + .idx) from source_data_node
             (reference volume_grpc_copy.go doCopyFile flow)."""
@@ -2246,6 +2449,23 @@ class VolumeServer:
 
         @svc.unary_stream("CopyFile", vpb.CopyFileRequest, vpb.CopyFileResponse)
         def copy_file(req, context):
+            # a maintenance-tagged pull (VolumeCopy / shard copy from a
+            # repairing peer) admits before streaming file bytes off
+            # this node's disks — repair storms must not out-read the
+            # tenants this node serves
+            from .. import qos as qos_mod
+            grant = None
+            if vs.qos.enabled and \
+                    qos_mod.current_class() == qos_mod.CLASS_MAINTENANCE:
+                grant = vs.qos.admit_sync(req.collection or "default",
+                                          qos_mod.CLASS_MAINTENANCE)
+            try:
+                yield from _copy_file_stream(req, context)
+            finally:
+                if grant is not None:
+                    grant.release()
+
+        def _copy_file_stream(req, context):
             # flush the live volume's buffered appends first — the stream
             # below reads through a fresh handle and would otherwise miss
             # them (reference syncs via the readonly flip in doCopyFile)

@@ -221,10 +221,13 @@ def check_exposition(text: str) -> list[str]:
 
 # -- registry lint -----------------------------------------------------------
 
-# any more distinct values than this on an address-/bucket-shaped label
-# means a cardinality leak (every peer/bucket mints a new series forever)
+# any more distinct values than this on an address-/bucket-/tenant-
+# shaped label means a cardinality leak (every peer/bucket/tenant mints
+# a new series forever). `tenant` is bounded BY CONSTRUCTION in the qos
+# scheduler — its policy max_tenants ceiling routes the long tail into
+# one "~other" overflow bucket — and this lint keeps that contract.
 DEFAULT_CARDINALITY_CEILING = 256
-_BOUNDED_LABELS = ("peer", "bucket")
+_BOUNDED_LABELS = ("peer", "bucket", "tenant")
 
 
 def lint_registry(registry=None,

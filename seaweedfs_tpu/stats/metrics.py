@@ -443,6 +443,29 @@ BULK_READ_NEEDLES = _histogram(
     "SeaweedFS_bulk_read_needles",
     "needles per bulk-GET frame answered by the volume server",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096))
+# Multi-tenant QoS plane (qos/scheduler.py): every admission decision
+# per tenant/class (outcome: admitted = fast path, queued = granted
+# after a WFQ wait, shed = refused with 503 + Retry-After), bytes
+# charged through the token buckets, live queue depth, and how long
+# queued requests waited (exemplar-linked so a throttled trace is one
+# click away). The `tenant` label space is BOUNDED by the policy's
+# max_tenants ceiling — the long tail shares the "~other" overflow
+# bucket — which the registry lint enforces like peer/bucket.
+QOS_REQUESTS = _counter(
+    "SeaweedFS_qos_requests_total",
+    "admission decisions by tenant, class and outcome "
+    "(admitted/queued/shed)", ("tenant", "class", "outcome"))
+QOS_BYTES = _counter(
+    "SeaweedFS_qos_bytes_total",
+    "bytes charged through qos token buckets", ("tenant", "class"))
+QOS_QUEUE_DEPTH = _gauge(
+    "SeaweedFS_qos_queue_depth",
+    "requests queued in the qos scheduler right now", ("tenant",))
+QOS_WAIT_SECONDS = _histogram(
+    "SeaweedFS_qos_wait_seconds",
+    "time queued requests waited before being granted", ("class",),
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+             2.5, 5.0, 10.0, 30.0))
 
 
 def scrape_payload(accept: str = "") -> tuple[str, str]:

@@ -107,6 +107,13 @@ def _outgoing_metadata() -> list[tuple[str, str]]:
     tp = tracing.injectable()
     if tp:
         md.append((tracing.TRACEPARENT_HEADER, tp))
+    # QoS class tag: maintenance-tagged flows (repair executor, rebuild
+    # readers) stay maintenance-class across every gRPC hop so remote
+    # survivor reads yield to foreground work on the serving node
+    from .. import qos
+    qc = qos.injectable()
+    if qc:
+        md.append((qos.QOS_HEADER, qc))
     if not _cluster_key:
         return md
     from ..security.jwt import gen_jwt_for_filer_server
@@ -172,6 +179,18 @@ def _extract_trace_context(context):
     return None
 
 
+def _extract_qos_class(context) -> str:
+    """Inbound x-swtpu-qos metadata -> class name ('' = untagged)."""
+    from .. import qos
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == qos.QOS_HEADER and v in qos.CLASSES:
+                return v
+    except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (qos tagging must never break dispatch)
+        pass
+    return ""
+
+
 def _component_of(service: str) -> str:
     # "swtpu.master.Master" -> "master"
     parts = service.split(".")
@@ -209,14 +228,21 @@ class RpcService:
         comp = self._component
 
         def wrapped(request, context):
-            with tracing.start_span(
-                    f"rpc/{method}", component=comp,
-                    child_of=_extract_trace_context(context)) as sp:
-                try:
-                    return fn(request, context)
-                except Exception as e:  # noqa: BLE001 — incl. grpc aborts
-                    sp.set_error(e)
-                    raise
+            from .. import qos as qos_mod
+            qc = _extract_qos_class(context)
+            token = qos_mod.set_class(qc) if qc else None
+            try:
+                with tracing.start_span(
+                        f"rpc/{method}", component=comp,
+                        child_of=_extract_trace_context(context)) as sp:
+                    try:
+                        return fn(request, context)
+                    except Exception as e:  # noqa: BLE001 — incl. grpc aborts
+                        sp.set_error(e)
+                        raise
+            finally:
+                if token is not None:
+                    qos_mod.reset_class(token)
         return wrapped
 
     def _traced_stream(self, method: str, fn: Callable) -> Callable:
@@ -224,19 +250,26 @@ class RpcService:
         comp = self._component
 
         def wrapped(request, context):
-            with tracing.start_span(
-                    f"rpc/{method}", component=comp,
-                    child_of=_extract_trace_context(context)) as sp:
-                try:
-                    yield from fn(request, context)
-                except GeneratorExit:
-                    # client cancelled / stopped consuming: routine
-                    # teardown, not a stream failure
-                    sp.status = "cancelled"
-                    raise
-                except Exception as e:  # noqa: BLE001
-                    sp.set_error(e)
-                    raise
+            from .. import qos as qos_mod
+            qc = _extract_qos_class(context)
+            token = qos_mod.set_class(qc) if qc else None
+            try:
+                with tracing.start_span(
+                        f"rpc/{method}", component=comp,
+                        child_of=_extract_trace_context(context)) as sp:
+                    try:
+                        yield from fn(request, context)
+                    except GeneratorExit:
+                        # client cancelled / stopped consuming: routine
+                        # teardown, not a stream failure
+                        sp.status = "cancelled"
+                        raise
+                    except Exception as e:  # noqa: BLE001
+                        sp.set_error(e)
+                        raise
+            finally:
+                if token is not None:
+                    qos_mod.reset_class(token)
         return wrapped
 
     def unary(self, method: str, req_cls, resp_cls):

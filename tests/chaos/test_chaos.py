@@ -834,6 +834,195 @@ def test_read_storm_schedule(cluster):
     assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
 
 
+def test_antagonist_tenant_schedule(cluster):
+    """The multi-tenant QoS schedule (ISSUE 12): one tenant (collection
+    'antag') hammers bulk PUT / bulk GET through throttled token
+    buckets while a victim tenant issues paced reads WITH read-path
+    faults armed. Invariants:
+
+      * every ACKED victim read returns byte-identical payloads (an
+        admission layer between reader and storage must never corrupt
+        or cross-wire responses);
+      * the victim's p99 over acked reads stays bounded and most paced
+        reads complete (the antagonist is throttled, the victim not);
+      * acked victim deletes stay deleted (no resurrection through the
+        QoS/queue machinery);
+      * the scheduler actually ENGAGED (antagonist sheds observed);
+      * breakers re-close once the faults clear; the session fixture
+        asserts zero lock-order cycles over the whole run."""
+    from conftest import wait_until
+
+    master, servers, mc = cluster
+    seed = BASE_SEED + 12012
+    rng = random.Random(seed)
+    failpoints.seed(seed)
+    ctx = f"antagonist seed={seed} (SWTPU_CHAOS_SEED={BASE_SEED})"
+    wait_until(lambda: len(master.topo.nodes) >= 3, timeout=15,
+               msg=f"{ctx}: all nodes registered")
+
+    policy = {
+        "classes": {"interactive": {"max_wait_s": 1.0},
+                    "ingest": {"max_wait_s": 1.0}},
+        "default": {"weight": 10},
+        "tenants": {"victim": {"weight": 100},
+                    "antag": {"weight": 10, "rps": 8, "burst": 4,
+                              "bytes_per_s": 1 << 20,
+                              "burst_bytes": 2 << 20}},
+    }
+    shed_before = sum(vs.qos.shed_total for vs in servers)
+
+    # -- seed both tenants (before enforcement arms) -------------------------
+    victim_payloads = {}
+    for i in range(24):
+        payload = b"vic-%03d-" % i + rng.randbytes(rng.randint(500, 4000))
+        res = operation.submit(mc, payload, collection="victim")
+        victim_payloads[res.fid] = payload
+    victim_fids = list(victim_payloads)
+    antag_payloads = [b"ant-%03d-" % i + rng.randbytes(16384)
+                      for i in range(64)]
+    antag_fids = [r.fid for r in operation.submit_batch(
+        mc, antag_payloads, collection="antag")]
+
+    for vs in servers:
+        vs.qos.load(policy)
+    stop = threading.Event()
+    violations: list = []
+    victim_lat: list = []
+    lat_lock = threading.Lock()
+
+    def antag_reader(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            sample = [antag_fids[wrng.randrange(len(antag_fids))]
+                      for _ in range(16)]
+            try:
+                operation.read_batch(mc, sample)
+            except Exception:  # noqa: BLE001 — sheds are the point
+                stop.wait(0.02)
+
+    def antag_writer(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            payloads = [wrng.randbytes(16384) for _ in range(8)]
+            try:
+                operation.submit_batch(mc, payloads, collection="antag",
+                                       retries=1)
+            except Exception:  # noqa: BLE001
+                stop.wait(0.02)
+
+    pace_s = 0.04
+    n_paced = int(2 * WINDOW_S / pace_s)
+    paced_idx = [0]
+
+    def victim_reader(wseed: int, t0: float) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            with lat_lock:
+                i = paced_idx[0]
+                if i >= n_paced:
+                    return
+                paced_idx[0] += 1
+            delay = t0 + i * pace_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            fid = victim_fids[wrng.randrange(len(victim_fids))]
+            s = time.monotonic()
+            try:
+                got = operation.read(mc, fid)
+            except Exception:  # noqa: BLE001 — faults armed: not acked
+                continue
+            dt = time.monotonic() - s
+            if got != victim_payloads[fid]:
+                violations.append((fid, "victim bytes differ", len(got)))
+            with lat_lock:
+                victim_lat.append(dt)
+
+    for site, spec in [
+            ("store.read", f"pct:{rng.randint(5, 15)}:delay:0.02"),
+            ("http.request", f"pct:{rng.randint(2, 5)}:error:chaos")]:
+        failpoints.configure(site, spec)
+        print(f"[chaos] {ctx}: armed {site}={spec}")
+
+    t0 = time.monotonic()
+    threads = ([threading.Thread(target=antag_reader, daemon=True,
+                                 args=(rng.randrange(1 << 30),))
+                for _ in range(4)]
+               + [threading.Thread(target=antag_writer, daemon=True,
+                                   args=(rng.randrange(1 << 30),))
+                  for _ in range(2)]
+               + [threading.Thread(target=victim_reader, daemon=True,
+                                   args=(rng.randrange(1 << 30), t0))
+                  for _ in range(3)])
+    try:
+        for t in threads:
+            t.start()
+        deadline = t0 + 2 * WINDOW_S + 30
+        while any(t.is_alive() for t in threads) and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+            with lat_lock:
+                done = paced_idx[0] >= n_paced
+            if done:
+                break
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), \
+            f"{ctx}: schedule thread hung"
+    finally:
+        stop.set()
+        failpoints.clear_all()
+
+    assert not violations, f"{ctx}: victim violations: {violations[:8]}"
+    assert len(victim_lat) >= n_paced // 2, (
+        f"{ctx}: only {len(victim_lat)}/{n_paced} paced victim reads "
+        "acked — goodput collapsed under the antagonist")
+    victim_lat.sort()
+    p99 = victim_lat[int(len(victim_lat) * 0.99)]
+    print(f"[chaos] {ctx}: victim {len(victim_lat)}/{n_paced} acked, "
+          f"p99 {p99 * 1e3:.0f} ms")
+    # bounded: generous absolute cap — the retry envelope's jittered
+    # backoff under armed faults is included, the antagonist must not
+    # push it into the tens of seconds its own bulk frames would take
+    assert p99 < 3.0, f"{ctx}: victim p99 {p99:.2f}s unbounded"
+    sheds = sum(vs.qos.shed_total for vs in servers) - shed_before
+    print(f"[chaos] {ctx}: {sheds} antagonist sheds across servers")
+    assert sheds > 0, f"{ctx}: scheduler never engaged"
+
+    # -- no resurrection through the admission plane -------------------------
+    tomb = []
+    for fid in victim_fids[:3]:
+        try:
+            if operation.delete(mc, fid):
+                tomb.append(fid)
+        except Exception:  # noqa: BLE001 — indeterminate: skip
+            pass
+    for vs in servers:
+        vs.qos.load(None)   # enforcement off; tombstones must hold
+    for fid in tomb:
+        try:
+            operation.read(mc, fid)
+            violations.append((fid, "read-after-delete served bytes"))
+        except (KeyError, RuntimeError):
+            pass
+    assert not violations, f"{ctx}: resurrection: {violations}"
+
+    # -- breakers re-close ---------------------------------------------------
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        open_peers = [p for p, s in retry.all_breakers().items()
+                      if s != retry.CLOSED]
+        if not open_peers:
+            break
+        for p in open_peers:
+            retry.breaker(p).cooldown = min(retry.breaker(p).cooldown, 0.5)
+            _probe_peer(p)
+        time.sleep(0.2)
+    still_open = {p: s for p, s in retry.all_breakers().items()
+                  if s != retry.CLOSED}
+    assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+
 def test_repair_loop_converges_after_node_death(cluster):
     """The self-healing schedule: a node holding a replica AND one shard
     of a piggybacked RS(4,3) stripe dies FOR GOOD (no failpoint, no

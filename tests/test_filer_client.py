@@ -98,14 +98,24 @@ def test_remote_filer_sync(tmp_path):
         src.write_file("/synced/one.txt", b"payload-one")
         src.write_file("/synced/sub/two.txt", b"payload-two")
         from conftest import wait_until
-        wait_until(lambda: sync.applied >= 2, timeout=15,
-                   msg="sync applied both events")
-        e = target.filer.find_entry("/synced", "one.txt")
-        assert e is not None
-        assert target.read_entry_bytes(e) == b"payload-one"
-        e = target.filer.find_entry("/synced/sub", "two.txt")
-        assert e is not None
-        assert target.read_entry_bytes(e) == b"payload-two"
+
+        # Poll the TARGET's observable state, not sync.applied: the
+        # counter also ticks for directory-creation events, so
+        # `applied >= 2` could be satisfied by (mkdir /synced, one.txt)
+        # with two.txt still in flight — the deadline then raced the
+        # last apply (the long-standing flake this replaces).
+        def synced(directory: str, name: str, want: bytes):
+            def check():
+                e = target.filer.find_entry(directory, name)
+                return e is not None and \
+                    target.read_entry_bytes(e) == want
+            return check
+
+        wait_until(synced("/synced", "one.txt", b"payload-one"),
+                   timeout=20, msg="one.txt replicated to target")
+        wait_until(synced("/synced/sub", "two.txt", b"payload-two"),
+                   timeout=20, msg="two.txt replicated to target")
+        assert sync.dead_lettered == 0
     finally:
         if sync is not None:
             sync.stop()

@@ -1,0 +1,683 @@
+"""Multi-tenant QoS plane (seaweedfs_tpu/qos/): policy grammar, token
+buckets, WFQ/DRR fairness, priority classes, and both enforcement tiers
+(volume server HTTP plane, S3 gateway) incl. the circuit breaker's byte
+limits folding into the same 503 SlowDown + Retry-After contract."""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu import qos
+from seaweedfs_tpu.qos import (CLASS_INGEST, CLASS_INTERACTIVE,
+                               CLASS_MAINTENANCE, OVERFLOW_TENANT,
+                               QosScheduler, QosShed, parse_policy)
+from seaweedfs_tpu.qos.policy import parse_size
+from seaweedfs_tpu.qos.scheduler import TokenBucket
+
+from conftest import wait_cluster_up, wait_http_up, wait_until  # noqa: F401
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+# -- policy document ---------------------------------------------------------
+
+def test_parse_size_grammar():
+    assert parse_size(0) == 0
+    assert parse_size(1024) == 1024
+    assert parse_size("4MB") == 4 << 20
+    assert parse_size("512kb") == 512 << 10
+    assert parse_size("1GiB") == 1 << 30
+    with pytest.raises(ValueError):
+        parse_size("fast")
+    with pytest.raises(ValueError):
+        parse_size(-1)
+    with pytest.raises(ValueError):
+        parse_size(True)
+
+
+def test_parse_policy_validates_hard():
+    pol = parse_policy({"tenants": {"a": {"weight": 30, "rps": 5}}})
+    assert pol.enabled and pol.tenant_spec("a").weight == 30
+    # burst defaults to one second of rate
+    assert pol.tenant_spec("a").burst == 5
+    assert pol.tenant_spec("unknown") is pol.default
+    for bad in (
+            {"tenants": {"a": {"wieght": 3}}},      # typo'd key
+            {"classes": {"bulk": {}}},               # unknown class
+            {"tenants": {"a": {"weight": 0}}},       # weight < 1
+            {"max_tenants": 0},
+            {"enabled": "yes"},
+            {"nodes": {}},                           # unknown top key
+    ):
+        with pytest.raises(ValueError):
+            parse_policy(bad)
+
+
+def test_parse_policy_disabled_forms():
+    assert not parse_policy(None).enabled
+    assert not parse_policy({}).enabled
+    assert not parse_policy({"enabled": False,
+                             "tenants": {"a": {}}}).enabled
+
+
+# -- token bucket ------------------------------------------------------------
+
+def test_token_bucket_refill_and_eta():
+    t = [100.0]
+    b = TokenBucket(rate=10, burst=5, now=t[0])
+    assert b.take(5, t[0]) == 0.0          # whole burst available
+    eta = b.take(1, t[0])
+    assert eta == pytest.approx(0.1)       # 1 token at 10/s
+    t[0] += 0.1
+    assert b.take(1, t[0]) == 0.0
+    # oversized cost grants at full bucket, tokens go negative
+    t[0] += 10
+    assert b.take(50, t[0]) == 0.0
+    assert b.tokens < 0
+    assert b.take(1, t[0]) > 0
+
+
+def test_token_bucket_force_debt():
+    b = TokenBucket(rate=100, burst=100, now=0.0)
+    b.force(1000, 0.0)   # post-facto charge: 900 in debt
+    assert b.eta(1, 0.0) > 8.0
+    assert b.eta(1, 9.01) == pytest.approx(0.0, abs=0.01)
+
+
+# -- scheduler core ----------------------------------------------------------
+
+def test_fast_path_and_rate_shed():
+    s = QosScheduler({"tenants": {"a": {"rps": 0.5, "burst": 1}},
+                      "classes": {"ingest": {"max_wait_s": 0.1}}},
+                     name="t-shed")
+    try:
+        g = s.admit_sync("a", CLASS_INGEST)
+        g.release()
+        with pytest.raises(QosShed) as ei:
+            s.admit_sync("a", CLASS_INGEST)
+        assert ei.value.reason == "rate limited"
+        assert int(ei.value.retry_after_header) >= 1
+    finally:
+        s.close()
+
+
+def test_disabled_scheduler_is_noop():
+    s = QosScheduler(None, name="t-off")
+    g = s.admit_sync("anyone", CLASS_INGEST, cost=10**9)
+    g.charge(10**9)
+    g.release()  # inert grant
+    assert not s.enabled
+    s.close()
+
+
+def test_queued_grant_and_wait_metric():
+    from seaweedfs_tpu.stats import QOS_WAIT_SECONDS
+    before = QOS_WAIT_SECONDS.count(CLASS_INGEST)
+    s = QosScheduler({"tenants": {"x": {"rps": 20, "burst": 1}}},
+                     name="t-queue")
+    try:
+        s.admit_sync("x", CLASS_INGEST).release()
+        t0 = time.monotonic()
+        g = s.admit_sync("x", CLASS_INGEST)   # waits ~50ms for a token
+        waited = time.monotonic() - t0
+        g.release()
+        assert 0.01 < waited < 1.0
+        assert QOS_WAIT_SECONDS.count(CLASS_INGEST) > before
+    finally:
+        s.close()
+
+
+def test_drr_weighted_fairness():
+    """Two tenants flooding one shared byte-rate: grants split by
+    weight (3:1), not by offered load."""
+    s = QosScheduler({"node": {"bytes_per_s": 102400, "burst_bytes": 1024},
+                      "tenants": {"heavy": {"weight": 30},
+                                  "light": {"weight": 10}},
+                      "classes": {"ingest": {"max_wait_s": 30}},
+                      "quantum_bytes": 1024}, name="t-drr")
+    counts = {"heavy": 0, "light": 0}
+    lock = threading.Lock()
+    stop = time.monotonic() + 2.0
+
+    def worker(tenant):
+        while time.monotonic() < stop:
+            try:
+                g = s.admit_sync(tenant, CLASS_INGEST, cost=1024)
+            except QosShed:
+                continue
+            with lock:
+                counts[tenant] += 1
+            g.release()
+
+    try:
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in ("heavy", "light") for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        s.close()
+    ratio = counts["heavy"] / max(1, counts["light"])
+    assert 1.5 < ratio < 6.0, counts
+
+
+def test_maintenance_yields_to_foreground():
+    """With the shared bucket drained and both a maintenance and an
+    interactive request queued, the interactive one is granted first
+    even though maintenance arrived earlier."""
+    s = QosScheduler({"node": {"rps": 5, "burst": 1}}, name="t-yield")
+    order = []
+    try:
+        s.admit_sync("t", CLASS_INTERACTIVE).release()  # drain burst
+
+        def maint():
+            g = s.admit_sync("t", CLASS_MAINTENANCE)
+            order.append("maintenance")
+            g.release()
+
+        def inter():
+            g = s.admit_sync("t", CLASS_INTERACTIVE)
+            order.append("interactive")
+            g.release()
+
+        tm = threading.Thread(target=maint)
+        tm.start()
+        time.sleep(0.05)   # maintenance queues first
+        ti = threading.Thread(target=inter)
+        ti.start()
+        tm.join(10)
+        ti.join(10)
+    finally:
+        s.close()
+    assert order[0] == "interactive", order
+
+
+def test_max_wait_deadline_shed():
+    s = QosScheduler({"tenants": {"a": {"rps": 100, "burst": 1,
+                                        "max_inflight": 1}},
+                      "classes": {"ingest": {"max_wait_s": 0.2}}},
+                     name="t-deadline")
+    try:
+        g = s.admit_sync("a", CLASS_INGEST)   # holds the inflight slot
+        t0 = time.monotonic()
+        with pytest.raises(QosShed) as ei:
+            s.admit_sync("a", CLASS_INGEST)   # queues, then deadline-sheds
+        assert 0.1 < time.monotonic() - t0 < 2.0
+        assert "max_wait" in ei.value.reason
+        g.release()
+    finally:
+        s.close()
+
+
+def test_inflight_cap_blocks_until_release():
+    s = QosScheduler({"tenants": {"a": {"max_inflight": 1}}},
+                     name="t-inflight")
+    try:
+        g1 = s.admit_sync("a", CLASS_INGEST)
+        got = []
+
+        def second():
+            g = s.admit_sync("a", CLASS_INGEST)
+            got.append(time.monotonic())
+            g.release()
+
+        t = threading.Thread(target=second)
+        t.start()
+        time.sleep(0.1)
+        assert not got          # still blocked on the slot
+        g1.release()
+        t.join(10)
+        assert got              # release unblocked it
+    finally:
+        s.close()
+
+
+def test_no_shed_forced_admission_charges():
+    """Replica-hop admission: never refused, but the byte debt pushes
+    the tenant's next normal admission out."""
+    s = QosScheduler({"tenants": {"a": {"bytes_per_s": 1000,
+                                        "burst_bytes": 1000}},
+                      "classes": {"ingest": {"max_wait_s": 0.1}}},
+                     name="t-forced")
+    try:
+        import asyncio
+
+        async def run():
+            g = await s.admit("a", CLASS_INGEST, cost=50_000, no_shed=True)
+            g.release()
+        asyncio.run(run())
+        with pytest.raises(QosShed):   # 49x burst in debt
+            s.admit_sync("a", CLASS_INGEST, cost=1000)
+    finally:
+        s.close()
+
+
+def test_overflow_tenant_bounds_label_space():
+    s = QosScheduler({"max_tenants": 3, "default": {"rps": 1000}},
+                     name="t-ovf")
+    try:
+        for n in range(8):
+            s.admit_sync(f"tenant-{n}", CLASS_INTERACTIVE).release()
+        names = {t["tenant"] for t in s.debug_payload()["tenants"]}
+        assert OVERFLOW_TENANT in names
+        assert len(names) <= 4   # 3 + overflow
+    finally:
+        s.close()
+
+
+def test_hot_reload_keeps_inflight_and_waiters():
+    s = QosScheduler({"tenants": {"a": {"max_inflight": 2}}},
+                     name="t-reload")
+    try:
+        g = s.admit_sync("a", CLASS_INGEST)
+        s.load({"tenants": {"a": {"max_inflight": 1}}})
+        # the carried-over inflight (1) now fills the tightened cap
+        got = []
+
+        def second():
+            gg = s.admit_sync("a", CLASS_INGEST)
+            got.append(1)
+            gg.release()
+
+        t = threading.Thread(target=second)
+        t.start()
+        time.sleep(0.1)
+        assert not got
+        g.release()   # release resolves against the NEW state by name
+        t.join(10)
+        assert got
+    finally:
+        s.close()
+
+
+def test_abandoned_waiter_grant_released_not_leaked():
+    """A waiter whose caller timed out before the pump granted it must
+    hand the slots straight back — otherwise every abandoned wait leaks
+    one inflight slot and the cap eventually locks the tenant out."""
+    s = QosScheduler({"tenants": {"a": {"max_inflight": 1}},
+                      "classes": {"ingest": {"max_wait_s": 30}}},
+                     name="t-abandon")
+    try:
+        g = s.admit_sync("a", CLASS_INGEST)
+        with pytest.raises(QosShed):
+            # caller gives up after 0.2s; the waiter stays queued
+            s.admit_sync("a", CLASS_INGEST, timeout=0.2)
+        g.release()
+        # the pump now grants the abandoned waiter; its Grant must be
+        # auto-released so the slot is free for a live caller
+        g2 = s.admit_sync("a", CLASS_INGEST, timeout=5)
+        g2.release()
+    finally:
+        s.close()
+
+
+def test_close_sheds_waiters():
+    s = QosScheduler({"tenants": {"a": {"max_inflight": 1}}},
+                     name="t-close")
+    g = s.admit_sync("a", CLASS_INGEST)
+    errs = []
+
+    def second():
+        try:
+            s.admit_sync("a", CLASS_INGEST)
+        except QosShed as e:
+            errs.append(e)
+
+    t = threading.Thread(target=second)
+    t.start()
+    time.sleep(0.1)
+    s.close()
+    t.join(10)
+    g.release()
+    assert errs and "shutdown" in errs[0].reason
+
+
+def test_shed_event_journaled():
+    from seaweedfs_tpu.ops import events
+    since = events.JOURNAL.last_seq
+    s = QosScheduler({"tenants": {"j": {"rps": 0.1, "burst": 1}},
+                      "classes": {"ingest": {"max_wait_s": 0.05}}},
+                     name="t-events")
+    try:
+        s.admit_sync("j", CLASS_INGEST).release()
+        with pytest.raises(QosShed):
+            s.admit_sync("j", CLASS_INGEST)
+    finally:
+        s.close()
+    evs = events.JOURNAL.snapshot(since=since, etype="qos.shed")
+    assert any(e["attrs"].get("tenant") == "j" for e in evs)
+
+
+def test_class_tag_plumbing():
+    assert qos.current_class() == ""
+    with qos.tagged(CLASS_MAINTENANCE):
+        assert qos.current_class() == CLASS_MAINTENANCE
+        h = qos.inject({})
+        assert h[qos.QOS_HEADER] == CLASS_MAINTENANCE
+    assert qos.current_class() == ""
+    assert qos.class_from_headers({qos.QOS_HEADER: "maintenance"},
+                                  "interactive") == "maintenance"
+    # garbage tags can't mint classes
+    assert qos.class_from_headers({qos.QOS_HEADER: "root"},
+                                  "interactive") == "interactive"
+    # tags are DOWNGRADE-only: a client stamping its writes
+    # "interactive" must not jump the priority queues
+    assert qos.class_from_headers({qos.QOS_HEADER: "interactive"},
+                                  "ingest") == "ingest"
+    assert qos.class_from_headers({qos.QOS_HEADER: "ingest"},
+                                  "maintenance") == "maintenance"
+    assert qos.class_from_headers({qos.QOS_HEADER: "maintenance"},
+                                  "ingest") == "maintenance"
+
+
+# -- S3 circuit breaker byte limits ------------------------------------------
+
+def test_breaker_count_limits_back_compat():
+    from seaweedfs_tpu.s3.circuit_breaker import (CircuitBreaker,
+                                                  ErrTooManyRequests)
+    cb = CircuitBreaker({"global": {"Read": 1}})
+    with cb.acquire("Read", "b"):
+        with pytest.raises(ErrTooManyRequests):
+            with cb.acquire("Read", "b"):
+                pass
+    with cb.acquire("Read", "b"):
+        pass  # released
+
+
+def test_breaker_byte_limits():
+    from seaweedfs_tpu.s3.circuit_breaker import (CircuitBreaker,
+                                                  ErrTooManyRequests)
+    cb = CircuitBreaker({"global": {"Write:bytes": "1KB"},
+                         "buckets": {"tight": {"Write:bytes": 100}}})
+    assert cb.enabled
+    # within the cap: two 400-byte writes co-exist
+    with cb.acquire("Write", "other", nbytes=400):
+        with cb.acquire("Write", "other", nbytes=400):
+            pass
+        # third would exceed 1KB in flight
+        with pytest.raises(ErrTooManyRequests) as ei:
+            with cb.acquire("Write", "other", nbytes=700):
+                pass
+        assert ei.value.status == 503 and ei.value.retry_after_s >= 1
+    # an oversized SINGLE request still passes an idle breaker
+    with cb.acquire("Write", "other", nbytes=10_000):
+        pass
+    # per-bucket byte cap stacks with the global one
+    with cb.acquire("Write", "tight", nbytes=60):
+        with pytest.raises(ErrTooManyRequests):
+            with cb.acquire("Write", "tight", nbytes=60):
+                pass
+
+
+def test_breaker_proto_shape_with_byte_overlay():
+    from seaweedfs_tpu.s3.circuit_breaker import CircuitBreaker
+    cb = CircuitBreaker({"global": {"enabled": True,
+                                    "actions": {"Read": 8},
+                                    "Write:bytes": "2MB"}})
+    assert cb.global_limits == {"Read": 8}
+    assert cb.global_byte_limits == {"Write": 2 << 20}
+    cb.load({"global": {"enabled": False, "actions": {"Read": 8}}})
+    assert not cb.enabled
+
+
+# -- volume tier end-to-end --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def qos_cluster(tmp_path_factory):
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    ms = MasterServer(port=free_port(), pulse_seconds=0.3,
+                      maintenance_scripts=[])
+    ms.start()
+    d = tmp_path_factory.mktemp("qosvol")
+    vport = free_port()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(d), max_volume_count=10)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport, grpc_port=free_port(),
+                      pulse_seconds=0.3)
+    vs.start()
+    wait_cluster_up(ms, [vs])
+    mc = MasterClient(ms.address).start()
+    yield ms, vs, mc
+    mc.stop()
+    vs.stop()
+    ms.stop()
+
+
+def test_volume_tier_shed_and_debug(qos_cluster):
+    from seaweedfs_tpu.client import http_util, operation
+    ms, vs, mc = qos_cluster
+    vs.qos.load({"tenants": {"limited": {"rps": 1, "burst": 1}},
+                 "classes": {"ingest": {"max_wait_s": 0.1},
+                             "interactive": {"max_wait_s": 0.1}}})
+    try:
+        res = operation.submit(mc, b"payload", collection="limited")
+        sheds = 0
+        retry_after = ""
+        for _ in range(6):
+            r = http_util.post(f"http://{vs.url}/{res.fid}", body=b"x")
+            if r.status == 503:
+                sheds += 1
+                retry_after = r.headers.get("retry-after")
+        assert sheds > 0 and retry_after
+        # per-tenant accounting on /metrics + /debug/qos
+        from seaweedfs_tpu.stats import QOS_REQUESTS
+        assert QOS_REQUESTS.value("limited", "ingest", "shed") > 0
+        dbg = http_util.get(f"http://{vs.url}/debug/qos").json()
+        t = next(x for x in dbg["tenants"] if x["tenant"] == "limited")
+        assert t["shed"] >= sheds and dbg["enabled"]
+        # the stored payload still reads fine (interactive class has
+        # its own admission; wait for the tenant's bucket to refill)
+        wait_until(lambda: http_util.get(
+            f"http://{vs.url}/{res.fid}").status == 200, timeout=5,
+            msg="read admitted after bucket refill")
+    finally:
+        vs.qos.load(None)
+
+
+def test_volume_tier_replicate_hop_never_sheds(qos_cluster):
+    """type=replicate is the durability hop: charged, never refused —
+    a throttled tenant must lose THROUGHPUT, not replica consistency."""
+    from seaweedfs_tpu.client import http_util, operation
+    ms, vs, mc = qos_cluster
+    res = operation.submit(mc, b"replica-safe", collection="limited")
+    vs.qos.load({"tenants": {"limited": {"rps": 0.001, "burst": 1,
+                                         "bytes_per_s": 1}},
+                 "classes": {"ingest": {"max_wait_s": 0.05}}})
+    try:
+        jwt = mc.lookup_file_id_jwt(res.fid)
+        params = "?type=replicate" + (f"&jwt={jwt}" if jwt else "")
+        r = http_util.post(f"http://{vs.url}/{res.fid}{params}",
+                           body=b"new-bytes")
+        assert r.status == 201, (r.status, r.content)
+        # ...while a normal write DOES shed under the same policy
+        r2 = http_util.post(f"http://{vs.url}/{res.fid}", body=b"zz")
+        assert r2.status == 503
+    finally:
+        vs.qos.load(None)
+
+
+def test_volume_tier_policy_file_hot_reload(qos_cluster, tmp_path):
+    from seaweedfs_tpu.client import http_util
+    ms, vs, mc = qos_cluster
+    path = tmp_path / "policy.json"
+    path.write_text(json.dumps(
+        {"tenants": {"filed": {"rps": 7}}}))
+    vs.qos.attach_file(str(path))
+    try:
+        assert vs.qos.enabled
+        dbg = http_util.get(f"http://{vs.url}/debug/qos").json()
+        assert "filed" in dbg["policy"]["named_tenants"]
+        # rewrite the file; the pump's mtime poll picks it up
+        time.sleep(0.02)  # distinct mtime even on coarse filesystems
+        path.write_text(json.dumps({"enabled": False}))
+        wait_until(lambda: not vs.qos.enabled, timeout=10,
+                   msg="policy file hot reload")
+        # a broken edit must not tear down the last good policy
+        path.write_text(json.dumps({"tenants": {"filed": {"rps": 7}}}))
+        wait_until(lambda: vs.qos.enabled, timeout=10,
+                   msg="policy re-enable")
+        time.sleep(0.02)
+        path.write_text("{not json")
+        time.sleep(1.2)  # a reload tick
+        assert vs.qos.enabled  # still running on the last good doc
+    finally:
+        vs.qos._file = None
+        vs.qos.load(None)
+
+
+def test_volume_tier_maintenance_tag_travels_grpc(qos_cluster):
+    """A maintenance-tagged flow crossing a gRPC hop keeps its class on
+    the serving node (utils/rpc metadata propagation)."""
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+    ms, vs, mc = qos_cluster
+
+    seen = []
+    svc_probe = vs.store  # noqa: F841 — cluster warm
+    # observe via the scheduler: cap maintenance inflight to 0 is not
+    # possible, so instead watch the class counter move
+    from seaweedfs_tpu.stats import QOS_REQUESTS
+    vs.qos.load({"default": {"rps": 1000}})
+    try:
+        before = QOS_REQUESTS.value("default", "maintenance", "admitted")
+        with qos.tagged(CLASS_MAINTENANCE):
+            # CopyFile of a nonexistent volume still walks the handler
+            # far enough to admit (grant then abort)
+            try:
+                for _ in Stub(f"127.0.0.1:{vs.grpc_port}",
+                              VOLUME_SERVICE).call_stream(
+                        "CopyFile",
+                        vpb.CopyFileRequest(volume_id=999999, ext=".dat"),
+                        vpb.CopyFileResponse):
+                    pass
+            except Exception:  # noqa: BLE001 — abort expected
+                pass
+        after = QOS_REQUESTS.value("default", "maintenance", "admitted")
+        assert after > before, (before, after, seen)
+    finally:
+        vs.qos.load(None)
+
+
+# -- S3 tier end-to-end ------------------------------------------------------
+
+def test_s3_tier_slowdown_with_retry_after(tmp_path):
+    import requests
+
+    from seaweedfs_tpu.filer.filer_server import FilerServer
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.s3.s3_server import S3Gateway
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    ms = MasterServer(port=free_port(), pulse_seconds=0.3,
+                      maintenance_scripts=[])
+    ms.start()
+    vport = free_port()
+    store = Store("127.0.0.1", vport, "",
+                  [DiskLocation(str(tmp_path), max_volume_count=10)],
+                  coder_name="numpy")
+    vs = VolumeServer(store, ms.address, port=vport,
+                      grpc_port=free_port(), pulse_seconds=0.3)
+    vs.start()
+    wait_cluster_up(ms, [vs])
+    fs = FilerServer(ms.address, store_spec="memory", port=free_port(),
+                     grpc_port=free_port() + 10000,
+                     meta_log_path=str(tmp_path / "meta.log"))
+    fs.start()
+    wait_http_up(f"http://{fs.url}/__status__")
+    gw = S3Gateway(fs, port=free_port(),
+                   qos_policy={"tenants": {"noisy": {"rps": 1,
+                                                     "burst": 1}},
+                               "classes": {"ingest": {"max_wait_s": 0.1},
+                                           "interactive":
+                                               {"max_wait_s": 0.1}}})
+    gw.start()
+    try:
+        assert requests.put(f"http://{gw.url}/noisy",
+                            timeout=5).status_code == 200
+        sheds, retry_after = 0, None
+        for i in range(6):
+            r = requests.put(f"http://{gw.url}/noisy/k{i}",
+                             data=b"x" * 64, timeout=5)
+            if r.status_code == 503:
+                sheds += 1
+                retry_after = r.headers.get("Retry-After")
+                assert "SlowDown" in r.text
+        assert sheds > 0 and retry_after
+        # anonymous traffic is accounted against the bucket tenant
+        dbg = requests.get(f"http://{gw.url}/debug/qos", timeout=5).json()
+        assert any(t["tenant"] == "noisy" and t["shed"] > 0
+                   for t in dbg["tenants"])
+        # breaker byte caps answer through the SAME 503 + Retry-After
+        gw.qos.load(None)
+        gw.breaker.load({"global": {"Write:bytes": 100}})
+        held = gw.breaker.acquire("Write", "noisy", nbytes=90)
+        held.__enter__()
+        try:
+            r = requests.put(f"http://{gw.url}/noisy/big",
+                             data=b"y" * 64, timeout=5)
+            assert r.status_code == 503
+            assert r.headers.get("Retry-After")
+            assert "SlowDown" in r.text
+        finally:
+            held.__exit__(None, None, None)
+    finally:
+        gw.stop()
+        fs.stop()
+        vs.stop()
+        ms.stop()
+
+
+def test_s3_tenant_extraction():
+    class Req:
+        def __init__(self, headers=None, query=None):
+            self.headers = headers or {}
+            self.query = query or {}
+
+    from seaweedfs_tpu.s3.s3_server import S3Gateway
+    t = S3Gateway._qos_tenant
+    assert t(Req({"Authorization":
+                  "AWS4-HMAC-SHA256 Credential=AKID1/20260801/us/s3/"
+                  "aws4_request, Signature=x"}), "b") == "AKID1"
+    assert t(Req({"Authorization": "AWS AKID2:sig"}), "b") == "AKID2"
+    assert t(Req(query={"X-Amz-Credential":
+                        "AKID3%2F20260801%2Fus"}), "b") == "AKID3"
+    assert t(Req(query={"AWSAccessKeyId": "AKID4"}), "b") == "AKID4"
+    assert t(Req(), "mybucket") == "mybucket"
+    assert t(Req(), "") == "anonymous"
+
+
+# -- metrics lint contract ----------------------------------------------------
+
+def test_tenant_label_bounded_in_registry_lint():
+    from seaweedfs_tpu.stats import QOS_REQUESTS, Registry
+    from seaweedfs_tpu.stats.expo_lint import lint_registry
+    reg = Registry()
+    reg.register(QOS_REQUESTS)
+    # the scheduler's overflow bucket keeps real deployments bounded;
+    # prove the lint WOULD catch an unbounded tenant label
+    from seaweedfs_tpu.stats.metrics import Counter
+    leak = Counter("SeaweedFS_qos_leak_total", "x", ("tenant",))
+    reg2 = Registry()
+    reg2.register(leak)
+    for i in range(300):
+        leak.inc(f"t{i}")
+    assert any("tenant" in p for p in lint_registry(reg2))
+    assert not lint_registry(reg)
