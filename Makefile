@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -93,6 +93,16 @@ bench-filer:
 # SeaweedFS_qos_requests_total{tenant,outcome="shed"}
 bench-qos:
 	JAX_PLATFORMS=cpu python bench.py --qos-only
+
+# scale-out placement & rebalance gate: a 4-server/2-rack topology must
+# push >= 2.5x one server's aggregate bulk PUT/GET needles/s under an
+# identical deterministic per-frame delay (per-node bottleneck modeled,
+# host CPU factored out), then a rack-skewed fleet must converge to
+# per-server byte skew <= 1.3 via volume.balance/ec.balance with EC
+# stripes rack-safe (<= p shards per rack), -dryRun mutation-free, and
+# rebalance traffic visible as maintenance-class in qos metrics
+bench-balance:
+	JAX_PLATFORMS=cpu python bench.py --balance-only
 
 smoke:
 	python bench.py --smoke

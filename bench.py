@@ -1547,6 +1547,525 @@ def bench_qos_smoke(out: dict) -> None:
         shutil.rmtree(os.path.dirname(policy_path), ignore_errors=True)
 
 
+_BALANCE_QOS_POLICY = {
+    # generous, rate-free doc: nothing sheds — the bench only needs the
+    # admission COUNTERS so rebalance traffic is visible as
+    # maintenance-class on the nodes that serve the copy pulls
+    "classes": {"interactive": {"max_wait_s": 5.0},
+                "ingest": {"max_wait_s": 5.0},
+                "maintenance": {"max_wait_s": 5.0}},
+    "default": {"weight": 10},
+}
+
+
+def _spawn_rack_cluster(tmp_prefix: str, volume_size_mb: int,
+                        vol_max: int, racks: "list[str]",
+                        extra_env: "dict | None" = None,
+                        extra_volume_args: "list | None" = None):
+    """Separate-process master + one volume server PER ENTRY of `racks`
+    (its value is the server's -rack; all in dc1) — the multi-node
+    topology the scale-out plane is benched on. Returns (procs, tmp,
+    mport, mhttp, vports, respawn) where respawn(i) re-launches server
+    i with its original args over the same dir/ports (node death +
+    rejoin). Tear down with _stop_procs_cluster(procs, tmp)."""
+    import socket
+    import subprocess
+
+    from seaweedfs_tpu.client import http_util
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix=tmp_prefix)
+    mport, mhttp = free_port(), free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # CPU-only children
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    procs: list = []
+    vports = []
+    vol_argv = []
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+
+    def respawn(i: int):
+        procs[1 + i] = subprocess.Popen(
+            vol_argv[i], cwd=repo_root, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return procs[1 + i]
+
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "master",
+             "-port", str(mport), "-httpPort", str(mhttp),
+             "-volumeSizeLimitMB", str(volume_size_mb)],
+            cwd=repo_root, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        for i, rack in enumerate(racks):
+            vdir = os.path.join(tmp, f"v{i}")
+            os.makedirs(vdir, exist_ok=True)
+            vport, vgrpc = free_port(), free_port()
+            vports.append(vport)
+            argv = [sys.executable, "-m", "seaweedfs_tpu", "volume",
+                    "-port", str(vport), "-grpcPort", str(vgrpc),
+                    "-mserver", f"127.0.0.1:{mport}", "-dir", vdir,
+                    "-max", str(vol_max), "-coder", "numpy",
+                    "-dataCenter", "dc1", "-rack", rack] \
+                + list(extra_volume_args or [])
+            vol_argv.append(argv)
+            procs.append(subprocess.Popen(
+                argv, cwd=repo_root, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                up = all(http_util.get(f"http://127.0.0.1:{p}/status",
+                                       timeout=1).ok for p in vports) and \
+                    http_util.get(f"http://127.0.0.1:{mhttp}/dir/status",
+                                  timeout=1).ok
+            except Exception:  # noqa: BLE001
+                time.sleep(0.25)
+        while up and time.time() < deadline:
+            try:
+                if "fid" in http_util.get(
+                        f"http://127.0.0.1:{mhttp}/dir/assign",
+                        timeout=1).json():
+                    return procs, tmp, mport, mhttp, vports, respawn
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.25)
+        raise RuntimeError("rack cluster failed to start")
+    except BaseException:
+        _stop_procs_cluster(procs, tmp)
+        raise
+
+
+def _balance_put_phase(mc, seconds: float, threads: int,
+                       payload_bytes: int, batch: int) -> "tuple[float, dict]":
+    """Free-running framed bulk PUT for `seconds`; returns (needles/s,
+    {vid: [fids]}). Each worker PINS one fid-range lease for its whole
+    run (the real bulk-ingest shape) — a re-rolled random volume per
+    call makes the closed loop convoy onto whichever server is
+    momentarily hot, which measures queueing variance, not topology."""
+    import threading
+
+    from seaweedfs_tpu.client import operation
+    from seaweedfs_tpu.client.master_client import FidLeaseAllocator
+
+    lock = threading.Lock()
+    fids_by_vid: dict = {}
+    acked = [0]
+    stop = time.monotonic() + seconds
+
+    def worker(seed: int) -> None:
+        rng = random.Random(seed)
+        try:
+            alloc = FidLeaseAllocator(mc, collection="bench")
+        except Exception:  # noqa: BLE001
+            alloc = None
+        while time.monotonic() < stop:
+            payloads = [rng.randbytes(payload_bytes) for _ in range(batch)]
+            try:
+                res = operation.submit_batch(mc, payloads,
+                                             collection="bench",
+                                             allocator=alloc)
+            except Exception:  # noqa: BLE001 — growth race mid-rollover
+                time.sleep(0.05)
+                continue
+            with lock:
+                acked[0] += len(res)
+                for r in res:
+                    fids_by_vid.setdefault(
+                        int(r.fid.split(",")[0]), []).append(r.fid)
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker, args=(7000 + i,))
+          for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t0
+    return acked[0] / wall, fids_by_vid
+
+
+def _balance_get_phase(mc, fids_by_vid: dict, seconds: float,
+                       threads: int, batch: int) -> float:
+    """Free-running framed bulk GET; each worker PINS one vid (round-
+    robin over the fleet's volumes) and reads random windows of it, so
+    one call = one /bulk-read frame on that vid's holder and in-flight
+    pressure stays spread across every server."""
+    import threading
+
+    from seaweedfs_tpu.client import operation
+
+    vids = sorted(v for v, fs in fids_by_vid.items() if fs)
+    got = [0]
+    lock = threading.Lock()
+    stop = time.monotonic() + seconds
+
+    def worker(idx: int) -> None:
+        rng = random.Random(8000 + idx)
+        fids = fids_by_vid[vids[idx % len(vids)]]
+        while time.monotonic() < stop:
+            start = rng.randrange(max(1, len(fids) - batch + 1))
+            try:
+                res = operation.read_batch(mc, fids[start:start + batch])
+            except Exception:  # noqa: BLE001
+                time.sleep(0.05)
+                continue
+            with lock:
+                got[0] += sum(1 for r in res if r is not None)
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return got[0] / (time.monotonic() - t0)
+
+
+def bench_balance_smoke(out: dict) -> None:
+    """`make bench-balance`: the scale-out placement & rebalance gate.
+
+    Phase A — multi-node scaling: the same framed bulk PUT/GET workload
+    runs against a 1-server cluster and a 4-server/2-rack cluster with
+    an identical deterministic 150 ms per-frame handler delay armed on
+    every volume server (the delay blocks each server's event loop —
+    the per-NODE resource the fleet multiplies — so the gate measures
+    topology scaling, not host CPU luck). Gate: 4-server aggregate
+    bulk PUT and GET needles/s >= 2.5x the single-server figures.
+
+    Phase B — skew + rebalance on the 4-server cluster: rack r2 dies,
+    a skew dataset lands on rack r1 alone, r2 rejoins empty, one volume
+    is EC-encoded RS(2,2) (shards rack-capped at p=2 by the placement
+    spread). Gates: `volume.balance -dryRun` performs ZERO mutating
+    RPCs; after volume.balance + ec.balance the per-server byte skew
+    max/min <= 1.3; no EC stripe has > p shards in one rack; rebalance
+    traffic shows up as maintenance-class in the volume servers' qos
+    metrics; and every move journaled `balance.move` with bytes_moved.
+    """
+    import io
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+
+    policy_path = os.path.join(tempfile.mkdtemp(prefix="swtpu_balpol_"),
+                               "policy.json")
+    with open(policy_path, "w", encoding="utf-8") as f:
+        json.dump(_BALANCE_QOS_POLICY, f)
+    # the per-frame handler delay is the per-NODE bottleneck the fleet
+    # multiplies. It must dominate the frame's CPU cost and the client's
+    # queueing noise on a small box (client + 4 servers share the
+    # cores): at 150 ms the single-server ceiling is ~6.7 frames/s and
+    # the 4-server target ~27 — both far under the box's CPU ceiling,
+    # so the ratio measures topology, not host luck
+    delay_spec = "pct:100:delay:0.15"
+    # 24 pinned client workers: each holds one lease/vid, so every
+    # server keeps several requests in flight at all times (Little's
+    # law against the 150 ms service time — a 4-server fleet needs
+    # well over 4 outstanding frames to stay busy)
+    put_s, get_s, threads, batch, payload = 3.0, 3.0, 24, 64, 256
+
+    def arm(vports, name, spec):
+        for p in vports:
+            r = http_util.get(f"http://127.0.0.1:{p}/debug/failpoints",
+                              params={"name": name, "spec": spec},
+                              timeout=5)
+            assert r.ok, (p, r.status)
+
+    def run_phases(mport, mhttp, vports) -> "tuple[float, float]":
+        arm(vports, "volume.bulk.put", delay_spec)
+        arm(vports, "volume.bulk.read", delay_spec)
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        try:
+            mc.wait_connected()
+            # pre-grow a writable-volume spread (grow-to-want) so the
+            # measured phase isn't funneled through the single volume a
+            # fresh collection starts with — frames must be able to
+            # land on every server from the first second
+            want = max(8, 4 * len(vports))
+            vids = set()
+            stop = time.monotonic() + 20
+            while len(vids) < want and time.monotonic() < stop:
+                try:
+                    r = http_util.get(
+                        f"http://127.0.0.1:{mhttp}/dir/assign",
+                        params={"collection": "bench",
+                                "writableVolumeCount": str(want)},
+                        timeout=5).json()
+                    if "fid" in r:
+                        vids.add(r["fid"].split(",")[0])
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+            put_rps, fids_by_vid = _balance_put_phase(
+                mc, put_s, threads, payload, batch)
+            get_rps = _balance_get_phase(mc, fids_by_vid, get_s,
+                                         threads, batch)
+            return put_rps, get_rps
+        finally:
+            mc.stop()
+
+    # -- Phase A: single-server baseline ---------------------------------
+    procs, tmp, mport, mhttp, vports, _re = _spawn_rack_cluster(
+        "swtpu_bench_bal1_", volume_size_mb=1, vol_max=64, racks=["r1"],
+        extra_env={"SWTPU_READ_CACHE_MB": "0"},
+        extra_volume_args=["-qosPolicy", policy_path])
+    try:
+        solo_put, solo_get = run_phases(mport, mhttp, vports)
+    finally:
+        _stop_procs_cluster(procs, tmp)
+    log(f"balance scaling: 1-server bulk PUT {solo_put:,.0f} needles/s, "
+        f"GET {solo_get:,.0f} needles/s")
+
+    # -- Phase A: 4 servers across 2 racks -------------------------------
+    procs, tmp, mport, mhttp, vports, respawn = _spawn_rack_cluster(
+        "swtpu_bench_bal4_", volume_size_mb=1, vol_max=64,
+        racks=["r1", "r1", "r2", "r2"],
+        extra_env={"SWTPU_READ_CACHE_MB": "0"},
+        extra_volume_args=["-qosPolicy", policy_path])
+    try:
+        fleet_put, fleet_get = run_phases(mport, mhttp, vports)
+        put_x = fleet_put / max(1e-9, solo_put)
+        get_x = fleet_get / max(1e-9, solo_get)
+        log(f"balance scaling: 4-server bulk PUT {fleet_put:,.0f} "
+            f"needles/s ({put_x:.1f}x), GET {fleet_get:,.0f} needles/s "
+            f"({get_x:.1f}x)")
+        out.update(balance_solo_put_rps=round(solo_put, 1),
+                   balance_solo_get_rps=round(solo_get, 1),
+                   balance_fleet_put_rps=round(fleet_put, 1),
+                   balance_fleet_get_rps=round(fleet_get, 1),
+                   balance_put_scaling_x=round(put_x, 2),
+                   balance_get_scaling_x=round(get_x, 2))
+        assert put_x >= 2.5, \
+            f"bulk PUT scaled only {put_x:.2f}x on 4 servers (floor 2.5x)"
+        assert get_x >= 2.5, \
+            f"bulk GET scaled only {get_x:.2f}x on 4 servers (floor 2.5x)"
+        arm(vports, "volume.bulk.put", "")   # disarm: balance runs at
+        arm(vports, "volume.bulk.read", "")  # full speed
+
+        # -- Phase B: kill rack r2, skew rack r1, rejoin, rebalance ------
+        from seaweedfs_tpu.maintenance import make_probes
+        from seaweedfs_tpu.ops import events
+        from seaweedfs_tpu.placement import snapshot_from_servers
+        from seaweedfs_tpu.shell import (ec_commands,  # noqa: F401
+                                         volume_commands)
+        from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+        from seaweedfs_tpu.stats import BALANCE_BYTES_MOVED, BALANCE_MOVES
+
+        for i in (2, 3):  # rack r2 dies
+            procs[1 + i].terminate()
+        for i in (2, 3):
+            procs[1 + i].wait(timeout=10)
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=io.StringIO())
+
+        def wait_servers(n: int, deadline_s: float = 30) -> None:
+            stop = time.monotonic() + deadline_s
+            while time.monotonic() < stop:
+                if len(env.collect_volume_servers()) == n:
+                    return
+                time.sleep(0.3)
+            raise RuntimeError(f"topology never settled at {n} servers")
+
+        mc.wait_connected()
+        wait_servers(2)
+        # pre-grow a 16-volume spread for the skew collection on the
+        # two live r1 servers: each submit_batch leases one volume, so
+        # without the spread ALL the skew bytes pile into a single
+        # giant volume (fid leases pin a vid; the 1 MB limit only
+        # propagates on the next heartbeat) and one unmovable monolith
+        # can't rebalance
+        grown = set()
+        stop = time.monotonic() + 20
+        while len(grown) < 12 and time.monotonic() < stop:
+            try:
+                r = http_util.get(
+                    f"http://127.0.0.1:{mhttp}/dir/assign",
+                    params={"collection": "skew",
+                            "writableVolumeCount": "16"},
+                    timeout=5).json()
+                if "fid" in r:
+                    grown.add(r["fid"].split(",")[0])
+            except Exception:  # noqa: BLE001
+                time.sleep(0.2)
+        # ~16 MB of skew data in ~0.5 MB batches across those volumes:
+        # the fleet's post-balance mean load (~4 MB/server) then dwarfs
+        # the per-volume granularity and the 1.3 skew gate is reachable
+        skew_payloads = {}
+        rng = random.Random(99)
+        for _ in range(32):
+            batch_p = [rng.randbytes(64 << 10) for _ in range(8)]
+            for r, p in zip(operation.submit_batch(mc, batch_p,
+                                                   collection="skew"),
+                            batch_p):
+                skew_payloads[r.fid] = p
+        for i in (2, 3):  # rack r2 rejoins, empty
+            respawn(i)
+        wait_servers(4)
+
+        def shell(line: str) -> str:
+            env.out = io.StringIO()
+            run_command(env, line)
+            return env.out.getvalue()
+
+        shell("lock")
+        # EC-encode one skew volume RS(2,2): the placement spread must
+        # rack-cap it at p=2 per rack across r1/r2
+        ec_vid = int(next(iter(skew_payloads)).split(",")[0])
+        text = shell(f"ec.encode -volumeId {ec_vid} -ecShards 2,2")
+        assert "ec encoded 1 volumes" in text, text
+
+        def wait_sizes() -> None:
+            # balance plans on heartbeat-propagated sizes; wait until
+            # every registered volume reports a size
+            stop = time.monotonic() + 20
+            while time.monotonic() < stop:
+                vols = [v for s in env.collect_volume_servers()
+                        for d in s["disks"].values()
+                        for v in d.volume_infos]
+                if vols and all(v.size > 0 for v in vols):
+                    return
+                time.sleep(0.3)
+
+        def loads_and_racks():
+            _rm, geom = make_probes(env)
+            snap = snapshot_from_servers(
+                env.collect_volume_servers(),
+                shard_bytes_of=lambda vid, col: (
+                    (geom(vid, col) or {}).get("shard_size")),
+                default_shard_bytes=(1 << 20) // 2)
+            rack_of = {}
+            for s in env.collect_volume_servers():
+                rack_of[s["id"]] = s["rack"]
+            return snap, rack_of
+
+        wait_sizes()
+        snap, rack_of = loads_and_racks()
+        skew0 = (max(n.load_bytes for n in snap.nodes)
+                 / max(1, min(n.load_bytes for n in snap.nodes)))
+        log(f"balance: pre-balance byte skew {skew0:.2f}")
+        out["balance_skew_before"] = round(skew0, 2)
+        assert skew0 > 1.3, \
+            f"fixture never skewed (skew {skew0:.2f}) — nothing to prove"
+
+        # -- dryRun: zero mutating RPCs ----------------------------------
+        def fleet_state():
+            return sorted(
+                (s["id"], sorted(v.id for d in s["disks"].values()
+                                 for v in d.volume_infos),
+                 sorted((e.id, e.ec_index_bits)
+                        for d in s["disks"].values()
+                        for e in d.ec_shard_infos))
+                for s in env.collect_volume_servers())
+
+        state0 = fleet_state()
+        moves0 = sum(BALANCE_MOVES.value(k) for k in ("volume", "ec"))
+        since = events.JOURNAL.last_seq
+        text = shell("volume.balance -dryRun")
+        assert "dry run: nothing executed" in text, text
+        plan_evs = [e for e in events.JOURNAL.snapshot(
+            since=since, etype="balance") if e["type"] == "balance.plan"]
+        assert plan_evs and plan_evs[-1]["attrs"]["dry_run"] is True
+        assert fleet_state() == state0, "-dryRun mutated the fleet"
+        assert sum(BALANCE_MOVES.value(k)
+                   for k in ("volume", "ec")) == moves0
+        out["balance_dryrun_zero_rpcs"] = True
+
+        # -- the real thing ----------------------------------------------
+        since = events.JOURNAL.last_seq
+        text = shell("volume.balance")
+        assert "balanced:" in text, text
+        shell("ec.balance")
+        move_evs = [e for e in events.JOURNAL.snapshot(
+            since=since, etype="balance") if e["type"] == "balance.move"]
+        assert move_evs, "no balance.move journaled"
+        assert all(e["attrs"]["bytes_moved"] > 0 for e in move_evs)
+        out["balance_moves"] = len(move_evs)
+        out["balance_bytes_moved"] = int(
+            BALANCE_BYTES_MOVED.value("true")
+            + BALANCE_BYTES_MOVED.value("false"))
+
+        def settled_skew() -> float:
+            snap, _ = loads_and_racks()
+            return (max(n.load_bytes for n in snap.nodes)
+                    / max(1, min(n.load_bytes for n in snap.nodes)))
+
+        stop = time.monotonic() + 30
+        skew1 = settled_skew()
+        while skew1 > 1.3 and time.monotonic() < stop:
+            time.sleep(0.5)  # heartbeat settle
+            skew1 = settled_skew()
+        log(f"balance: post-balance byte skew {skew1:.2f} "
+            f"({len(move_evs)} moves, "
+            f"{out['balance_bytes_moved']:,} B)")
+        out["balance_skew_after"] = round(skew1, 2)
+        assert skew1 <= 1.3, \
+            f"post-balance byte skew {skew1:.2f} > 1.3"
+
+        # -- rack safety: no stripe has > p shards in one rack -----------
+        _rm, geom = make_probes(env)
+        per_stripe_rack: dict = {}
+        for s in env.collect_volume_servers():
+            for d in s["disks"].values():
+                for e in d.ec_shard_infos:
+                    bits = bin(e.ec_index_bits).count("1")
+                    racks = per_stripe_rack.setdefault(e.id, {})
+                    racks[s["rack"]] = racks.get(s["rack"], 0) + bits
+        assert per_stripe_rack, "EC stripe vanished"
+        for vid, racks in per_stripe_rack.items():
+            g = geom(vid, "skew") or {}
+            p = g.get("p") or 2
+            assert max(racks.values()) <= p, \
+                f"stripe {vid}: rack shard counts {racks} exceed p={p}"
+        out["balance_rack_safe_stripes"] = len(per_stripe_rack)
+
+        # -- rebalance visible as maintenance-class in qos metrics -------
+        def maint_admissions() -> float:
+            total = 0.0
+            for p in vports:
+                try:
+                    body = http_util.get(
+                        f"http://127.0.0.1:{p}/metrics",
+                        timeout=5).content.decode()
+                except Exception:  # noqa: BLE001
+                    continue
+                for line in body.splitlines():
+                    if line.startswith("SeaweedFS_qos_requests_total") \
+                            and 'class="maintenance"' in line:
+                        total += float(line.split()[-1])
+            return total
+
+        maint = maint_admissions()
+        assert maint > 0, \
+            "no maintenance-class qos admissions observed on any server"
+        out["balance_qos_maintenance_reqs"] = int(maint)
+
+        # -- data still serves, including the EC stripe ------------------
+        for fid, payload_b in list(skew_payloads.items())[:10]:
+            assert operation.read(mc, fid) == payload_b
+        mc.stop()
+        out["balance_topology"] = (
+            "separate-process master + 4 volume servers across 2 racks; "
+            "150 ms deterministic per-frame handler delay + 24 pinned-"
+            "lease workers for the scaling gate; skew = rack r2 down "
+            "while ~16 MB lands on r1 across a pre-grown volume "
+            "spread, then rejoin + ec.encode RS(2,2) + "
+            "volume.balance/ec.balance")
+        out["bench_balance_smoke"] = "ok"
+    finally:
+        _stop_procs_cluster(procs, tmp)
+        shutil.rmtree(os.path.dirname(policy_path), ignore_errors=True)
+
+
 def bench_cluster(out: dict, n_files: int, conc: int) -> None:
     import socket
 
@@ -1737,6 +2256,15 @@ def main() -> None:
                          "victim p99 <= 3x solo and goodput >= 50% with "
                          "QoS on, bound demonstrably violated with QoS "
                          "hot-disabled, sheds answer 503 + Retry-After")
+    ap.add_argument("--balance-only", action="store_true",
+                    dest="balance_only",
+                    help="run only the scale-out placement/rebalance "
+                         "smoke (make bench-balance): 4-server 2-rack "
+                         "topology must scale aggregate bulk PUT/GET "
+                         ">= 2.5x one server, post-balance byte skew "
+                         "<= 1.3, EC stripes rack-safe, -dryRun "
+                         "mutation-free, rebalance maintenance-class "
+                         "in qos metrics")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -1780,6 +2308,12 @@ def main() -> None:
         out_q: dict = {"metric": "bench_qos_smoke"}
         bench_qos_smoke(out_q)
         print(json.dumps(out_q))
+        return
+    if args.balance_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_b: dict = {"metric": "bench_balance_smoke"}
+        bench_balance_smoke(out_b)
+        print(json.dumps(out_b))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

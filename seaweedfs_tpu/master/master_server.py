@@ -311,6 +311,11 @@ class MasterServer:
                         replication=q.get("replication", ""),
                         ttl=q.get("ttl", ""),
                         disk_type=q.get("disk_type", ""),
+                        # placement preferences (reference
+                        # /dir/assign?dataCenter=&rack=): honored by
+                        # VolumeGrowth when the assign has to grow
+                        data_center=q.get("dataCenter", ""),
+                        rack=q.get("rack", ""),
                         writable_volume_count=int(
                             q.get("writableVolumeCount", 0)))
                 except ValueError as e:

@@ -5,6 +5,15 @@ Reference: weed/topology/volume_growth.go:94 (AutomaticGrowByType),
 replica placement xyz code decides the spread: first server in some rack,
 `same_rack` more in that rack, `other_rack` in other racks of the same DC,
 `other_dc` in other data centers.
+
+Candidate picks inside each structural slot go through the placement
+engine's shared scoring core (seaweedfs_tpu/placement/engine.py): free
+slots, byte load (volume AND EC shard bytes), and live breaker state
+rank the candidates, so a half-dead or shard-crushed node stops winning
+placements just because it has free slots. All randomness flows through
+ONE injectable `random.Random` (`rng=`) — tests seed it and the pick
+paths become reproducible (the spread property tests pin the
+same_rack/other_rack contract across randomized topologies).
 """
 
 from __future__ import annotations
@@ -32,18 +41,25 @@ class GrowRequest:
 
 
 class VolumeGrowth:
-    def __init__(self, topo: Topology, allocate_fn=None):
+    def __init__(self, topo: Topology, allocate_fn=None,
+                 rng: "random.Random | None" = None):
         """allocate_fn(node, vid, req) performs the AllocateVolume RPC; tests
-        inject a fake."""
+        inject a fake. `rng` seeds every shuffle/choice in the pick paths
+        (tests pin it; production uses the module-global stream)."""
         self.topo = topo
         self.allocate_fn = allocate_fn
+        self.rng = rng if rng is not None else random
 
     def find_slots(self, req: GrowRequest) -> list[DataNode]:
         """Pick a replica set honoring the placement code, or raise."""
         rp = ReplicaPlacement.parse(req.replication)
         with self.topo.lock:
             dcs = list(self.topo.dcs.values())
-            random.shuffle(dcs)
+            # shuffle-then-stable-sort: DCs rank by free capacity
+            # (emptiest first) with ties staying randomized — repeated
+            # grows fill the fleet evenly instead of coin-flipping
+            self.rng.shuffle(dcs)
+            dcs.sort(key=lambda d: -self._dc_free(d, req.disk_type))
             main_dc = None
             for dc in dcs:
                 if req.preferred_dc and dc.id != req.preferred_dc:
@@ -58,7 +74,7 @@ class VolumeGrowth:
                     continue
                 main_dc = dc
                 servers = picked
-                for d in random.sample(others, rp.other_dc):
+                for d in self.rng.sample(others, rp.other_dc):
                     n = self._pick_one(self._dc_nodes(d), req)
                     if n is None:
                         break
@@ -79,15 +95,29 @@ class VolumeGrowth:
 
     def _pick_one(self, nodes: list[DataNode], req: GrowRequest,
                   exclude: set[str] = frozenset()) -> DataNode | None:
+        """Best candidate by the shared placement score (free ratio,
+        byte load incl. EC shards, breaker state); exact-score ties
+        break through self.rng so a seeded run is reproducible."""
         cands = [n for n in nodes if n.id not in exclude
                  and n.free_slots(req.disk_type) >= 1
                  and (not req.preferred_node or n.id == req.preferred_node)]
-        return random.choice(cands) if cands else None
+        if not cands:
+            return None
+        from ..placement import engine as placement_engine
+        views = [placement_engine.view_of_data_node(
+            n, self.topo.volume_size_limit, disk_type=req.disk_type)
+            for n in cands]
+        best = placement_engine.pick_best(views, rng=self.rng)
+        return next(n for n in cands if n.id == best.id)
 
     def _pick_in_dc(self, dc, rp: ReplicaPlacement, req: GrowRequest
                     ) -> list[DataNode] | None:
         racks = list(dc.racks.values())
-        random.shuffle(racks)
+        # same shuffle-then-sort as DCs: the emptiest rack hosts the
+        # next volume (rack-level even fill), random only across ties
+        self.rng.shuffle(racks)
+        racks.sort(key=lambda r: -sum(n.free_slots(req.disk_type)
+                                      for n in r.nodes.values()))
         for rack in racks:
             if req.preferred_rack and rack.id != req.preferred_rack:
                 continue
@@ -109,7 +139,7 @@ class VolumeGrowth:
                 used.add(n.id)
             if not picked:
                 continue
-            for r in random.sample(other_racks, rp.other_rack):
+            for r in self.rng.sample(other_racks, rp.other_rack):
                 n = self._pick_one(list(r.nodes.values()), req)
                 if n is None:
                     return None

@@ -68,10 +68,74 @@ class VolumeLayout:
                     self.writable.discard(vid)
 
     def pick_for_write(self) -> int | None:
+        """A writable volume id, placement-aware: volumes whose every
+        holder sits behind an OPEN circuit breaker are deprioritized
+        (an assign pointing at a half-dead node costs the client a
+        retry budget), and among the healthy the pick is weighted
+        toward holders with lower byte load — the placement engine's
+        load definition (volume + EC shard bytes), so hot nodes shed
+        new write traffic naturally. Still randomized across the
+        preferred tier so one volume never becomes the write hotspot."""
         with self.lock:
             if not self.writable:
                 return None
-            return random.choice(tuple(self.writable))
+            cands = tuple(self.writable)
+            if len(cands) == 1:
+                return cands[0]
+            healthy, shunned = [], []
+            try:
+                from .. import ec as ec_accounting
+                from ..placement.engine import DEFAULT_SHARD_DIVISOR
+                from ..utils import retry
+                est_shard = (self.topo.volume_size_limit
+                             // DEFAULT_SHARD_DIVISOR)
+                # per-NODE byte loads memoized once (several writable
+                # vids share holders — recomputing per vid made every
+                # assign O(vids x volumes) under the topology lock),
+                # counting volume bytes AND estimated EC shard bytes:
+                # the engine's one load definition, so a shard-crushed
+                # holder can't read as empty on the write path either
+                node_bytes: dict[str, int] = {}
+
+                def load_of(h) -> int:
+                    b = node_bytes.get(h.id)
+                    if b is None:
+                        b = sum(v.size for v in h.all_volumes()) + \
+                            est_shard * sum(
+                                ec_accounting.shard_count(s.shard_bits)
+                                for s in h.all_ec_shards())
+                        node_bytes[h.id] = b
+                    return b
+
+                # iterate holder maps under the topology lock:
+                # heartbeat ingest mutates them concurrently
+                with self.topo.lock:
+                    loads = {}
+                    for vid in cands:
+                        holders = list(
+                            self.topo.volume_locations.get(vid, {})
+                            .values())
+                        if holders and all(
+                                retry.breaker(h.id).state == retry.OPEN
+                                for h in holders):
+                            shunned.append(vid)
+                            continue
+                        healthy.append(vid)
+                        loads[vid] = max(
+                            (load_of(h) for h in holders), default=0)
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (placement nuance must never fail an assign)
+                return random.choice(cands)
+            if not healthy:
+                return random.choice(cands)
+            # prefer volumes at or under the median holder byte load;
+            # <= median (not "first half sorted") so ties — the common
+            # fresh-cluster case — keep the WHOLE candidate set and
+            # writes stay uniformly spread across servers
+            ranked = sorted(loads.get(vid, 0) for vid in healthy)
+            median = ranked[(len(ranked) - 1) // 2]
+            tier = [vid for vid in healthy
+                    if loads.get(vid, 0) <= median]
+            return random.choice(tier or healthy)
 
     def active_count(self) -> int:
         with self.lock:

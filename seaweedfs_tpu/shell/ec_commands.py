@@ -69,13 +69,23 @@ def _free_slots(srv: dict) -> int:
     return sum(d.free_volume_count for d in srv["disks"].values())
 
 
-def balanced_ec_distribution(servers: list[dict], n_shards: int) -> list[dict]:
-    """Round-robin shards onto servers with most free slots
-    (reference command_ec_encode.go:333)."""
+def balanced_ec_distribution(servers: list[dict], n_shards: int,
+                             parity: int = 0, vid: int = 0) -> list[dict]:
+    """Shard -> server assignment through the placement engine
+    (placement/engine.py spread_ec_shards): scored by free slots, byte
+    load and breaker state, and RACK-CAPPED — no rack holds more than
+    `parity` shards of the stripe, so a rack loss stays reconstructable
+    (degrades gracefully to the most-even spread when the fleet has too
+    few racks). parity=0 keeps the legacy free-slot ranking semantics
+    with no rack cap (the reference command_ec_encode.go:333 shape)."""
     if not servers:
         raise RuntimeError("no volume servers")
-    ranked = sorted(servers, key=_free_slots, reverse=True)
-    return [ranked[i % len(ranked)] for i in range(n_shards)]
+    from ..placement import snapshot_from_servers, spread_ec_shards
+    snap = snapshot_from_servers(servers)
+    by_id = {s["id"]: s for s in servers}
+    views = spread_ec_shards(snap, n_shards,
+                             parity if parity > 0 else n_shards, vid=vid)
+    return [by_id[v.id] for v in views]
 
 
 @command("ec.encode",
@@ -190,9 +200,11 @@ def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
     (reference command_ec_encode.go:187 spreadEcShards)."""
     stub = _stub(env, srv)
     n_shards = (d or 10) + (p or 4)
-    # 3. spread (command_ec_encode.go:187): copy to targets, mount, clean src
+    # 3. spread (command_ec_encode.go:187): copy to targets, mount, clean
+    # src — rack-capped at p shards per rack so rack loss != data loss
     servers = env.collect_volume_servers()
-    placement = balanced_ec_distribution(servers, n_shards)
+    placement = balanced_ec_distribution(servers, n_shards,
+                                         parity=(p or 4), vid=vid)
     by_server: dict[str, tuple[dict, list[int]]] = {}
     for sid, target in enumerate(placement):
         by_server.setdefault(target["id"], (target, []))[1].append(sid)
@@ -363,45 +375,79 @@ def _probe_n_shards(env: CommandEnv, srv: dict, vid: int, collection: str) -> in
     return 14
 
 
-@command("ec.balance", "spread ec shards evenly across servers", needs_lock=True)
+@command("ec.balance",
+         "[-dryRun] [-collection C] [-maxMoves 64]: spread ec shards "
+         "evenly across servers, rack-safety-capped")
 def cmd_ec_balance(env: CommandEnv, args):
-    """Reference command_ec_balance.go simplified: while one server holds
-    more shards of a volume than ceil(n/servers), move one to the server
-    with fewest (fork VolumeEcShardsMove does copy+delete)."""
-    moves = 0
-    vols = set()
-    for srv in env.collect_volume_servers():
-        for disk in srv["disks"].values():
-            for s in disk.ec_shard_infos:
-                vols.add((s.id, s.collection))
-    for vid, collection in sorted(vols):
-        while True:
-            holders = _settled_ec_holders(env, vid)
-            servers = env.collect_volume_servers()
-            count: dict[str, list[int]] = {s["id"]: [] for s in servers}
-            for sid, hs in holders.items():
-                for h in hs:
-                    count.setdefault(h["id"], []).append(sid)
-            total = len(holders)
-            cap = -(-total // max(1, len(servers)))  # ceil
-            over = [(k, v) for k, v in count.items() if len(v) > cap]
-            under = sorted(count.items(), key=lambda kv: len(kv[1]))
-            if not over or len(under[0][1]) >= cap:
-                break
-            src_id, sids = over[0]
-            dst_id = under[0][0]
-            srv_map = {s["id"]: s for s in servers}
-            sid = sids[0]
-            env.println(f"  ec.balance vol {vid} shard {sid} {src_id} -> {dst_id}")
-            _stub(env, srv_map[dst_id]).call(
-                "VolumeEcShardsMove",
-                vpb.VolumeEcShardsMoveRequest(
-                    volume_id=vid, collection=collection, shard_ids=[sid],
-                    source_data_node=env.grpc_addr(
-                        src_id, srv_map[src_id]["grpc_port"])),
-                vpb.VolumeEcShardsMoveResponse, timeout=3600)
-            moves += 1
-    env.println(f"moved {moves} shards")
+    """Thin shell over the placement plane (seaweedfs_tpu/placement/):
+    ONE topology snapshot plans every move (the old loop re-ran the
+    settled-holder poll + a full cluster collect per single shard), all
+    shards of a stripe moving between one (src, dst) pair ride ONE
+    VolumeEcShardsMove RPC, no rack ends up holding more than the
+    stripe's parity count, and every hop is maintenance-class through
+    the QoS plane with its byte cost journaled. -dryRun prints the
+    exact plan and performs zero mutating RPCs."""
+    from ..maintenance import make_probes
+    from ..placement import (BalanceExecutor, build_ec_balance_plan,
+                             snapshot_from_servers)
+
+    p = argparse.ArgumentParser(prog="ec.balance")
+    p.add_argument("-dryRun", action="store_true",
+                   help="print the plan, mutate nothing")
+    p.add_argument("-collection", default=None,
+                   help="balance only this collection's stripes")
+    p.add_argument("-maxMoves", type=int, default=64)
+    opt = p.parse_args(args)
+
+    # stripes can drift for a pulse after encode/rebuild RPCs; settle
+    # one stripe's holder view (two consecutive identical reads) before
+    # snapshotting so the plan isn't built mid-heartbeat — ONCE, not
+    # once per move like the old loop
+    any_vid = next((s.id for srv in env.collect_volume_servers()
+                    for disk in srv["disks"].values()
+                    for s in disk.ec_shard_infos), None)
+    if any_vid is None:
+        env.println("no ec shards to balance")
+        return
+    _settled_ec_holders(env, any_vid, tries=5)
+    _remount_probe, geometry_probe = make_probes(env)
+
+    def parity_of(vid: int, collection: str) -> "int | None":
+        g = geometry_probe(vid, collection)
+        return g.get("p") if g else None
+
+    def shard_bytes_of(vid: int, collection: str) -> "int | None":
+        g = geometry_probe(vid, collection)
+        return g.get("shard_size") if g else None
+
+    limit_mb = env.mc.volume_list().volume_size_limit_mb or 30_000
+    snap = snapshot_from_servers(
+        env.collect_volume_servers(), shard_bytes_of=shard_bytes_of,
+        default_shard_bytes=(limit_mb << 20) // 10)
+    plan = build_ec_balance_plan(snap, collection=opt.collection,
+                                 parity_of=parity_of,
+                                 max_moves=opt.maxMoves)
+    plan.render(env.println)
+    if opt.dryRun:
+        BalanceExecutor(env).execute(plan, dry_run=True)
+        env.println("dry run: nothing executed")
+        return
+    had_lock = bool(env.lock_token)
+    env.acquire_lock()
+    try:
+        res = BalanceExecutor(env, max_moves=opt.maxMoves).execute(plan)
+    finally:
+        if not had_lock:
+            try:
+                env.release_lock()
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (lease already expired/released)
+                pass
+    moved = sum(len(m["shard_ids"]) for m in res["done"])
+    env.println(f"moved {moved} shards in {len(res['done'])} grouped "
+                f"move(s), {len(res['failed'])} failed")
+    for f in res["failed"]:
+        env.println(f"  FAILED ec {f['vid']} shards {f['shard_ids']} "
+                    f"{f['src']} -> {f['dst']}: {f['error']}")
 
 
 @command("ec.decode", "-volumeId N: convert ec shards back to a normal volume",

@@ -514,35 +514,76 @@ def cmd_volume_move(env: CommandEnv, args):
     env.println(f"moved volume {opt.volumeId} {opt.source} -> {opt.target}")
 
 
-@command("volume.balance", "even out volume counts across servers",
-         needs_lock=True)
+@command("volume.balance",
+         "[-dryRun] [-collection C] [-maxMoves 64] [-targetSkew 1.15] "
+         "[-crossRackLimitMB N]: move volumes toward even BYTE load")
 def cmd_volume_balance(env: CommandEnv, args):
-    """Reference command_volume_balance.go simplified: move volumes from the
-    fullest server to the emptiest until counts differ by <= 1.
+    """Thin shell over the placement plane (seaweedfs_tpu/placement/):
+    one topology snapshot becomes a deterministic byte-costed MovePlan —
+    most-loaded server sheds toward least-loaded until max/min byte
+    skew converges, with EC SHARD BYTES counted in every server's load
+    (the old count-based pass treated a shard-crushed server as empty
+    and piled volumes onto it), intra-rack moves preferred and
+    cross-rack bytes capped per run. Execution is maintenance-class
+    through the QoS plane, every move journals `balance.move` with its
+    byte cost, and -dryRun prints the exact plan with zero mutating
+    RPCs — the cluster.repair shape."""
+    from ..maintenance import make_probes
+    from ..placement import (BalanceExecutor, build_volume_balance_plan,
+                             snapshot_from_servers)
+    from ..placement.plan import (DEFAULT_CROSS_RACK_LIMIT,
+                                  DEFAULT_TARGET_SKEW)
 
-    Plans every move against ONE topology snapshot updated locally after
-    each move — re-collecting from the master mid-loop races heartbeat
-    propagation and can replay a finished move ("volume already here")."""
-    servers = env.collect_volume_servers()
-    state = {s["id"]: {v.id: v for d in s["disks"].values()
-                       for v in d.volume_infos} for s in servers}
-    info = {s["id"]: s for s in servers}
-    while True:
-        counts = sorted((len(vols), sid) for sid, vols in state.items())
-        (low_n, low_id), (high_n, high_id) = counts[0], counts[-1]
-        if high_n - low_n <= 1:
-            break
-        movable = [v for vid, v in state[high_id].items()
-                   if vid not in state[low_id]]
-        if not movable:
-            break
-        v = movable[0]
-        env.println(f"  balancing: volume {v.id} {high_id} -> {low_id}")
-        _safe_copy_volume(env, v.id, v.collection, info[high_id],
-                          info[low_id], delete_source=True)
-        state[low_id][v.id] = v
-        del state[high_id][v.id]
-    env.println("balanced")
+    p = argparse.ArgumentParser(prog="volume.balance")
+    p.add_argument("-dryRun", action="store_true",
+                   help="print the plan, mutate nothing")
+    p.add_argument("-collection", default=None,
+                   help="move only this collection's volumes (load is "
+                        "still scored fleet-wide)")
+    p.add_argument("-maxMoves", type=int, default=64)
+    p.add_argument("-targetSkew", type=float, default=DEFAULT_TARGET_SKEW,
+                   help="stop when max/min per-server bytes <= this")
+    p.add_argument("-crossRackLimitMB", type=int, default=0,
+                   help="cap on cross-rack bytes this run "
+                        "(0 = default 30 GB)")
+    opt = p.parse_args(args)
+
+    _remount_probe, geometry_probe = make_probes(env)
+
+    def shard_bytes_of(vid: int, collection: str) -> "int | None":
+        g = geometry_probe(vid, collection)
+        return g.get("shard_size") if g else None
+
+    limit_mb = env.mc.volume_list().volume_size_limit_mb or 30_000
+    snap = snapshot_from_servers(
+        env.collect_volume_servers(), shard_bytes_of=shard_bytes_of,
+        default_shard_bytes=(limit_mb << 20) // 10)
+    plan = build_volume_balance_plan(
+        snap, collection=opt.collection, target_skew=opt.targetSkew,
+        max_moves=opt.maxMoves,
+        cross_rack_limit_bytes=(opt.crossRackLimitMB << 20
+                                or DEFAULT_CROSS_RACK_LIMIT))
+    plan.render(env.println)
+    if opt.dryRun:
+        BalanceExecutor(env).execute(plan, dry_run=True)
+        env.println("dry run: nothing executed")
+        return
+    had_lock = bool(env.lock_token)
+    env.acquire_lock()
+    try:
+        res = BalanceExecutor(env, max_moves=opt.maxMoves).execute(plan)
+    finally:
+        if not had_lock:
+            try:
+                env.release_lock()
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (lease already expired/released)
+                pass
+    env.println(f"balanced: {len(res['done'])} move(s), "
+                f"{len(res['failed'])} failed, "
+                f"{sum(m['bytes_moved'] for m in res['done']):,} B moved")
+    for f in res["failed"]:
+        env.println(f"  FAILED volume {f['vid']} {f['src']} -> "
+                    f"{f['dst']}: {f['error']}")
 
 
 @command("volume.tier.upload",

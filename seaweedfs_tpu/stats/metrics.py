@@ -405,6 +405,21 @@ REPAIR_BYTES_READ = _counter(
 REPAIR_BYTES_WRITTEN = _counter(
     "SeaweedFS_repair_bytes_written_total",
     "shard bytes written by repairs", ("codec",))
+# Rebalance plane (placement/): moves executed by kind (volume / ec
+# shard group) and the bytes they dragged across the fleet, split by
+# rack locality — the warehouse-cluster lesson is that CROSS-RACK
+# rebalance bytes compete with repair and foreground reads for the
+# inter-rack fabric, so operators graph the cross_rack="true" series
+# against the planner's per-run cap. Both label spaces are bounded by
+# construction (kind ∈ {volume, ec}, cross_rack ∈ {true, false}).
+BALANCE_MOVES = _counter(
+    "SeaweedFS_balance_moves_total",
+    "rebalance moves executed, by kind (volume / ec shard group)",
+    ("kind",))
+BALANCE_BYTES_MOVED = _counter(
+    "SeaweedFS_balance_bytes_moved_total",
+    "bytes moved by rebalance, by rack locality of the hop",
+    ("cross_rack",))
 # Batched ingest plane (fid-range leases + bulk PUT): outstanding leases
 # on the master (a drained system reads 0 — the bench-ingest smoke
 # asserts it), the per-frame batching the /bulk handler actually sees

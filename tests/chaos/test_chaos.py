@@ -1164,3 +1164,149 @@ def test_repair_loop_converges_after_node_death(cluster):
     # payloads still served from the healed stripe
     for fid, data in list(ec_payloads.items())[:5]:
         assert operation.read(mc, fid) == data
+
+def test_rack_kill_after_balance_keeps_ec_reconstructable(tmp_path):
+    """The rack-kill schedule (ISSUE 13): a 4-server/2-rack fleet
+    EC-encodes RS(2,2) through the placement spread, runs a full
+    balance pass (volume.balance + ec.balance), then EVERY volume
+    server in one synthetic rack dies at once. The rack-safety
+    invariant — no rack holds more than p shards of a stripe — must
+    make that survivable end-to-end: every EC payload still
+    reconstructs from the surviving rack, and health returns to OK
+    once the rack resurrects over its old directories. Runs on its own
+    mini-cluster (the shared fixture's topology has no racks)."""
+    import io
+
+    import numpy as np
+    from conftest import wait_until
+    from seaweedfs_tpu.shell import ec_commands, volume_commands  # noqa: F401
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3, ec_parity_shards=2)
+    master.start()
+    racks = ["r1", "r1", "r2", "r2"]
+    servers = []
+    dirs = []
+    try:
+        for i, rack in enumerate(racks):
+            d = tmp_path / f"rk{i}"
+            d.mkdir()
+            dirs.append(str(d))
+            port = free_port()
+            store = Store("127.0.0.1", port, "",
+                          [DiskLocation(str(d), max_volume_count=20)],
+                          coder_name="numpy")
+            vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                              grpc_port=free_port(), pulse_seconds=0.3,
+                              data_center="dc1", rack=rack)
+            vs.start()
+            servers.append(vs)
+        from conftest import wait_cluster_up
+        wait_cluster_up(master, servers)
+        mc = MasterClient(f"127.0.0.1:{mport}").start()
+        env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=io.StringIO())
+
+        def shell(line: str) -> str:
+            env.out = io.StringIO()
+            run_command(env, line)
+            return env.out.getvalue()
+
+        # -- fixture data: one EC collection + replicated needles ----------
+        rng = np.random.default_rng(31)
+        ec_payloads = {}
+        for _ in range(20):
+            data = rng.integers(0, 256, int(rng.integers(800, 9000)),
+                                dtype=np.uint8).tobytes()
+            r = operation.submit(mc, data, collection="rkec")
+            ec_payloads[r.fid] = data
+        rep_payloads = {}
+        for _ in range(6):
+            data = rng.integers(0, 256, 2048, dtype=np.uint8).tobytes()
+            r = operation.submit(mc, data, replication="010")
+            rep_payloads[r.fid] = data
+        ec_vid = int(next(iter(ec_payloads)).split(",")[0])
+        wait_until(lambda: master.topo.lookup(ec_vid),
+                   timeout=15, msg="ec source volume registered")
+
+        shell("lock")
+        text = shell(f"ec.encode -volumeId {ec_vid} -ecShards 2,2")
+        assert "ec encoded 1 volumes" in text, text
+        wait_until(lambda: sorted(master.topo.lookup_ec(ec_vid)) ==
+                   [0, 1, 2, 3], timeout=20,
+                   msg="all 4 ec shards registered")
+
+        # -- the balance pass the schedule requires ------------------------
+        shell("volume.balance")
+        shell("ec.balance")
+
+        def rack_shard_counts() -> dict:
+            counts: dict[str, int] = {}
+            holders = master.topo.lookup_ec(ec_vid)
+            for _sid, nodes in holders.items():
+                for n in nodes:
+                    counts[n.rack.id] = counts.get(n.rack.id, 0) + 1
+            return counts
+
+        wait_until(lambda: sum(rack_shard_counts().values()) == 4,
+                   timeout=20, msg="ec shards settled post-balance")
+        counts = rack_shard_counts()
+        assert max(counts.values()) <= 2, \
+            f"rack-safety violated post-balance: {counts}"
+
+        # -- kill EVERY server in rack r2 ----------------------------------
+        victims = [vs for vs, rack in zip(servers, racks) if rack == "r2"]
+        for vs in victims:
+            vs.stop()
+        wait_until(lambda: all(f"127.0.0.1:{vs.port}" not in
+                               master.topo.nodes for vs in victims),
+                   timeout=15, msg="rack r2 dropped from topology")
+
+        # the rack-safety invariant end-to-end: >= d shards survive in
+        # rack r1, so every payload still reconstructs
+        for fid, data in ec_payloads.items():
+            assert operation.read(mc, fid) == data, \
+                f"ec payload {fid} unreadable after rack loss"
+        # replicated 010 payloads kept a copy in the surviving rack
+        for fid, data in rep_payloads.items():
+            assert operation.read(mc, fid) == data
+        assert master.health.scan()["verdict"] != "OK"
+
+        # -- resurrection over the same directories ------------------------
+        for idx, vs in enumerate(servers):
+            if vs not in victims:
+                continue
+            store = Store("127.0.0.1", vs.port, "",
+                          [DiskLocation(dirs[idx], max_volume_count=20)],
+                          coder_name="numpy")
+            store.port = vs.port
+            store.public_url = f"127.0.0.1:{vs.port}"
+            reborn = VolumeServer(store, f"127.0.0.1:{mport}",
+                                  port=vs.port, grpc_port=vs.grpc_port,
+                                  pulse_seconds=0.3,
+                                  data_center="dc1", rack="r2")
+            reborn.start()
+            servers[idx] = reborn
+        wait_until(lambda: len(master.topo.nodes) == 4, timeout=20,
+                   msg="rack r2 re-registered")
+        wait_until(lambda: master.health.scan()["verdict"] == "OK",
+                   timeout=30, msg="health verdict returns to OK after "
+                                   "rack resurrection")
+        for fid, data in list(ec_payloads.items())[:6]:
+            assert operation.read(mc, fid) == data
+        mc.stop()
+    finally:
+        for vs in servers:
+            try:
+                vs.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        master.stop()

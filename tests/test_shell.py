@@ -153,11 +153,18 @@ def test_full_ec_lifecycle_via_shell(cluster):
 
 
 def test_volume_balance_and_fix_replication(cluster):
+    """volume.balance is byte-costed through the placement plane: -dryRun
+    mutates nothing, a mutating run reaches a fixed point (replanning on
+    the post-balance topology finds no improving move), byte skew never
+    worsens, and every payload stays readable after its volume moved."""
     master, servers, mc, env, out = cluster
     sh(env, out, "lock")
     from conftest import wait_until
+    fids = {}
     for i in range(6):
-        operation.submit(mc, os.urandom(2000), collection=f"bal{i}")
+        payload = os.urandom(2000)
+        fids[operation.submit(mc, payload, collection=f"bal{i}").fid] = \
+            payload
     def sizes_settled():
         with master.topo.lock:
             infos = [v for n in master.topo.all_nodes()
@@ -165,11 +172,34 @@ def test_volume_balance_and_fix_replication(cluster):
         return len(infos) >= 6 and all(v.size > 0 for v in infos)
 
     wait_until(sizes_settled, msg="volume sizes reach the master")
+
+    def server_state():
+        return [sorted(vid for loc in vs.store.locations
+                       for vid in loc.volumes) for vs in servers]
+
+    def byte_loads():
+        return [max(1, sum(v.content_size for loc in vs.store.locations
+                           for v in loc.volumes.values()))
+                for vs in servers]
+
+    before_state = server_state()
+    before_skew = max(byte_loads()) / min(byte_loads())
+    # dry run: the exact plan prints, zero mutating RPCs land
+    text = sh(env, out, "volume.balance -dryRun")
+    assert "balance plan:" in text and "dry run: nothing executed" in text
+    assert server_state() == before_state, "dry run moved volumes"
+
     sh(env, out, "volume.balance")
-    counts = []
-    for vs in servers:
-        counts.append(sum(len(l.volumes) for l in vs.store.locations))
-    assert max(counts) - min(counts) <= 1, counts
+    # fixed point: replanning over the settled post-balance topology
+    # finds nothing left worth moving
+    wait_until(lambda: "0 move(s)" in
+               sh(env, out, "volume.balance -dryRun"),
+               msg="balance reaches a fixed point")
+    after_skew = max(byte_loads()) / min(byte_loads())
+    assert after_skew <= before_skew + 1e-9, (before_skew, after_skew)
+    # moved volumes still serve every byte
+    for fid, payload in fids.items():
+        assert operation.read(mc, fid) == payload
 
 
 def test_volume_tier_move(tmp_path):
