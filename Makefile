@@ -61,10 +61,13 @@ bench-ec:
 bench-ingest:
 	JAX_PLATFORMS=cpu python bench.py --ingest-only
 
-# seconds-long repair-traffic smoke: rebuild one lost data shard of the
-# same volume under plain RS and the piggybacked codec, assert the
-# piggyback path reads <= 0.7x the survivor bytes (via
-# SeaweedFS_repair_bytes_read_total) with a byte-identical result
+# seconds-long repair-traffic CODEC MATRIX: rebuild a lost data AND a
+# lost parity shard under rs / piggyback / msr at RS(14,2) and RS(10,4),
+# recording per-codec repair_bytes_read_per_lost_byte (via
+# SeaweedFS_repair_bytes_read_total) with byte-identical results; gates
+# piggyback <= 0.7x rs at 10,4 and msr <= 8.0 / <= 4.0 shard-equivalents
+# (data AND parity; cut-set bounds 7.5 / 3.25), msr multi-loss reading
+# each survivor exactly once
 bench-repair:
 	JAX_PLATFORMS=cpu python bench.py --repair-only
 

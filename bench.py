@@ -501,25 +501,27 @@ def bench_ec_smoke(out: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Repair-traffic smoke (make bench-repair): rebuild ONE lost data shard
-# under both codecs on the same volume bytes and compare survivor bytes
-# read. Plain RS reads d full shards; the piggybacked codec's ranged plan
-# reads (d+|group|)/2 half-shard ranges — asserted <= 0.7x via the
-# SeaweedFS_repair_bytes_read_total counter, with the rebuilt shard
-# byte-identical to the original in both cases.
+# Repair-traffic smoke (make bench-repair): the CODEC MATRIX. For each
+# registered codec at the fork's RS(14,2) AND upstream RS(10,4), rebuild
+# one lost DATA shard and one lost PARITY shard from the same volume
+# bytes and record survivor bytes read per lost byte (via the
+# SeaweedFS_repair_bytes_read_total counter, rebuilt shards asserted
+# byte-identical). Gates:
+#   * piggyback data-shard repair <= 0.7x plain RS at RS(10,4);
+#   * msr repair — data AND parity — <= 8.0 shard-equivalents at
+#     RS(14,2) (cut-set bound 7.5; plain RS reads 14) and <= 4.0 at
+#     RS(10,4) (bound 3.25; plain RS reads 10);
+#   * msr multi-loss rebuild reads each survivor exactly once.
 # ---------------------------------------------------------------------------
 
 def bench_repair_smoke(out: dict) -> None:
     from seaweedfs_tpu.ec import files as ecf
     from seaweedfs_tpu.ec.encoder import encode_volume, rebuild_shards
     from seaweedfs_tpu.ec.locate import EcGeometry
-    from seaweedfs_tpu.ops.coder import NumpyCoder
-    from seaweedfs_tpu.ops.piggyback import PiggybackCoder
+    from seaweedfs_tpu.ops.coder import codec_coder
     from seaweedfs_tpu.stats import REPAIR_BYTES_READ
 
-    geo = EcGeometry(d=D, p=P, large_block=1 << 22, small_block=1 << 18)
-    # lost shard 1 sits in a size-3 piggyback group: plan = (10+3)/2 = 6.5
-    lost = 1
+    msr_gate = {(14, 2): 8.0, (10, 4): 4.0}
     tmp = tempfile.mkdtemp(prefix="swtpu_bench_repair_")
     try:
         rng = np.random.default_rng(11)
@@ -527,39 +529,90 @@ def bench_repair_smoke(out: dict) -> None:
         datp = os.path.join(tmp, "v.dat")
         with open(datp, "wb") as f:
             f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
-        ratios = {}
-        for codec, coder in (("rs", NumpyCoder(D, P)),
-                             ("piggyback", PiggybackCoder(D, P))):
-            base = os.path.join(tmp, codec)
-            encode_volume(datp, base, geo, coder)
-            shard_size = os.path.getsize(base + ecf.shard_ext(lost))
-            original = open(base + ecf.shard_ext(lost), "rb").read()
-            os.remove(base + ecf.shard_ext(lost))
+
+        def one_rebuild(base, geo, coder, lost: "list[int]",
+                        originals) -> tuple[float, float, str]:
+            codec = coder.codec
             before = REPAIR_BYTES_READ.value(codec)
             stats: dict = {}
             t0 = time.perf_counter()
             rebuilt = rebuild_shards(base, geo, coder, stats=stats)
             dt = time.perf_counter() - t0
-            assert rebuilt == [lost], rebuilt
-            rebuilt_bytes = open(base + ecf.shard_ext(lost), "rb").read()
-            assert rebuilt_bytes == original, \
-                f"{codec}: rebuilt shard not byte-identical"
+            assert sorted(rebuilt) == sorted(lost), (rebuilt, lost)
+            for sid in lost:
+                got = open(base + ecf.shard_ext(sid), "rb").read()
+                assert got == originals[sid], \
+                    f"{codec}: shard {sid} not byte-identical"
             read = REPAIR_BYTES_READ.value(codec) - before
             assert read == stats["bytes_read"], (read, stats)
-            per_lost = read / shard_size
-            ratios[codec] = per_lost
-            out[f"repair_{codec}_bytes_read_per_lost_byte"] = round(
-                per_lost, 3)
-            out[f"repair_{codec}_rebuild_GBps"] = round(
-                shard_size / dt / 1e9, 3)
-            out[f"repair_{codec}_path"] = stats["path"]
-            log(f"repair smoke [{codec}]: {per_lost:.2f} bytes read per "
-                f"lost byte, {shard_size / dt / 1e9:.3f} GB/s rebuild "
-                f"({stats['path']})")
-        ratio = ratios["piggyback"] / ratios["rs"]
-        out["repair_piggyback_vs_rs"] = round(ratio, 3)
-        # the acceptance gate: piggybacked repair moves <= 0.7x the bytes
-        assert ratio <= 0.7, f"piggyback repair ratio {ratio} > 0.7"
+            shard_size = len(originals[lost[0]])
+            return read / shard_size, shard_size / dt / 1e9, stats["path"]
+
+        for (d, p) in ((14, 2), (10, 4)):
+            geo = EcGeometry(d=d, p=p, large_block=1 << 22,
+                             small_block=1 << 18)
+            per_codec: dict = {}
+            for codec in ("rs", "piggyback", "msr"):
+                coder = codec_coder(codec, d, p)
+                base = os.path.join(tmp, f"{codec}_{d}_{p}")
+                encode_volume(datp, base, geo, coder)
+                originals = {
+                    sid: open(base + ecf.shard_ext(sid), "rb").read()
+                    for sid in (1, d + 1)}
+                tag = f"{codec}_rs{d}_{p}"
+                for kind, lost in (("data", 1), ("parity", d + 1)):
+                    os.remove(base + ecf.shard_ext(lost))
+                    per, gbps, path = one_rebuild(base, geo, coder,
+                                                  [lost], originals)
+                    per_codec[(codec, kind)] = per
+                    out[f"repair_{tag}_{kind}_bytes_read_per_lost_byte"] \
+                        = round(per, 3)
+                    out[f"repair_{tag}_{kind}_rebuild_GBps"] = round(gbps, 3)
+                    out[f"repair_{tag}_{kind}_path"] = path
+                    log(f"repair [{codec} RS({d},{p}) {kind}-loss]: "
+                        f"{per:.2f} bytes read per lost byte, "
+                        f"{gbps:.3f} GB/s rebuild ({path})")
+                if codec == "msr":
+                    # multi-loss: one data + one parity shard gone —
+                    # the streamed coupled decode reads each of the d
+                    # survivors EXACTLY once
+                    multi = {sid: open(base + ecf.shard_ext(sid),
+                                       "rb").read() for sid in (0, d)}
+                    os.remove(base + ecf.shard_ext(0))
+                    os.remove(base + ecf.shard_ext(d))
+                    stats: dict = {}
+                    rebuilt = rebuild_shards(base, geo, coder, stats=stats)
+                    assert sorted(rebuilt) == [0, d], rebuilt
+                    for sid, want in multi.items():
+                        got = open(base + ecf.shard_ext(sid), "rb").read()
+                        assert got == want, f"msr multi-loss shard {sid}"
+                    shard_size = len(multi[0])
+                    per = stats["bytes_read"] / shard_size
+                    out[f"repair_{tag}_multiloss_bytes_read_per_lost"] = \
+                        round(per, 3)
+                    assert abs(per - d) < 0.01, \
+                        f"msr multi-loss read {per:.2f} shard-equivalents" \
+                        f" (each of {d} survivors must be read once)"
+                    assert stats["path"] == "general", stats
+            # gates
+            msr_worst = max(per_codec[("msr", "data")],
+                            per_codec[("msr", "parity")])
+            gate = msr_gate[(d, p)]
+            assert msr_worst <= gate, \
+                f"msr repair at RS({d},{p}): {msr_worst:.2f} > {gate}"
+            out[f"repair_msr_rs{d}_{p}_vs_rs"] = round(
+                per_codec[("msr", "data")] / per_codec[("rs", "data")], 3)
+            if (d, p) == (10, 4):
+                ratio = (per_codec[("piggyback", "data")]
+                         / per_codec[("rs", "data")])
+                out["repair_piggyback_vs_rs"] = round(ratio, 3)
+                assert ratio <= 0.7, \
+                    f"piggyback repair ratio {ratio} > 0.7"
+                # legacy artifact keys (pre-matrix dashboards)
+                out["repair_rs_bytes_read_per_lost_byte"] = \
+                    out["repair_rs_rs10_4_data_bytes_read_per_lost_byte"]
+                out["repair_piggyback_bytes_read_per_lost_byte"] = out[
+                    "repair_piggyback_rs10_4_data_bytes_read_per_lost_byte"]
         out["bench_repair_smoke"] = "ok"
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -101,9 +101,10 @@ def _add_volume_flags(p):
     p.add_argument("-coder", default="auto",
                    help="erasure coder backend: auto|jax|native|numpy")
     p.add_argument("-codec", default="rs",
-                   help="erasure codec for new encodes: rs | piggyback "
-                        "(repair-efficient piggybacked RS; rebuilds always "
-                        "follow each volume's .vif)")
+                   help="erasure codec for new encodes: rs | piggyback | "
+                        "msr (msr = product-matrix regenerating code, "
+                        "bandwidth-optimal repair for any single loss; "
+                        "rebuilds always follow each volume's .vif)")
     p.add_argument("-ecShards", default="",
                    help="default EC geometry as 'd,p' (e.g. 14,2 fork / "
                         "10,4 upstream)")
@@ -205,7 +206,7 @@ def run_server(argv):
     p.add_argument("-max", type=int, default=8)
     p.add_argument("-coder", default="auto")
     p.add_argument("-codec", default="rs",
-                   help="erasure codec for new encodes: rs | piggyback")
+                   help="erasure codec for new encodes: rs | piggyback | msr")
     p.add_argument("-filer", action="store_true")
     p.add_argument("-filerPort", type=int, default=8888)
     p.add_argument("-s3", action="store_true")

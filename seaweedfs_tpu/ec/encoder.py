@@ -59,6 +59,7 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
                    shard_reader=None,
                    remote_shards: Sequence[int] | None = None,
                    stats: "dict | None" = None,
+                   fragment_reader=None,
                    ) -> list[int]:
     """Recreate missing shard files from >= d survivors.
 
@@ -66,9 +67,12 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
     Survivors may live elsewhere: `shard_reader(sid, offset, length)`
     (ec/volume.py contract -> VolumeEcShardRead) serves the ids listed in
     `remote_shards` by RANGE, so a repair-efficient codec's plan fetches
-    byte ranges off the network instead of d full shards. Every survivor
-    byte consumed lands in SeaweedFS_repair_bytes_read_total{codec} and
-    in `stats` (bytes_read / bytes_written / codec / path). Returns the
+    byte ranges off the network instead of d full shards;
+    `fragment_reader(sid, ranges)` additionally lets a survivor holder
+    gather scattered ranges server-side and ship ONE computed fragment
+    (the MSR codec's beta-fragments ride this). Every survivor byte
+    consumed lands in SeaweedFS_repair_bytes_read_total{codec} and in
+    `stats` (bytes_read / bytes_written / codec / path). Returns the
     shard ids rebuilt (always materialized locally under `base`).
     """
     from .. import tracing
@@ -96,12 +100,13 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
                    "codec": coder.codec}) as sp:
         from . import repair
         counter = repair.RepairCounter(coder.codec)
-        readers, close = repair.make_readers(
-            base, present_local, shard_reader, remote, counter)
+        readers, frag_readers, close = repair.make_readers(
+            base, present_local, shard_reader, remote, counter,
+            fragment_reader=fragment_reader)
         try:
             path = _dispatch_rebuild(base, geo, coder, tuple(sorted(present)),
-                                     missing, readers, shard_size, chunk,
-                                     batch, counter)
+                                     missing, readers, frag_readers,
+                                     shard_size, chunk, batch, counter)
         finally:
             close()
         sp.set_attr("bytes_read", counter.bytes_read)
@@ -129,19 +134,22 @@ def _shard_size(base: str, geo: EcGeometry,
 
 def _dispatch_rebuild(base: str, geo: EcGeometry, coder: ErasureCoder,
                       present: tuple, missing: list[int], readers: dict,
-                      shard_size: int, chunk: int, batch: int,
-                      counter) -> str:
-    """Pick the cheapest reconstruction the codec supports; returns the
-    path taken ("ranged" | "general" | "full") for stats/traces."""
+                      frag_readers: dict, shard_size: int, chunk: int,
+                      batch: int, counter) -> str:
+    """Pick the cheapest reconstruction the codec supports — resolved
+    through the repair.REBUILDERS registry, so a new codec plugs in its
+    executors without touching this dispatch. Returns the path taken
+    ("ranged" | "general" | "full") for stats/traces."""
     from . import repair
+    ranged, general = repair.REBUILDERS.get(coder.codec, (None, None))
     plan = coder.repair_plan(present, tuple(missing), shard_size)
-    if plan is not None:
-        repair.rebuild_piggyback_single(base, coder, missing[0], readers,
-                                        shard_size, counter)
+    if plan is not None and ranged is not None:
+        ranged(base, coder, missing[0], readers, frag_readers,
+               shard_size, counter)
         return "ranged"
-    if coder.codec == "piggyback":
-        repair.rebuild_piggyback_general(base, coder, present, missing,
-                                         readers, shard_size, counter)
+    if general is not None:
+        general(base, coder, present, missing, readers, frag_readers,
+                shard_size, counter)
         return "general"
     _rebuild_positional(base, geo, coder, present, missing, readers,
                         shard_size, chunk, batch, counter)

@@ -1,14 +1,16 @@
-"""Repair-traffic plumbing: byte-counted shard readers, the piggyback
-overlay, ranged/codec-aware rebuild paths, and degraded-interval
-reconstruction for piggybacked volumes.
+"""Repair-traffic plumbing: byte-counted shard readers, codec overlay
+seals, ranged/codec-aware rebuild paths, and degraded-interval
+reconstruction for piggybacked and MSR volumes.
 
-This module is the file-and-wire half of ops/piggyback.py: the coder
-owns the GF math and the repair *plan* (which byte ranges of which
-survivors), this module executes plans against local shard files and
-remote ranged fetches (`shard_reader` -> VolumeEcShardRead, which
-already takes offset/length), counts every survivor byte into
-`SeaweedFS_repair_bytes_read_total` / `_written_total`, and streams in
-bounded windows so a 30 GB stripe never needs d shards of RAM.
+This module is the file-and-wire half of ops/piggyback.py and
+ops/product_matrix.py: the coders own the GF math and the repair *plan*
+(which byte ranges — or computed fragments — of which survivors), this
+module executes plans against local shard files and remote fetches
+(`shard_reader` -> ranged VolumeEcShardRead; `fragment_reader` -> its
+ranged-COMPUTE mode, one wire fragment per survivor per window), counts
+every survivor byte into `SeaweedFS_repair_bytes_read_total` /
+`_written_total`, and streams in bounded windows so a 30 GB stripe
+never needs d shards of RAM.
 """
 
 from __future__ import annotations
@@ -30,6 +32,12 @@ REPAIR_WINDOW = 4 << 20
 
 # shard_reader(shard_id, offset, length) -> bytes (ec/volume.py contract)
 ShardReader = Callable[[int, int, int], bytes]
+
+# fragment_reader(shard_id, [(offset, length), ...]) -> bytes: the
+# ranged-compute shard read — the holder gathers the scattered ranges
+# server-side and ships ONE packed fragment (VolumeEcShardRead with
+# fragment_offsets/fragment_lengths)
+FragmentReader = Callable[[int, list], bytes]
 
 
 class RepairCounter:
@@ -61,9 +69,14 @@ class RepairCounter:
 def make_readers(base: str, present_local: "dict[int, str]",
                  shard_reader: "ShardReader | None",
                  remote_sids, counter: RepairCounter,
-                 ) -> "tuple[dict[int, Callable[[int, int], np.ndarray]], Callable[[], None]]":
-    """(readers, close): per-shard `read(offset, length) -> uint8 array`
-    over local files and ranged remote fetches, every byte counted."""
+                 fragment_reader: "FragmentReader | None" = None,
+                 ) -> "tuple[dict[int, Callable[[int, int], np.ndarray]], dict[int, Callable], Callable[[], None]]":
+    """(readers, frag_readers, close): per-shard `read(offset, length)`
+    and `frag(ranges) -> concatenated uint8 array` over local files and
+    remote fetches, every byte counted. Fragment reads of local shards
+    are gathered preads; remote ones go through the holder's ranged-
+    compute mode when the caller wires `fragment_reader`, else degrade
+    to one ranged fetch per run."""
     fds: dict[int, int] = {}
 
     def local(sid: int):
@@ -75,6 +88,20 @@ def make_readers(base: str, present_local: "dict[int, str]",
             return np.frombuffer(buf, dtype=np.uint8)
         return read
 
+    def local_frag(sid: int):
+        def frag(ranges) -> np.ndarray:
+            out = np.empty(sum(ln for _, ln in ranges), dtype=np.uint8)
+            pos = 0
+            for off, ln in ranges:
+                buf = os.pread(fds[sid], ln, off)
+                if len(buf) != ln:
+                    raise OSError(f"short read of shard {sid} at {off}")
+                out[pos:pos + ln] = np.frombuffer(buf, dtype=np.uint8)
+                pos += ln
+            counter.read(len(out))
+            return out
+        return frag
+
     def remote(sid: int):
         def read(off: int, ln: int) -> np.ndarray:
             buf = shard_reader(sid, off, ln)
@@ -84,13 +111,38 @@ def make_readers(base: str, present_local: "dict[int, str]",
             return np.frombuffer(buf, dtype=np.uint8)
         return read
 
+    def remote_frag(sid: int):
+        def frag(ranges) -> np.ndarray:
+            want = sum(ln for _, ln in ranges)
+            if fragment_reader is not None:
+                buf = fragment_reader(sid, list(ranges))
+                if len(buf) != want:
+                    raise OSError(f"short fragment from shard {sid}: "
+                                  f"{len(buf)} != {want}")
+                counter.read(want)
+                return np.frombuffer(buf, dtype=np.uint8)
+            out = np.empty(want, dtype=np.uint8)
+            pos = 0
+            for off, ln in ranges:
+                buf = shard_reader(sid, off, ln)
+                if len(buf) != ln:
+                    raise OSError(f"short remote read of shard {sid}")
+                out[pos:pos + ln] = np.frombuffer(buf, dtype=np.uint8)
+                pos += ln
+            counter.read(want)
+            return out
+        return frag
+
     readers: dict[int, Callable] = {}
+    frag_readers: dict[int, Callable] = {}
     for sid, path in present_local.items():
         fds[sid] = os.open(path, os.O_RDONLY)
         readers[sid] = local(sid)
+        frag_readers[sid] = local_frag(sid)
     for sid in remote_sids or ():
         if sid not in readers and shard_reader is not None:
             readers[sid] = remote(sid)
+            frag_readers[sid] = remote_frag(sid)
 
     def close() -> None:
         for fd in fds.values():
@@ -99,7 +151,7 @@ def make_readers(base: str, present_local: "dict[int, str]",
             except OSError:
                 log.debug("closing survivor fd under %s failed", base,
                           exc_info=True)
-    return readers, close
+    return readers, frag_readers, close
 
 
 def _open_outputs(base: str, missing, shard_size: int) -> "dict[int, int]":
@@ -282,6 +334,144 @@ def apply_piggyback_overlay(out_base: str, pb: PiggybackCoder,
 
 
 # ---------------------------------------------------------------------------
+# MSR (product-matrix) repair: β-sized computed fragments from every
+# survivor for single loss; streamed coupled decode for multi-loss.
+# ---------------------------------------------------------------------------
+
+def _msr_window(pm, shard_size: int, window: int) -> int:
+    """Inner-offset window width: the decode working set is
+    nbar * alpha * width, so dividing `window` by alpha caps it near
+    nbar * window (~64 MB at the default 4 MB window) while each
+    helper's in-flight fragment stays <= window / q."""
+    s = shard_size // pm.alpha
+    return max(1, min(s, window // pm.alpha))
+
+
+def rebuild_msr_single(base: str, pm, f: int, readers: dict,
+                       frag_readers: dict, shard_size: int,
+                       counter: RepairCounter,
+                       window: int = REPAIR_WINDOW) -> None:
+    """Rebuild any single lost shard — data OR parity — from computed
+    fragments of ALL n-1 survivors: each ships only its repair-plane
+    sub-symbols ((n-1)/p shard-equivalents total, the MSR cut-set
+    bound), one fragment RPC per survivor per window."""
+    g = pm.grid
+    planes = g.repair_planes(f)
+    s = shard_size // pm.alpha
+    wl = _msr_window(pm, shard_size, window)
+    outs = _open_outputs(base, [f], shard_size)
+    try:
+        for u in range(0, s, wl):
+            w = min(wl, s - u)
+            c = np.zeros((g.nbar, g.alpha, w), dtype=np.uint8)
+            for sid in range(pm.n):
+                if sid == f:
+                    continue
+                ranges = [(int(z) * s + u, w) for z in planes]
+                frag = frag_readers[sid](ranges)
+                c[sid, planes] = frag.reshape(len(planes), w)
+            row = pm.repair_decode(c, f)
+            for z in range(pm.alpha):
+                _pwrite(outs[f], row[z], z * s + u)
+            counter.wrote(pm.alpha * w)
+    finally:
+        for fd in outs.values():
+            os.fsync(fd)
+            os.close(fd)
+
+
+def rebuild_msr_general(base: str, pm, present, missing, readers: dict,
+                        frag_readers: dict, shard_size: int,
+                        counter: RepairCounter,
+                        window: int = REPAIR_WINDOW) -> None:
+    """Multi-loss (or missing-helper) rebuild: stream the coupled
+    layered decode over d full survivors, reading EACH SURVIVOR EXACTLY
+    ONCE across all losses — never once per lost shard."""
+    g = pm.grid
+    s = shard_size // pm.alpha
+    missing = tuple(sorted(missing))
+    # prefer local survivors: make_readers inserts local fds before
+    # remote fetchers, so frag_readers' iteration order is the byte-
+    # cheapest d-subset
+    avail = set(present)
+    order = [sid for sid in frag_readers if sid in avail]
+    used = tuple(sorted(order[: pm.d]))
+    if len(used) < pm.d:
+        raise RuntimeError(f"msr rebuild needs {pm.d} survivors, "
+                           f"have {len(used)}")
+    all_layers = np.arange(pm.alpha)
+    wl = _msr_window(pm, shard_size, window)
+    outs = _open_outputs(base, missing, shard_size)
+    try:
+        for u in range(0, s, wl):
+            w = min(wl, s - u)
+            c = np.zeros((g.nbar, g.alpha, w), dtype=np.uint8)
+            for sid in used:
+                ranges = [(int(z) * s + u, w) for z in all_layers]
+                c[sid] = frag_readers[sid](ranges).reshape(pm.alpha, w)
+            pm.decode_coupled(c, used)
+            for m in missing:
+                for z in range(pm.alpha):
+                    _pwrite(outs[m], c[m, z], z * s + u)
+                counter.wrote(pm.alpha * w)
+    finally:
+        for fd in outs.values():
+            os.fsync(fd)
+            os.close(fd)
+
+
+def apply_msr_overlay(out_base: str, pm, shard_size: int,
+                      window: int = REPAIR_WINDOW) -> None:
+    """Encode-side seal: rewrite the parity files with the MSR coupled
+    parities computed from the data shard files (ec/stream.py's device
+    pipeline encodes plain-RS slabs — codec-agnostic — and this overlay
+    replaces the parity bytes before the .vif seals the codec)."""
+    if shard_size == 0:
+        return
+    if shard_size % pm.alpha:
+        raise ValueError(
+            f"msr needs shard files divisible by alpha={pm.alpha}, got "
+            f"{shard_size}: use a power-of-two p or a small_block "
+            "divisible by alpha")
+    g = pm.grid
+    s = shard_size // pm.alpha
+    wl = _msr_window(pm, shard_size, window)
+    data_fds = [os.open(out_base + files.shard_ext(i), os.O_RDONLY)
+                for i in range(pm.d)]
+    par_fds = [os.open(out_base + files.shard_ext(pm.d + j), os.O_RDWR)
+               for j in range(pm.p)]
+    try:
+        for u in range(0, s, wl):
+            w = min(wl, s - u)
+            sub = np.empty((pm.d, pm.alpha, w), dtype=np.uint8)
+            for i, fd in enumerate(data_fds):
+                for z in range(pm.alpha):
+                    buf = os.pread(fd, w, z * s + u)
+                    if len(buf) != w:
+                        raise OSError(f"short read sealing {out_base}")
+                    sub[i, z] = np.frombuffer(buf, dtype=np.uint8)
+            par = pm.encode_subsymbols(sub)
+            for j, fd in enumerate(par_fds):
+                for z in range(pm.alpha):
+                    _pwrite(fd, par[j, z], z * s + u)
+        for fd in par_fds:
+            os.fsync(fd)
+    finally:
+        for fd in data_fds + par_fds:
+            os.close(fd)
+
+
+def apply_codec_overlay(out_base: str, coder, shard_size: int,
+                        window: int = REPAIR_WINDOW) -> None:
+    """Seal-time overlay dispatch for codecs whose parity differs from
+    the plain-RS slabs the streaming pipeline writes."""
+    fn = OVERLAYS.get(coder.codec)
+    if fn is None:
+        raise ValueError(f"codec {coder.codec!r} has no overlay seal")
+    fn(out_base, coder, shard_size, window)
+
+
+# ---------------------------------------------------------------------------
 # Degraded reads: reconstruct one interval of a lost data shard when the
 # gathered survivors include piggybacked parities.
 # ---------------------------------------------------------------------------
@@ -330,3 +520,34 @@ def reconstruct_interval(pb: PiggybackCoder, gathered: "dict[int, np.ndarray]",
                          dtype=np.uint8)
         out[a_len:] = rec[0]
     return out.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Codec dispatch: how encoder.rebuild_shards executes each codec's
+# cheapest path. Uniform signatures:
+#   ranged(base, coder, f, readers, frag_readers, shard_size, counter)
+#   general(base, coder, present, missing, readers, frag_readers,
+#           shard_size, counter)
+# A codec registered here never falls through to the positional plain-RS
+# rebuild (which would decode its parities as if they were RS).
+# ---------------------------------------------------------------------------
+
+def _pb_single(base, coder, f, readers, frag_readers, shard_size, counter):
+    rebuild_piggyback_single(base, coder, f, readers, shard_size, counter)
+
+
+def _pb_general(base, coder, present, missing, readers, frag_readers,
+                shard_size, counter):
+    rebuild_piggyback_general(base, coder, present, missing, readers,
+                              shard_size, counter)
+
+
+REBUILDERS = {
+    "piggyback": (_pb_single, _pb_general),
+    "msr": (rebuild_msr_single, rebuild_msr_general),
+}
+
+OVERLAYS = {
+    "piggyback": apply_piggyback_overlay,
+    "msr": apply_msr_overlay,
+}

@@ -375,10 +375,12 @@ class _VolumePlan:
     finished: bool = False
     # (view4d [rows, d, nch, C], shard_base, rows, nch) per region
     regions: list[tuple[np.ndarray, int, int, int]] = field(default_factory=list)
-    # piggybacked codec (ops/piggyback.py) to seal with: slabs are encoded
-    # as plain RS by the inner coder (device batching untouched) and
-    # finish() folds the piggyback overlay in before the .vif seal
-    piggyback: "object | None" = None
+    # overlay codec (ops/piggyback.py, ops/product_matrix.py) to seal
+    # with: slabs are encoded as plain RS by the inner coder (device
+    # batching untouched) and finish() applies the codec's overlay —
+    # piggyback XOR-folds, msr rewrites the parities — before the .vif
+    # seal
+    overlay: "object | None" = None
     # iteration cursor: (region_idx, row, chunk)
     _pos: tuple[int, int, int] = (0, 0, 0)
     # source mapping ownership + outstanding writer-pool runs
@@ -518,14 +520,14 @@ class _VolumePlan:
         self._release_source()
         geo = self.geo
         codec = "rs"
-        if self.piggyback is not None:
+        if self.overlay is not None:
             # overlay BEFORE the .vif seal: a crash mid-overlay leaves
             # unsealed (hence rebuildable-from-.dat) outputs, never a
-            # valid-looking .vif over half-piggybacked parities
-            from .repair import apply_piggyback_overlay
-            apply_piggyback_overlay(self.out_base, self.piggyback,
-                                    self.shard_size)
-            codec = self.piggyback.codec
+            # valid-looking .vif over half-sealed parities
+            from .repair import apply_codec_overlay
+            apply_codec_overlay(self.out_base, self.overlay,
+                                self.shard_size)
+            codec = self.overlay.codec
         if self.idx_path and os.path.exists(self.idx_path):
             files.write_ecx_from_idx(self.idx_path, self.out_base + ".ecx")
         files.write_vif(self.out_base + ".vif", version=3,
@@ -604,10 +606,12 @@ def encode_volumes(jobs: "list[tuple[str, str, str | None]]", geo: EcGeometry,
     """
     assert coder.d == geo.d and coder.p == geo.p
     chunk = fit_chunk(geo, chunk)
-    # a piggybacked codec encodes its slabs as plain RS through the inner
-    # backend (so the device pipeline below is codec-agnostic) and folds
-    # the piggyback overlay in at seal time (_VolumePlan.finish)
-    pb = coder if coder.codec == "piggyback" else None
+    # overlay codecs (piggyback, msr) encode their slabs as plain RS
+    # through the inner backend (so the device pipeline below is
+    # codec-agnostic) and seal the real parities at finish()
+    # (_VolumePlan.finish -> repair.apply_codec_overlay)
+    from .repair import OVERLAYS
+    pb = coder if coder.codec in OVERLAYS else None
     slab_coder = coder.inner if pb is not None else coder
     if null_sink and slab_coder.async_dispatch:
         raise ValueError("null_sink is a sync-coder measurement mode")
@@ -691,7 +695,7 @@ def _encode_volumes_sync(jobs, geo: EcGeometry, coder: ErasureCoder,
     try:
         for dat_path, out_base, idx_path in jobs:
             plan = _VolumePlan(dat_path, out_base, idx_path, geo, chunk,
-                               piggyback=pb)
+                               overlay=pb)
             created.append(plan)
             out[dat_path] = [out_base + files.shard_ext(i)
                              for i in range(geo.n)]
@@ -784,7 +788,7 @@ def _encode_volumes_async(jobs, geo: EcGeometry, coder: ErasureCoder,
     todo = deque()
     for dat_path, out_base, idx_path in jobs:
         todo.append(_VolumePlan(dat_path, out_base, idx_path, geo, chunk,
-                                piggyback=pb))
+                                overlay=pb))
         out[dat_path] = [out_base + files.shard_ext(i) for i in range(geo.n)]
 
     d, p = geo.d, geo.p

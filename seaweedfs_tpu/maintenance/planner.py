@@ -200,8 +200,9 @@ def build_plan(report: dict, probe_remountable=None,
     `probe_geometry(vid, collection) -> {codec, d, p, shard_size}` is
     equally optional/read-only (executor.make_geometry_probe): with it,
     every item carries its network cost in `bytes_moved` — computed with
-    the volume's sealed codec, so a piggybacked stripe's cheaper
-    reconstruction is what gets costed and ordered.
+    the volume's sealed codec through the coder registry, so a
+    piggybacked stripe's 0.65x and an msr stripe's (n-1)/p repair reads
+    are what get costed and ordered, not the plain-RS d-full-shards.
     """
     from ..utils import retry
 

@@ -144,17 +144,30 @@ class PallasCoder(JaxCoder):
 
 _REGISTRY = {"numpy": NumpyCoder, "jax": JaxCoder, "pallas": PallasCoder}
 
+# backend names double as the plain-RS codec: NumpyCoder is the host
+# oracle, so "rs" resolves there (repair costing, codec enumeration)
+_REGISTRY["rs"] = NumpyCoder
+
+# self-registering implementations live in modules nobody has imported
+# yet when a CLI (or a .vif read) asks for them by name; the bool marks
+# entries that register a NEW erasure codec (vs just a compute backend),
+# so codec enumeration doesn't drag in jax for a help string
+_LAZY = {
+    "native": ("seaweedfs_tpu.ops.native", False),
+    "mesh": ("seaweedfs_tpu.parallel.pipeline", False),
+    "piggyback": ("seaweedfs_tpu.ops.piggyback", True),
+    "msr": ("seaweedfs_tpu.ops.product_matrix", True),
+}
+
+
+def _lazy_load(name: str) -> None:
+    mod, _ = _LAZY[name]
+    __import__(mod, fromlist=["_"])
+
 
 def get_coder(name: str, d: int, p: int) -> ErasureCoder:
-    if name not in _REGISTRY:
-        # self-registering implementations live in modules nobody has
-        # imported yet when a CLI asks for them by name
-        if name == "native":
-            from . import native  # noqa: F401 — registers "native"
-        elif name == "mesh":
-            from ..parallel import pipeline  # noqa: F401 — registers "mesh"
-        elif name == "piggyback":
-            from . import piggyback  # noqa: F401 — registers "piggyback"
+    if name not in _REGISTRY and name in _LAZY:
+        _lazy_load(name)
     try:
         cls = _REGISTRY[name]
     except KeyError:
@@ -166,13 +179,57 @@ def register_coder(name: str, cls) -> None:
     _REGISTRY[name] = cls
 
 
+def registered_codecs() -> "list[str]":
+    """Erasure CODEC names (one per wire/disk format, not per compute
+    backend) — drives shell help/validation so a new registered codec
+    shows up everywhere without hand-edited name lists. Entries may be
+    classes or factory callables (mesh); factories without a `codec`
+    attribute are plain-RS backends."""
+    for name, (_, is_codec) in _LAZY.items():
+        if is_codec and name not in _REGISTRY:
+            try:
+                _lazy_load(name)
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (enumeration must list what IS loadable, not fail on what isn't)
+                pass
+    return sorted({getattr(cls, "codec", "rs")
+                   for cls in _REGISTRY.values()})
+
+
+def codec_coder(codec: str, d: int, p: int,
+                backend: str = "numpy") -> ErasureCoder:
+    """Construct the coder for an erasure `codec` on a compute
+    `backend`. Plain "rs" is the backend coder itself; layered codecs
+    (piggyback, msr) wrap the backend as their inner GF engine."""
+    if not codec or codec == "rs":
+        return get_coder(backend if backend != "auto" else "numpy", d, p)
+    if codec not in _REGISTRY and codec in _LAZY:
+        _lazy_load(codec)
+    cls = _REGISTRY.get(codec)
+    if cls is None or getattr(cls, "codec", "rs") != codec:
+        raise ValueError(
+            f"unknown erasure codec {codec!r}; have {registered_codecs()}")
+    # pass the backend only when the constructor takes one — probing via
+    # except TypeError would also swallow TypeErrors raised INSIDE the
+    # constructor and silently drop the requested backend
+    import inspect
+    try:
+        takes_backend = "backend" in inspect.signature(cls).parameters
+    except (TypeError, ValueError):  # uninspectable callable
+        takes_backend = False
+    if takes_backend:
+        return cls(d, p, backend=backend)
+    return cls(d, p)
+
+
 def repair_read_bytes(codec: str, d: int, p: int, missing, shard_size: int,
                       ) -> int:
     """Survivor bytes a rebuild of `missing` must read under `codec` —
-    the repair planner's byte-costing primitive. Uses the numpy-backed
-    coder purely for plan geometry (no data touches it)."""
+    the repair planner's byte-costing primitive. Resolves the codec
+    through the registry (numpy inner backend: no data touches it, the
+    coder is consulted purely for plan geometry), so any registered
+    codec costs correctly without editing this helper."""
     missing = sorted(set(missing))
-    coder = get_coder("piggyback" if codec == "piggyback" else "numpy", d, p)
+    coder = codec_coder(codec or "rs", d, p)
     present = tuple(i for i in range(d + p) if i not in missing)
     plan = coder.repair_plan(present, tuple(missing), shard_size)
     if plan is None:

@@ -1575,6 +1575,13 @@ class VolumeServer:
             if ev is None:
                 raise KeyError(f"shard {shard_id} unreachable")
             geo = ev.geo
+            if ev.codec == "msr":
+                # the coupled code is not positional: degraded reads
+                # fetch the interval plan's layer slices (repair planes
+                # when all n-1 helpers answer, a closure-restricted
+                # general decode otherwise)
+                return _reconstruct_msr(ev, shard_id, offset, length,
+                                        locs, sp)
             piggybacked = ev.codec == "piggyback"
             gathered: dict[int, bytes] = {}
             remote_sids = []
@@ -1679,13 +1686,111 @@ class VolumeServer:
             out = np.asarray(inner.reconstruct(sl, present, (shard_id,)))
             DEGRADED_EC_READS.inc()
             return out[0].tobytes()
+
+        def _fetch_plan(ev, plan, locs) -> "dict[int, bytes | None]":
+            """Gather one IntervalPlan's per-survivor fragments — local
+            shards by pread, remote by ranged-compute fetch — fanned out
+            on the EC read pool. None entries mark unreachable helpers."""
+            import concurrent.futures as cf
+            import contextvars
+
+            def one(sid: int) -> "bytes | None":
+                ranges = plan.byte_ranges(sid)
+                local = ev.shards.get(sid)
+                try:
+                    if local is not None:
+                        return b"".join(local.read_at(o, ln)
+                                        for o, ln in ranges)
+                    return self._fetch_fragment_or_raise(
+                        vid, sid, ranges, locs.get(sid, []))
+                except Exception as e:  # noqa: BLE001
+                    log.warning("msr fragment %d.%d: %s", vid, sid, e)
+                    return None
+
+            futs = {self._ec_read_pool.submit(
+                contextvars.copy_context().run, one, sid): sid
+                for sid in plan.fetch}
+            return {futs[f]: f.result() for f in cf.as_completed(futs)}
+
+        def _reconstruct_msr(ev, shard_id: int, offset: int, length: int,
+                             locs: dict, sp) -> bytes:
+            from ..stats import DEGRADED_EC_READS
+            geo = ev.geo
+            coder = self.store.coder(geo.d, geo.p, codec="msr")
+            sub = ev.shard_size // coder.alpha
+            ragged = sub and (offset % sub or (offset + length) % sub)
+            sp.set_attr("msr", True)
+            if ragged and offset // sub != (offset + length - 1) // sub:
+                # a span crossing sub-symbol boundaries would widen the
+                # shared inner window to the full sub-symbol width.
+                # Split into at most THREE pieces — partial head,
+                # layer-aligned middle (one combined plan: every interior
+                # byte is wanted, so the full-width window wastes
+                # nothing), partial tail — so a ragged edge fetches only
+                # its exact inner span without serializing one
+                # plan+fan-out round per interior layer.
+                end = offset + length
+                cuts = [offset]
+                head_end = -(-offset // sub) * sub   # round up
+                mid_end = (end // sub) * sub         # round down
+                if offset < head_end:
+                    cuts.append(head_end)
+                if head_end < mid_end:
+                    cuts.append(mid_end)
+                if cuts[-1] != end:
+                    cuts.append(end)
+                pieces = [_msr_piece(ev, coder, shard_id, a, b - a,
+                                     locs, sp)
+                          for a, b in zip(cuts, cuts[1:])]
+                sp.set_attr("msr_mode", "+".join(m for _, m, _ in pieces))
+                sp.set_attr("msr_fetch_bytes",
+                            sum(fb for _, _, fb in pieces))
+            else:
+                pieces = [_msr_piece(ev, coder, shard_id, offset, length,
+                                     locs, sp)]
+                sp.set_attr("msr_mode", pieces[0][1])
+                sp.set_attr("msr_fetch_bytes", pieces[0][2])
+            DEGRADED_EC_READS.inc()  # one logical degraded read
+            return b"".join(buf for buf, _, _ in pieces)
+
+        def _msr_piece(ev, coder, shard_id: int, offset: int, length: int,
+                       locs: dict, sp) -> "tuple[bytes, str, int]":
+            """(bytes, plan mode, fetch bytes) for one boundary-aligned
+            (or single-layer) span of the lost shard."""
+            geo = ev.geo
+            helpers = tuple(s for s in range(geo.n) if s != shard_id)
+            plan = coder.interval_plan(helpers, shard_id, offset,
+                                       length, ev.shard_size)
+            got = _fetch_plan(ev, plan, locs)
+            if any(v is None for v in got.values()):
+                # a helper is down: closure-restricted decode over d
+                # survivors that DID answer (one retry; a second wave of
+                # failures means the stripe is genuinely unreadable)
+                present = tuple(s for s, v in got.items() if v is not None)
+                if len(present) < geo.d:
+                    raise OSError(
+                        f"cannot reconstruct shard {shard_id}: only "
+                        f"{len(present)} msr helpers reachable")
+                sp.add_event("msr_repair_degraded",
+                             reachable=len(present))
+                plan = coder.interval_plan(present, shard_id, offset,
+                                           length, ev.shard_size)
+                got = _fetch_plan(ev, plan, locs)
+                if any(v is None for v in got.values()):
+                    raise OSError(
+                        f"cannot reconstruct shard {shard_id}: msr "
+                        "survivors unreachable")
+            return (coder.interval_decode(plan, got), plan.mode,
+                    plan.bytes_total())
         return reader
 
     def _make_repair_reader(self, vid: int):
-        """(shard_reader, remote_sids) for a rebuild on THIS server:
-        survivors that live elsewhere are fetched by RANGE through
-        VolumeEcShardRead, so a repair-efficient codec's plan moves only
-        its byte ranges instead of whole gathered shard files.
+        """(shard_reader, fragment_reader, remote_sids) for a rebuild on
+        THIS server: survivors that live elsewhere are fetched by RANGE
+        through VolumeEcShardRead — or, for repair-efficient codecs
+        whose plans name many scattered ranges (msr repair planes), by
+        its ranged-COMPUTE mode, which packs them into one wire fragment
+        per survivor per window.
 
         The read-path location cache is BYPASSED: its freshest tier is
         still 11 s, and a rebuild planned against a pre-failure holder
@@ -1711,7 +1816,11 @@ class VolumeServer:
         def reader(sid: int, offset: int, length: int) -> bytes:
             return self._fetch_range_or_raise(vid, sid, offset, length,
                                               peers.get(sid, []))
-        return reader, remote
+
+        def fragment_reader(sid: int, ranges) -> bytes:
+            return self._fetch_fragment_or_raise(vid, sid, ranges,
+                                                 peers.get(sid, []))
+        return reader, fragment_reader, remote
 
     def _fetch_range_or_raise(self, vid: int, sid: int, offset: int,
                               length: int, holders: "list[str]") -> bytes:
@@ -1726,6 +1835,57 @@ class VolumeServer:
             raise OSError(f"shard {vid}.{sid} range [{offset}, +{length}) "
                           "unreachable")
         return data
+
+    def _fetch_fragment_or_raise(self, vid: int, sid: int, ranges,
+                                 holders: "list[str]") -> bytes:
+        """Fetch one computed fragment (scattered ranges packed holder-
+        side). A holder predating the ranged-compute fields answers the
+        legacy zero-size read with an empty stream — detected and
+        degraded to per-range fetches so mixed-version repairs still
+        converge."""
+        from .. import tracing
+        want = sum(ln for _, ln in ranges)
+        if want == 0:
+            return b""
+        ordered = retry.order_by_breaker([a for a in holders
+                                          if retry.breaker(a).would_allow()]) \
+            or list(holders)
+        for addr in ordered:
+            try:
+                # same fault-injection site as the ranged path; a firing
+                # failpoint degrades to per-range fetches (no breaker
+                # penalty — the peer did nothing wrong)
+                failpoints.check("ec.shard.read")
+            except failpoints.FailpointError as e:
+                log.warning("ec fragment read failpoint: %s", e)
+                break
+            br = retry.breaker(addr)
+            try:
+                stub = Stub(addr, VOLUME_SERVICE)
+                parts = [r.data for r in stub.call_stream(
+                    "VolumeEcShardRead",
+                    vpb.VolumeEcShardReadRequest(
+                        volume_id=vid, shard_id=sid,
+                        fragment_offsets=[o for o, _ in ranges],
+                        fragment_lengths=[ln for _, ln in ranges]),
+                    vpb.VolumeEcShardReadResponse)]
+                buf = b"".join(parts)
+                if len(buf) == want:
+                    br.record_success()
+                    return failpoints.corrupt("ec.shard.read.data", buf)
+                if not buf:
+                    tracing.add_event("fragment_unsupported", peer=addr,
+                                      vid=vid, shard=sid)
+                    break  # legacy holder: per-range fallback below
+                raise OSError(f"fragment length {len(buf)} != {want}")
+            except Exception as e:  # noqa: BLE001
+                br.record_failure()
+                log.warning("fragment read %d.%d from %s: %s",
+                            vid, sid, addr, e)
+        out = bytearray()
+        for off, ln in ranges:
+            out += self._fetch_range_or_raise(vid, sid, off, ln, holders)
+        return bytes(out)
 
     # shard-location cache staleness tiers (store_ec.go:256-267): complete
     # location sets refresh every 37 min, incomplete every 7 min, and a
@@ -2199,13 +2359,14 @@ class VolumeServer:
             t0 = time.perf_counter()
             stats: dict = {}
             try:
-                reader, remote = vs._make_repair_reader(req.volume_id)
+                reader, frag, remote = vs._make_repair_reader(req.volume_id)
                 _ensure_vif(req.volume_id, req.collection)
                 rebuilt = store.rebuild_ec_shards(req.volume_id,
                                                   req.collection,
                                                   shard_reader=reader,
                                                   remote_shards=remote,
-                                                  stats=stats)
+                                                  stats=stats,
+                                                  fragment_reader=frag)
             except Exception as e:  # noqa: BLE001
                 events.emit("ec.rebuild.finish", severity=events.ERROR,
                             vid=req.volume_id, node=vs.url, ok=False,
@@ -2279,13 +2440,13 @@ class VolumeServer:
             _ensure_vif(req.volume_id, req.collection, base)
             info = ec_files.read_vif(base + ".vif")
             geo = EcGeometry.from_vif(info, store.ec_geometry)
-            reader, remote = vs._make_repair_reader(req.volume_id)
+            reader, frag, remote = vs._make_repair_reader(req.volume_id)
             stats: dict = {}
             rebuilt = rebuild_shards(
                 base, geo,
                 store.coder(geo.d, geo.p, codec=info.get("codec", "rs")),
                 wanted=list(req.shard_ids), shard_reader=reader,
-                remote_shards=remote, stats=stats)
+                remote_shards=remote, stats=stats, fragment_reader=frag)
             return vpb.VolumeEcShardsCopyByRebuildResponse(
                 rebuilt_shard_ids=rebuilt,
                 bytes_read=stats.get("bytes_read", 0),
@@ -2367,6 +2528,12 @@ class VolumeServer:
             sh = ev.shards.get(req.shard_id)
             if sh is None:
                 context.abort(5, f"shard {req.shard_id} not on this server")
+            frag_ranges = list(zip(req.fragment_offsets,
+                                   req.fragment_lengths))
+            if len(req.fragment_offsets) != len(req.fragment_lengths):
+                context.abort(3, "fragment_offsets/lengths length mismatch")
+            cost = (sum(ln for _, ln in frag_ranges) if frag_ranges
+                    else req.size)
             # a maintenance-tagged survivor read (repair plans pulling
             # ranged fetches) admits through the QoS plane and YIELDS
             # to queued foreground work; untagged shard reads are the
@@ -2377,8 +2544,16 @@ class VolumeServer:
                     qos_mod.current_class() == qos_mod.CLASS_MAINTENANCE:
                 grant = vs.qos.admit_sync(
                     ev.collection or "default",
-                    qos_mod.CLASS_MAINTENANCE, cost=req.size)
+                    qos_mod.CLASS_MAINTENANCE, cost=cost)
             try:
+                if frag_ranges:
+                    # ranged-COMPUTE mode: gather the scattered ranges
+                    # (an MSR repair plane is alpha/p layer slices) and
+                    # ship ONE packed — optionally GF-combined — wire
+                    # fragment instead of one RPC per range
+                    yield from _serve_fragment(sh, req, frag_ranges,
+                                               context)
+                    return
                 remaining = req.size
                 offset = req.offset
                 while remaining > 0:
@@ -2392,6 +2567,53 @@ class VolumeServer:
             finally:
                 if grant is not None:
                     grant.release()
+
+        def _serve_fragment(sh, req, frag_ranges, context):
+            import numpy as np
+            if not req.combine_rows:
+                # pack-only: stream straight from disk, range by range
+                # in 1 MB chunks — a request-controlled fragment size
+                # must never materialize whole in the holder's RSS
+                for off, ln in frag_ranges:
+                    rem, pos = ln, off
+                    while rem > 0:
+                        buf = sh.read_at(pos, min(rem, 1 << 20))
+                        if not buf:
+                            context.abort(3, f"fragment range [{off}, "
+                                             f"+{ln}) beyond shard")
+                        yield vpb.VolumeEcShardReadResponse(data=buf)
+                        pos += len(buf)
+                        rem -= len(buf)
+                return
+            # helper-side GF fold: rows_out = M (x) range_rows, the
+            # hook for codecs whose helpers ship inner products. The
+            # fold must hold all rows at once, so unlike the streamed
+            # pack path its request-controlled size is CAPPED — repair
+            # executors window fragments to ~window/q (ec/repair.py),
+            # far below this
+            from ..ops import gf8
+            if sum(ln for _, ln in frag_ranges) > (64 << 20):
+                context.abort(3, "combine fragment exceeds 64 MB; "
+                                 "window the request")
+            lens = {ln for _, ln in frag_ranges}
+            if len(lens) != 1:
+                context.abort(3, "combine needs equal-length ranges")
+            if len(req.combine_matrix) != \
+                    req.combine_rows * len(frag_ranges):
+                context.abort(3, "combine_matrix shape mismatch")
+            rows = []
+            for off, ln in frag_ranges:
+                buf = sh.read_at(off, ln)
+                if len(buf) != ln:
+                    context.abort(3, f"fragment range [{off}, +{ln}) "
+                                     "beyond shard")
+                rows.append(np.frombuffer(buf, dtype=np.uint8))
+            mat = np.frombuffer(req.combine_matrix, dtype=np.uint8)
+            mat = mat.reshape(req.combine_rows, len(frag_ranges))
+            data = gf8.np_gf_apply(mat, np.stack(rows)).tobytes()
+            for i in range(0, len(data), 1 << 20):
+                yield vpb.VolumeEcShardReadResponse(
+                    data=data[i:i + (1 << 20)])
 
         @svc.unary("VolumeEcBlobDelete", vpb.VolumeEcBlobDeleteRequest,
                    vpb.VolumeEcBlobDeleteResponse)

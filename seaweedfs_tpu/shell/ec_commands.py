@@ -88,11 +88,22 @@ def balanced_ec_distribution(servers: list[dict], n_shards: int,
     return [by_id[v.id] for v in views]
 
 
+def _codec_names() -> "list[str]":
+    """Registered erasure codecs — any codec behind the ErasureCoder
+    seam shows up in help/validation without editing this file. Called
+    at parse time, never at import (the lazy codec registry exists so a
+    help string doesn't eagerly import every codec module)."""
+    from ..ops.coder import registered_codecs
+    return registered_codecs()
+
+
 @command("ec.encode",
          "-volumeId N | -collection C|'*' [-fullPercent 95] "
-         "[-sourceDiskType ssd] [-ecShards d,p] [-codec rs|piggyback]: "
+         "[-sourceDiskType ssd] [-ecShards d,p] [-codec NAME]: "
          "erasure-code volumes and spread shards (geometry defaults to the "
-         "server's -ecShards; fork 14+2 and upstream 10+4 both just work)",
+         "server's -ecShards; fork 14+2 and upstream 10+4 both just work; "
+         "-codec takes any registered erasure codec — ec.encode -h "
+         "enumerates them; piggyback and msr are repair-efficient)",
          needs_lock=True)
 def cmd_ec_encode(env: CommandEnv, args):
     p = argparse.ArgumentParser(prog="ec.encode")
@@ -106,9 +117,13 @@ def cmd_ec_encode(env: CommandEnv, args):
                    help="geometry as 'd,p' (e.g. 14,2 or 10,4); shorthand "
                         "for -dataShards/-parityShards")
     p.add_argument("-codec", default="",
-                   help="erasure codec: rs | piggyback (repair-efficient; "
-                        "blank = server default)")
+                   help=f"erasure codec: {' | '.join(_codec_names())} "
+                        "(blank = server default; piggyback and msr are "
+                        "repair-efficient)")
     opt = p.parse_args(args)
+    if opt.codec and opt.codec not in _codec_names():
+        raise ValueError(f"unknown codec {opt.codec!r}; registered: "
+                         f"{', '.join(_codec_names())}")
     if opt.ecShards:
         opt.dataShards, opt.parityShards = parse_ec_shards(opt.ecShards)
 
@@ -243,11 +258,13 @@ def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
 @command("ec.rebuild", "[-volumeId N] [-byRebuild]: restore missing ec shards",
          needs_lock=True)
 def cmd_ec_rebuild(env: CommandEnv, args):
-    """Rebuild runs ON a holder; remote survivors stream in by RANGE
-    (VolumeEcShardRead) following the volume's codec repair plan — a
+    """Rebuild runs ON a holder; remote survivors stream in by RANGE —
+    or as packed computed fragments through VolumeEcShardRead's
+    ranged-compute mode — following the volume's codec repair plan: a
     piggybacked stripe moves ~(d+|group|)/2 half-shards for a single
-    data-shard loss where the old gather-then-rebuild flow copied d
-    full shard files before reconstructing anything. Returns
+    data-shard loss, an msr stripe (n-1)/p shard-equivalents for ANY
+    single loss, where the old gather-then-rebuild flow copied d full
+    shard files before reconstructing anything. Returns
     {rebuilt, bytes_read, bytes_written} so callers (cluster.repair)
     can journal the traffic."""
     p = argparse.ArgumentParser(prog="ec.rebuild")

@@ -61,12 +61,17 @@ class Store:
         p = p or self.ec_geometry.p
         codec = codec or self.ec_codec
         name = self._backend_name()
-        if codec == "piggyback":
-            from ..ops.piggyback import PiggybackCoder
+        if codec and codec != "rs":
+            # layered codecs (piggyback, msr, ...) resolve through the
+            # registry and wrap the compute backend as their GF engine.
+            # A failing BACKEND (bad -coder name, jax init) degrades to
+            # numpy like the plain-RS branch below; an unknown CODEC
+            # raises from the numpy retry too — never silently rs.
+            from ..ops.coder import codec_coder
             try:
-                return PiggybackCoder(d, p, backend=name)
-            except Exception:  # noqa: BLE001
-                return PiggybackCoder(d, p, backend="numpy")
+                return codec_coder(codec, d, p, backend=name)
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (numpy retry below re-raises unknown codecs)
+                return codec_coder(codec, d, p, backend="numpy")
         try:
             return get_coder(name, d, p)
         except Exception:  # noqa: BLE001
@@ -372,7 +377,8 @@ class Store:
     def rebuild_ec_shards(self, vid: int, collection: str = "",
                           shard_reader=None,
                           remote_shards: "list[int] | None" = None,
-                          stats: "dict | None" = None) -> list[int]:
+                          stats: "dict | None" = None,
+                          fragment_reader=None) -> list[int]:
         """Rebuild missing shards locally, decoding with the codec the
         .vif seal says encoded them. Survivors not on this disk are
         fetched by RANGE through `shard_reader` (the volume server wires
@@ -395,7 +401,8 @@ class Store:
         coder = self.coder(geo.d, geo.p, codec=info.get("codec", "rs"))
         rebuilt = rebuild_shards(base, geo, coder,
                                  shard_reader=shard_reader,
-                                 remote_shards=remote_shards, stats=stats)
+                                 remote_shards=remote_shards, stats=stats,
+                                 fragment_reader=fragment_reader)
         if ev:
             for loc in self.locations:
                 if loc.ec_volumes.get(vid) is ev:

@@ -1105,8 +1105,68 @@ def test_repair_loop_converges_after_node_death(cluster):
     lost_shard = open(
         victim.store.find_ec_volume(ec_vid).base + ec_files.shard_ext(3),
         "rb").read()
+
+    # -- an msr RS(4,2) stripe with shard 3 on the victim -------------------
+    # the product-matrix lane: p=2 is exactly where piggyback degenerates
+    # to plain RS, so this is the geometry where only msr moves fewer
+    # bytes — the health-driven rebuild must pull (n-1)/p = 2.5
+    # shard-equivalents of survivor fragments, not d = 4 full shards
+    msr_payloads = {}
+    for _ in range(12):
+        data = rng.integers(0, 256, int(rng.integers(600, 7000)),
+                            dtype=np.uint8).tobytes()
+        r = operation.submit(mc, data, collection="cmsr")
+        msr_payloads[r.fid] = data
+    msr_vid = int(next(iter(msr_payloads)).split(",")[0])
+    msrc_vs = next(vs for vs in servers
+                   if vs.store.find_volume(msr_vid) is not None)
+    msrc = Stub(f"127.0.0.1:{msrc_vs.grpc_port}", VOLUME_SERVICE)
+    msrc.call("VolumeMarkReadonly",
+              vpb.VolumeMarkReadonlyRequest(volume_id=msr_vid),
+              vpb.VolumeMarkReadonlyResponse)
+    msrc.call("VolumeEcShardsGenerate",
+              vpb.VolumeEcShardsGenerateRequest(
+                  volume_id=msr_vid, collection="cmsr", data_shards=4,
+                  parity_shards=2, codec="msr"),
+              vpb.VolumeEcShardsGenerateResponse, timeout=120)
+    mwant = {victim: [3], rest[0]: [0, 1, 2], rest[1]: [4, 5]}
+    for vs, sids in mwant.items():
+        if vs is not msrc_vs:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=msr_vid, collection="cmsr", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True,
+                    copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{msrc_vs.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=msr_vid,
+                                           collection="cmsr",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    msrc_base = msrc_vs.store.find_ec_volume(msr_vid).base
+    mdrop = sorted(set(range(6)) - set(mwant[msrc_vs]))
+    msrc.call("VolumeEcShardsUnmount",
+              vpb.VolumeEcShardsUnmountRequest(volume_id=msr_vid,
+                                               shard_ids=mdrop),
+              vpb.VolumeEcShardsUnmountResponse)
+    for sid in mdrop:
+        os.remove(msrc_base + ec_files.shard_ext(sid))
+    msrc.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=msr_vid),
+              vpb.VolumeDeleteResponse)
+    wait_until(lambda: sorted(master.topo.lookup_ec(msr_vid)) ==
+               list(range(6)), timeout=20,
+               msg="all 6 msr shards registered")
+    msr_lost_shard = open(
+        victim.store.find_ec_volume(msr_vid).base + ec_files.shard_ext(3),
+        "rb").read()
+
     read_before = REPAIR_BYTES_READ.value("piggyback")
     written_before = REPAIR_BYTES_WRITTEN.value("piggyback")
+    msr_read_before = REPAIR_BYTES_READ.value("msr")
+    msr_written_before = REPAIR_BYTES_WRITTEN.value("msr")
 
     victim.stop()
     wait_until(lambda: f"127.0.0.1:{victim.port}" not in master.topo.nodes,
@@ -1163,6 +1223,42 @@ def test_repair_loop_converges_after_node_death(cluster):
     assert read_delta >= done_read
     # payloads still served from the healed stripe
     for fid, data in list(ec_payloads.items())[:5]:
+        assert operation.read(mc, fid) == data
+
+    # -- the msr half: byte-identity + cut-set repair traffic ---------------
+    wait_until(lambda: sorted(master.topo.lookup_ec(msr_vid)) ==
+               list(range(6)), timeout=20,
+               msg="all 6 msr shards re-registered post-heal")
+    msr_rebuilt = None
+    for vs in rest:
+        ev = vs.store.find_ec_volume(msr_vid)
+        if ev is not None and os.path.exists(
+                ev.base + ec_files.shard_ext(3)):
+            msr_rebuilt = open(ev.base + ec_files.shard_ext(3),
+                               "rb").read()
+            break
+    assert msr_rebuilt is not None, "rebuilt msr shard 3 not found"
+    assert msr_rebuilt == msr_lost_shard, \
+        "rebuilt msr shard 3 not byte-identical"
+    msr_shard_size = len(msr_lost_shard)
+    msr_read_delta = REPAIR_BYTES_READ.value("msr") - msr_read_before
+    msr_written_delta = (REPAIR_BYTES_WRITTEN.value("msr")
+                         - msr_written_before)
+    assert msr_read_delta > 0 and msr_written_delta >= msr_shard_size
+    msr_done = [e for e in events.JOURNAL.snapshot(since=since,
+                                                   etype="repair.done")
+                if e["attrs"].get("action") == "ec.rebuild"
+                and e["attrs"].get("vid") == msr_vid]
+    assert msr_done, "no repair.done for the msr rebuild"
+    msr_done_read = msr_done[-1]["attrs"]["bytes_read"]
+    # the cut-set bound: (n-1)/p = 5/2 shard-equivalents of computed
+    # fragments — strictly below the d = 4 full shards plain RS (and
+    # piggyback, which degenerates at p=2) would move
+    assert msr_done_read == 5 * msr_shard_size // 2, \
+        f"msr repair read {msr_done_read} B, want " \
+        f"{5 * msr_shard_size // 2} B (plain RS: {4 * msr_shard_size} B)"
+    assert msr_read_delta >= msr_done_read
+    for fid, data in list(msr_payloads.items())[:5]:
         assert operation.read(mc, fid) == data
 
 def test_rack_kill_after_balance_keeps_ec_reconstructable(tmp_path):
