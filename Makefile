@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -106,6 +106,17 @@ bench-qos:
 # rebalance traffic visible as maintenance-class in qos metrics
 bench-balance:
 	JAX_PLATFORMS=cpu python bench.py --balance-only
+
+# tiered-storage lifecycle gate: a cooling collection must auto-
+# transition hot -> EC -> remote under the master cron's
+# -lifecyclePolicy with zero operator commands, cold GETs must read
+# through the remote backend byte-identical and promote the volume
+# back on heat, `lifecycle.apply -dryRun` must issue zero mutating
+# RPCs, and a migration storm must run maintenance-class: the victim
+# tenant's paced read p99 stays <= 3x its solo p99 while
+# SeaweedFS_lifecycle_bytes_moved_total{from,to} books the move
+bench-tier:
+	JAX_PLATFORMS=cpu python bench.py --tier-only
 
 smoke:
 	python bench.py --smoke

@@ -707,7 +707,8 @@ def bench_s3(out: dict, obj_mb: int = 24) -> None:
 
 def _spawn_procs_cluster(tmp_prefix: str, volume_size_mb: int,
                          vol_max: int, extra_env: "dict | None" = None,
-                         extra_volume_args: "list | None" = None):
+                         extra_volume_args: "list | None" = None,
+                         extra_master_args: "list | None" = None):
     """Separate-process master + volume pair (CPU-only children), waited
     until both answer HTTP. Returns (procs, tmp, mport, mhttp, vport);
     tear down with _stop_procs_cluster(procs, tmp)."""
@@ -735,7 +736,8 @@ def _spawn_procs_cluster(tmp_prefix: str, volume_size_mb: int,
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "seaweedfs_tpu", "master",
              "-port", str(mport), "-httpPort", str(mhttp),
-             "-volumeSizeLimitMB", str(volume_size_mb)],
+             "-volumeSizeLimitMB", str(volume_size_mb)]
+            + list(extra_master_args or []),
             cwd=repo_root, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
         procs.append(subprocess.Popen(
@@ -1784,6 +1786,350 @@ def _balance_get_phase(mc, fids_by_vid: dict, seconds: float,
     return got[0] / (time.monotonic() - t0)
 
 
+_TIER_QOS_POLICY = {
+    # victim: heavy WFQ weight, interactive class — its p99 is the gate
+    # while the lifecycle storm (maintenance class at every enforcement
+    # point) yields to it
+    "classes": {"interactive": {"max_wait_s": 2.0},
+                "ingest": {"max_wait_s": 5.0},
+                "maintenance": {"max_wait_s": 10.0}},
+    "default": {"weight": 10},
+    "tenants": {"victim": {"weight": 100}},
+}
+
+
+def bench_tier_smoke(out: dict) -> None:
+    """`make bench-tier`: the tiered-storage lifecycle gate (ISSUE 15)
+    on a separate-process cluster whose master runs the REAL maintenance
+    cron with a `-lifecyclePolicy` attached:
+
+      1. a cooling collection auto-transitions hot -> EC -> remote with
+         ZERO operator commands (the cron plans + executes);
+      2. cold GETs read through the remote backend byte-identical, and
+         the heat they generate promotes the volume back (remote -> ec,
+         also operator-free);
+      3. `lifecycle.apply -dryRun` plans the transition and issues zero
+         mutating RPCs;
+      4. a lifecycle migration storm runs maintenance-class: a victim
+         tenant's paced interactive read p99 stays <= 3x its solo p99
+         (same deterministic 10 ms store.read delay as bench-qos), the
+         volume server's qos counters show maintenance-class
+         admissions, and the lifecycle {from,to} byte counters balance
+         the move.
+    """
+    import io
+    import threading
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.shell import lifecycle_commands  # noqa: F401
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    base = tempfile.mkdtemp(prefix="swtpu_bench_tier_")
+    remote_dir = os.path.join(base, "remote")
+    qos_path = os.path.join(base, "qos.json")
+    with open(qos_path, "w", encoding="utf-8") as f:
+        json.dump(_TIER_QOS_POLICY, f)
+    auto_policy = os.path.join(base, "lifecycle.json")
+    with open(auto_policy, "w", encoding="utf-8") as f:
+        json.dump({"rules": [{"collection": "cool", "ec_after_s": 1,
+                              "remote_after_s": 2,
+                              "remote": f"local:{remote_dir}",
+                              "promote_reads": 4}]}, f)
+    storm_policy = os.path.join(base, "storm.json")
+    with open(storm_policy, "w", encoding="utf-8") as f:
+        json.dump({"rules": [{"collection": "storm", "ec_after_s": 0,
+                              "remote_after_s": 0,
+                              "remote": f"local:{remote_dir}"}]}, f)
+    freeze_policy = os.path.join(base, "freeze.json")
+    with open(freeze_policy, "w", encoding="utf-8") as f:
+        json.dump({"rules": [{"collection": "freeze",
+                              "ec_after_s": 0}]}, f)
+
+    procs, tmp, mport, mhttp, vport = _spawn_procs_cluster(
+        "swtpu_bench_tierv_", volume_size_mb=64, vol_max=32,
+        # cache off: cold reads must actually traverse the tier; the
+        # cron's first sweep lands ~1 s in, then every 2 s
+        extra_env={"SWTPU_READ_CACHE_MB": "0",
+                   "SWTPU_CRON_INITIAL_DELAY_S": "1"},
+        extra_volume_args=["-qosPolicy", qos_path, "-ecShards", "4,2"],
+        extra_master_args=["-maintenanceScripts", "",
+                           "-maintenanceIntervalS", "2",
+                           "-ecShards", "4,2",
+                           "-lifecyclePolicy", auto_policy])
+    try:
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        mc.wait_connected()
+
+        def vs_lifecycle() -> dict:
+            return http_util.get(
+                f"http://127.0.0.1:{vport}/debug/lifecycle",
+                timeout=5).json()
+
+        def wait_tier(pred, msg: str, timeout: float = 60.0) -> float:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < timeout:
+                try:
+                    if pred(vs_lifecycle()):
+                        return time.monotonic() - t0
+                except Exception:  # noqa: BLE001 — server busy mid-move
+                    pass
+                time.sleep(0.4)
+            raise AssertionError(
+                f"bench-tier: {msg} not reached in {timeout:.0f}s; "
+                f"state={json.dumps(vs_lifecycle())[:600]}")
+
+        def metric_sum(port: int, name: str, *must: str) -> float:
+            body = http_util.get(f"http://127.0.0.1:{port}/metrics",
+                                 timeout=5).content.decode()
+            return sum(float(ln.split()[-1]) for ln in body.splitlines()
+                       if ln.startswith(name)
+                       and all(m in ln for m in must))
+
+        def read_ok(fid: str, want: bytes, deadline_s: float = 25.0):
+            """Read through whatever tier the volume is in RIGHT NOW —
+            lookups go stale across the hot->EC handoff, so refresh and
+            retry; served bytes must always be identical."""
+            last = None
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                try:
+                    got = operation.read(mc, fid)
+                except Exception as e:  # noqa: BLE001
+                    last = e
+                    mc.refresh_lookup(int(fid.split(",")[0]))
+                    time.sleep(0.2)
+                    continue
+                assert got == want, \
+                    f"bench-tier: {fid} served WRONG BYTES " \
+                    f"({len(got)} vs {len(want)})"
+                return
+            raise AssertionError(f"bench-tier: read {fid} failed past "
+                                 f"deadline: {last}")
+
+        # -- phase A: zero-operator hot -> EC -> remote -> promoted -----
+        cool = {}
+        for i in range(24):
+            data = os.urandom(6000 + 37 * i)
+            cool[operation.submit(mc, data, collection="cool").fid] = data
+        t0 = time.monotonic()
+
+        def _cool_ec(rep):
+            return any(e["collection"] == "cool" and e["local_shards"]
+                       for e in rep["ec_volumes"].values())
+
+        def _cool_offloaded(rep):
+            ecs = [e for e in rep["ec_volumes"].values()
+                   if e["collection"] == "cool"]
+            return ecs and all(e["remote_shards"] and not e["local_shards"]
+                               for e in ecs)
+
+        def _cool_promoted(rep):
+            ecs = [e for e in rep["ec_volumes"].values()
+                   if e["collection"] == "cool"]
+            return ecs and all(e["local_shards"] and not e["remote_shards"]
+                               for e in ecs)
+
+        wait_tier(_cool_ec, "auto hot->EC encode")
+        enc_s = time.monotonic() - t0
+        wait_tier(_cool_offloaded, "auto EC->remote offload")
+        out["tier_auto_hot_to_remote_s"] = round(time.monotonic() - t0, 1)
+        log(f"tier: auto hot->EC in {enc_s:.1f}s, ->remote in "
+            f"{out['tier_auto_hot_to_remote_s']}s (zero operator cmds)")
+        assert os.listdir(remote_dir), "no objects landed on the remote"
+        # cold reads: byte-identical THROUGH the remote tier, and the
+        # heat promotes the volume back without an operator
+        t1 = time.monotonic()
+        cold_bytes = 0
+        for fid, data in cool.items():
+            read_ok(fid, data)
+            cold_bytes += len(data)
+        out["tier_cold_read_MBps"] = round(
+            cold_bytes / (time.monotonic() - t1) / 1e6, 2)
+        promote_s = wait_tier(_cool_promoted, "promote-on-heat")
+        out["tier_promote_on_heat_s"] = round(promote_s, 1)
+        log(f"tier: cold GETs byte-identical "
+            f"({out['tier_cold_read_MBps']} MB/s), promoted back in "
+            f"{promote_s:.1f}s")
+        for fid, data in cool.items():
+            read_ok(fid, data)
+        trans_hot_ec = metric_sum(
+            mhttp, "SeaweedFS_lifecycle_transitions_total",
+            'from="hot"', 'to="ec"')
+        trans_ec_remote = metric_sum(
+            mhttp, "SeaweedFS_lifecycle_transitions_total",
+            'from="ec"', 'to="remote"')
+        trans_promote = metric_sum(
+            mhttp, "SeaweedFS_lifecycle_transitions_total",
+            'from="remote"', 'to="ec"')
+        assert trans_hot_ec >= 1 and trans_ec_remote >= 1 \
+            and trans_promote >= 1, \
+            (trans_hot_ec, trans_ec_remote, trans_promote)
+        out["tier_master_transitions"] = int(
+            trans_hot_ec + trans_ec_remote + trans_promote)
+
+        # -- phase B: -dryRun plans, mutates nothing --------------------
+        frz = {}
+        for i in range(8):
+            data = os.urandom(4000)
+            frz[operation.submit(mc, data, collection="freeze").fid] = data
+        frz_vids = {int(f.split(",")[0]) for f in frz}
+        # the planner costs from topology heartbeats: wait for size
+        sh_out = io.StringIO()
+        env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=sh_out)
+
+        def _frz_sized():
+            return any(v.id in frz_vids and v.size
+                       for s in env.collect_volume_servers()
+                       for d in s["disks"].values()
+                       for v in d.volume_infos)
+
+        deadline = time.monotonic() + 20
+        while not _frz_sized() and time.monotonic() < deadline:
+            time.sleep(0.3)
+
+        def lock_retry(deadline_s: float = 20.0):
+            deadline = time.monotonic() + deadline_s
+            while True:
+                try:
+                    env.acquire_lock()
+                    return
+                except Exception:  # noqa: BLE001 — cron holds the lease
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.3)
+
+        lock_retry()
+        try:
+            run_command(env, f"lifecycle.apply -policy {freeze_policy} "
+                             "-dryRun")
+        finally:
+            env.release_lock()
+        assert "hot->ec" in sh_out.getvalue(), sh_out.getvalue()
+        rep = vs_lifecycle()
+        assert all(str(v) in rep["volumes"] for v in frz_vids), \
+            "dry run mutated: a freeze volume left the hot tier"
+        assert not any(str(v) in rep["ec_volumes"] for v in frz_vids)
+        out["tier_dryrun_mutations"] = 0
+        log("tier: lifecycle.apply -dryRun planned the transition, "
+            "mutated nothing")
+
+        # -- phase C: migration storm vs a paced victim -----------------
+        victim_payloads = [b"v%05d-" % i + b"x" * 2000 for i in range(200)]
+        victim_fids = [r.fid for r in operation.submit_batch(
+            mc, victim_payloads, collection="victim")]
+        for i in range(48):
+            operation.submit(mc, os.urandom(30_000), collection="storm")
+        # deterministic slow disk (bench-qos): victim reads pay 10 ms
+        http_util.get(f"http://127.0.0.1:{vport}/debug/failpoints",
+                      params={"name": "store.read",
+                              "spec": "pct:100:delay:0.01"})
+
+        def victim_phase(duration_s: float, pace_s: float) -> dict:
+            n = int(duration_s / pace_s)
+            lat: "list[float]" = []
+            errors = [0]
+            vlock = threading.Lock()
+            idx = [0]
+            t0 = time.monotonic()
+
+            def worker(seed: int) -> None:
+                rng = random.Random(seed)
+                while True:
+                    with vlock:
+                        i = idx[0]
+                        if i >= n:
+                            return
+                        idx[0] += 1
+                    delay = t0 + i * pace_s - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    f = rng.randrange(len(victim_fids))
+                    s = time.monotonic()
+                    try:
+                        data = operation.read(mc, victim_fids[f])
+                        assert data == victim_payloads[f]
+                    except Exception:  # noqa: BLE001
+                        errors[0] += 1
+                        continue
+                    with vlock:
+                        lat.append(time.monotonic() - s)
+
+            ts = [threading.Thread(target=worker, args=(1000 + s,))
+                  for s in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            lat.sort()
+            return {"ok": len(lat), "errors": errors[0],
+                    "p99_ms": (lat[int(len(lat) * 0.99)] * 1e3)
+                    if lat else float("inf")}
+
+        pace_s = 1 / 20.0
+        solo = victim_phase(4.0, pace_s)
+        assert solo["ok"] > 0 and solo["errors"] == 0, solo
+        log(f"tier: victim solo p99 {solo['p99_ms']:.1f} ms")
+
+        maint_before = metric_sum(vport, "SeaweedFS_qos_requests_total",
+                                  'class="maintenance"')
+        from seaweedfs_tpu.stats import LIFECYCLE_BYTES_MOVED
+        bytes_before = LIFECYCLE_BYTES_MOVED.value("ec", "remote")
+        storm_done = []
+
+        def storm() -> None:
+            lock_retry()
+            try:
+                # sweep 1 encodes, sweep 2+ offload once heartbeats
+                # register the fresh stripes
+                for _ in range(3):
+                    run_command(env, "lifecycle.apply -policy "
+                                     f"{storm_policy} -maxConcurrent 2")
+                    time.sleep(2.0)
+                storm_done.append(True)
+            finally:
+                env.release_lock()
+
+        st = threading.Thread(target=storm)
+        st.start()
+        contended = victim_phase(8.0, pace_s)
+        st.join(timeout=60)
+        assert not st.is_alive(), "lifecycle storm hung"
+        assert storm_done, "lifecycle storm failed"
+        maint_delta = metric_sum(
+            vport, "SeaweedFS_qos_requests_total",
+            'class="maintenance"') - maint_before
+        storm_bytes = LIFECYCLE_BYTES_MOVED.value("ec", "remote") \
+            - bytes_before
+        out["tier_victim_solo_p99_ms"] = round(solo["p99_ms"], 1)
+        out["tier_victim_storm_p99_ms"] = round(contended["p99_ms"], 1)
+        out["tier_storm_maintenance_admissions"] = int(maint_delta)
+        out["tier_storm_bytes_offloaded"] = int(storm_bytes)
+        out["tier_topology"] = (
+            "separate-process master (cron: lifecycle.apply every 2s, "
+            "-lifecyclePolicy) + volume server (RS(4,2), -qosPolicy, "
+            "10 ms deterministic store.read delay, cache off); remote "
+            "tier = local dir backend")
+        log(f"tier: storm p99 {contended['p99_ms']:.1f} ms vs solo "
+            f"{solo['p99_ms']:.1f} ms; {int(storm_bytes)} bytes "
+            f"offloaded maintenance-class ({int(maint_delta)} "
+            "admissions)")
+        # -- the acceptance gates ---------------------------------------
+        bound = 3.0 * solo["p99_ms"]
+        assert contended["p99_ms"] <= bound, \
+            f"victim p99 {contended['p99_ms']:.1f} ms > 3x solo " \
+            f"({bound:.1f} ms) during the migration storm"
+        assert contended["ok"] > 0 and contended["errors"] == 0, contended
+        assert storm_bytes > 0, "storm moved no lifecycle bytes"
+        assert maint_delta > 0, \
+            "no maintenance-class qos admissions during the storm"
+    finally:
+        _stop_procs_cluster(procs, tmp)
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def bench_balance_smoke(out: dict) -> None:
     """`make bench-balance`: the scale-out placement & rebalance gate.
 
@@ -2309,6 +2655,14 @@ def main() -> None:
                          "victim p99 <= 3x solo and goodput >= 50% with "
                          "QoS on, bound demonstrably violated with QoS "
                          "hot-disabled, sheds answer 503 + Retry-After")
+    ap.add_argument("--tier-only", action="store_true", dest="tier_only",
+                    help="run only the tiered-storage lifecycle smoke "
+                         "(make bench-tier): a cooling collection must "
+                         "auto-transition hot->EC->remote under the "
+                         "master cron's -lifecyclePolicy and promote "
+                         "back on heat, cold GETs byte-identical, "
+                         "-dryRun mutation-free, and a migration storm "
+                         "maintenance-class with victim p99 <= 3x solo")
     ap.add_argument("--balance-only", action="store_true",
                     dest="balance_only",
                     help="run only the scale-out placement/rebalance "
@@ -2361,6 +2715,12 @@ def main() -> None:
         out_q: dict = {"metric": "bench_qos_smoke"}
         bench_qos_smoke(out_q)
         print(json.dumps(out_q))
+        return
+    if args.tier_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_t: dict = {"metric": "bench_tier_smoke"}
+        bench_tier_smoke(out_t)
+        print(json.dumps(out_t))
         return
     if args.balance_only:
         # CPU-only child processes: safe for make test's fast path

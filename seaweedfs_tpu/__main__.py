@@ -57,6 +57,12 @@ def _add_master_flags(p):
                    help="cluster EC geometry as 'd,p' (e.g. 14,2 fork / "
                         "10,4 upstream); the p half feeds the health "
                         "engine like -ecParityShards")
+    p.add_argument("-lifecyclePolicy", default="",
+                   help="tiered-storage lifecycle policy JSON file; wires "
+                        "lifecycle.apply into the maintenance cron so "
+                        "cooling collections EC-encode, offload to the "
+                        "remote tier and promote back on heat with zero "
+                        "operator commands (status: /debug/lifecycle)")
     _add_security_flags(p)
 
 
@@ -166,7 +172,8 @@ def run_master(argv):
                       maintenance_interval_s=opt.maintenanceIntervalS or None,
                       maintenance_health_driven=(
                           opt.maintenanceHealthDriven == "on"),
-                      ec_parity_shards=_ec_parity(opt))
+                      ec_parity_shards=_ec_parity(opt),
+                      lifecycle_policy=opt.lifecyclePolicy)
     ms.admin_cron.repair_max_concurrent = opt.maintenanceMaxConcurrentRepairs
     ms.start()
     _wait_forever()
@@ -259,8 +266,8 @@ def run_server(argv):
 
 def run_shell(argv):
     from .shell import (ec_commands, fs_commands,  # noqa: F401 (register)
-                        mq_commands, qos_commands, remote_commands,
-                        volume_commands)
+                        lifecycle_commands, mq_commands, qos_commands,
+                        remote_commands, volume_commands)
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 
 import numpy as np
 
@@ -132,8 +133,16 @@ def max_ecx_extent(ecx_path: str) -> int:
 
 
 def write_vif(path: str, **info) -> None:
-    with open(path, "w") as f:
+    """Atomic replace (tmp + fsync + rename): the .vif is the volume's
+    source of truth for geometry/codec/tiering — a crash mid-write must
+    leave the OLD sidecar, never a truncated one that fails json.load
+    and makes an otherwise-intact volume unmountable."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(info, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def read_vif(path: str) -> dict:
@@ -141,3 +150,36 @@ def read_vif(path: str) -> dict:
         return {}
     with open(path) as f:
         return json.load(f)
+
+
+# Concurrent .vif writers (idle-close last-read stamp on the heartbeat
+# thread, tier offload/promote seals and DestroyTime stamps on gRPC
+# threads) must not interleave read-modify-write cycles — a lost update
+# could drop the remote_shards mapping AFTER the local payloads were
+# deleted. One lock per sidecar path serializes them.
+_vif_locks: dict = {}
+_vif_locks_guard = threading.Lock()
+
+
+def _vif_lock(path: str):
+    key = os.path.abspath(path)
+    with _vif_locks_guard:
+        lk = _vif_locks.get(key)
+        if lk is None:
+            lk = _vif_locks[key] = threading.Lock()
+        return lk
+
+
+def update_vif(path: str, updates: "dict | None" = None,
+               remove: tuple = ()) -> dict:
+    """Locked read-modify-write of a .vif: merge `updates`, drop the
+    `remove` keys, write atomically. Returns the resulting dict. EVERY
+    mutation of an existing .vif must go through here (initial seals of
+    a fresh sidecar are exclusive by construction and may write_vif)."""
+    with _vif_lock(path):
+        info = read_vif(path)
+        info.update(updates or {})
+        for k in remove:
+            info.pop(k, None)
+        write_vif(path, **info)
+        return info

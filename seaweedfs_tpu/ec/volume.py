@@ -19,8 +19,11 @@ import numpy as np
 
 from ..storage import types as t
 from ..storage.needle import Needle, record_size_from_header
+from ..utils.log import logger
 from . import files
 from .locate import EcGeometry, locate
+
+log = logger("ec.volume")
 
 
 class ShardBits:
@@ -80,6 +83,35 @@ class EcVolumeShard:
                 self._f.close()
 
 
+class RemoteEcVolumeShard:
+    """An EC shard whose payload lives in a remote tier (lifecycle
+    EC→remote offload). Same read_at/close surface as EcVolumeShard so
+    EcVolume's stripe map, degraded reads and the heartbeat shard_bits
+    are tier-blind: this holder still OWNS the shard, it just serves it
+    through lazy ranged reads (RemoteDatFile's LRU block cache) instead
+    of a local fd. `reads` feeds the promote-on-heat policy."""
+
+    def __init__(self, shard_id: int, client, key: str, size: int):
+        from ..storage.backend import RemoteDatFile
+        self.shard_id = shard_id
+        self.key = key
+        self.size = size
+        self._f = RemoteDatFile(client, key, size)
+        self._mu = threading.Lock()  # _pos is shared; reads serialize
+        self.reads = 0
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        with self._mu:
+            self.reads += 1
+            self._f.seek(offset)
+            return self._f.read(length)
+
+    def close(self):
+        # nothing to release but the block cache; an idle close must
+        # not force a re-fetch storm, so keep it
+        pass
+
+
 class EcVolume:
     def __init__(self, base: str, vid: int, collection: str = "",
                  geo: EcGeometry | None = None):
@@ -99,7 +131,32 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         for i, p in sorted(self._scan_shards().items()):
             self.shards[i] = EcVolumeShard(i, p)
+        # lifecycle EC→remote: shards whose payload was offloaded keep
+        # serving through ranged remote reads (.vif `remote_shards` is
+        # the source of truth: {"spec":, "keys": {sid: key}, "sizes":
+        # {sid: size}}). A shard present BOTH locally and remotely —
+        # a promote raced a crash — serves local (fresher is identical,
+        # local is cheaper); the stale remote copy is cleaned up by the
+        # next offload/promote pass.
+        self.remote_spec: dict | None = info.get("remote_shards") or None
+        if self.remote_spec:
+            from ..storage.backend import open_remote
+            client = open_remote(self.remote_spec["spec"])
+            for sid_s, key in self.remote_spec.get("keys", {}).items():
+                sid = int(sid_s)
+                if sid not in self.shards:
+                    self.shards[sid] = RemoteEcVolumeShard(
+                        sid, client, key,
+                        int(self.remote_spec.get("sizes", {}).get(
+                            sid_s, 0)) or self.shard_size)
         self.last_read_at = time.monotonic()
+        self.reads = 0  # needle reads since mount (lifecycle heat)
+        # last-read instant persisted across restarts (stamped into the
+        # .vif on idle-close): without it a remount would reset the
+        # read-age clock to zero and postpone every EC→remote offload
+        # by a full remote_after_s after a restart
+        self._last_read_wall = float(info.get("last_read_wall", 0.0))
+        self._idle_stamped = False
 
     def _scan_shards(self) -> dict[int, str]:
         return {i: self.base + files.shard_ext(i)
@@ -123,14 +180,65 @@ class EcVolume:
     def shard_bits(self) -> ShardBits:
         return ShardBits().add(*self.shards.keys())
 
+    def remote_shard_ids(self) -> list[int]:
+        """Shard ids this holder serves from the remote tier."""
+        return sorted(i for i, s in self.shards.items()
+                      if isinstance(s, RemoteEcVolumeShard))
+
+    def remote_reads(self) -> int:
+        """Ranged remote reads served since mount — the promote-on-heat
+        signal (a cold volume that keeps getting read belongs local)."""
+        return sum(s.reads for s in self.shards.values()
+                   if isinstance(s, RemoteEcVolumeShard))
+
+    def read_age_s(self) -> float:
+        """Seconds since the last KNOWN needle read. In-process reads
+        drive the monotonic clock; with none since mount, the
+        `last_read_wall` stamp the idle-close persisted into the .vif
+        extends the quiet period across restarts (no stamp = the mount
+        instant is the conservative floor)."""
+        mono_age = time.monotonic() - self.last_read_at
+        if self.reads == 0 and self._last_read_wall:
+            wall_age = time.time() - self._last_read_wall  # swtpu-lint: disable=wallclock-duration (stamp is persisted wall-clock)
+            return max(mono_age, wall_age)
+        return mono_age
+
     def close_idle(self, idle_s: float) -> bool:
         """Fork behavior (ec_volume.go:303-319,348-353 IsExpire/idle close):
         release file handles of EC volumes nobody read recently; reads
-        lazily reopen. Returns True if handles were closed."""
+        lazily reopen. Returns True if handles were closed. Crossing
+        into idle also persists the last-read instant into the .vif
+        (once per quiet period) so read_age_s survives a restart."""
         if time.monotonic() - self.last_read_at < idle_s:
+            # reads resumed: a persisted stamp is now STALE — left in
+            # place it would survive a restart and make this hot volume
+            # read as cold-for-days (offloading warm data is the
+            # expensive mistake). Cleared here, off the read path, at
+            # most once per busy period.
+            if self._idle_stamped or self._last_read_wall:
+                try:
+                    files.update_vif(self.base + ".vif",
+                                     remove=("last_read_wall",))
+                except OSError as e:
+                    log.debug("stale read stamp clear for %d: %s",
+                              self.id, e)
+                self._last_read_wall = 0.0
+            self._idle_stamped = False
             return False
+        if not self._idle_stamped:
+            try:
+                last_wall = time.time() - (  # swtpu-lint: disable=wallclock-duration (persisting a wall-clock stamp)
+                    time.monotonic() - self.last_read_at)
+                files.update_vif(self.base + ".vif",
+                                 {"last_read_wall": last_wall})
+                self._last_read_wall = last_wall
+            except OSError as e:
+                log.debug("idle last-read stamp for %d: %s", self.id, e)
+            self._idle_stamped = True
         closed = False
         for shard in self.shards.values():
+            if isinstance(shard, RemoteEcVolumeShard):
+                continue  # no fd to release; block cache stays warm
             if not shard._f.closed:
                 shard.close()
                 closed = True
@@ -150,6 +258,7 @@ class EcVolume:
         Reference store_ec.go:154 ReadEcShardNeedle -> readEcShardIntervals.
         """
         self.last_read_at = time.monotonic()
+        self.reads += 1
         loc = self.find_needle(needle_id)
         if loc is None:
             raise KeyError(f"needle {needle_id:x} not in ec volume {self.id}")
@@ -197,7 +306,21 @@ class EcVolume:
             s.close()
 
     def destroy(self, to_trash: str | None = None):
-        """Remove (or soft-move, fork behavior ec_volume.go:184-198) all files."""
+        """Remove (or soft-move, fork behavior ec_volume.go:184-198) all files.
+
+        Offloaded shard payloads: a soft-delete to trash keeps the
+        remote objects (the .vif rides into the trash dir, so a restore
+        before the grace expires remounts the remote tier intact); a
+        hard destroy deletes them best-effort, like Volume.destroy."""
+        if to_trash is None and self.remote_spec:
+            try:
+                from ..storage.backend import open_remote
+                client = open_remote(self.remote_spec["spec"])
+                for key in self.remote_spec.get("keys", {}).values():
+                    client.delete_object(key)
+            except Exception as e:  # noqa: BLE001 — orphan object, not data
+                log.warning("delete remote shards of ec volume %d: %s",
+                            self.id, e)
         self.close()
         exts = [files.shard_ext(i) for i in range(self.geo.n)] + [".ecx", ".ecj", ".vif"]
         for ext in exts:

@@ -125,7 +125,8 @@ class AdminCron:
         if self._env is None:
             # import for side effect: registers the command tables
             from ..shell import (commands, ec_commands,  # noqa: F401
-                                 fs_commands, mq_commands, remote_commands,
+                                 fs_commands, lifecycle_commands,
+                                 mq_commands, remote_commands,
                                  volume_commands)
             from ..client.master_client import MasterClient
             mc = MasterClient(self.master_address,

@@ -42,7 +42,8 @@ class MasterServer:
                  maintenance_initial_delay_s: float | None = None,
                  maintenance_health_driven: bool = True,
                  metrics_gateway: str = "", metrics_interval_s: int = 15,
-                 ec_parity_shards: int | None = None):
+                 ec_parity_shards: int | None = None,
+                 lifecycle_policy: str = ""):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -116,6 +117,23 @@ class MasterServer:
             # unregisters dead nodes, this catches wedged-but-connected
             stale_after_s=max(4 * pulse_seconds, 5.0))
         from .admin_cron import DEFAULT_INTERVAL_S, AdminCron
+        # Tiered-storage lifecycle (lifecycle/): a policy FILE path
+        # wires `lifecycle.apply` into the maintenance cron, so cooling
+        # collections EC-encode, offload to the remote tier and promote
+        # back on heat with zero operator commands. Served (with recent
+        # transitions) at /debug/lifecycle.
+        self.lifecycle_policy = lifecycle_policy
+        if lifecycle_policy:
+            import shlex as _shlex
+            from .admin_cron import DEFAULT_SCRIPTS
+            maintenance_scripts = list(
+                DEFAULT_SCRIPTS if maintenance_scripts is None
+                else maintenance_scripts)
+            if not any(s.split()[:1] == ["lifecycle.apply"]
+                       for s in maintenance_scripts):
+                maintenance_scripts.append(
+                    "lifecycle.apply -policy "
+                    + _shlex.quote(lifecycle_policy))
         # health-driven: each sweep consumes the in-process engine's
         # report and runs planner->executor (maintenance/) in place of
         # the blind ec.rebuild / volume.fix.replication lines, falling
@@ -424,6 +442,28 @@ class MasterServer:
             from ..utils import locktrack
             return json_response(locktrack.debug_locks_payload(q))
 
+        def debug_lifecycle(req, q):
+            """Lifecycle plane status: the configured policy (parsed
+            fresh so edits to the file show without a restart) and the
+            recent lifecycle.* journal events — the cron's transitions
+            run in THIS process, so its plan/transition/skip history is
+            one filter away."""
+            from ..ops import events
+            policy = None
+            err = ""
+            if ms.lifecycle_policy:
+                try:
+                    from ..lifecycle import parse_policy
+                    policy = parse_policy(ms.lifecycle_policy).to_doc()
+                except Exception as e:  # noqa: BLE001 — show, don't 500
+                    err = str(e)
+            qq = dict(q)
+            qq["type"] = "lifecycle."
+            return json_response({
+                "policy": policy, "source": ms.lifecycle_policy,
+                "policy_error": err,
+                "recent": events.debug_events_payload(qq)})
+
         app = fastweb.FastApp()
         app.route("/metrics", metrics)
         app.route("/dir/status", offloaded(guarded("/dir/status", dir_status)))
@@ -447,6 +487,10 @@ class MasterServer:
                   offloaded(guarded("/debug/locks", debug_locks)))
         app.route("/cluster/health",
                   offloaded(guarded("/cluster/health", cluster_health)))
+        # guarded+offloaded like the other /debug routes (the journal
+        # filter walks the whole ring)
+        app.route("/debug/lifecycle",
+                  offloaded(guarded("/debug/lifecycle", debug_lifecycle)))
 
         self._http_stop = threading.Event()
         threading.Thread(

@@ -130,6 +130,10 @@ class VolumeServer:
         from ..storage import read_cache as read_cache_mod
         from ..utils.env import env_int
         self.read_cache = read_cache_mod.default_cache()
+        # lifecycle heat epoch: read counters live in memory, so this
+        # server can only attest "quiet for <= uptime" — the planner
+        # uses it as the ceiling for volumes with no recorded read
+        self._started_mono = time.monotonic()
         self._read_pool = ThreadPoolExecutor(
             max_workers=max(1, env_int("SWTPU_READ_THREADS", 8)),
             thread_name_prefix=f"vs-read-{port}")
@@ -235,10 +239,27 @@ class VolumeServer:
         while not (self._stop.is_set() or self._leave.is_set()):
             try:
                 # per-pulse housekeeping (fork store.go:389 reap +
-                # ec_volume.go idle-handle close)
+                # ec_volume.go idle-handle close). Reaps are lifecycle
+                # transitions (→trash): journaled + metered like every
+                # other tier move so the plane's books balance.
                 reaped = self.store.delete_expired_ec_volumes()
                 if reaped:
-                    log.info("reaped expired ec volumes %s", reaped)
+                    from ..lifecycle import TIER_TRASH
+                    from ..ops import events
+                    from ..stats import (LIFECYCLE_BYTES_MOVED,
+                                         LIFECYCLE_TRANSITIONS)
+                    for rec in reaped:
+                        events.emit("lifecycle.transition", kind="reap",
+                                    vid=rec["vid"], node=self.url,
+                                    collection=rec["collection"],
+                                    **{"from": rec["from"],
+                                       "to": TIER_TRASH},
+                                    bytes_moved=rec["bytes"])
+                        LIFECYCLE_TRANSITIONS.inc(rec["from"], TIER_TRASH)
+                        LIFECYCLE_BYTES_MOVED.inc(rec["from"], TIER_TRASH,
+                                                  amount=rec["bytes"])
+                    log.info("reaped expired ec volumes %s",
+                             [r["vid"] for r in reaped])
                 self.store.close_idle_ec_handles()
             except Exception as e:  # noqa: BLE001
                 log.warning("ec housekeeping: %s", e)
@@ -488,6 +509,36 @@ class VolumeServer:
                 self.qos._reload_file(initial=True)
             return json_response(self.qos.debug_payload())
 
+        def debug_lifecycle(request):
+            """GET dumps this server's per-volume heat + tier state —
+            the planner's input: read counters and last-read/last-write
+            ages from the storage layer (the read-cache hit path feeds
+            them too), per-EC-volume local vs offloaded shards, remote
+            read counts and DestroyTime. POST stamps a DestroyTime onto
+            a local EC volume's .vif ({"volume": N, "destroy_time": T}
+            — the lifecycle executor's TTL verb after a policy encode);
+            guarded like /debug/qos: a tenant must not be able to
+            schedule its own data's reaping."""
+            if request.method == "POST":
+                if self.guard is not None:
+                    ok, why = self.guard.check_write(request.remote or "",
+                                                     request.query,
+                                                     request.headers)
+                    if not ok:
+                        return json_response({"error": why}, status=401)
+                try:
+                    doc = json.loads(request.body or b"{}")
+                    vid = int(doc["volume"])
+                    at = float(doc["destroy_time"])
+                except (KeyError, TypeError, ValueError) as e:
+                    return json_response({"error": str(e)}, status=400)
+                if not self._set_destroy_time(vid, at):
+                    return json_response(
+                        {"error": f"no ec volume {vid}"}, status=404)
+                return json_response({"ok": True, "volume": vid,
+                                      "destroy_time": at})
+            return json_response(self._lifecycle_payload())
+
         async def debug_profile(request):
             import contextvars
 
@@ -671,9 +722,81 @@ class VolumeServer:
         app.route("/debug/events", debug_events)
         app.route("/debug/locks", debug_locks)
         app.route("/debug/qos", debug_qos)
+        app.route("/debug/lifecycle", debug_lifecycle)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
                                client_max_size=256 << 20, logger=log)
+
+    # -- lifecycle heat report ----------------------------------------------
+    def _set_destroy_time(self, vid: int, at: float) -> bool:
+        """Stamp DestroyTime into a local EC volume's .vif + live
+        object (one seam for the gRPC verb and the debug POST).
+        False = no such EC volume here."""
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return False
+        from ..ec import files as ec_files
+        ec_files.update_vif(ev.base + ".vif", {"destroy_time": at})
+        ev.destroy_time = at
+        return True
+
+    def _lifecycle_payload(self) -> dict:
+        """The planner's per-server input (served at /debug/lifecycle):
+        heat AGES, never absolute clocks — monotonic read clocks and
+        wall-clock needle timestamps both reduce to seconds-ago here so
+        the planner compares apples across processes."""
+        access = self.store.access_snapshot()
+        now_wall = time.time()  # swtpu-lint: disable=wallclock-duration (needle timestamps are persisted wall-clock)
+        now_mono = time.monotonic()
+        vols: dict = {}
+        ecs: dict = {}
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                a = access.get(vid, {})
+                if v.last_append_at_ns:
+                    write_age = max(0.0,
+                                    now_wall - v.last_append_at_ns / 1e9)
+                else:  # loaded sealed: the .dat mtime is the last write
+                    try:
+                        write_age = max(0.0, now_wall - os.path.getmtime(
+                            v.dat_path))
+                    except OSError:
+                        write_age = None
+                vols[str(vid)] = {
+                    "collection": v.collection,
+                    "size": v.content_size,
+                    "read_only": v.read_only,
+                    "tiered": v.remote_spec is not None,
+                    "last_write_age_s": (round(write_age, 3)
+                                         if write_age is not None
+                                         else None),
+                    "reads": a.get("reads", 0),
+                    "last_read_age_s": a.get("last_read_age_s"),
+                }
+            for vid, ev in list(loc.ec_volumes.items()):
+                a = access.get(vid, {})
+                # read_age_s() extends the quiet period across restarts
+                # via the .vif last-read stamp; the store's counter can
+                # only SHORTEN it (a more recent read)
+                ages = [ev.read_age_s()]
+                if a.get("last_read_age_s") is not None:
+                    ages.append(a["last_read_age_s"])
+                remote = ev.remote_shard_ids()
+                ecs[str(vid)] = {
+                    "collection": ev.collection,
+                    "local_shards": sorted(set(ev.shards) - set(remote)),
+                    "remote_shards": remote,
+                    "remote_spec": (ev.remote_spec or {}).get("spec", ""),
+                    "remote_reads": ev.remote_reads(),
+                    "reads": ev.reads,
+                    "last_read_age_s": round(min(ages), 3),
+                    "destroy_time": ev.destroy_time,
+                    "shard_size": ev.shard_size,
+                    "dat_size": ev.dat_size,
+                }
+        return {"server": self.url,
+                "uptime_s": round(now_mono - self._started_mono, 3),
+                "volumes": vols, "ec_volumes": ecs}
 
     # -- QoS helpers ---------------------------------------------------------
     def _qos_tenant(self, vid: int) -> str:
@@ -1143,6 +1266,10 @@ class VolumeServer:
                               FLAG_GZIP if n.is_gzipped else 0, n.data)
             else:
                 misses.append(i)
+        if hits:
+            # cache hits never reach the store: feed the lifecycle heat
+            # counters (misses are counted inside read_needles_bulk)
+            self.store.note_read(vid, n=hits)
         if misses:
             got = self.store.read_needles_bulk(
                 vid, [pairs[i] for i in misses],
@@ -1252,6 +1379,10 @@ class VolumeServer:
         epoch = None
         if cacheable:
             n = cache.get(vid, key, cookie)
+            if n is not None:
+                # cache hits never reach the store: feed the lifecycle
+                # heat counters here or hot volumes would read as cold
+                self.store.note_read(vid)
             sp = tracing.current_span()
             if sp is not None:
                 sp.set_attr("cache", "hit" if n is not None else "miss")
@@ -2635,8 +2766,29 @@ class VolumeServer:
         @_maintenance_tagged
         def volume_copy(req, context):
             """Pull a whole volume (.dat + .idx) from source_data_node
-            (reference volume_grpc_copy.go doCopyFile flow)."""
-            if store.find_volume(req.volume_id) is not None:
+            (reference volume_grpc_copy.go doCopyFile flow).
+
+            Same-server special case: when the volume is ALREADY here
+            and the request names a different disk_type, this is a
+            cross-tier move on one machine (volume.tier.move without a
+            second server) — a local disk-to-disk copy + retire, not a
+            network pull. A same-server request WITHOUT a differing
+            disk_type keeps the historical 'already here' rejection."""
+            v_here = store.find_volume(req.volume_id)
+            if v_here is not None:
+                if req.disk_type and not any(
+                        loc.volumes.get(req.volume_id) is v_here
+                        and loc.disk_type == req.disk_type
+                        for loc in store.locations):
+                    try:
+                        store.move_volume_local(req.volume_id,
+                                                req.disk_type)
+                    except (KeyError, OSError) as e:
+                        context.abort(9, f"local tier move: {e}")
+                    vs.flush_heartbeat()
+                    nv = store.find_volume(req.volume_id)
+                    return vpb.VolumeCopyResponse(
+                        last_append_at_ns=nv.last_append_at_ns)
                 context.abort(6, f"volume {req.volume_id} already here")
             src = Stub(req.source_data_node, VOLUME_SERVICE)
             loc = store._location_for(req.disk_type or None)
@@ -2775,9 +2927,7 @@ class VolumeServer:
                 context.abort(13, f"tier upload: {e}")
             remote = {"spec": req.destination_backend_name,
                       "key": key, "size": size}
-            vif = ec_files.read_vif(v.vif_path)
-            vif["remote"] = remote
-            ec_files.write_vif(v.vif_path, **vif)
+            ec_files.update_vif(v.vif_path, {"remote": remote})
             if req.keep_local_dat_file:
                 # local .dat keeps serving reads; volume stays read-only
                 # and marked tiered so the guards above hold
@@ -2823,15 +2973,108 @@ class VolumeServer:
                 context.abort(13, f"tier download: {e}")
             v.close()
             os.replace(tmp, v.dat_path)
-            vif = ec_files.read_vif(v.vif_path)
-            vif.pop("remote", None)
-            ec_files.write_vif(v.vif_path, **vif)
+            ec_files.update_vif(v.vif_path, remove=("remote",))
             nv = store.reload_volume(req.volume_id)
             if not req.keep_remote_dat_file and nv is not None:
                 client.delete_object(remote["key"])
             return vpb.VolumeTierMoveDatFromRemoteResponse(
                 processed=remote.get("size", 0),
                 processedPercentage=100.0)
+
+        @svc.unary("VolumeEcShardsTierMoveToRemote",
+                   vpb.VolumeTierMoveDatToRemoteRequest,
+                   vpb.VolumeTierMoveDatToRemoteResponse)
+        @_maintenance_tagged
+        def ec_tier_offload(req, context):
+            """Lifecycle EC→remote: offload this holder's local shard
+            payloads of an EC volume to the remote tier named by
+            `destination_backend_name` (the .dat tier-upload message is
+            reused — same field meanings at shard granularity; see the
+            volume_server.proto tiering note). The volume keeps serving
+            through lazy ranged reads; sidecars stay local. Offload
+            bytes admit maintenance-class so a lifecycle sweep can't
+            out-read the tenants this node serves."""
+            from ..ops import events
+            from .. import qos as qos_mod
+            grant = None
+            if vs.qos.enabled:
+                grant = vs.qos.admit_sync(req.collection or "default",
+                                          qos_mod.CLASS_MAINTENANCE)
+            moved = 0
+            try:
+                moved = store.offload_ec_shards(
+                    req.volume_id, req.destination_backend_name,
+                    collection=req.collection)
+            except KeyError as e:
+                context.abort(5, str(e))
+            except ValueError as e:
+                context.abort(3, str(e))
+            except Exception as e:  # noqa: BLE001
+                context.abort(13, f"ec tier offload: {e}")
+            finally:
+                if grant is not None:
+                    if moved:
+                        grant.charge(moved)
+                    grant.release()
+            if moved:
+                events.emit("lifecycle.transition", kind="offload",
+                            vid=req.volume_id, node=vs.url,
+                            collection=req.collection,
+                            **{"from": "ec", "to": "remote"},
+                            bytes_moved=moved)
+            return vpb.VolumeTierMoveDatToRemoteResponse(
+                processed=moved, processedPercentage=100.0)
+
+        @svc.unary("VolumeEcShardsTierMoveFromRemote",
+                   vpb.VolumeTierMoveDatFromRemoteRequest,
+                   vpb.VolumeTierMoveDatFromRemoteResponse)
+        @_maintenance_tagged
+        def ec_tier_promote(req, context):
+            """Lifecycle remote→ec (promote-on-heat): pull this
+            holder's offloaded shard payloads back to local disk."""
+            from ..ops import events
+            from .. import qos as qos_mod
+            grant = None
+            if vs.qos.enabled:
+                grant = vs.qos.admit_sync(req.collection or "default",
+                                          qos_mod.CLASS_MAINTENANCE)
+            moved = 0
+            try:
+                moved = store.promote_ec_shards(
+                    req.volume_id, collection=req.collection,
+                    keep_remote=req.keep_remote_dat_file)
+            except KeyError as e:
+                context.abort(5, str(e))
+            except Exception as e:  # noqa: BLE001
+                context.abort(13, f"ec tier promote: {e}")
+            finally:
+                if grant is not None:
+                    if moved:
+                        grant.charge(moved)
+                    grant.release()
+            if moved:
+                events.emit("lifecycle.transition", kind="promote",
+                            vid=req.volume_id, node=vs.url,
+                            collection=req.collection,
+                            **{"from": "remote", "to": "ec"},
+                            bytes_moved=moved)
+            return vpb.VolumeTierMoveDatFromRemoteResponse(
+                processed=moved, processedPercentage=100.0)
+
+        @svc.unary("VolumeEcShardsSetDestroyTime",
+                   vpb.VolumeTailReceiverRequest,
+                   vpb.VolumeTailReceiverResponse)
+        def ec_set_destroy_time(req, context):
+            """Stamp a DestroyTime onto a local EC volume's .vif — the
+            lifecycle executor's TTL verb, on the AUTHENTICATED gRPC
+            plane (the cluster token gates it on guarded clusters,
+            unlike a bare HTTP POST). Message reuse (no protoc in
+            image): since_ns = the DestroyTime instant in NANOSECONDS,
+            source_volume_server = collection; see volume_server.proto."""
+            if not self._set_destroy_time(req.volume_id,
+                                          req.since_ns / 1e9):
+                context.abort(5, f"no ec volume {req.volume_id}")
+            return vpb.VolumeTailReceiverResponse(received=1)
 
         @svc.unary_stream("Query", vpb.QueryRequest, vpb.QueriedStripe)
         def query(req, context):

@@ -214,6 +214,18 @@ def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
     """Distribute generated shards and delete the source volume
     (reference command_ec_encode.go:187 spreadEcShards)."""
     stub = _stub(env, srv)
+    if not d or not p:
+        # the batch response didn't carry the geometry (pre-geometry
+        # server): ask the holder for the SEALED (d,p) instead of
+        # assuming an RS default — the fork's stale "10.4" bug class,
+        # where help text and fallbacks hardcode one geometry while the
+        # .vif is the source of truth
+        info = stub.call("VolumeEcShardsInfo",
+                         vpb.VolumeEcShardsInfoRequest(
+                             volume_id=vid, collection=collection),
+                         vpb.VolumeEcShardsInfoResponse, timeout=30)
+        d = d or info.data_shards
+        p = p or info.parity_shards
     n_shards = (d or 10) + (p or 4)
     # 3. spread (command_ec_encode.go:187): copy to targets, mount, clean
     # src — rack-capped at p shards per rack so rack loss != data loss
@@ -255,8 +267,9 @@ def _spread_and_clean(env: CommandEnv, vid: int, collection: str, srv: dict,
               vpb.VolumeDeleteResponse)
 
 
-@command("ec.rebuild", "[-volumeId N] [-byRebuild]: restore missing ec shards",
-         needs_lock=True)
+@command("ec.rebuild", "[-volumeId N] [-byRebuild]: restore missing ec "
+         "shards (geometry and codec follow each volume's sealed .vif, "
+         "never a fixed RS default)", needs_lock=True)
 def cmd_ec_rebuild(env: CommandEnv, args):
     """Rebuild runs ON a holder; remote survivors stream in by RANGE —
     or as packed computed fragments through VolumeEcShardRead's
@@ -467,8 +480,9 @@ def cmd_ec_balance(env: CommandEnv, args):
                     f"{f['src']} -> {f['dst']}: {f['error']}")
 
 
-@command("ec.decode", "-volumeId N: convert ec shards back to a normal volume",
-         needs_lock=True)
+@command("ec.decode", "-volumeId N: convert ec shards back to a normal "
+         "volume (decodes with the codec and (data,parity) sealed in the "
+         "volume's .vif)", needs_lock=True)
 def cmd_ec_decode(env: CommandEnv, args):
     p = argparse.ArgumentParser(prog="ec.decode")
     p.add_argument("-volumeId", type=int, required=True)

@@ -458,6 +458,34 @@ def _safe_copy_volume(env: CommandEnv, vid: int, collection: str,
                       vpb.VolumeMarkWritableResponse)
 
 
+def _local_tier_move(env: CommandEnv, vid: int, srv: dict,
+                     to_disk_type: str) -> None:
+    """Same-server cross-tier move: freeze writes, then one VolumeCopy
+    addressed to the HOLDER with a differing disk_type — the handler
+    recognizes itself as the source and does a local disk-to-disk copy
+    + retire (store.move_volume_local) instead of a network pull. The
+    read-only flag survives the move inside the store, so only a
+    pre-move writable volume is thawed after."""
+    stub = _vs_stub(env, srv["id"], srv["grpc_port"])
+    was_ro = stub.call(
+        "VolumeStatus", vpb.VolumeStatusRequest(volume_id=vid),
+        vpb.VolumeStatusResponse).is_read_only
+    if not was_ro:
+        stub.call("VolumeMarkReadonly",
+                  vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                  vpb.VolumeMarkReadonlyResponse)
+    try:
+        stub.call("VolumeCopy", vpb.VolumeCopyRequest(
+            volume_id=vid, disk_type=to_disk_type,
+            source_data_node=env.grpc_addr(srv["id"], srv["grpc_port"])),
+            vpb.VolumeCopyResponse, timeout=600)
+    finally:
+        if not was_ro:
+            stub.call("VolumeMarkWritable",
+                      vpb.VolumeMarkWritableRequest(volume_id=vid),
+                      vpb.VolumeMarkWritableResponse)
+
+
 @command("volume.fix.replication",
          "[-volumeId N] re-replicate volumes whose replica sets are "
          "incomplete", needs_lock=True)
@@ -1062,11 +1090,14 @@ def cmd_volume_vacuum_enable(env: CommandEnv, args):
          needs_lock=True)
 def cmd_volume_tier_move(env: CommandEnv, args):
     """Reference command_volume_tier_move.go: for every matching volume
-    sitting on a `fromDiskType` disk, copy it to a DIFFERENT server that
-    has a `toDiskType` disk, then delete the source copy. (VolumeCopy
-    refuses a same-server copy, so same-server cross-tier moves are not
-    supported.) The copy lands on the target tier because VolumeCopy
-    carries disk_type (volume_server.py handler picks the location by it)."""
+    sitting on a `fromDiskType` disk, move it to a `toDiskType` disk.
+    A server that has BOTH tiers moves its own volumes with a local
+    disk-to-disk copy (VolumeCopy with a differing disk_type on the
+    holder itself — zero network bytes); otherwise the copy streams to
+    the least-loaded other server with a target-tier disk and the
+    source copy is deleted. Either way the copy lands on the target
+    tier because VolumeCopy carries disk_type (volume_server.py handler
+    picks the location by it)."""
     p = argparse.ArgumentParser(prog="volume.tier.move")
     p.add_argument("-fromDiskType", required=True)
     p.add_argument("-toDiskType", required=True)
@@ -1096,6 +1127,20 @@ def cmd_volume_tier_move(env: CommandEnv, args):
                 if opt.volumeId and v.id != opt.volumeId:
                     continue
                 if opt.collection and v.collection != opt.collection:
+                    continue
+                # a source server that has the target tier itself moves
+                # locally — zero network bytes, no replica-set changes
+                if opt.toDiskType in src["disks"]:
+                    env.println(f"  moving volume {v.id} on {src['id']} "
+                                f"{opt.fromDiskType} -> {opt.toDiskType} "
+                                "(local disk-to-disk)")
+                    try:
+                        _local_tier_move(env, v.id, src, opt.toDiskType)
+                    except Exception as e:  # noqa: BLE001 — keep sweeping
+                        env.println(f"  volume {v.id}: move failed: {e}")
+                        continue
+                    load[src["id"]] = load.get(src["id"], 0) + 1
+                    moved += 1
                     continue
                 # exclude the source AND any server already holding a copy
                 # of vid on any tier (replicated volumes, or a prior sweep

@@ -229,12 +229,22 @@ def check_exposition(text: str) -> list[str]:
 DEFAULT_CARDINALITY_CEILING = 256
 _BOUNDED_LABELS = ("peer", "bucket", "tenant")
 
+# the lifecycle plane's {from,to} tier-label pair is a tiny CLOSED set
+# (lifecycle.TIERS: hot/ec/remote/trash) — a typo'd or computed tier
+# name minting new series is a bug, so its ceiling is far tighter than
+# the address-shaped labels above.
+TIER_CARDINALITY_CEILING = 8
+_TIER_LABELS = ("from", "to")
+
 
 def lint_registry(registry=None,
-                  ceiling: int = DEFAULT_CARDINALITY_CEILING) -> list[str]:
+                  ceiling: int = DEFAULT_CARDINALITY_CEILING,
+                  tier_ceiling: int = TIER_CARDINALITY_CEILING
+                  ) -> list[str]:
     """Registry-level problems: duplicate family names and per-label
-    cardinality over the ceiling on `peer`/`bucket` labels. Returns a
-    list of human-readable findings (empty = clean)."""
+    cardinality over the ceiling on `peer`/`bucket`/`tenant` labels
+    (and the much tighter tier ceiling on `from`/`to`). Returns a list
+    of human-readable findings (empty = clean)."""
     from .metrics import REGISTRY, Counter, Gauge, Histogram
     registry = registry or REGISTRY
     problems: list[str] = []
@@ -244,7 +254,11 @@ def lint_registry(registry=None,
             problems.append(f"duplicate metric name {m.name}")
         seen.add(m.name)
         for i, lname in enumerate(m.label_names):
-            if lname not in _BOUNDED_LABELS:
+            if lname in _TIER_LABELS:
+                cap = tier_ceiling
+            elif lname in _BOUNDED_LABELS:
+                cap = ceiling
+            else:
                 continue
             if isinstance(m, (Counter, Gauge)):
                 values = {lv[i] for lv in m._values}
@@ -252,10 +266,10 @@ def lint_registry(registry=None,
                 values = {lv[i] for lv in m._counts}
             else:  # pragma: no cover
                 continue
-            if len(values) > ceiling:
+            if len(values) > cap:
                 problems.append(
                     f"{m.name}: label {lname!r} has {len(values)} distinct "
-                    f"values (> ceiling {ceiling})")
+                    f"values (> ceiling {cap})")
     return problems
 
 

@@ -420,6 +420,20 @@ BALANCE_BYTES_MOVED = _counter(
     "SeaweedFS_balance_bytes_moved_total",
     "bytes moved by rebalance, by rack locality of the hop",
     ("cross_rack",))
+# Tiered-storage lifecycle plane (lifecycle/): every tier transition by
+# its {from,to} edge — hot->ec (policy EC-encode), ec->remote (shard
+# payload offload), remote->ec (promote-on-heat), ec->trash / remote->
+# trash (DestroyTime reap) — and the bytes each edge moved. The tier
+# label space is a tiny CLOSED set (lifecycle.TIERS); the registry lint
+# enforces a ceiling on the pair like peer/bucket/tenant.
+LIFECYCLE_TRANSITIONS = _counter(
+    "SeaweedFS_lifecycle_transitions_total",
+    "lifecycle tier transitions completed, by from/to tier",
+    ("from", "to"))
+LIFECYCLE_BYTES_MOVED = _counter(
+    "SeaweedFS_lifecycle_bytes_moved_total",
+    "bytes moved by lifecycle tier transitions, by from/to tier",
+    ("from", "to"))
 # Batched ingest plane (fid-range leases + bulk PUT): outstanding leases
 # on the master (a drained system reads 0 — the bench-ingest smoke
 # asserts it), the per-frame batching the /bulk handler actually sees

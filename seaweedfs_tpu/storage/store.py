@@ -41,8 +41,38 @@ class Store:
         # to coder_name, which picks the compute backend. Reads/rebuilds
         # always follow the codec sealed in each volume's .vif.
         self.ec_codec = ec_codec or "rs"
+        # lifecycle heat: per-volume read counters + last-read clock
+        # (monotonic — the planner consumes AGES, never absolute times).
+        # vid -> [reads_total, last_read_monotonic]; plain dict ops are
+        # GIL-atomic and a lost increment under contention only shades
+        # a heat score, so no lock on the read hot path.
+        self._access: dict[int, list] = {}
         for loc in locations:
             loc.load_existing()
+
+    # -- lifecycle access stats ---------------------------------------------
+    def note_read(self, vid: int, n: int = 1) -> None:
+        """Record needle reads against a volume (called by the storage
+        read paths below AND by the volume server's cache-hit path,
+        which never reaches the store). Only vids RESOLVED to a local
+        volume are noted, and removal paths prune their entry, so the
+        dict is bounded by volumes this server ever served — probes of
+        unknown vids must not grow it forever."""
+        ent = self._access.get(vid)
+        if ent is None:
+            ent = self._access[vid] = [0, 0.0]
+        ent[0] += n
+        ent[1] = time.monotonic()
+
+    def _drop_access(self, vid: int) -> None:
+        self._access.pop(vid, None)
+
+    def access_snapshot(self) -> dict:
+        """vid -> {"reads": total, "last_read_age_s": seconds | None}."""
+        now = time.monotonic()
+        return {vid: {"reads": ent[0],
+                      "last_read_age_s": round(now - ent[1], 3)}
+                for vid, ent in list(self._access.items())}
 
     # -- coder selection (the pluggable north-star seam) --------------------
     def _backend_name(self) -> str:
@@ -94,6 +124,7 @@ class Store:
                 with loc.lock:
                     loc.volumes.pop(vid, None)
                 v.close()
+                self._drop_access(vid)
                 return True
         return False
 
@@ -168,6 +199,8 @@ class Store:
             with loc.lock:
                 loc.volumes.pop(vid, None)
             v.destroy()
+            if self.find_ec_volume(vid) is None:
+                self._drop_access(vid)  # ec conversion keeps the heat
             return
         raise KeyError(f"volume {vid} not found")
 
@@ -202,12 +235,14 @@ class Store:
                     shard_reader=None) -> Needle:
         failpoints.check("store.read")  # delay = slow disk; error = bad disk
         for v in self._read_volumes(vid):
+            self.note_read(vid)  # the vid resolved locally: it is heat
             try:
                 return v.read_needle(needle_id, cookie=cookie)
             except VolumeClosedError:
                 continue  # retry through the refreshed mapping
         ev = self.find_ec_volume(vid)
         if ev is not None:
+            self.note_read(vid)
             return ev.read_needle(needle_id, cookie=cookie,
                                   shard_reader=shard_reader)
         raise KeyError(f"volume {vid} not found")
@@ -225,6 +260,7 @@ class Store:
         from .bulk import (READ_ERROR, READ_NOT_FOUND, READ_OK,
                            READ_OVERFLOW)
         for v in self._read_volumes(vid):
+            self.note_read(vid, n=len(pairs))
             try:
                 return v.read_needles(pairs, byte_budget=byte_budget)
             except VolumeClosedError:
@@ -232,6 +268,7 @@ class Store:
         ev = self.find_ec_volume(vid)
         if ev is None:
             raise KeyError(f"volume {vid} not found")
+        self.note_read(vid, n=len(pairs))
         out = []
         used = 0
         for key, cookie in pairs:
@@ -363,6 +400,7 @@ class Store:
                 with loc.lock:
                     loc.ec_volumes.pop(vid, None)
                 ev.close()
+                self._drop_access(vid)
             else:
                 for sid in shard_ids:
                     sh = ev.shards.pop(sid, None)
@@ -372,6 +410,7 @@ class Store:
                     with loc.lock:
                         loc.ec_volumes.pop(vid, None)
                     ev.close()
+                    self._drop_access(vid)
             return
 
     def rebuild_ec_shards(self, vid: int, collection: str = "",
@@ -441,6 +480,201 @@ class Store:
                 return v
         raise RuntimeError("location vanished")
 
+    # -- lifecycle tiering (EC→remote offload, remote→local promote) --------
+    def offload_ec_shards(self, vid: int, spec: str, collection: str = ""
+                          ) -> int:
+        """Move this holder's LOCAL shard payloads of an EC volume to a
+        remote tier. The .ecx/.ecj/.vif sidecars stay local (lookup is
+        local, payload is remote), the .vif records the remote mapping,
+        and the volume keeps serving through lazy ranged reads. Returns
+        bytes offloaded (0 = nothing local to move; idempotent)."""
+        from ..ec.volume import EcVolume, RemoteEcVolumeShard
+        from .backend import open_remote
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"no ec volume {vid}")
+        remote = dict(ev.remote_spec or {"spec": spec, "keys": {},
+                                         "sizes": {}})
+        if remote["spec"] != spec:
+            # one remote tier per volume: mixing specs would strand the
+            # earlier objects when the .vif only records one client
+            raise ValueError(
+                f"ec volume {vid} already offloaded to "
+                f"{remote['spec']!r}; refusing {spec!r}")
+        local = [(sid, sh) for sid, sh in sorted(ev.shards.items())
+                 if not isinstance(sh, RemoteEcVolumeShard)]
+        if not local:
+            return 0
+        client = open_remote(spec)
+        prefix = f"{collection or ev.collection or 'default'}"
+        moved = 0
+        uploaded: list[tuple[int, str, int]] = []
+        try:
+            for sid, sh in local:
+                key = f"{prefix}/{vid}{ec_files.shard_ext(sid)}"
+                size = client.write_object(key, sh.path)
+                uploaded.append((sid, key, size))
+                moved += size
+        except Exception:
+            # roll back: local files are untouched, so the volume is
+            # still whole — only already-uploaded objects are orphaned
+            for _sid, key, _size in uploaded:
+                try:
+                    client.delete_object(key)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("offload rollback of %s: %s", key, e)
+            raise
+        for sid, key, size in uploaded:
+            remote["keys"][str(sid)] = key
+            remote["sizes"][str(sid)] = size
+        # seal the mapping BEFORE deleting local payloads: a crash in
+        # between leaves both copies (served local, cleaned on the next
+        # pass) — never neither. Locked update: the idle-close stamp on
+        # the heartbeat thread must not lose this seal.
+        ec_files.update_vif(ev.base + ".vif", {"remote_shards": remote})
+        # unlink the local payloads, then swap in a fresh EcVolume that
+        # scans remote read-through. The OLD object is deliberately NOT
+        # closed: in-flight reads keep their open fds (posix unlink
+        # semantics) and finish byte-identical mid-transition; the fds
+        # release when the object is collected
+        for _sid, sh in local:
+            os.remove(sh.path)
+        for loc in self.locations:
+            if loc.ec_volumes.get(vid) is ev:
+                nev = EcVolume(ev.base, vid, ev.collection, ev.geo)
+                with loc.lock:
+                    loc.ec_volumes[vid] = nev
+        return moved
+
+    def promote_ec_shards(self, vid: int, collection: str = "",
+                          keep_remote: bool = False) -> int:
+        """Pull this holder's offloaded shard payloads back to local
+        disk (promote-on-heat). Downloads land beside the sidecars
+        under a temp name and swap in atomically — a torn download
+        never costs the remote copy. Returns bytes promoted."""
+        from ..ec.volume import EcVolume, RemoteEcVolumeShard
+        from .backend import open_remote
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"no ec volume {vid}")
+        if not ev.remote_spec:
+            return 0
+        client = open_remote(ev.remote_spec["spec"])
+        remote_shards = [(sid, sh) for sid, sh in sorted(ev.shards.items())
+                         if isinstance(sh, RemoteEcVolumeShard)]
+        moved = 0
+        landed: list[tuple[int, str]] = []
+        try:
+            for sid, sh in remote_shards:
+                path = ev.base + ec_files.shard_ext(sid)
+                tmp = path + ".tiertmp"
+                client.read_object_to(sh.key, tmp)
+                got = os.path.getsize(tmp)
+                if sh.size and got != sh.size:
+                    raise OSError(f"short promote of shard {sid}: "
+                                  f"{got} != {sh.size}")
+                os.replace(tmp, path)
+                landed.append((sid, sh.key))
+                moved += got
+        except Exception:
+            for sid, _key in landed:
+                try:
+                    os.remove(ev.base + ec_files.shard_ext(sid))
+                except OSError:
+                    pass
+            raise
+        ec_files.update_vif(ev.base + ".vif", remove=("remote_shards",))
+        # swap in a fresh local-backed EcVolume; the old (remote-backed)
+        # object is NOT closed so in-flight ranged reads finish — same
+        # mid-transition contract as offload above
+        for loc in self.locations:
+            if loc.ec_volumes.get(vid) is ev:
+                nev = EcVolume(ev.base, vid, ev.collection, ev.geo)
+                with loc.lock:
+                    loc.ec_volumes[vid] = nev
+        if not keep_remote:
+            # delete EVERY mapped key, not just the shards downloaded
+            # this pass: a shard present both locally and remotely (a
+            # promote raced a crash) still has a remote object, and the
+            # mapping just popped was its last reference
+            for key in (ev.remote_spec or {}).get("keys", {}).values():
+                try:
+                    client.delete_object(key)
+                except Exception as e:  # noqa: BLE001 — orphan, not data
+                    log.warning("delete promoted remote shard %s: %s",
+                                key, e)
+        return moved
+
+    def move_volume_local(self, vid: int, disk_type: str) -> str:
+        """Same-server cross-tier move: copy a volume's files to a
+        location of `disk_type` on THIS server and retire the old copy
+        (the disk-to-disk half of volume.tier.move that VolumeCopy's
+        no-same-server rule used to refuse). Returns the new directory."""
+        import shutil
+        src_loc = None
+        v = None
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                src_loc = loc
+                break
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        if src_loc.disk_type == disk_type:
+            return src_loc.directory  # already on the target tier
+        dst_loc = self._location_for(disk_type)
+        # freeze for the copy window (callers normally froze already —
+        # volume.tier.move does — but an append landing between copy
+        # and swap would otherwise be silently lost)
+        was_read_only = v.read_only
+        v.read_only = True
+        v.sync()
+        src_base = v.file_name()
+        dst_base = dst_loc.base_name(v.collection, vid)
+        exts = [e for e in (".dat", ".idx", ".vif")
+                if os.path.exists(src_base + e)]
+        copied = []
+        try:
+            for ext in exts:
+                # copy + fsync under a temp name, then rename: a crash
+                # mid-move leaves the source authoritative
+                tmp = dst_base + ext + ".tiertmp"
+                shutil.copyfile(src_base + ext, tmp)
+                with open(tmp, "rb+") as f:
+                    os.fsync(f.fileno())
+                os.replace(tmp, dst_base + ext)
+                copied.append(dst_base + ext)
+            # build the replacement FULLY (needle-map load, integrity
+            # scan) before touching the mapping: reads must never find
+            # the vid unmapped mid-move
+            nv = Volume(dst_loc.directory, v.collection, vid,
+                        needle_map_kind=dst_loc.needle_map_kind,
+                        create_if_missing=False)
+        except Exception:
+            v.read_only = was_read_only
+            for p in copied:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            raise
+        nv.read_only = was_read_only
+        # map the destination BEFORE unmapping the source — both serve
+        # identical frozen bytes, so whichever a racing read resolves
+        # is correct; closing the source then routes stragglers through
+        # the refreshed mapping (VolumeClosedError retry)
+        with dst_loc.lock:
+            dst_loc.volumes[vid] = nv
+        with src_loc.lock:
+            src_loc.volumes.pop(vid, None)
+        v.close()
+        for ext in exts:
+            try:
+                os.remove(src_base + ext)
+            except OSError as e:
+                log.warning("retire source copy %s%s: %s", src_base, ext, e)
+        return dst_loc.directory
+
     def close_idle_ec_handles(self, idle_s: float = 3600.0) -> int:
         """Idle-close EC shard handles (fork ec_volume.go:348 IsExpire)."""
         n = 0
@@ -450,18 +684,58 @@ class Store:
                     n += 1
         return n
 
-    def delete_expired_ec_volumes(self) -> list[int]:
-        """Fork behavior (store.go:389): reap EC volumes past DestroyTime."""
-        now = time.time()  # swtpu-lint: disable=wallclock-duration (destroy_time is persisted wall-clock)
+    def delete_expired_ec_volumes(self, now: "float | None" = None
+                                  ) -> "list[dict]":
+        """Fork behavior (store.go:389): reap EC volumes past DestroyTime
+        into the soft-delete trash dir. `now` is injectable so the TTL
+        boundary is testable without sleeping: a volume reaps AT its
+        destroy_time instant (<=), not one poll-interval later.
+
+        Returns one record per reaped volume for the caller to journal:
+        {"vid", "collection", "from" (ec|remote), "bytes" (local bytes
+        soft-moved to trash)}."""
+        from ..ec.volume import RemoteEcVolumeShard
+        from ..lifecycle import TIER_EC, TIER_REMOTE
+        if now is None:
+            now = time.time()  # swtpu-lint: disable=wallclock-duration (destroy_time is persisted wall-clock)
         reaped = []
         for loc in self.locations:
             for vid, ev in list(loc.ec_volumes.items()):
-                if ev.destroy_time and ev.destroy_time < now:
+                if ev.destroy_time and ev.destroy_time <= now:
                     with loc.lock:
                         loc.ec_volumes.pop(vid, None)
+                    rec = {"vid": vid, "collection": ev.collection,
+                           "from": (TIER_REMOTE if ev.remote_spec
+                                    else TIER_EC),
+                           "bytes": sum(
+                               sh.size for sh in ev.shards.values()
+                               if not isinstance(sh, RemoteEcVolumeShard))}
                     ev.destroy(to_trash=os.path.join(loc.directory, ".trash"))
-                    reaped.append(vid)
+                    self._drop_access(vid)
+                    reaped.append(rec)
         return reaped
+
+    def restore_ec_volume_from_trash(self, vid: int, collection: str = ""
+                                     ) -> EcVolume:
+        """Undo a DestroyTime reap before the trash grace expires: move
+        the soft-deleted files back beside the live volumes and remount.
+        (The reap keeps remote-tier objects, so an offloaded volume
+        restores with its remote shards intact.)"""
+        for loc in self.locations:
+            trash = os.path.join(loc.directory, ".trash")
+            if not os.path.isdir(trash):
+                continue
+            base = os.path.basename(loc.base_name(collection, vid))
+            moved = False
+            for fn in os.listdir(trash):
+                stem, ext = os.path.splitext(fn)
+                if stem == base:
+                    os.replace(os.path.join(trash, fn),
+                               os.path.join(loc.directory, fn))
+                    moved = True
+            if moved:
+                return self.mount_ec_shards(vid, collection)
+        raise KeyError(f"ec volume {vid} not in trash")
 
     # -- heartbeat assembly (store.go:259) ----------------------------------
     def collect_heartbeat(self) -> dict:

@@ -101,7 +101,9 @@ def cluster(tmp_path_factory):
 
     mport = free_port()
     # parity 3 matches the RS(4,3) piggybacked stripe the node-death
-    # repair schedule encodes (no other schedule creates EC volumes)
+    # repair schedule encodes; the tier-transition schedule's RS(4,2)
+    # stripe keeps all its shards on one holder, so the high-water
+    # expected-n (6) scores it OK under either parity setting
     master = MasterServer(port=mport, volume_size_limit_mb=64,
                           pulse_seconds=0.3, ec_parity_shards=3)
     master.start()
@@ -1021,6 +1023,142 @@ def test_antagonist_tenant_schedule(cluster):
     still_open = {p: s for p, s in retry.all_breakers().items()
                   if s != retry.CLOSED}
     assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+
+def test_tier_transition_schedule(cluster, tmp_path):
+    """The lifecycle tier-transition lane (ISSUE 15): reads of a volume
+    MID-MIGRATION stay byte-identical across all three transition edges
+    — hot→EC (encode + plain-volume retirement), EC→remote (shard
+    payload offload behind storage/backend) and remote→promoted — while
+    a seeded fault schedule flakes the store and the HTTP hop. Hammer
+    threads read the collection continuously; a fault may fail a read
+    (the retry envelope's job), but a SUCCESSFUL read serving wrong
+    bytes at any point in any tier is the data-loss bug this lane
+    exists to catch. Every phase must also observe successful reads
+    (the transitions must not block the data plane)."""
+    from conftest import wait_until
+
+    master, servers, mc = cluster
+    seed = BASE_SEED + 7001
+    rng = random.Random(seed)
+    failpoints.seed(seed)
+    ctx = f"tier seed={seed} (SWTPU_CHAOS_SEED={BASE_SEED})"
+
+    payloads = {}
+    for i in range(20):
+        data = rng.randbytes(rng.randint(800, 9000))
+        r = operation.submit(mc, data, collection="tier")
+        payloads[r.fid] = data
+    vid = int(next(iter(payloads)).split(",")[0])
+    holder = next(vs for vs in servers
+                  if vs.store.find_volume(vid) is not None)
+    stub = Stub(f"127.0.0.1:{holder.grpc_port}", VOLUME_SERVICE)
+    wait_until(lambda: master.topo.lookup(vid), timeout=15,
+               msg=f"{ctx}: volume registered")
+
+    # -- hammer readers: byte-identity is the invariant, not liveness --
+    stop = threading.Event()
+    mismatches: list = []
+    phase_ok = {"hot": 0, "ec": 0, "remote": 0, "promoted": 0}
+    phase = ["hot"]
+    counter_lock = threading.Lock()
+
+    def hammer(hseed: int) -> None:
+        hrng = random.Random(hseed)
+        fids = list(payloads)
+        while not stop.is_set():
+            fid = hrng.choice(fids)
+            ph = phase[0]
+            try:
+                got = operation.read(mc, fid)
+            except Exception:  # noqa: BLE001 — faults may fail a read
+                continue
+            if got != payloads[fid]:
+                mismatches.append((ph, fid, len(got)))
+                return
+            with counter_lock:
+                phase_ok[ph] += 1
+
+    hammers = [threading.Thread(target=hammer, args=(seed + i,))
+               for i in range(3)]
+    for t in hammers:
+        t.start()
+
+    failpoints.configure("store.read",
+                         f"pct:{rng.randint(5, 15)}:delay:0.02")
+    failpoints.configure("http.request",
+                         f"pct:{rng.randint(3, 10)}:error:chaos")
+    remote_dir = str(tmp_path / "tier_remote")
+    try:
+        time.sleep(0.8)  # hot-phase reads under faults
+
+        # -- hot -> EC (encode, mount, retire the plain volume) ---------
+        stub.call("VolumeMarkReadonly",
+                  vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+                  vpb.VolumeMarkReadonlyResponse)
+        stub.call("VolumeEcShardsGenerate",
+                  vpb.VolumeEcShardsGenerateRequest(
+                      volume_id=vid, collection="tier",
+                      data_shards=4, parity_shards=2),
+                  vpb.VolumeEcShardsGenerateResponse, timeout=120)
+        stub.call("VolumeEcShardsMount",
+                  vpb.VolumeEcShardsMountRequest(
+                      volume_id=vid, collection="tier",
+                      shard_ids=list(range(6))),
+                  vpb.VolumeEcShardsMountResponse)
+        stub.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
+                  vpb.VolumeDeleteResponse)
+        phase[0] = "ec"
+        wait_until(lambda: master.topo.lookup_ec(vid), timeout=15,
+                   msg=f"{ctx}: ec shards registered")
+        time.sleep(0.8)
+
+        # -- EC -> remote (payload offload, lazy ranged read-through) ---
+        resp = stub.call("VolumeEcShardsTierMoveToRemote",
+                         vpb.VolumeTierMoveDatToRemoteRequest(
+                             volume_id=vid, collection="tier",
+                             destination_backend_name=f"local:{remote_dir}"),
+                         vpb.VolumeTierMoveDatToRemoteResponse,
+                         timeout=120)
+        assert resp.processed > 0, f"{ctx}: offload moved nothing"
+        phase[0] = "remote"
+        assert holder.store.find_ec_volume(vid).remote_shard_ids()
+        time.sleep(0.8)
+
+        # -- remote -> promoted (pull payload back on heat) -------------
+        resp = stub.call("VolumeEcShardsTierMoveFromRemote",
+                         vpb.VolumeTierMoveDatFromRemoteRequest(
+                             volume_id=vid, collection="tier"),
+                         vpb.VolumeTierMoveDatFromRemoteResponse,
+                         timeout=120)
+        assert resp.processed > 0, f"{ctx}: promote moved nothing"
+        phase[0] = "promoted"
+        assert holder.store.find_ec_volume(vid).remote_shard_ids() == []
+        time.sleep(0.8)
+    finally:
+        stop.set()
+        for t in hammers:
+            t.join(timeout=30)
+        failpoints.clear_all()
+    assert not any(t.is_alive() for t in hammers), \
+        f"{ctx}: hammer thread hung"
+
+    # -- invariants ---------------------------------------------------------
+    assert not mismatches, f"{ctx}: wrong bytes served: {mismatches}"
+    assert all(n > 0 for n in phase_ok.values()), \
+        f"{ctx}: a phase served no successful reads: {phase_ok}"
+    print(f"[chaos] {ctx}: per-phase successful reads {phase_ok}")
+
+    # faults cleared: every payload reads byte-identical from the
+    # promoted tier, and the lifecycle books recorded both moves
+    for fid, data in payloads.items():
+        assert operation.read(mc, fid) == data, f"{ctx}: {fid} corrupt"
+    from seaweedfs_tpu.ops import events
+    kinds = [e["attrs"].get("kind") for e in events.JOURNAL.snapshot(
+        etype="lifecycle.transition")]
+    assert "offload" in kinds and "promote" in kinds, kinds
+    wait_until(lambda: master.health.scan()["verdict"] == "OK",
+               timeout=20, msg=f"{ctx}: health verdict OK")
 
 
 def test_repair_loop_converges_after_node_death(cluster):
