@@ -1,6 +1,6 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos chaos-ha race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-ha smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
@@ -44,6 +44,17 @@ race:
 # session ends with zero ordering cycles.
 chaos:
 	SWTPU_CHAOS=1 SWTPU_LOCKCHECK=1 python -m pytest tests/chaos -q
+
+# HA control-plane chaos lane only: a 3-master raft quorum under >= 3
+# leader kill/restart cycles mid-lease-window (bulk + single-put
+# writers live throughout). Asserts every acked write readable, zero
+# duplicate fids across elections (the sequencer high-water mark rides
+# the raft log), breakers re-close, the maintenance cron resumes on
+# each NEW leader and never sweeps on followers, and the lock-order
+# detector ends the session with zero cycles. Part of `make chaos`
+# (tests/chaos discovery); this target runs just the HA lane.
+chaos-ha:
+	SWTPU_CHAOS=1 SWTPU_LOCKCHECK=1 python -m pytest tests/chaos/test_chaos_ha.py -q
 
 bench:
 	python bench.py
@@ -117,6 +128,16 @@ bench-balance:
 # SeaweedFS_lifecycle_bytes_moved_total{from,to} books the move
 bench-tier:
 	JAX_PLATFORMS=cpu python bench.py --tier-only
+
+# HA control-plane gate: closed-loop assign (gRPC, redirect-following)
+# and lookup (HTTP, round-robin across ALL masters) workers drive an
+# in-process 3-master quorum through a 2-cycle leader kill/restart
+# election storm. Storm p99 must stay <= 5x the steady-state p99 for
+# both classes, follower-served lookups must be observed
+# (SeaweedFS_master_lookup_requests{source="follower"} > 0), and the
+# raft metrics must book >= 2 leader changes.
+bench-ha:
+	JAX_PLATFORMS=cpu python bench.py --ha-only
 
 smoke:
 	python bench.py --smoke

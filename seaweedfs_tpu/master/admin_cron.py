@@ -97,6 +97,8 @@ class AdminCron:
         self._sweep_lock = threading.Lock()
         self._repair_exec = None  # lazy; cooldowns persist across sweeps
         self.sweeps = 0          # completed sweeps (observability + tests)
+        self.resumes = 0         # leadership-gain wakeups received
+        self._wake = threading.Event()
         self.last_output = ""
 
     # -- lifecycle ----------------------------------------------------------
@@ -109,6 +111,7 @@ class AdminCron:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # unblock the loop promptly
         if self._env is not None:
             try:
                 self._env.mc.stop()
@@ -119,6 +122,19 @@ class AdminCron:
         """Run one sweep immediately (tests / admin HTTP hook).
         Serialized against the background loop's sweeps."""
         self._sweep()
+
+    def notify_leadership(self, is_leader: bool) -> None:
+        """Raft role-change hook (master_server wires raft.on_state_change
+        here). A newly-elected leader re-runs the initial-delay schedule
+        — repair resumes within the jittered initial delay of a failover
+        instead of after the remainder of a 17-minute interval. (With
+        initial_delay_s pinned to 0 — the test-suite default — the timer
+        just re-arms for a full interval: no surprise sweeps.) Losing
+        leadership needs no action: every sweep is already leader-gated,
+        and a sweep in flight aborts between script lines."""
+        if is_leader:
+            self.resumes += 1
+            self._wake.set()
 
     # -- internals ----------------------------------------------------------
     def _get_env(self):
@@ -135,10 +151,23 @@ class AdminCron:
                                             out=io.StringIO())
         return self._env
 
-    def _loop(self) -> None:
-        wait = (min(self.initial_delay_s, self.interval_s)
+    def _initial_wait(self) -> float:
+        return (min(self.initial_delay_s, self.interval_s)
                 if self.initial_delay_s > 0 else self.interval_s)
-        while not self._stop.wait(wait):
+
+    def _loop(self) -> None:
+        wait = self._initial_wait()
+        while not self._stop.is_set():
+            woke = self._wake.wait(timeout=wait)
+            if self._stop.is_set():
+                return
+            if woke:
+                # leadership gained mid-wait: restart the initial-delay
+                # schedule so the new leader's first sweep comes up on
+                # the prompt (jittered) timetable, not the stale timer
+                self._wake.clear()
+                wait = self._initial_wait()
+                continue
             wait = self.interval_s
             if not self.is_leader():
                 continue
@@ -173,6 +202,12 @@ class AdminCron:
         repaired = False
         try:
             for line in self.scripts:
+                if not self.is_leader():
+                    # deposed mid-sweep: stop issuing repair commands —
+                    # the new leader's cron owns them now (a demoted
+                    # master driving moves would race it)
+                    out.write("aborting sweep: leadership lost\n")
+                    break
                 name = line.split()[0] if line.split() else ""
                 if report is not None and name in REPAIR_SCRIPTS:
                     if repaired:

@@ -37,15 +37,32 @@ class FidLeaseRegistry:
         """Record one range grant of `count` keys; returns the lease TTL
         in seconds (what the HTTP assign response advertises and the
         range JWT's exp is derived from)."""
+        return self._grant(count, self.ttl_s)
+
+    def grant_replicated(self, count: int,
+                         ttl_s: float | None = None) -> float:
+        """FSM-apply path: a grant committed through the raft log lands
+        here on EVERY master (leader included — the leader does not also
+        call grant(), so the gauge counts each lease exactly once). The
+        expiry clock starts at local apply time: followers apply within
+        one replication round of the leader, so the gauge converges, and
+        a restart that replays unsnapshotted grant entries re-arms them
+        for at most one TTL (the snapshot fold drops leases as
+        ephemeral). Expired-but-unreplayed grants are never REISSUED in
+        any case — key uniqueness lives in the replicated sequencer
+        high-water mark, not in this registry."""
+        return self._grant(count, self.ttl_s if ttl_s is None else ttl_s)
+
+    def _grant(self, count: int, ttl_s: float) -> float:
         now = time.monotonic()
         with self._lock:
             self._prune_locked(now)
-            self._expiries.append(now + self.ttl_s)
+            self._expiries.append(now + ttl_s)
             self.granted_total += 1
             self.keys_granted_total += count
             active = len(self._expiries)
         self._publish(active)
-        return self.ttl_s
+        return ttl_s
 
     def active(self) -> int:
         """Leases granted and not yet past their TTL."""

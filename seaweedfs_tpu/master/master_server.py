@@ -64,6 +64,7 @@ class MasterServer:
         # leaderless-raft-free with is_leader pinned True.
         self.peers = [p for p in (peers or []) if p] or [self.address]
         self.raft = None
+        self._follower = None   # FollowerVidCache when raft is on
         self._raft_state_path = raft_state_path
         # Optional security.Guard: when its signing_key is set, Assign
         # responses carry a single-fid JWT the volume server will demand
@@ -162,23 +163,103 @@ class MasterServer:
         return self.raft.leader_address or ""
 
     def _raft_apply(self, command: dict) -> None:
-        """FSM apply (reference raft_server.go:53 StateMachine.Apply):
-        replicated MaxVolumeId keeps vid allocation monotonic across
-        leader changes."""
+        """FSM apply (reference raft_server.go:53 StateMachine.Apply).
+        Runs on every master as entries commit (the leader included), so
+        all replicated control state lives here:
+
+        - max_volume_id: vid allocation stays monotonic across leader
+          changes (the reference FSM's only state).
+        - seq_hwm: the sequencer high-water mark. The leader commits
+          `key + count` BEFORE handing out [key, key+count), so a new
+          leader's sequencer always starts past every range ever acked —
+          zero duplicate fids across failovers, even when the granting
+          leader died mid-lease-window.
+        - lease: fid-range grant bookkeeping, so the leases-active gauge
+          is correct on whichever master is scraped / becomes leader.
+        - volume_new: layout mutations from growth, so a new leader's
+          layout registry is warm before the first heartbeats arrive
+          (locations still come from heartbeats; register is idempotent
+          and the janitor drops locationless vids from writables).
+
+        Lock order here is raft._lock -> {topo.lock, sequencer._lock,
+        fid_leases._lock}; no path takes them in reverse."""
         mvid = command.get("max_volume_id")
         if mvid:
             with self.topo.lock:
                 self.topo.max_volume_id = max(self.topo.max_volume_id, mvid)
+        hwm = command.get("seq_hwm")
+        if hwm:
+            # set_max(seen) bumps past `seen`: next_id() returns >= hwm
+            self.sequencer.set_max(hwm - 1)
+        lease = command.get("lease")
+        if lease:
+            self.fid_leases.grant_replicated(int(lease.get("count", 1)),
+                                             lease.get("ttl_s"))
+        vol = command.get("volume_new")
+        if vol:
+            v = VolumeInfo(
+                id=int(vol["id"]), collection=vol.get("collection", ""),
+                replica_placement=ReplicaPlacement.parse(
+                    vol.get("replication", "")),
+                ttl=TTL.parse(vol.get("ttl", "")),
+                disk_type=vol.get("disk_type", "hdd") or "hdd")
+            self.layouts.register_volume(v)
+
+    def _on_raft_state(self, role: str, term: int,
+                       leader: "str | None") -> None:
+        """Published from the raft _run loop (outside the raft lock)
+        whenever (role, term, leader) changes: step the maintenance
+        plane up/down and point the follower read cache at the new
+        leader promptly instead of on its next poll."""
+        lead = role == "leader"
+        log.info("%s: raft %s (term %d, leader %s)", self.address, role,
+                 term, leader or "?")
+        if lead:
+            # stale growth backoffs from a previous leadership stint
+            # must not delay this leader's first growth
+            self._want_growth_backoff.clear()
+        self.admin_cron.notify_leadership(lead)
+        if self._follower is not None:
+            self._follower.poke()
+
+    def lookup_locations(self, vid: int) -> "tuple[list[dict] | None, str]":
+        """(locations, source) for a vid. The leader answers from its
+        heartbeat-fed topology (`topo`); a follower answers from the
+        replicated read cache (`follower`, bounded staleness). (None,
+        "redirect") means the caller must send the client to the leader —
+        a follower never serves an authoritative miss (write barrier);
+        (None, "miss") is the leader's authoritative not-found."""
+        if self.is_leader or self.raft is None:
+            nodes = self.topo.lookup(vid)
+            if nodes:
+                return ([{"url": n.url, "public_url": n.public_url,
+                          "grpc_port": n.grpc_port} for n in nodes], "topo")
+            return (None, "miss")
+        # a deposed leader's topology is stale until its heartbeat
+        # streams die; only the replicated cache is staleness-bounded
+        if self._follower is not None:
+            locs = self._follower.lookup(vid)
+            if locs:
+                return (locs, "follower")
+        return (None, "redirect")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         svc = self._build_service()
         services = [svc]
         if len(self.peers) > 1:
+            from .follower import FollowerVidCache
             from .raft import RaftNode
             self.raft = RaftNode(self.address, self.peers,
                                  self._raft_apply,
                                  state_path=self._raft_state_path)
+            self.raft.on_state_change = self._on_raft_state
+            # while we are NOT the leader, mirror the leader's vid map so
+            # /dir/lookup can be served here (bounded staleness)
+            self._follower = FollowerVidCache(
+                self.address,
+                leader_of=lambda: (None if self.raft.is_leader
+                                   else self.raft.leader_address))
             services.append(self.raft.build_service())
         key = self.guard.signing_key if self.guard is not None else ""
         if key:
@@ -187,6 +268,7 @@ class MasterServer:
         self._grpc = serve(f"{self.ip}:{self.port}", services, auth_key=key)
         if self.raft is not None:
             self.raft.start()
+            self._follower.start()
         if self.http_port:
             self._start_http()
         threading.Thread(target=self._janitor, daemon=True,
@@ -204,6 +286,8 @@ class MasterServer:
         self.admin_cron.stop()
         if self._metrics_push is not None:
             self._metrics_push.stop()
+        if self._follower is not None:
+            self._follower.stop()
         if self.raft is not None:
             self.raft.stop()
         if self._grpc:
@@ -297,24 +381,45 @@ class MasterServer:
                                   "Leader": ms.leader_address,
                                   "IsLeader": ms.is_leader})
 
+        def not_leader_response():
+            # typed redirect: 421 Misdirected Request + the leader hint
+            # in the body (the hint is a gRPC address, so no Location
+            # header — master_client follows the `leader` field)
+            hint = ms.leader_address
+            return json_response(
+                {"error": (f"not leader; leader is {hint}" if hint
+                           else "not leader; leader unknown"),
+                 "leader": hint}, status=421)
+
         def dir_lookup(req, q):
             from .. import tracing
+            from ..stats import MASTER_LOOKUP_COUNTER
             with tracing.start_span(
                     "master.lookup", component="master",
                     child_of=tracing.extract(req.headers),
                     attrs={"vid": q.get("volumeId", "")}):
                 vid = q.get("volumeId", "").split(",")[0]
                 try:
-                    nodes = ms.topo.lookup(int(vid))
+                    locs, source = ms.lookup_locations(int(vid))
                 except ValueError:
-                    nodes = None
-                if not nodes:
-                    return json_response(
-                        {"error": f"volume {vid} not found"}, status=404)
-                return json_response({
-                    "volumeId": vid,
-                    "locations": [{"url": n.url, "publicUrl": n.public_url}
-                                  for n in nodes]})
+                    locs, source = None, "miss"
+                MASTER_LOOKUP_COUNTER.inc(source)
+                if locs:
+                    body = {"volumeId": vid,
+                            "locations": [{"url": l["url"],
+                                           "publicUrl": l["public_url"]}
+                                          for l in locs]}
+                    if source == "follower":
+                        # bounded-staleness answer from a non-leader:
+                        # advertise where authority lives
+                        body["leader"] = ms.leader_address
+                    return json_response(body)
+                if source == "redirect":
+                    # write barrier: a follower never 404s a vid — the
+                    # assign may simply not have replicated here yet
+                    return not_leader_response()
+                return json_response(
+                    {"error": f"volume {vid} not found"}, status=404)
 
         async def dir_assign(req, q):
             from .. import tracing
@@ -348,12 +453,18 @@ class MasterServer:
                 # copy the context)
                 import contextvars
 
-                if ms.needs_growth(areq):
+                if ms.raft is not None or ms.needs_growth(areq):
                     # growth does AllocateVolume RPCs + a raft commit —
                     # seconds, not microseconds: run it off-loop so other
-                    # assigns/lookups/scrapes aren't head-of-line blocked
+                    # assigns/lookups/scrapes aren't head-of-line blocked.
+                    # With raft on, EVERY assign commits its fid range
+                    # through the log (quorum RPCs that can block for the
+                    # propose timeout during an election) — so the whole
+                    # raft-mode assign path runs off-loop too; follower
+                    # lookups stay responsive through election storms.
                     import asyncio
-                    sp.add_event("volume_growth")
+                    if ms.raft is None:
+                        sp.add_event("volume_growth")
                     resp = await asyncio.get_running_loop().run_in_executor(
                         None, contextvars.copy_context().run,
                         ms.do_assign, areq)
@@ -372,6 +483,8 @@ class MasterServer:
                                 ms.do_assign, areq)
                 if resp.error:
                     sp.set_error(resp.error)
+                    if resp.error.startswith("not leader"):
+                        return not_leader_response()
                     return json_response({"error": resp.error}, status=406)
                 sp.set_attr("fid", resp.fid)
                 body = {
@@ -396,9 +509,13 @@ class MasterServer:
                 return json_response(body)
 
         def cluster_status(req, q):
+            # `leader` (lowercase) is the stable client-facing hint the
+            # redirect protocol uses; `Leader` stays for the reference-
+            # compatible status shape
             return json_response({
                 "IsLeader": ms.is_leader,
                 "Leader": ms.leader_address,
+                "leader": ms.leader_address,
                 "Peers": [p for p in ms.peers if p != ms.address]})
 
         def ui(req, q):
@@ -514,6 +631,20 @@ class MasterServer:
                        ttl=TTL.parse(req.ttl), disk_type=req.disk_type)
         self.topo.incremental_volumes(node, [v], [])
         self.layouts.register_volume(v)
+        if self.raft is not None and self.raft.is_leader:
+            # replicate the layout mutation (and the vid watermark) so a
+            # new leader knows this volume before its first heartbeat;
+            # a failed commit is non-fatal — the volume exists on the
+            # server and heartbeats will resync it
+            if not self.raft.propose(
+                    {"max_volume_id": self.topo.max_volume_id,
+                     "volume_new": {"id": vid, "collection": req.collection,
+                                    "replication": req.replication,
+                                    "ttl": req.ttl,
+                                    "disk_type": req.disk_type}},
+                    timeout=2.0):
+                log.warning("volume_new vid=%d not committed (no quorum); "
+                            "heartbeats will resync", vid)
         from ..ops import events
         events.emit("volume.grow", vid=vid, collection=req.collection,
                     replication=req.replication, node=node.id)
@@ -601,7 +732,14 @@ class MasterServer:
                     try:
                         yield q.get(timeout=1.0)
                     except queue.Empty:
-                        continue
+                        # idle keepalive carrying the current leader
+                        # hint: follower read caches use it as their
+                        # bounded-staleness liveness signal, and any
+                        # subscriber learns of a leadership move without
+                        # waiting for the next data event
+                        yield pb.KeepConnectedResponse(
+                            volume_location=pb.VolumeLocation(
+                                leader=ms.leader_address))
             finally:
                 with ms._sub_lock:
                     ms._subscribers.pop(sid, None)
@@ -629,6 +767,24 @@ class MasterServer:
                     vid = int(vf.split(",")[0])
                 except ValueError:
                     entry.error = f"bad volume id {vf!r}"
+                    continue
+                if not ms.is_leader and ms.raft is not None:
+                    # follower-served lookup from the replicated cache;
+                    # miss/stale -> typed redirect (write barrier: never
+                    # an authoritative not-found from a non-leader)
+                    locs, source = ms.lookup_locations(vid)
+                    from ..stats import MASTER_LOOKUP_COUNTER
+                    MASTER_LOOKUP_COUNTER.inc(source)
+                    if locs:
+                        for l in locs:
+                            entry.locations.add(url=l["url"],
+                                                public_url=l["public_url"],
+                                                grpc_port=l["grpc_port"])
+                    else:
+                        hint = ms.leader_address
+                        entry.error = (f"not leader; leader is {hint}"
+                                       if hint else
+                                       "not leader; leader unknown")
                     continue
                 nodes = ms.topo.lookup(vid)
                 if not nodes and vid in ms.topo.ec_locations:
@@ -968,6 +1124,21 @@ class MasterServer:
         count = max(1, req.count)
         key = self.sequencer.next_id(count)
         cookie = random.getrandbits(32)
+        if self.raft is not None:
+            # Replicate the sequencer high-water mark (and the lease
+            # grant riding the same entry) BEFORE the fids leave this
+            # master: an acked range must be durable on a quorum, or a
+            # new leader elected after our crash could hand out the same
+            # keys again (duplicate fids). A failed commit means the
+            # quorum is gone — refuse rather than ack unreplicated keys
+            # (the locally-burned range just goes unused).
+            cmd: dict = {"seq_hwm": key + count}
+            if count > 1:
+                cmd["lease"] = {"count": count,
+                                "ttl_s": self.fid_leases.ttl_s}
+            if not self.raft.propose(cmd):
+                return pb.AssignResponse(
+                    error="not leader; commit quorum lost")
         nodes = self.topo.lookup(vid)
         if not nodes:
             return pb.AssignResponse(error=f"volume {vid} has no locations")
@@ -982,9 +1153,12 @@ class MasterServer:
         lease_ttl = 0.0
         if count > 1:
             # a multi-count assign IS a fid-range lease: the sequencer
-            # reserved [key, key+count) above; record the grant so the
-            # leases-active gauge reflects outstanding ingest ranges
-            lease_ttl = self.fid_leases.grant(count)
+            # reserved [key, key+count) above. With raft on, the grant
+            # was recorded by the FSM apply of the entry committed above
+            # (on every master, this one included); single-master mode
+            # records it directly.
+            lease_ttl = (self.fid_leases.ttl_s if self.raft is not None
+                         else self.fid_leases.grant(count))
         if self.guard is not None and self.guard.signing_key:
             if count > 1:
                 # range-scoped token: ONE signature authorizes all N

@@ -51,12 +51,38 @@ def _fold(state: dict, command: dict) -> dict:
     mvid = command.get("max_volume_id")
     if mvid:
         state["max_volume_id"] = max(state.get("max_volume_id", 0), mvid)
+    hwm = command.get("seq_hwm")
+    if hwm:
+        # sequencer high-water mark must survive compaction: a node that
+        # catches up from the snapshot and later becomes leader would
+        # otherwise reissue fid keys the old leader already handed out
+        state["seq_hwm"] = max(state.get("seq_hwm", 0), hwm)
     members = command.get("raft_members")
     if members:
         # membership rides the snapshot so a compacted log still tells a
         # restarting/lagging node who the cluster is
         state["_members"] = sorted(members)
+    # "lease" grants are deliberately NOT folded: they are ephemeral
+    # (TTL-bounded observability state) and re-arming them long after the
+    # grant would inflate the leases-active gauge forever
     return state
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the parent directory of `path` so a just-completed
+    os.replace / file creation survives a crash. Without it the rename
+    itself can be lost, resurrecting a stale voted_for — which lets the
+    node vote twice in one term (the exact double-vote raft §5.2
+    forbids)."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds; best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 class RaftNode:
@@ -94,6 +120,13 @@ class RaftNode:
         self._quorum_seen = time.monotonic()
         self._election_deadline = 0.0
         self._removed = False       # self decommissioned via raft_members
+        # (role, term, leader) last published to on_state_change /
+        # metrics; compared each _run tick OUTSIDE the raft lock so the
+        # callback (admin cron wakeups, follower re-dials) can never
+        # deadlock against raft internals
+        self.on_state_change: "Callable[[str, int, str | None], None] | None" \
+            = None
+        self._published: tuple = (None, -1, None)
         self._load()
         self.commit_index = self.log_start - 1
         self.last_applied = self.log_start - 1
@@ -196,6 +229,9 @@ class RaftNode:
                 self._wal.write(
                     json.dumps({"log_start": self.log_start}).encode()
                     + b"\n")
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
+                _fsync_dir(path)  # the file itself must survive a crash
         return self._wal
 
     def _persist_meta(self) -> None:
@@ -215,6 +251,10 @@ class RaftNode:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path)
+        # fsync the rename too: vote/term durability must be complete
+        # BEFORE the RPC reply leaves (a crash after replying "granted"
+        # but before the rename is durable double-votes in this term)
+        _fsync_dir(self.state_path)
 
     def _wal_append(self, entries: "list[LogEntry]") -> None:
         """Append + fsync just the new entries (the per-propose hot path)."""
@@ -250,6 +290,7 @@ class RaftNode:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path + ".wal")
+        _fsync_dir(self.state_path)
         self._persist_meta()
 
     def _maybe_compact(self) -> None:
@@ -302,11 +343,44 @@ class RaftNode:
                 role = self.role
             if role == LEADER:
                 self._broadcast_append()
+                self._publish_state()
                 self._stop.wait(self.heartbeat_interval)
             else:
                 if time.monotonic() >= self._election_deadline:
                     self._start_election()
+                self._publish_state()
                 self._stop.wait(0.02)
+
+    def _publish_state(self) -> None:
+        """Poll-publish (role, term, leader) transitions to metrics and
+        the on_state_change callback — from the _run loop, outside the
+        raft lock, so subscribers (admin cron, follower read cache) can
+        take their own locks without an ABBA against raft internals.
+        Latency bound: one loop tick (20ms follower / one heartbeat
+        interval leader)."""
+        with self._lock:
+            snap = (self.role, self.current_term, self.leader_address)
+        if snap == self._published:
+            return
+        prev = self._published
+        self._published = snap
+        try:
+            from ..stats import MASTER_LEADER_CHANGES, RAFT_LEADER_CHANGES, \
+                RAFT_TERM
+            RAFT_TERM.set(value=snap[1])
+            if snap[2] and snap[2] != prev[2]:
+                # leader identity changed (elections that fizzle without
+                # a winner bump terms, not this counter)
+                RAFT_LEADER_CHANGES.inc()
+                MASTER_LEADER_CHANGES.inc()
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never stall the raft loop)
+            pass
+        cb = self.on_state_change
+        if cb is not None:
+            try:
+                cb(snap[0], snap[1], snap[2])
+            except Exception as e:  # noqa: BLE001
+                log.warning("raft state-change callback: %s", e)
 
     # -- election ------------------------------------------------------------
     def _start_election(self) -> None:

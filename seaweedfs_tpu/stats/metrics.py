@@ -273,6 +273,19 @@ MASTER_ASSIGN_COUNTER = _counter(
     "SeaweedFS_master_assign_requests", "assign requests", ("state",))
 MASTER_LEADER_CHANGES = _counter(
     "SeaweedFS_master_leader_changes", "raft leader changes")
+# HA control plane. Per-process in production; test fixtures that run a
+# whole quorum in one process multiplex these (last-writer-wins on the
+# gauge), so in-process assertions read the RaftNode directly instead.
+RAFT_TERM = _gauge(
+    "SeaweedFS_raft_term", "current raft term on this master")
+RAFT_LEADER_CHANGES = _counter(
+    "SeaweedFS_raft_leader_changes_total",
+    "raft leader identity changes observed by this node")
+MASTER_LOOKUP_COUNTER = _counter(
+    "SeaweedFS_master_lookup_requests",
+    "dir lookups served, by answering source (topo=leader authoritative, "
+    "follower=bounded-staleness replicated cache, redirect=sent to leader)",
+    ("source",))
 VOLUME_REQUEST_COUNTER = _counter(
     "SeaweedFS_volumeServer_request_total", "volume server requests",
     ("type", "code"))

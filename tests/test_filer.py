@@ -562,6 +562,7 @@ def test_encrypted_chunks_at_rest(cluster, tmp_path):
     """-encryptVolumeData: volume servers hold only ciphertext; reads
     decrypt transparently via per-chunk keys in filer metadata (reference
     util/cipher.go)."""
+    pytest.importorskip("cryptography")
     import requests
 
     from seaweedfs_tpu.filer.filer_server import FilerServer

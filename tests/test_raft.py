@@ -81,6 +81,44 @@ class TestElection:
         wait_until(lambda: all(m.topo.max_volume_id >= 41 for m in quorum),
                    timeout=5, msg="max_volume_id replicated")
 
+    def test_seq_hwm_replicated(self, quorum):
+        """The sequencer high-water mark rides the raft log: every
+        master's sequencer moves past committed fid ranges, so a new
+        leader can never reissue keys an old leader acked."""
+        leader = _wait_for_leader(quorum)
+        assert leader.raft.propose({"seq_hwm": 500})
+        from conftest import wait_until
+        wait_until(lambda: all(m.sequencer.peek >= 500 for m in quorum),
+                   timeout=5, msg="seq_hwm replicated")
+
+    def test_lease_grant_replicated(self, quorum):
+        """A fid-range lease grant committed by the leader lands in
+        every master's registry (leases-active gauge correct wherever
+        scraped / whoever becomes leader next)."""
+        leader = _wait_for_leader(quorum)
+        assert leader.raft.propose(
+            {"seq_hwm": 4097, "lease": {"count": 4096, "ttl_s": 60.0}})
+        from conftest import wait_until
+        wait_until(lambda: all(m.fid_leases.active() == 1 for m in quorum),
+                   timeout=5, msg="lease grant replicated")
+        assert all(m.sequencer.peek >= 4097 for m in quorum)
+
+    def test_admin_cron_notified_on_election(self, quorum):
+        """The new leader's maintenance cron gets a resume notification
+        (prompt first sweep on the production schedule); followers are
+        never notified."""
+        from conftest import wait_until
+        leader = _wait_for_leader(quorum)
+        wait_until(lambda: leader.admin_cron.resumes >= 1,
+                   msg="leader cron notified")
+        before = {m.address: m.admin_cron.resumes for m in quorum}
+        leader.stop()
+        rest = [m for m in quorum if m is not leader]
+        new_leader = _wait_for_leader(rest)
+        wait_until(lambda: new_leader.admin_cron.resumes
+                   > before[new_leader.address],
+                   msg="new leader cron resumed")
+
     def test_raft_state_persists(self, tmp_path):
         from seaweedfs_tpu.master.raft import LogEntry, RaftNode
 
@@ -94,6 +132,111 @@ class TestElection:
         assert n2.current_term == 7
         assert n2.voted_for == "b:2"
         assert n2.log[0].command == {"max_volume_id": 3}
+
+
+class TestVoteDurability:
+    """Satellite: persisted vote/term state must be durable BEFORE the
+    RPC reply leaves — including the rename's directory entry. A crash
+    after replying 'granted' that resurrects the pre-vote state lets the
+    node vote twice in one term (two leaders, split-brain)."""
+
+    def test_vote_survives_crash_replay(self, tmp_path):
+        from seaweedfs_tpu.master.raft import RaftNode
+
+        path = str(tmp_path / "raft.json")
+        members = ["a:1", "b:2", "c:3"]
+        n = RaftNode("a:1", members, lambda c: None, state_path=path)
+        out = n._on_request_vote({"term": 5, "candidate": "b:2",
+                                  "last_log_index": -1, "last_log_term": 0})
+        assert out["granted"]
+        n.stop()
+        # crash-replay: reconstruct from the same state path
+        n2 = RaftNode("a:1", members, lambda c: None, state_path=path)
+        assert n2.current_term == 5
+        assert n2.voted_for == "b:2"
+        # a competing candidate in the SAME term must be denied ...
+        out = n2._on_request_vote({"term": 5, "candidate": "c:3",
+                                   "last_log_index": 3, "last_log_term": 5})
+        assert not out["granted"]
+        # ... while the original candidate's retransmit is re-granted
+        out = n2._on_request_vote({"term": 5, "candidate": "b:2",
+                                   "last_log_index": -1, "last_log_term": 0})
+        assert out["granted"]
+        n2.stop()
+
+    def test_term_adoption_survives_crash_replay(self, tmp_path):
+        from seaweedfs_tpu.master.raft import RaftNode
+
+        path = str(tmp_path / "raft.json")
+        members = ["a:1", "b:2", "c:3"]
+        n = RaftNode("a:1", members, lambda c: None, state_path=path)
+        out = n._on_append_entries({"term": 9, "leader": "b:2",
+                                    "prev_log_index": -1, "prev_log_term": 0,
+                                    "entries": [], "snapshot": None,
+                                    "leader_commit": -1})
+        assert out["success"]
+        n.stop()
+        n2 = RaftNode("a:1", members, lambda c: None, state_path=path)
+        # the adopted term was durable before the reply: after a crash
+        # this node can never vote in a term below 9 again
+        assert n2.current_term == 9
+        out = n2._on_request_vote({"term": 8, "candidate": "c:3",
+                                   "last_log_index": 99, "last_log_term": 8})
+        assert not out["granted"]
+        n2.stop()
+
+
+class TestRedirectProtocol:
+    """Satellite: typed leader redirects on the HTTP plane (421 +
+    `leader` hint) and the follower lookup write barrier."""
+
+    @pytest.fixture()
+    def quorum_http(self, tmp_path):
+        ports = [_fp() for _ in range(3)]
+        peers = [f"127.0.0.1:{p}" for p in ports]
+        masters = []
+        for p in ports:
+            ms = MasterServer(port=p, volume_size_limit_mb=64,
+                              pulse_seconds=0.5, peers=peers,
+                              http_port=_fp(),
+                              raft_state_path=str(tmp_path / f"raft-{p}.json"))
+            ms.start()
+            masters.append(ms)
+        yield masters
+        for m in masters:
+            m.stop()
+
+    def test_follower_http_redirects(self, quorum_http):
+        import requests
+
+        from conftest import wait_until
+        leader = _wait_for_leader(quorum_http)
+        follower = next(m for m in quorum_http if m is not leader)
+        wait_until(lambda: follower.leader_address == leader.address,
+                   msg="follower learns leader")
+        base = f"http://127.0.0.1:{follower.http_port}"
+        # /cluster/status carries the lowercase `leader` hint
+        st = requests.get(f"{base}/cluster/status", timeout=5).json()
+        assert st["leader"] == leader.address
+        assert st["IsLeader"] is False
+        # mutating call on a follower: 421 + typed redirect body
+        r = requests.get(f"{base}/dir/assign", params={"count": 1},
+                         timeout=5)
+        assert r.status_code == 421
+        body = r.json()
+        assert body["error"].startswith("not leader")
+        assert body["leader"] == leader.address
+        # lookup of an unknown vid on a follower: redirect, never an
+        # authoritative 404 (the write barrier)
+        r = requests.get(f"{base}/dir/lookup", params={"volumeId": "123"},
+                         timeout=5)
+        assert r.status_code == 421
+        assert r.json()["leader"] == leader.address
+        # the leader itself 404s authoritatively
+        r = requests.get(
+            f"http://127.0.0.1:{leader.http_port}/dir/lookup",
+            params={"volumeId": "123"}, timeout=5)
+        assert r.status_code == 404
 
 
 class TestFailoverEndToEnd:
