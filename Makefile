@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos chaos-ha race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-ha smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos chaos-ha race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-ha bench-telemetry smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-telemetry
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -138,6 +138,18 @@ bench-tier:
 # raft metrics must book >= 2 leader changes.
 bench-ha:
 	JAX_PLATFORMS=cpu python bench.py --ha-only
+
+# fleet telemetry & SLO plane gate: on a separate-process master + two
+# volume servers, the leader-resident collector must cost <= 3% RPS on
+# a delay-dominated read workload (one scrape/evaluate cycle every
+# 0.5s), its merged cluster p99 must land within 10% of a direct merge
+# of both nodes' raw scrapes, the per-stage hot-path histograms
+# (recv_parse/auth_admit/store/serialize_flush) must account for
+# >= 90% of end-to-end GET time, and live scrapes must pass the
+# exposition lint; records the no-failpoint per-stage means for the
+# protocol-ceiling teardown
+bench-telemetry:
+	JAX_PLATFORMS=cpu python bench.py --telemetry-only
 
 smoke:
 	python bench.py --smoke

@@ -1331,6 +1331,265 @@ def bench_read_smoke(out: dict) -> None:
         _stop_procs_cluster(procs, tmp)
 
 
+_TELEMETRY_BENCH_POLICY = {
+    "slos": [
+        {"name": "read-availability", "kind": "availability",
+         "objective": 0.999},
+        {"name": "get-latency", "kind": "latency", "verb": "get",
+         "threshold_s": 0.25, "objective": 0.99},
+    ],
+    # default multi-window pairs: nothing here should burn — the bench
+    # gate is overhead + fidelity, the chaos lane owns firing alerts
+}
+
+
+def bench_telemetry_smoke(out: dict) -> None:
+    """`make bench-telemetry`: the fleet telemetry plane's cost and
+    fidelity gates on a separate-process 2-volume-server topology:
+
+    * collector overhead <= 3% on delay-dominated read RPS (a
+      store.read failpoint makes every GET cost 10 ms, so the only
+      thing that can move RPS is the scrape/evaluate machinery);
+    * the leader's merged p99 within 10% of the ground truth computed
+      by merging both nodes' raw /metrics scrapes directly;
+    * per-stage hot-path histograms account for >= 90% of end-to-end
+      request time (they bracket it: recv-to-flush vs handler-entry
+      to handler-exit), with the no-failpoint per-stage breakdown
+      recorded for the ROADMAP protocol-ceiling teardown;
+    * both exposition dialects of a live node pass the metrics lint.
+    """
+    import subprocess
+    import threading
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.stats.expo_lint import check_exposition
+    from seaweedfs_tpu.stats.parse import histogram_series, parse_exposition
+    from seaweedfs_tpu.telemetry.merge import merge_buckets, quantile
+
+    procs, tmp, mport, mhttp, vport = _spawn_procs_cluster(
+        "swtpu_bench_telemetry_", volume_size_mb=64, vol_max=16,
+        # no read cache: every GET must reach store.read so the delay
+        # failpoint dominates and the overhead gate measures the
+        # collector, not cache luck
+        extra_env={"SWTPU_READ_CACHE_MB": "0"},
+        extra_master_args=[
+            "-sloPolicy", json.dumps(_TELEMETRY_BENCH_POLICY),
+            # huge interval: every collector cycle in this bench comes
+            # from an explicit ?trigger=1, so the overhead phases are
+            # deterministic instead of racing a background timer
+            "-telemetryIntervalS", "3600"])
+    import socket as _socket
+
+    def _free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    v2dir = os.path.join(tmp, "v2")
+    os.makedirs(v2dir, exist_ok=True)
+    v2port, v2grpc = _free_port(), _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SWTPU_READ_CACHE_MB"] = "0"
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "volume",
+         "-port", str(v2port), "-grpcPort", str(v2grpc),
+         "-mserver", f"127.0.0.1:{mport}", "-dir", v2dir,
+         "-max", "16", "-coder", "numpy"],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    try:
+        # both volume servers registered = the collector's target list
+        # (fed from heartbeat topology) shows them, plus the master
+        def snapshot(trigger: bool = True) -> dict:
+            params = {"top": "10"}
+            if trigger:
+                params["trigger"] = "1"
+            return http_util.get(
+                f"http://127.0.0.1:{mhttp}/cluster/telemetry",
+                params=params, timeout=10).json()
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            snap = snapshot()
+            vol_targets = [t for t in snap["targets"]
+                           if t["node"].startswith("volume@")]
+            if len(vol_targets) >= 2:
+                break
+            time.sleep(0.25)
+        else:
+            raise RuntimeError("second volume server never registered")
+
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        mc.wait_connected()
+        # several collections = several volume grows; emptiest-first
+        # placement then spreads them across BOTH servers, which the
+        # merged-p99 truth gate depends on
+        n_files, conc = 400, 4
+        payloads = [b"t%05d-" % i + b"x" * 2000 for i in range(n_files)]
+        fids = []
+        per_col = n_files // 4
+        for c in range(4):
+            batch = payloads[c * per_col:(c + 1) * per_col]
+            fids.extend(r.fid for r in operation.submit_batch(
+                mc, batch, collection=f"benchtel{c}"))
+
+        errors = [0]
+
+        def read_phase(per_thread: int) -> float:
+            def worker(seed):
+                rng = random.Random(seed)
+                for _ in range(per_thread):
+                    i = rng.randrange(n_files)
+                    try:
+                        assert operation.read(mc, fids[i]) == payloads[i]
+                    except Exception:  # noqa: BLE001
+                        errors[0] += 1
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=worker, args=(7000 + s,))
+                  for s in range(conc)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return per_thread * conc / (time.perf_counter() - t0)
+
+        def scrape_stage_sums(port: int):
+            """(per-stage {stage: (sum, count)}, e2e (sum, count)) for
+            type=get from one node's live scrape."""
+            text = http_util.get(f"http://127.0.0.1:{port}/metrics",
+                                 timeout=5).content.decode()
+            fams = parse_exposition(text)
+            stages: dict = {}
+            fam = fams.get("SeaweedFS_volumeServer_stage_seconds")
+            if fam is not None:
+                for labels, ent in histogram_series(fam).items():
+                    ld = dict(labels)
+                    if ld.get("type") != "get":
+                        continue
+                    stages[ld["stage"]] = (ent["sum"] or 0.0,
+                                           ent["count"] or 0.0)
+            e2e = (0.0, 0.0)
+            fam = fams.get("SeaweedFS_volumeServer_request_seconds")
+            if fam is not None:
+                for labels, ent in histogram_series(fam).items():
+                    if dict(labels).get("type") == "get":
+                        e2e = (ent["sum"] or 0.0, ent["count"] or 0.0)
+            return stages, e2e
+
+        # -- no-failpoint warmup: the PROTOCOL-cost stage breakdown ----
+        read_phase(100)
+        stage_sums: dict = {}
+        warm_count = 0.0
+        for port in (vport, v2port):
+            stages, e2e = scrape_stage_sums(port)
+            for st, (s, c) in stages.items():
+                a, b = stage_sums.get(st, (0.0, 0.0))
+                stage_sums[st] = (a + s, b + c)
+            warm_count += e2e[1]
+        for st, (s, c) in sorted(stage_sums.items()):
+            out[f"stage_{st}_us"] = round(s / max(c, 1.0) * 1e6, 1)
+        log("GET wire-to-wire stage means (us, no failpoint): " +
+            ", ".join(f"{st} {out[f'stage_{st}_us']}"
+                      for st in sorted(stage_sums)))
+
+        # -- deterministic slow disk on BOTH nodes: reads cost 10 ms --
+        for port in (vport, v2port):
+            http_util.get(f"http://127.0.0.1:{port}/debug/failpoints",
+                          params={"name": "store.read",
+                                  "spec": "pct:100:delay:0.01"})
+
+        # -- overhead gate: identical phases, +- collector cycles ------
+        per_thread = 250
+        rps_quiet = read_phase(per_thread)
+        stop_triggers = threading.Event()
+
+        def trigger_loop():
+            while not stop_triggers.is_set():
+                try:
+                    snapshot()
+                except Exception:  # noqa: BLE001
+                    pass
+                stop_triggers.wait(0.5)
+
+        tt = threading.Thread(target=trigger_loop, daemon=True)
+        tt.start()
+        try:
+            rps_scraped = read_phase(per_thread)
+        finally:
+            stop_triggers.set()
+            tt.join(timeout=5)
+        assert errors[0] == 0, f"telemetry smoke saw {errors[0]} errors"
+        overhead = 1.0 - rps_scraped / rps_quiet
+        out["telemetry_quiet_rps"] = round(rps_quiet, 1)
+        out["telemetry_scraped_rps"] = round(rps_scraped, 1)
+        out["telemetry_overhead_pct"] = round(overhead * 100, 2)
+        log(f"collector overhead: {rps_quiet:.0f} -> {rps_scraped:.0f} "
+            f"req/s ({overhead * 100:+.1f}%) with a cycle every 0.5s")
+        assert overhead <= 0.03, \
+            f"collector overhead {overhead * 100:.1f}% > 3% gate"
+
+        # -- merged-p99 fidelity: collector vs direct 2-node merge -----
+        shards = []
+        per_node_counts = []
+        coverage_num = coverage_den = 0.0
+        for port in (vport, v2port):
+            text = http_util.get(f"http://127.0.0.1:{port}/metrics",
+                                 timeout=5).content.decode()
+            # raises on any grammar or histogram-shape violation
+            assert check_exposition(text), "empty volume scrape"
+            fams = parse_exposition(text)
+            for labels, ent in histogram_series(
+                    fams["SeaweedFS_volumeServer_request_seconds"]).items():
+                if dict(labels).get("type") == "get":
+                    shards.append(ent["buckets"])
+                    per_node_counts.append(ent["count"])
+                    coverage_den += ent["sum"]
+            stages, _ = scrape_stage_sums(port)
+            coverage_num += sum(s for s, _ in stages.values())
+        assert len(shards) == 2 and min(per_node_counts) > 0, \
+            f"both nodes must serve reads, got counts {per_node_counts}"
+        truth_p99 = quantile(merge_buckets(shards), 0.99)
+
+        snap = snapshot()  # fresh cycle AFTER the workload stopped
+        merged = snap["merged"]["SeaweedFS_volumeServer_request_seconds"]
+        col_p99 = merged["type=get"]["p99"]
+        out["merged_get_p99_ms"] = round(col_p99 * 1e3, 2)
+        out["truth_get_p99_ms"] = round(truth_p99 * 1e3, 2)
+        rel = abs(col_p99 - truth_p99) / truth_p99
+        log(f"merged GET p99: collector {col_p99 * 1e3:.2f} ms vs "
+            f"direct merge {truth_p99 * 1e3:.2f} ms "
+            f"({rel * 100:.1f}% apart, counts {per_node_counts})")
+        assert rel <= 0.10, \
+            f"collector merged p99 {rel * 100:.1f}% from truth (gate 10%)"
+
+        # -- stage coverage gate: sums bracket the e2e histogram -------
+        coverage = coverage_num / max(coverage_den, 1e-9)
+        out["stage_coverage"] = round(coverage, 3)
+        log(f"stage histograms cover {coverage * 100:.1f}% of e2e GET "
+            "time (gate >= 90%)")
+        assert coverage >= 0.90, \
+            f"stage coverage {coverage * 100:.1f}% < 90% gate"
+
+        # -- SLO + heavy hitters present in the served snapshot --------
+        slo_names = {s["name"] for s in snap["slo"]["status"]}
+        assert slo_names == {"read-availability", "get-latency"}, slo_names
+        assert snap["slo"]["burning"] == [], \
+            f"healthy bench must not burn: {snap['slo']['burning']}"
+        hot_vols = snap["top"]["requests"]["volume"]
+        assert hot_vols, "cluster top-k saw no hot volumes"
+        out["hot_volume_keys"] = [i["key"] for i in hot_vols[:3]]
+        mc.stop()
+        out["bench_telemetry_smoke"] = "ok"
+    finally:
+        _stop_procs_cluster(procs, tmp)
+
+
 _QOS_BENCH_POLICY = {
     # victim: unthrottled, heavy WFQ weight — its latency is the gate
     # antag: tight rate + byte buckets (its bulk frames are 64 KB
@@ -2946,6 +3205,15 @@ def main() -> None:
                          "2-cycle leader kill/restart storm; storm p99 "
                          "<= 5x steady per class and follower-served "
                          "lookups observed via metrics")
+    ap.add_argument("--telemetry-only", action="store_true",
+                    dest="telemetry_only",
+                    help="run only the fleet-telemetry smoke (make "
+                         "bench-telemetry): separate-process master + "
+                         "2 volume servers; collector overhead <= 3% "
+                         "on delay-dominated reads, merged p99 within "
+                         "10% of a direct 2-node merge, stage "
+                         "histograms >= 90% of e2e GET time, live "
+                         "scrapes lint-clean")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -3007,6 +3275,12 @@ def main() -> None:
         out_ha: dict = {"metric": "bench_ha_smoke"}
         bench_ha_smoke(out_ha)
         print(json.dumps(out_ha))
+        return
+    if args.telemetry_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_tm: dict = {"metric": "bench_telemetry_smoke"}
+        bench_telemetry_smoke(out_tm)
+        print(json.dumps(out_tm))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

@@ -63,6 +63,16 @@ def _add_master_flags(p):
                         "cooling collections EC-encode, offload to the "
                         "remote tier and promote back on heat with zero "
                         "operator commands (status: /debug/lifecycle)")
+    p.add_argument("-sloPolicy", default="",
+                   help="SLO policy: JSON file path or inline JSON doc of "
+                        "availability/latency objectives; the leader's "
+                        "telemetry collector evaluates multi-window "
+                        "burn-rate alerts from it (status: "
+                        "/cluster/telemetry, shell cluster.top)")
+    p.add_argument("-telemetryIntervalS", type=float, default=0,
+                   help="fleet telemetry scrape interval seconds; 0 uses "
+                        "SWTPU_TELEMETRY_INTERVAL_S (default 15), "
+                        "negative disables the collector")
     _add_security_flags(p)
 
 
@@ -173,7 +183,9 @@ def run_master(argv):
                       maintenance_health_driven=(
                           opt.maintenanceHealthDriven == "on"),
                       ec_parity_shards=_ec_parity(opt),
-                      lifecycle_policy=opt.lifecyclePolicy)
+                      lifecycle_policy=opt.lifecyclePolicy,
+                      slo_policy=opt.sloPolicy,
+                      telemetry_interval_s=opt.telemetryIntervalS or None)
     ms.admin_cron.repair_max_concurrent = opt.maintenanceMaxConcurrentRepairs
     ms.start()
     _wait_forever()
@@ -226,7 +238,9 @@ def run_server(argv):
     ms = MasterServer(ip=opt.ip, port=opt.port,
                       volume_size_limit_mb=opt.volumeSizeLimitMB,
                       default_replication=opt.defaultReplication,
-                      guard=_make_guard(opt), http_port=opt.httpPort or None)
+                      guard=_make_guard(opt), http_port=opt.httpPort or None,
+                      slo_policy=opt.sloPolicy,
+                      telemetry_interval_s=opt.telemetryIntervalS or None)
     ms.start()
     store = Store(opt.ip, opt.volumePort, f"{opt.ip}:{opt.volumePort}",
                   [DiskLocation(opt.dir, "hdd", opt.max)],
@@ -267,7 +281,8 @@ def run_server(argv):
 def run_shell(argv):
     from .shell import (ec_commands, fs_commands,  # noqa: F401 (register)
                         lifecycle_commands, mq_commands, qos_commands,
-                        remote_commands, volume_commands)
+                        remote_commands, telemetry_commands,
+                        volume_commands)
     from .shell.commands import CommandEnv, repl, run_command
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")

@@ -268,6 +268,11 @@ class HealthEngine:
         self.parity = parity
         self.stale_after_s = stale_after_s
         self.disk_full_ratio = disk_full_ratio
+        # optional () -> [item dicts] merged into every scan's report:
+        # the telemetry plane injects burning-SLO items here so the
+        # verdict reflects user-facing objectives, not just structure.
+        # Extra items ride the same counts/verdict/journal machinery.
+        self.extra_items = None
         self._lock = threading.Lock()
         self._last_severity: dict[tuple[str, object], str] = {}
         self._last_read_only: set[int] = set()
@@ -328,6 +333,7 @@ class HealthEngine:
             report = evaluate(snap, parity=self.parity,
                               stale_after_s=self.stale_after_s,
                               disk_full_ratio=self.disk_full_ratio)
+            self._merge_extra_items(report)
             self._publish_gauges(report)
             read_only_now = {v["id"] for v in snap["volumes"]
                              if v.get("read_only")}
@@ -340,6 +346,22 @@ class HealthEngine:
             return self._last_report or {}
 
     # -- internals -----------------------------------------------------------
+    def _merge_extra_items(self, report: dict) -> None:
+        fn = self.extra_items
+        if fn is None:
+            return
+        try:
+            extra = fn() or []
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (a broken provider must not break the structural scan)
+            return
+        for it in extra:
+            sev = it.get("severity", OK)
+            report["items"].append(it)
+            if sev in report["counts"]:
+                report["counts"][sev] += 1
+            report["verdict"] = worse(report["verdict"], sev)
+        report["items"].sort(key=lambda it: -_RANK[it["severity"]])
+
     def _publish_gauges(self, report: dict) -> None:
         try:
             from ..stats import (EC_SHARDS_MISSING, NODES_STALE,

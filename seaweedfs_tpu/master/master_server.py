@@ -43,7 +43,9 @@ class MasterServer:
                  maintenance_health_driven: bool = True,
                  metrics_gateway: str = "", metrics_interval_s: int = 15,
                  ec_parity_shards: int | None = None,
-                 lifecycle_policy: str = ""):
+                 lifecycle_policy: str = "",
+                 slo_policy: str = "",
+                 telemetry_interval_s: float | None = None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
@@ -81,6 +83,11 @@ class MasterServer:
         # HTTP status/metrics API (reference master_server_handlers*.go);
         # 0/None disables. gRPC stays on `port`, HTTP on its own port.
         self.http_port = http_port
+        # (leader_grpc, leader_http) advertised through the raft FSM by
+        # each new leader: followers serve it in 421 bodies so HTTP
+        # clients (shell -url fetches) can follow to the leader without
+        # guessing its HTTP port from a gRPC hint
+        self._leader_http_hint: tuple[str, str] = ("", "")
         self._grpc = None
         self._http = None
         self._http_stop = None
@@ -147,6 +154,32 @@ class MasterServer:
             vacuum_enabled=lambda: not self.vacuum_disabled,
             health_fetch=(self.health.scan if maintenance_health_driven
                           else None))
+        # Fleet telemetry & SLO plane (telemetry/): a leader-resident
+        # collector scrapes every node's exposition into a ring TSDB,
+        # merges histograms into cluster percentiles, tracks heavy
+        # hitters and evaluates burn-rate alerts. Follows raft
+        # leadership exactly like the admin cron. `slo_policy` is a
+        # JSON file path or inline JSON doc of objectives.
+        self.slo_policy_source = slo_policy
+        from ..telemetry import TelemetryCollector, parse_slo_policy
+        policy = None
+        if slo_policy:
+            doc = slo_policy
+            if not slo_policy.lstrip().startswith("{"):
+                with open(slo_policy, encoding="utf-8") as f:
+                    doc = f.read()
+            policy = parse_slo_policy(doc)
+        self.telemetry = TelemetryCollector(
+            node_id=f"master@{self.address}",
+            targets_fn=self._telemetry_targets,
+            is_leader=lambda: self.is_leader,
+            interval_s=telemetry_interval_s,
+            slo_policy=policy,
+            local_scrape=self._local_scrape,
+            health_stale_fn=self._telemetry_stale_nodes)
+        # burning SLOs become health items: the cluster verdict reflects
+        # user-facing objectives, not just structural integrity
+        self.health.extra_items = self.telemetry.health_items
 
     @property
     def is_leader(self) -> bool:
@@ -161,6 +194,37 @@ class MasterServer:
         if self.raft.is_leader:
             return self.address
         return self.raft.leader_address or ""
+
+    # -- telemetry wiring ---------------------------------------------------
+    def _telemetry_targets(self) -> list[dict]:
+        """Scrape targets from live cluster membership: volume servers
+        come from the heartbeat-fed topology, filers from the
+        KeepConnected subscriber metadata (their metrics live under
+        /__metrics__ because / is the filesystem namespace)."""
+        targets = []
+        for n in self.topo.all_nodes():
+            targets.append({"node": f"volume@{n.id}",
+                            "url": f"http://{n.url}/metrics"})
+        with self._sub_lock:
+            metas = list(self._sub_meta.values())
+        for address, client_type, _ver, _ts, _grpc in metas:
+            if client_type == "filer" and address:
+                targets.append({"node": f"filer@{address}",
+                                "url": f"http://{address}/__metrics__"})
+        return targets
+
+    def _local_scrape(self) -> str:
+        from ..stats import scrape_payload
+        body, _ctype = scrape_payload()
+        return body
+
+    def _telemetry_stale_nodes(self) -> list[str]:
+        """Health-plane staleness (missed heartbeats) -> telemetry node
+        ids, so dead volume servers drop out of cluster merges even
+        before their scrapes start failing."""
+        report = self.health.last_report()
+        return [f"volume@{nd['id']}" for nd in report.get("nodes", ())
+                if nd.get("stale")]
 
     def _raft_apply(self, command: dict) -> None:
         """FSM apply (reference raft_server.go:53 StateMachine.Apply).
@@ -195,6 +259,9 @@ class MasterServer:
         if lease:
             self.fid_leases.grant_replicated(int(lease.get("count", 1)),
                                              lease.get("ttl_s"))
+        lh = command.get("leader_http")
+        if lh:
+            self._leader_http_hint = (lh.get("grpc", ""), lh.get("http", ""))
         vol = command.get("volume_new")
         if vol:
             v = VolumeInfo(
@@ -218,9 +285,26 @@ class MasterServer:
             # stale growth backoffs from a previous leadership stint
             # must not delay this leader's first growth
             self._want_growth_backoff.clear()
+            if self.http_port:
+                # advertise this leader's HTTP address through the FSM
+                # (propose blocks on commit, so not on the raft loop)
+                threading.Thread(
+                    target=self._advertise_leader_http, daemon=True,
+                    name="leader-http-advertise").start()
         self.admin_cron.notify_leadership(lead)
+        self.telemetry.notify_leadership(lead)
         if self._follower is not None:
             self._follower.poke()
+
+    def _advertise_leader_http(self) -> None:
+        if self.raft is None:
+            return
+        try:
+            self.raft.propose({"leader_http": {
+                "grpc": self.address,
+                "http": f"{self.ip}:{self.http_port}"}})
+        except Exception as e:  # noqa: BLE001 — best-effort hint
+            log.warning("leader http advertise failed: %s", e)
 
     def lookup_locations(self, vid: int) -> "tuple[list[dict] | None, str]":
         """(locations, source) for a vid. The leader answers from its
@@ -274,6 +358,7 @@ class MasterServer:
         threading.Thread(target=self._janitor, daemon=True,
                          name="master-janitor").start()
         self.admin_cron.start()
+        self.telemetry.start()
         if self.metrics_gateway:
             from ..stats import start_push_loop
             self._metrics_push = start_push_loop(
@@ -284,6 +369,7 @@ class MasterServer:
     def stop(self) -> None:
         self._stop.set()
         self.admin_cron.stop()
+        self.telemetry.stop()
         if self._metrics_push is not None:
             self._metrics_push.stop()
         if self._follower is not None:
@@ -374,6 +460,22 @@ class MasterServer:
             # risk NOW" must not get a stale janitor-tick answer
             return json_response(ms.health.scan())
 
+        def cluster_telemetry(req, q):
+            # leader-resident: only the leader scrapes the fleet, so a
+            # follower redirects (421 + hint) like the write paths
+            if not ms.is_leader:
+                return not_leader_response()
+            if q.get("trigger"):
+                # force one scrape/evaluate cycle now (tests, bench and
+                # `cluster.top -watch` first paint all need fresh data
+                # without waiting out the jittered interval)
+                ms.telemetry.trigger()
+            try:
+                top = int(q.get("top", "10") or 10)
+            except ValueError:
+                top = 10
+            return json_response(ms.telemetry.snapshot(top_limit=top))
+
         def dir_status(req, q):
             # leader_address, not ms.address: a follower answering here
             # must hint at the real leader (empty mid-election)
@@ -386,10 +488,16 @@ class MasterServer:
             # in the body (the hint is a gRPC address, so no Location
             # header — master_client follows the `leader` field)
             hint = ms.leader_address
+            # FSM-advertised HTTP address, served only while it matches
+            # the CURRENT leader (a hint from a deposed leader would
+            # bounce the client to another follower at best)
+            lh_grpc, lh_http = ms._leader_http_hint
             return json_response(
                 {"error": (f"not leader; leader is {hint}" if hint
                            else "not leader; leader unknown"),
-                 "leader": hint}, status=421)
+                 "leader": hint,
+                 "leader_http": (lh_http if hint and lh_grpc == hint
+                                 else "")}, status=421)
 
         def dir_lookup(req, q):
             from .. import tracing
@@ -604,6 +712,8 @@ class MasterServer:
                   offloaded(guarded("/debug/locks", debug_locks)))
         app.route("/cluster/health",
                   offloaded(guarded("/cluster/health", cluster_health)))
+        app.route("/cluster/telemetry",
+                  offloaded(guarded("/cluster/telemetry", cluster_telemetry)))
         # guarded+offloaded like the other /debug routes (the journal
         # filter walks the whole ring)
         app.route("/debug/lifecycle",

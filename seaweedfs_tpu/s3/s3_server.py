@@ -259,6 +259,10 @@ class S3Gateway:
             app.router.add_route("*", "/debug/qos", debug_qos)
             app.router.add_route("*", "/debug/profile", debug_profile)
             app.router.add_route("*", "/metrics", metrics)
+            # alias matching the filer's reserved-namespace spelling so
+            # the fleet telemetry collector can scrape either daemon
+            # kind at /__metrics__ without knowing which it hit
+            app.router.add_route("*", "/__metrics__", metrics)
             app.router.add_route("*", "/{tail:.*}", dispatch)
 
         from ..utils.webapp import serve_web_app

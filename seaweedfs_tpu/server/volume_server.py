@@ -24,11 +24,39 @@ from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.types import TTL, parse_file_id
 from ..storage.vacuum import commit_compact, compact
+from ..telemetry.hot import record as hot_record
 from ..utils import failpoints, retry
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 
 log = logger("volume")
+
+
+def _observe_stages(kind: str, t_recv: float, t0: float, t_admit,
+                    t_done, t_end: float) -> None:
+    """Per-stage timing for the protocol-ceiling teardown (BENCH_r05:
+    93-139 us of protocol per hop): contiguous perf_counter segments
+    recv/parse (first wire byte -> handler entry, includes queue wait),
+    auth/admit (QoS admission), store (the storage handler itself, jwt
+    check included) and serialize/flush (response build + accounting).
+    The four sums cover the full wire-to-wire interval, so per-type
+    stage totals account for >= 100% of VOLUME_REQUEST_SECONDS.
+    t_admit/t_done may be None on shed/error paths (stage collapses to
+    zero and the tail lands in serialize_flush)."""
+    from ..stats import VOLUME_STAGE_SECONDS
+    a = t_admit if t_admit is not None else t0
+    d = t_done if t_done is not None else a
+    VOLUME_STAGE_SECONDS.observe(kind, "recv_parse",
+                                 value=max(0.0, t0 - (t_recv or t0)))
+    VOLUME_STAGE_SECONDS.observe(kind, "auth_admit", value=max(0.0, a - t0))
+    VOLUME_STAGE_SECONDS.observe(kind, "store", value=max(0.0, d - a))
+    VOLUME_STAGE_SECONDS.observe(kind, "serialize_flush",
+                                 value=max(0.0, t_end - d))
+
+
+def _vid_of_path(path: str) -> "str | None":
+    head = path.lstrip("/").split(",", 1)[0]
+    return head if head.isdigit() else None
 
 
 def _maintenance_tagged(fn):
@@ -382,6 +410,7 @@ class VolumeServer:
         async def handle(request: fastweb.Request):
             kind = _kind.get(request.method, "other")
             t0 = time.perf_counter()
+            t_admit = t_done = None
             resp = None
             status = 500
             # server span continues the caller's trace (traceparent
@@ -422,6 +451,7 @@ class VolumeServer:
                         # the handler (and its replication fan-out)
                         # inherits the admitted class
                         qos_token = qos_mod.set_class(klass)
+                    t_admit = time.perf_counter()
                     try:
                         if request.method in ("POST", "PUT"):
                             resp = await self._handle_write(request)
@@ -443,6 +473,7 @@ class VolumeServer:
                     except Exception as e:  # noqa: BLE001
                         log.error("http error: %s", e)
                         resp = json_response({"error": str(e)}, status=500)
+                    t_done = time.perf_counter()
                     status = resp.status
                     if grant is not None and request.method in \
                             ("GET", "HEAD") and resp.body:
@@ -457,9 +488,19 @@ class VolumeServer:
                     sp.set_attr("status", status)
                     if status >= 500:
                         sp.set_error(f"HTTP {status}")
+                    t_end = time.perf_counter()
                     VOLUME_REQUEST_COUNTER.inc(kind, str(status))
-                    VOLUME_REQUEST_SECONDS.observe(
-                        kind, value=time.perf_counter() - t0)
+                    VOLUME_REQUEST_SECONDS.observe(kind, value=t_end - t0)
+                    _observe_stages(kind, request.t_recv, t0, t_admit,
+                                    t_done, t_end)
+                    # heavy hitters: bytes moved = payload in + body out
+                    hot_record(
+                        volume=_vid_of_path(request.path),
+                        tenant=self._qos_tenant_of_path(request.path),
+                        method=kind,
+                        nbytes=len(request.body or b"")
+                        + (len(resp.body) if resp is not None and resp.body
+                           else 0))
 
         def status(request):
             return json_response({"version": "swtpu", **self.store.status()})
@@ -605,6 +646,8 @@ class VolumeServer:
             # per-needle PUTs; the span is the bulk.put root the
             # replication fan-out children hang under
             t0 = time.perf_counter()
+            t_admit = t_done = None
+            resp = None
             status = 500
             with tracing.start_span(
                     "bulk.put", component="volume",
@@ -630,6 +673,7 @@ class VolumeServer:
                             return self._qos_shed_response(e)
                         sp.set_attr("qos_class", klass)
                         qos_token = qos_mod.set_class(klass)
+                    t_admit = time.perf_counter()
                     try:
                         resp = await self._handle_bulk(request, sp)
                     except KeyError as e:
@@ -639,6 +683,7 @@ class VolumeServer:
                     except Exception as e:  # noqa: BLE001
                         log.error("bulk http error: %s", e)
                         resp = json_response({"error": str(e)}, status=500)
+                    t_done = time.perf_counter()
                     status = resp.status
                     return resp
                 finally:
@@ -650,14 +695,23 @@ class VolumeServer:
                     sp.set_attr("status", status)
                     if status >= 500:
                         sp.set_error(f"HTTP {status}")
+                    t_end = time.perf_counter()
                     VOLUME_REQUEST_COUNTER.inc("bulk", str(status))
-                    VOLUME_REQUEST_SECONDS.observe(
-                        "bulk", value=time.perf_counter() - t0)
+                    VOLUME_REQUEST_SECONDS.observe("bulk", value=t_end - t0)
+                    _observe_stages("bulk", request.t_recv, t0, t_admit,
+                                    t_done, t_end)
+                    hot_record(
+                        volume=request.query.get("vid") or None,
+                        tenant=self._qos_tenant_of_query(request.query),
+                        method="bulk",
+                        nbytes=len(request.body or b""))
 
         async def handle_bulk_read(request: fastweb.Request):
             # bulk.read mirrors bulk.put: its own request kind on the
             # dashboards, one span the per-needle resolution hangs under
             t0 = time.perf_counter()
+            t_admit = t_done = None
+            resp = None
             status = 500
             with tracing.start_span(
                     "bulk.read", component="volume",
@@ -680,6 +734,7 @@ class VolumeServer:
                             return self._qos_shed_response(e)
                         sp.set_attr("qos_class", klass)
                         qos_token = qos_mod.set_class(klass)
+                    t_admit = time.perf_counter()
                     try:
                         resp = await self._handle_bulk_read(request, sp)
                     except KeyError as e:
@@ -689,6 +744,7 @@ class VolumeServer:
                     except Exception as e:  # noqa: BLE001
                         log.error("bulk-read http error: %s", e)
                         resp = json_response({"error": str(e)}, status=500)
+                    t_done = time.perf_counter()
                     status = resp.status
                     if grant is not None and resp.body:
                         # the assembled frame is the byte cost of a bulk
@@ -704,9 +760,18 @@ class VolumeServer:
                     sp.set_attr("status", status)
                     if status >= 500:
                         sp.set_error(f"HTTP {status}")
+                    t_end = time.perf_counter()
                     VOLUME_REQUEST_COUNTER.inc("bulk-read", str(status))
-                    VOLUME_REQUEST_SECONDS.observe(
-                        "bulk-read", value=time.perf_counter() - t0)
+                    VOLUME_REQUEST_SECONDS.observe("bulk-read",
+                                                   value=t_end - t0)
+                    _observe_stages("bulk-read", request.t_recv, t0,
+                                    t_admit, t_done, t_end)
+                    hot_record(
+                        volume=request.query.get("vid") or None,
+                        tenant=self._qos_tenant_of_query(request.query),
+                        method="bulk-read",
+                        nbytes=(len(resp.body) if resp is not None
+                                and resp.body else 0))
 
         app = fastweb.FastApp()
         app.route("/status", status)

@@ -13,11 +13,43 @@ place instead of drifting between the two commands.
 
 from __future__ import annotations
 
-import json
-import urllib.request
-
 from ..pb import volume_server_pb2 as vpb
 from ..utils.rpc import Stub, VOLUME_SERVICE
+
+
+def fetch_master_json(base_url: str, path: str, params: "dict | None" = None,
+                      timeout: float = 10.0, max_hops: int = 3) -> dict:
+    """GET a master HTTP endpoint, following 421 leader redirects.
+
+    Leader-resident endpoints (/cluster/telemetry, the write paths)
+    answer 421 Misdirected Request on a follower with the leader's
+    FSM-advertised HTTP address in the body's `leader_http` field —
+    follow it (bounded hops: elections can bounce the hint) instead of
+    handing the operator a JSON error dict that quacks like a report."""
+    from ..client import http_util
+
+    url = base_url.rstrip("/")
+    if not url.startswith("http"):
+        url = f"http://{url}"
+    last_err = "no hops attempted"
+    for _ in range(max_hops):
+        r = http_util.get(f"{url}{path}", params=params, timeout=timeout)
+        try:
+            body = r.json()
+        except ValueError:
+            raise RuntimeError(
+                f"non-JSON response from {url}{path} ({r.status})") from None
+        if r.status == 421:
+            nxt = body.get("leader_http", "")
+            last_err = body.get("error", "not leader")
+            if not nxt:
+                break  # follower without a usable hint — report as-is
+            url = f"http://{nxt}"
+            continue
+        if r.status != 200:
+            raise RuntimeError(body.get("error") or f"HTTP {r.status}")
+        return body
+    raise RuntimeError(f"no leader answered {path}: {last_err}")
 
 
 def fetch_or_compute_health(env, url: str = "", timeout: float = 10.0) -> dict:
@@ -26,9 +58,7 @@ def fetch_or_compute_health(env, url: str = "", timeout: float = 10.0) -> dict:
     caller asked for the live engine; silently degrading to a dump would
     hide a dead master)."""
     if url:
-        with urllib.request.urlopen(
-                f"{url.rstrip('/')}/cluster/health", timeout=timeout) as r:
-            return json.loads(r.read().decode())
+        return fetch_master_json(url, "/cluster/health", timeout=timeout)
 
     from ..master.health import evaluate, snapshot_from_topology_info
 

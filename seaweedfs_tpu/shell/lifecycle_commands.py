@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import argparse
 
-from ..client import http_util
 from .commands import CommandEnv, command
 
 
@@ -28,10 +27,9 @@ def cmd_lifecycle_status(env: CommandEnv, args):
     opt = p.parse_args(args)
 
     if opt.url:
+        from .health_util import fetch_master_json
         try:
-            r = http_util.get(f"{opt.url.rstrip('/')}/debug/lifecycle",
-                              timeout=5)
-            doc = r.json() if r.ok else {}
+            doc = fetch_master_json(opt.url, "/debug/lifecycle", timeout=5)
         except Exception as e:  # noqa: BLE001
             doc = {}
             env.println(f"master lifecycle fetch failed: {e}")

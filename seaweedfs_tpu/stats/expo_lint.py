@@ -1,4 +1,4 @@
-"""Strict Prometheus text-exposition grammar checker + registry lint.
+"""Exposition lint + registry lint, on top of the shared parser.
 
 Two consumers:
   * tests/test_stats.py parses `REGISTRY.gather()` through
@@ -10,213 +10,58 @@ Two consumers:
     ceiling on the unbounded-by-construction labels (`peer`, `bucket`)
     that would otherwise grow a label set per address / per S3 bucket.
 
-The grammar follows the text format spec (version 0.0.4): HELP/TYPE
-comment lines, sample lines `name{labels} value [timestamp]`, label
-values with \\ \" \\n escapes, histograms with ascending `le` buckets,
-a `+Inf` bucket, monotone bucket counts, and `_sum`/`_count` series.
+The exposition *grammar* lives in stats/parse.py (one parser shared
+with the fleet telemetry scraper); this module keeps the semantic
+rules layered on top: histograms must have ascending `le` ending at
++Inf with monotone cumulative counts and `_sum`/`_count` series, and
+the registry's bounded label families must stay under their ceilings.
 """
 
 from __future__ import annotations
 
 import math
-import re
 import sys
 
-_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
-_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
-# a label VALUE is any run of chars with \\ \" \n escaped
-_LABEL_VALUE_RE = re.compile(r'"((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+from .parse import Family, ParseError, histogram_series, parse_exposition
 
-_SUFFIXES = ("_bucket", "_sum", "_count")
-
-
-class ExpositionError(ValueError):
-    def __init__(self, lineno: int, line: str, why: str):
-        super().__init__(f"line {lineno}: {why}: {line[:120]!r}")
-        self.lineno = lineno
-        self.why = why
-
-
-def _parse_labels(lineno: int, line: str, raw: str) -> dict[str, str]:
-    labels: dict[str, str] = {}
-    pos = 0
-    while pos < len(raw):
-        m = _LABEL_NAME_RE.match(raw, pos)
-        if m is None:
-            raise ExpositionError(lineno, line, "bad label name")
-        name = m.group(0)
-        pos = m.end()
-        if raw[pos:pos + 1] != "=":
-            raise ExpositionError(lineno, line, "label missing '='")
-        pos += 1
-        vm = _LABEL_VALUE_RE.match(raw, pos)
-        if vm is None:
-            raise ExpositionError(lineno, line,
-                                  "bad label value escaping/quoting")
-        if name in labels:
-            raise ExpositionError(lineno, line, f"duplicate label {name}")
-        labels[name] = vm.group(1)
-        pos = vm.end()
-        if raw[pos:pos + 1] == ",":
-            pos += 1
-        elif pos != len(raw):
-            raise ExpositionError(lineno, line, "junk between labels")
-    return labels
-
-
-def _label_block_end(raw: str) -> int:
-    """Index of the closing '}' of a label block (raw starts just after
-    the opening '{'), honoring quoted values and escapes."""
-    in_quotes = False
-    escaped = False
-    for i, ch in enumerate(raw):
-        if escaped:
-            escaped = False
-        elif ch == "\\":
-            escaped = True
-        elif ch == '"':
-            in_quotes = not in_quotes
-        elif ch == "}" and not in_quotes:
-            return i
-    return -1
-
-
-def _family_of(name: str) -> str:
-    for suf in _SUFFIXES:
-        if name.endswith(suf):
-            return name[: -len(suf)]
-    return name
+# Backwards-compatible name: the lint's callers catch ExpositionError;
+# grammar violations now surface from the shared parser.
+ExpositionError = ParseError
 
 
 def check_exposition(text: str) -> list[str]:
-    """Validate one exposition; returns the family names seen, raising
-    ExpositionError on the first grammar violation."""
-    helps: set[str] = set()
-    types: dict[str, str] = {}
-    # histogram family -> labelset-key -> {"le": [..], "sum":, "count":}
-    hist: dict[str, dict[tuple, dict]] = {}
-    samples_seen: dict[str, int] = {}
+    """Validate one exposition; returns the family names seen (those
+    with samples), raising ExpositionError on the first grammar or
+    histogram-shape violation."""
+    families = parse_exposition(text)
+    for family in families.values():
+        if family.kind == "histogram":
+            _check_histogram(family)
+    return sorted(f.name for f in families.values() if f.samples)
 
-    for lineno, line in enumerate(text.split("\n"), 1):
-        if not line:
-            continue
-        if line.startswith("#"):
-            parts = line.split(None, 3)
-            if len(parts) >= 2 and parts[1] == "EOF":
-                continue  # OpenMetrics terminator (tolerated)
-            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
-                raise ExpositionError(lineno, line, "malformed comment")
-            name = parts[2]
-            if _NAME_RE.fullmatch(name) is None:
-                raise ExpositionError(lineno, line, "bad metric name")
-            if parts[1] == "HELP":
-                if name in helps:
-                    raise ExpositionError(lineno, line, "duplicate HELP")
-                helps.add(name)
-            else:
-                if name in types:
-                    raise ExpositionError(lineno, line, "duplicate TYPE")
-                if len(parts) < 4 or parts[3] not in (
-                        "counter", "gauge", "histogram", "summary",
-                        "untyped", "unknown"):
-                    raise ExpositionError(lineno, line, "bad TYPE kind")
-                if name not in helps:
-                    raise ExpositionError(lineno, line,
-                                          "TYPE without preceding HELP")
-                types[name] = parts[3]
-            continue
-        # sample line: name[{labels}] value [timestamp] [# exemplar]
-        m = _NAME_RE.match(line)
-        if m is None:
-            raise ExpositionError(lineno, line, "bad sample name")
-        name = m.group(0)
-        rest = line[m.end():]
-        labels: dict[str, str] = {}
-        if rest.startswith("{"):
-            # quote-aware scan for the closing brace: an OpenMetrics
-            # exemplar later on the line has its own braces, so rfind
-            # would overshoot
-            end = _label_block_end(rest[1:])
-            if end < 0:
-                raise ExpositionError(lineno, line, "unclosed label braces")
-            labels = _parse_labels(lineno, line, rest[1:1 + end])
-            rest = rest[end + 2:]
-        toks = rest.split("#", 1)[0].split()
-        if not toks:
-            raise ExpositionError(lineno, line, "sample without value")
-        try:
-            value = float(toks[0])
-        except ValueError:
-            raise ExpositionError(lineno, line,
-                                  f"bad sample value {toks[0]!r}") from None
-        if len(toks) > 2:
-            raise ExpositionError(lineno, line, "junk after timestamp")
-        family = _family_of(name)
-        if family not in types and name not in types:
-            # OpenMetrics counters: sample `<family>_total` under a
-            # suffix-free `# TYPE <family> counter` header
-            base = (name[:-len("_total")] if name.endswith("_total")
-                    else name)
-            if types.get(base) == "counter":
-                family = base
-            else:
-                raise ExpositionError(lineno, line,
-                                      "sample without HELP/TYPE header")
-        fam_type = types.get(family) or types.get(name)
-        samples_seen[family] = samples_seen.get(family, 0) + 1
-        if fam_type == "histogram":
-            key = tuple(sorted((k, v) for k, v in labels.items()
-                               if k != "le"))
-            ent = hist.setdefault(family, {}).setdefault(
-                key, {"le": [], "sum": None, "count": None})
-            if name.endswith("_bucket"):
-                if "le" not in labels:
-                    raise ExpositionError(lineno, line,
-                                          "histogram bucket without le")
-                le = (math.inf if labels["le"] == "+Inf"
-                      else float(labels["le"]))
-                ent["le"].append((le, value))
-            elif name.endswith("_sum"):
-                ent["sum"] = value
-            elif name.endswith("_count"):
-                ent["count"] = value
-            else:
-                raise ExpositionError(
-                    lineno, line, "histogram sample must be "
-                    "_bucket/_sum/_count")
-        elif name != family and not (
-                fam_type == "counter" and name == f"{family}_total"):
-            raise ExpositionError(lineno, line,
-                                  f"suffix sample for non-histogram "
-                                  f"{fam_type}")
 
-    for name in types:
-        if name not in helps:
-            raise ExpositionError(0, name, "TYPE without HELP")
-    for family, sets in hist.items():
-        for key, ent in sets.items():
-            les = ent["le"]
-            if not les:
-                raise ExpositionError(0, family,
-                                      f"histogram {dict(key)} has no buckets")
-            order = [le for le, _ in les]
-            if order != sorted(order):
-                raise ExpositionError(0, family,
-                                      f"histogram le not ascending: {order}")
-            if order[-1] != math.inf:
-                raise ExpositionError(0, family, "histogram missing +Inf")
-            counts = [c for _, c in les]
-            if any(b > a for a, b in zip(counts[1:], counts)):
-                raise ExpositionError(0, family,
-                                      "bucket counts not monotone")
-            if ent["sum"] is None or ent["count"] is None:
-                raise ExpositionError(0, family,
-                                      f"histogram {dict(key)} missing "
-                                      "_sum/_count")
-            if ent["count"] != counts[-1]:
-                raise ExpositionError(0, family,
-                                      "_count != +Inf bucket")
-    return sorted(samples_seen)
+def _check_histogram(family: Family) -> None:
+    for key, ent in histogram_series(family).items():
+        les = ent["buckets"]
+        if not les:
+            raise ExpositionError(0, family.name,
+                                  f"histogram {dict(key)} has no buckets")
+        order = [le for le, _ in les]
+        if order != sorted(order):
+            raise ExpositionError(0, family.name,
+                                  f"histogram le not ascending: {order}")
+        if order[-1] != math.inf:
+            raise ExpositionError(0, family.name, "histogram missing +Inf")
+        counts = [c for _, c in les]
+        if any(b > a for a, b in zip(counts[1:], counts)):
+            raise ExpositionError(0, family.name,
+                                  "bucket counts not monotone")
+        if ent["sum"] is None or ent["count"] is None:
+            raise ExpositionError(0, family.name,
+                                  f"histogram {dict(key)} missing "
+                                  "_sum/_count")
+        if ent["count"] != counts[-1]:
+            raise ExpositionError(0, family.name, "_count != +Inf bucket")
 
 
 # -- registry lint -----------------------------------------------------------
@@ -226,24 +71,38 @@ def check_exposition(text: str) -> list[str]:
 # a new series forever). `tenant` is bounded BY CONSTRUCTION in the qos
 # scheduler — its policy max_tenants ceiling routes the long tail into
 # one "~other" overflow bucket — and this lint keeps that contract.
+# `key` (the heavy-hitter sketches' label) is bounded by the sketch
+# capacity (telemetry/topk.py, SWTPU_HOT_KEYS) the same way.
 DEFAULT_CARDINALITY_CEILING = 256
-_BOUNDED_LABELS = ("peer", "bucket", "tenant")
+_BOUNDED_LABELS = ("peer", "bucket", "tenant", "key")
 
 # the lifecycle plane's {from,to} tier-label pair is a tiny CLOSED set
 # (lifecycle.TIERS: hot/ec/remote/trash) — a typo'd or computed tier
 # name minting new series is a bug, so its ceiling is far tighter than
-# the address-shaped labels above.
+# the address-shaped labels above. The telemetry plane's enumerated
+# label families ride the same tight ceiling: `stage` (the volume
+# server's fixed recv/parse->admit->store->serialize pipeline),
+# `window` (the SLO policy's burn-rate window names), and `kind` (the
+# heavy-hitter dimensions: volume/tenant/method).
 TIER_CARDINALITY_CEILING = 8
-_TIER_LABELS = ("from", "to")
+_TIER_LABELS = ("from", "to", "stage", "window", "kind")
+
+# SLO names come from the operator's policy doc — small by design (a
+# policy with hundreds of objectives is unreviewable), but not a
+# closed set, so they get their own intermediate ceiling.
+SLO_CARDINALITY_CEILING = 64
+_SLO_LABELS = ("slo",)
 
 
 def lint_registry(registry=None,
                   ceiling: int = DEFAULT_CARDINALITY_CEILING,
-                  tier_ceiling: int = TIER_CARDINALITY_CEILING
+                  tier_ceiling: int = TIER_CARDINALITY_CEILING,
+                  slo_ceiling: int = SLO_CARDINALITY_CEILING
                   ) -> list[str]:
     """Registry-level problems: duplicate family names and per-label
-    cardinality over the ceiling on `peer`/`bucket`/`tenant` labels
-    (and the much tighter tier ceiling on `from`/`to`). Returns a list
+    cardinality over the ceiling on `peer`/`bucket`/`tenant`/`key`
+    labels (the much tighter tier ceiling covers `from`/`to`/`stage`/
+    `window`/`kind`; SLO names get an intermediate one). Returns a list
     of human-readable findings (empty = clean)."""
     from .metrics import REGISTRY, Counter, Gauge, Histogram
     registry = registry or REGISTRY
@@ -256,6 +115,8 @@ def lint_registry(registry=None,
         for i, lname in enumerate(m.label_names):
             if lname in _TIER_LABELS:
                 cap = tier_ceiling
+            elif lname in _SLO_LABELS:
+                cap = slo_ceiling
             elif lname in _BOUNDED_LABELS:
                 cap = ceiling
             else:

@@ -27,9 +27,17 @@ OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
                             "charset=utf-8")
 
 
+def _escape_label_value(v: str) -> str:
+    # text-format spec: backslash, double-quote and newline must be
+    # escaped inside label values or the exposition is unparseable
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(label_names: tuple[str, ...], label_values: tuple[str, ...],
                 extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in zip(label_names, label_values)]
+    parts = [f'{k}="{_escape_label_value(v)}"'
+             for k, v in zip(label_names, label_values)]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -106,6 +114,14 @@ class Gauge(_Metric):
     def value(self, *label_values: str) -> float:
         with self._lock:
             return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def clear(self) -> None:
+        """Drop every label set. For gauges mirroring an external
+        bounded structure (the heavy-hitter sketches): the structure
+        evicts keys, so the mirror must too or evicted keys scrape
+        stale forever."""
+        with self._lock:
+            self._values.clear()
 
     def expose(self, openmetrics: bool = False) -> list[str]:
         with self._lock:
@@ -508,6 +524,68 @@ QOS_WAIT_SECONDS = _histogram(
     "time queued requests waited before being granted", ("class",),
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
              2.5, 5.0, 10.0, 30.0))
+# Fleet telemetry plane (telemetry/): per-stage wall time inside the
+# volume server's request envelope. The stages are CONTIGUOUS segments
+# of one perf_counter timeline (recv/parse -> auth/admit -> store ->
+# serialize/flush), so per-{type} stage sums account for ~100% of
+# SeaweedFS_volumeServer_request_seconds — the per-hop protocol
+# breakdown the ROADMAP's protocol-ceiling teardown needs (BENCH_r05:
+# 6.7 us store read under 93-139 us/hop). Microsecond-resolution
+# buckets; exemplar-linked to /debug/traces via the shared Histogram
+# plumbing. `stage` is a closed set the registry lint caps at the tier
+# ceiling.
+VOLUME_STAGE_SECONDS = _histogram(
+    "SeaweedFS_volumeServer_stage_seconds",
+    "volume request per-stage seconds (contiguous segments: recv/parse, "
+    "auth/admit, store, serialize/flush)",
+    ("type", "stage"),
+    buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+             0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.5, 1.0))
+# Heavy hitters: the space-saving sketches' current top-k per dimension
+# (kind: volume/tenant/method), refreshed at scrape time by a
+# pre-scrape hook. Gauges, not counters — sketch keys get evicted and
+# inherit counts, so values are top-k *estimates* (each key's
+# guaranteed error rides the sketch, see telemetry/topk.py). Label
+# cardinality is bounded by the sketch capacity (SWTPU_HOT_KEYS).
+HOT_REQUESTS = _gauge(
+    "SeaweedFS_hot_requests",
+    "space-saving top-k request counts by dimension (volume/tenant/"
+    "method)", ("kind", "key"))
+HOT_BYTES = _gauge(
+    "SeaweedFS_hot_bytes",
+    "space-saving top-k byte counts by dimension (volume/tenant/"
+    "method)", ("kind", "key"))
+# SLO plane (telemetry/slo.py): burn rate per objective per evaluation
+# window side (window label: "<pair>_long"/"<pair>_short"). Burn 1.0 =
+# spending the error budget exactly at the sustainable rate; the
+# policy's threshold per window pair is where slo.burn fires.
+SLO_BURN_RATE = _gauge(
+    "SeaweedFS_slo_burn_rate",
+    "SLO error-budget burn rate per objective and evaluation window",
+    ("slo", "window"))
+# Leader-resident collector health: scrape outcomes and the live/stale
+# split of its target set (stale ties into the health plane's
+# nodes_stale signal — a node the collector can't scrape is a node
+# whose series are marked, not dropped).
+TELEMETRY_SCRAPES = _counter(
+    "SeaweedFS_telemetry_scrapes_total",
+    "fleet metric scrapes by the leader collector", ("outcome",))
+TELEMETRY_TARGETS = _gauge(
+    "SeaweedFS_telemetry_targets",
+    "collector scrape targets by state (live/stale)", ("state",))
+
+
+# Pre-scrape hooks: callables run (errors swallowed) before every
+# scrape_payload render, for families mirroring external structures —
+# the heavy-hitter sketches register their gauge refresh here so every
+# exposition carries the sketch's current top-k.
+_SCRAPE_HOOKS: list = []
+
+
+def register_scrape_hook(fn) -> None:
+    if fn not in _SCRAPE_HOOKS:
+        _SCRAPE_HOOKS.append(fn)
 
 
 def scrape_payload(accept: str = "") -> tuple[str, str]:
@@ -515,6 +593,11 @@ def scrape_payload(accept: str = "") -> tuple[str, str]:
     scraper's Accept header: OpenMetrics (with trace exemplars) when
     requested, else the Prometheus text format with the strict
     `version=0.0.4` parameter scrapers require."""
+    for hook in list(_SCRAPE_HOOKS):
+        try:
+            hook()
+        except Exception as e:  # noqa: BLE001 — a hook must never break a scrape
+            log.warning("scrape hook %s failed: %s", hook, e)
     if "application/openmetrics-text" in (accept or ""):
         return REGISTRY.gather(openmetrics=True), OPENMETRICS_CONTENT_TYPE
     return REGISTRY.gather(), PROM_CONTENT_TYPE

@@ -20,6 +20,7 @@ import asyncio
 import inspect
 import json as _json
 import threading
+import time
 import urllib.parse
 from collections import deque
 
@@ -41,7 +42,7 @@ class Headers(dict):
 
 class Request:
     __slots__ = ("method", "path", "query_string", "headers", "body",
-                 "remote", "_query")
+                 "remote", "_query", "t_recv")
 
     def __init__(self, method: str, path: str, query_string: str,
                  headers: Headers, body: bytes, remote: str):
@@ -52,6 +53,10 @@ class Request:
         self.body = body
         self.remote = remote
         self._query = None
+        # perf_counter at the request's first wire byte, stamped by the
+        # protocol; lets handlers charge a recv/parse profiling stage
+        # (handler-entry minus t_recv covers parse + queue wait too)
+        self.t_recv = 0.0
 
     @property
     def query(self) -> dict:
@@ -139,6 +144,7 @@ class _HttpProtocol(asyncio.Protocol):
         self._worker: asyncio.Task | None = None
         self._closing = False
         self._poison = None  # (status, msg) once unparseable bytes arrive
+        self._t_first = None  # perf_counter at current request's first byte
 
     # -- wire in -----------------------------------------------------------
     def connection_made(self, transport):
@@ -161,6 +167,8 @@ class _HttpProtocol(asyncio.Protocol):
     def data_received(self, data: bytes):
         if self._poison is not None:
             return  # already answering-then-closing; drop further bytes
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
         self.buf += data
         try:
             self._pump()
@@ -198,6 +206,10 @@ class _HttpProtocol(asyncio.Protocol):
             method, path, qs, headers = self._head
             req = Request(method, path, qs, headers, bytes(self._body),
                           self.remote)
+            # pipelined followers in the same buffer get "now" — their
+            # bytes arrived with the previous request's, so recv ~ 0
+            req.t_recv = self._t_first or time.perf_counter()
+            self._t_first = None
             self._head, self._body = None, None
             self._queue.append(req)
             if self._worker is None or self._worker.done():

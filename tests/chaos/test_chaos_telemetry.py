@@ -1,0 +1,266 @@
+"""Chaos telemetry lane: SLO burn-rate alerts under injected faults.
+
+The fleet telemetry plane's whole value is during incidents, so this
+lane drives one end-to-end against a live in-process mini-cluster
+(master with a seconds-scale SLO policy + 2 volume servers):
+
+  * a store.read delay failpoint pushes every GET past the latency
+    objective's threshold -> `slo.burn` fires (WARN, window + burn
+    attrs) and the burning SLO rides the health plane's extra-items
+    hook into a DEGRADED cluster verdict;
+  * clearing the fault and running healthy traffic ages the slow
+    observations out of both burn windows -> `slo.ok` fires with the
+    recovered-from context, the verdict returns to OK;
+  * stalled heartbeats (volume.heartbeat delay failpoint — the node's
+    HTTP port still answers scrapes) ride the health plane's overdue
+    view into the collector -> `telemetry.stale` fires and the node is
+    excluded from merges; resumed heartbeats flip it back live; an
+    outright kill tears the heartbeat stream, the master unregisters
+    the node and its scrape target disappears while survivors serve.
+
+Events correlate in the shared ops journal by seq: burn strictly
+before ok, stale after the kill. Runs with SWTPU_LOCKCHECK=1 under
+`make chaos`; the session must end with zero lock-order cycles (the
+collector + SLO engine add new lock/scrape interleavings).
+"""
+
+import json
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if not os.environ.get("SWTPU_CHAOS"):
+    pytest.skip("chaos suite is opt-in: set SWTPU_CHAOS=1",
+                allow_module_level=True)
+
+from seaweedfs_tpu.client import operation  # noqa: E402
+from seaweedfs_tpu.client.master_client import MasterClient  # noqa: E402
+from seaweedfs_tpu.master.master_server import MasterServer  # noqa: E402
+from seaweedfs_tpu.ops import events  # noqa: E402
+from seaweedfs_tpu.server.volume_server import VolumeServer  # noqa: E402
+from seaweedfs_tpu.storage.disk_location import DiskLocation  # noqa: E402
+from seaweedfs_tpu.storage.store import Store  # noqa: E402
+from seaweedfs_tpu.utils import failpoints  # noqa: E402
+
+# seconds-scale burn windows: the production defaults (1h/6h) are
+# untestable in a lane; the policy machinery is identical
+_POLICY = {
+    "slos": [{"name": "get-latency", "kind": "latency", "verb": "get",
+              "threshold_s": 0.02, "objective": 0.9}],
+    "windows": [{"name": "fast", "long_s": 4.0, "short_s": 1.0,
+                 "burn": 5.0}],
+}
+
+
+@pytest.fixture(scope="module")
+def no_lock_order_cycles():
+    yield
+    if os.environ.get("SWTPU_LOCKCHECK") != "1":
+        return
+    from seaweedfs_tpu.utils import locktrack
+
+    rep = locktrack.findings()
+    assert rep["cycles"] == [], (
+        "lock-order cycles observed during the telemetry chaos lane: "
+        + "; ".join(" -> ".join(c["locks"]) for c in rep["cycles"]))
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory, no_lock_order_cycles):
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    mport = free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3,
+                          slo_policy=json.dumps(_POLICY),
+                          telemetry_interval_s=-1)  # trigger()-driven
+    master.start()
+    servers = []
+    for i in range(2):
+        d = tmp_path_factory.mktemp(f"chaostel{i}")
+        store = Store("127.0.0.1", 0, "",
+                      [DiskLocation(str(d), max_volume_count=20)],
+                      coder_name="numpy")
+        port = free_port()
+        store.port = port
+        store.public_url = f"127.0.0.1:{port}"
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=free_port(), pulse_seconds=0.3)
+        # every GET must reach store.read for the delay failpoint to
+        # shape the latency histograms this lane scores
+        vs.read_cache = None
+        vs.start()
+        servers.append(vs)
+    from conftest import wait_cluster_up
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+    yield master, servers, mc
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+def _read_burst(mc, fids, payloads, n: int = 30, conc: int = 2) -> None:
+    errs = [0]
+
+    def worker(seed):
+        rng = random.Random(seed)
+        for _ in range(n):
+            i = rng.randrange(len(fids))
+            try:
+                assert operation.read(mc, fids[i]) == payloads[i]
+            except Exception:  # noqa: BLE001
+                errs[0] += 1
+
+    ts = [threading.Thread(target=worker, args=(100 + s,))
+          for s in range(conc)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert errs[0] == 0, f"read burst saw {errs[0]} errors"
+
+
+def _cycle(master, sleep_s: float = 0.35) -> dict:
+    """One collector cycle + settle gap so consecutive cycles give the
+    windowed rates two distinct points."""
+    master.telemetry.trigger()
+    snap = master.telemetry.snapshot()
+    time.sleep(sleep_s)
+    return snap
+
+
+def test_slo_burns_under_delay_and_recovers(cluster):
+    master, servers, mc = cluster
+    events.JOURNAL.clear()
+    payloads = [b"c%04d-" % i + b"x" * 1500 for i in range(60)]
+    fids = [r.fid for r in operation.submit_batch(mc, payloads,
+                                                  collection="chaostel")]
+
+    # -- healthy baseline: sub-threshold reads, no burn ------------------
+    _read_burst(mc, fids, payloads)
+    _cycle(master)
+    _read_burst(mc, fids, payloads)
+    snap = _cycle(master)
+    assert snap["slo"]["burning"] == [], \
+        f"healthy cluster burning: {snap['slo']}"
+    assert not events.JOURNAL.snapshot(etype="slo.burn")
+
+    # -- fault window: every store read blows the 20 ms objective --------
+    failpoints.configure("store.read", "pct:100:delay:0.05")
+    try:
+        deadline = time.time() + 15
+        burning = []
+        while time.time() < deadline and not burning:
+            _read_burst(mc, fids, payloads, n=15)
+            burning = _cycle(master)["slo"]["burning"]
+        assert burning == ["get-latency"], \
+            f"latency SLO never burned under 50 ms reads: {burning}"
+    finally:
+        failpoints.clear_all()
+
+    burn_evs = events.JOURNAL.snapshot(etype="slo.burn")
+    assert len(burn_evs) == 1
+    attrs = burn_evs[0]["attrs"]
+    assert burn_evs[0]["severity"] == events.WARN
+    assert attrs["slo"] == "get-latency" and attrs["window"] == "fast"
+    assert attrs["long_burn"] >= 5.0 and attrs["short_burn"] >= 5.0
+
+    # the burn reaches the health plane's verdict via extra_items
+    report = master.health.scan()
+    assert report["verdict"] == "DEGRADED", report["items"]
+    slo_items = [it for it in report["items"] if it.get("kind") == "slo"]
+    assert slo_items and slo_items[0]["id"] == "get-latency"
+
+    # -- repair: healthy traffic ages the slow reads out of the windows --
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        _read_burst(mc, fids, payloads, n=15)
+        if _cycle(master)["slo"]["burning"] == []:
+            break
+    else:
+        pytest.fail("SLO never recovered after the fault cleared: "
+                    f"{master.telemetry.snapshot()['slo']}")
+
+    ok_evs = events.JOURNAL.snapshot(etype="slo.ok")
+    assert len(ok_evs) == 1
+    assert ok_evs[0]["attrs"]["slo"] == "get-latency"
+    assert ok_evs[0]["attrs"]["recovered_from"]["window"] == "fast"
+    # journal correlation: burn strictly precedes ok, exactly one edge
+    assert burn_evs[0]["seq"] < ok_evs[0]["seq"]
+    assert master.health.scan()["verdict"] == "OK"
+
+
+def test_stalled_heartbeats_go_stale_then_recover(cluster):
+    master, servers, mc = cluster
+    events.JOURNAL.clear()
+    vol_nodes = {f"volume@127.0.0.1:{vs.port}" for vs in servers}
+    snap = _cycle(master, sleep_s=0.1)
+    states = {t["node"]: t for t in snap["targets"]}
+    assert vol_nodes <= set(states) and \
+        not any(states[n]["stale"] for n in vol_nodes), states
+
+    # stall every heartbeat 3s against a 1s overdue threshold: the
+    # nodes stay registered (HTTP still answers, stream never tears)
+    # but the failure detector flags them, and the collector unions
+    # that view in so their last scrapes stop feeding cluster merges
+    master.health.stale_after_s, saved = 1.0, master.health.stale_after_s
+    failpoints.configure("volume.heartbeat", "pct:100:delay:3")
+    try:
+        time.sleep(1.5)
+        master.health.scan()
+        snap = _cycle(master, sleep_s=0.1)
+        states = {t["node"]: t for t in snap["targets"]}
+        assert all(states[n]["stale"] for n in vol_nodes), states
+        stale_evs = events.JOURNAL.snapshot(etype="telemetry.stale")
+        flagged = {e["attrs"]["node"] for e in stale_evs
+                   if e["severity"] == events.WARN
+                   and "overdue" in e["attrs"]["error"]}
+        assert vol_nodes <= flagged, stale_evs
+    finally:
+        failpoints.clear_all()
+        master.health.stale_after_s = saved
+
+    # resumed heartbeats + a fresh scrape flip the nodes back live
+    from conftest import wait_until
+
+    def recovered():
+        master.health.scan()
+        snap = _cycle(master, sleep_s=0.05)
+        st = {t["node"]: t for t in snap["targets"]}
+        return not any(st[n]["stale"] for n in vol_nodes if n in st)
+
+    wait_until(recovered, timeout=15)
+    live_evs = events.JOURNAL.snapshot(etype="telemetry.live")
+    assert vol_nodes <= {e["attrs"]["node"] for e in live_evs}, live_evs
+
+    # an outright kill tears the heartbeat stream: the master
+    # unregisters the node, so its target disappears from the scrape
+    # set while the survivor (and the master itself) keep serving
+    victim = servers[-1]
+    victim_node = f"volume@127.0.0.1:{victim.port}"
+    victim.stop()
+    wait_until(lambda: victim_node not in
+               {t["node"] for t in _cycle(master, sleep_s=0.1)["targets"]},
+               timeout=10)
+    snap = master.telemetry.snapshot()
+    states = {t["node"]: t for t in snap["targets"]}
+    survivor = f"volume@127.0.0.1:{servers[0].port}"
+    assert survivor in states and not states[survivor]["stale"], states
+    assert snap["merged"], "merge went empty after one node died"

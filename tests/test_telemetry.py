@@ -1,0 +1,654 @@
+"""Fleet telemetry & SLO plane (telemetry/ + stats/parse.py).
+
+Pins the contracts the tentpole rests on: the exposition parser
+round-trips what the registry renders; the ring TSDB's windowed counter
+deltas survive resets and staleness; summed same-boundary buckets ARE
+the pooled histogram (property-tested over random shardings); the
+space-saving sketch honors its guaranteed error bound; burn-rate
+alerting transitions emit slo.burn/slo.ok exactly once per edge and
+feed the health verdict; and the shell's master fetch follows 421
+leader redirects.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import random
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from seaweedfs_tpu.ops import events
+from seaweedfs_tpu.stats.metrics import (Counter, Gauge, Histogram,
+                                         Registry, scrape_payload)
+from seaweedfs_tpu.stats.parse import (ParseError, histogram_series,
+                                       parse_exposition)
+from seaweedfs_tpu.telemetry import (RingTSDB, SpaceSaving,
+                                     TelemetryCollector, merge_buckets,
+                                     parse_slo_policy, quantile)
+from seaweedfs_tpu.telemetry.merge import fraction_at_most, summarize
+from seaweedfs_tpu.telemetry.slo import LATENCY_FAMILY, QOS_FAMILY, SloEngine
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# exposition parser (stats/parse.py)
+# ---------------------------------------------------------------------------
+
+class TestParseRoundTrip:
+    def _registry(self) -> Registry:
+        reg = Registry()
+        c = reg.register(Counter("rt_requests_total", "req help", ("op",)))
+        c.inc("get", amount=3)
+        c.inc("put")
+        g = reg.register(Gauge("rt_depth", "queue depth", ("q",)))
+        g.set("ingest", value=7.5)
+        g.set("with\"quote\nnl\\slash", value=1)
+        h = reg.register(Histogram("rt_lat_seconds", "lat", ("op",),
+                                   buckets=(0.01, 0.1, 1.0)))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe("get", value=v)
+        return reg
+
+    def test_parse_render_equals_registry_state(self):
+        reg = self._registry()
+        fams = parse_exposition(reg.gather())
+        assert fams["rt_requests_total"].kind == "counter"
+        assert fams["rt_requests_total"].help == "req help"
+        vals = {s.label_dict()["op"]: s.value
+                for s in fams["rt_requests_total"].samples}
+        assert vals == {"get": 3.0, "put": 1.0}
+
+        gauge = {s.label_dict()["q"]: s.value
+                 for s in fams["rt_depth"].samples}
+        # label escaping round-trips: \" \n \\ come back verbatim
+        assert gauge == {"ingest": 7.5, "with\"quote\nnl\\slash": 1.0}
+
+        hist = histogram_series(fams["rt_lat_seconds"])
+        ((labels, ent),) = hist.items()
+        assert dict(labels) == {"op": "get"}
+        assert ent["buckets"] == [(0.01, 1.0), (0.1, 2.0), (1.0, 3.0),
+                                  (math.inf, 4.0)]
+        assert ent["count"] == 4.0
+        assert ent["sum"] == pytest.approx(5.555)
+
+    def test_global_scrape_parses_both_dialects(self):
+        # the live registry's own rendering must satisfy the parser —
+        # this is the scraper's actual input format
+        from seaweedfs_tpu.stats import SLO_BURN_RATE
+        SLO_BURN_RATE.set("rt-avail", "w_long", value=1.5)
+        plain, _ = scrape_payload()
+        fams = parse_exposition(plain)
+        sample = next(s for s in fams["SeaweedFS_slo_burn_rate"].samples
+                      if s.label_dict()["slo"] == "rt-avail")
+        assert sample.label_dict()["window"] == "w_long"
+        assert sample.value == 1.5
+        om, ctype = scrape_payload("application/openmetrics-text")
+        assert "openmetrics" in ctype
+        om_fams = parse_exposition(om)
+        assert set(fams) <= set(om_fams) | set(fams)
+
+    @pytest.mark.parametrize("bad", [
+        "no_header_sample 1",
+        "# HELP x h\n# TYPE x gauge\nx{le=} 1",
+        "# HELP x h\n# TYPE x gauge\nx{a=\"1\",a=\"2\"} 1",
+        "# HELP x h\n# TYPE x gauge\nx oops",
+        "# TYPE y gauge\ny 1",
+    ])
+    def test_grammar_violations_raise(self, bad):
+        with pytest.raises(ParseError):
+            parse_exposition(bad)
+
+
+# ---------------------------------------------------------------------------
+# ring TSDB
+# ---------------------------------------------------------------------------
+
+class TestRingTSDB:
+    def test_window_delta_and_counter_reset(self):
+        db = RingTSDB()
+        lb = (("op", "get"),)
+        for ts, v in ((0, 0.0), (10, 10.0), (20, 25.0)):
+            db.add("n1", "c_total", lb, ts, v)
+        assert db.window_delta("n1", "c_total", lb, 30, 20) == 25.0
+        # restart mid-window: 25 -> 3 counts the post-restart growth
+        db.add("n1", "c_total", lb, 30, 3.0)
+        db.add("n1", "c_total", lb, 40, 8.0)
+        assert db.window_delta("n1", "c_total", lb, 50, 40) == 33.0
+        # a window holding one point anchors on the last point before it
+        assert db.window_delta("n1", "c_total", lb, 5, 40) == 5.0
+
+    def test_ring_is_bounded(self):
+        db = RingTSDB(max_points=4)
+        for i in range(20):
+            db.add("n1", "c_total", (), float(i), float(i))
+        assert len(db.series_points("n1", "c_total", ())) == 4
+
+    def test_staleness_gates_merges(self):
+        db = RingTSDB()
+        for node in ("n1", "n2"):
+            db.add(node, "c_total", (), 0, 0.0)
+            db.add(node, "c_total", (), 10, 100.0)
+        assert db.sum_window_delta("c_total", 60, 10) == 200.0
+        db.mark_stale("n2")
+        assert db.sum_window_delta("c_total", 60, 10) == 100.0
+        assert db.sum_window_delta("c_total", 60, 10,
+                                   include_stale=True) == 200.0
+        # a successful ingest clears the mark
+        reg = Registry()
+        reg.register(Counter("c_total", "h")).inc(amount=150)
+        db.ingest("n2", parse_exposition(reg.gather()), 20)
+        assert not db.is_stale("n2")
+
+    def test_label_filter_and_grouping(self):
+        db = RingTSDB()
+        for tenant, v in (("a", 30.0), ("b", 70.0)):
+            lb = (("outcome", "ok"), ("tenant", tenant))
+            db.add("n1", "q_total", lb, 0, 0.0)
+            db.add("n1", "q_total", lb, 10, v)
+        assert db.sum_window_delta("q_total", 60, 10,
+                                   label_filter={"tenant": "a"}) == 30.0
+        assert db.sum_window_delta("q_total", 60, 10,
+                                   label_filter={"tenant": "*"}) == 100.0
+        assert db.grouped_window_delta("q_total", "tenant", 60, 10) == \
+            {"a": 30.0, "b": 70.0}
+
+    def test_histogram_window_merges_nodes_and_labelsets(self):
+        db = RingTSDB()
+        for node in ("n1", "n2"):
+            for le, v in (("0.1", 10.0), ("+Inf", 20.0)):
+                lb = (("le", le), ("type", "get"))
+                db.add(node, "h_seconds_bucket", lb, 0, 0.0)
+                db.add(node, "h_seconds_bucket", lb, 10, v)
+        assert db.histogram_window("h_seconds", 60, 10) == \
+            {0.1: 20.0, math.inf: 40.0}
+        assert db.histogram_window(
+            "h_seconds", 60, 10, label_filter={"type": "put"}) == {}
+
+
+# ---------------------------------------------------------------------------
+# cross-node histogram merge (property test)
+# ---------------------------------------------------------------------------
+
+BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+          1.0, math.inf)
+
+
+def _cum(obs):
+    return [(le, float(sum(1 for o in obs if o <= le))) for le in BOUNDS]
+
+
+class TestHistogramMerge:
+    def test_merged_shards_equal_pooled_histogram(self):
+        """The tentpole's central claim: identical boundaries make the
+        flat bucket sum EXACTLY the pooled histogram, for any sharding
+        of the observations across nodes — and the quantile read off
+        the merge brackets the true empirical quantile's bucket."""
+        rng = random.Random(0xC0FFEE)
+        for _ in range(25):
+            obs = [rng.random() ** 3 for _ in range(rng.randint(1, 400))]
+            n_nodes = rng.randint(1, 6)
+            shards = [[] for _ in range(n_nodes)]
+            for o in obs:
+                shards[rng.randrange(n_nodes)].append(o)
+            merged = merge_buckets([_cum(s) for s in shards])
+            assert merged == _cum(obs)
+            n = len(obs)
+            for q in (0.5, 0.9, 0.99):
+                v = quantile(merged, q)
+                i = bisect.bisect_left(BOUNDS, v)
+                upper = BOUNDS[i]
+                lower = BOUNDS[i - 1] if i else 0.0
+                assert sum(1 for o in obs if o <= upper) >= q * n - 1e-9
+                assert sum(1 for o in obs if o <= lower) <= q * n + 1e-9
+
+    def test_boundary_mismatch_raises(self):
+        with pytest.raises(ValueError, match="boundaries differ"):
+            merge_buckets([[(0.1, 1.0), (math.inf, 2.0)],
+                           [(0.2, 1.0), (math.inf, 2.0)]])
+
+    def test_fraction_at_most(self):
+        b = [(0.1, 10.0), (0.2, 30.0), (math.inf, 40.0)]
+        assert fraction_at_most(b, 0.1) == pytest.approx(0.25)
+        assert fraction_at_most(b, 0.15) == pytest.approx(0.5)
+        # threshold past the finite range: only +Inf growth is "slow"
+        assert fraction_at_most(b, 5.0) == pytest.approx(0.75)
+        assert math.isnan(fraction_at_most([], 0.1))
+
+    def test_summarize_and_quantile_edges(self):
+        assert math.isnan(quantile([], 0.5))
+        assert math.isnan(quantile([(0.1, 0.0), (math.inf, 0.0)], 0.5))
+        # quantile landing in +Inf clamps to the largest finite bound
+        assert quantile([(0.1, 1.0), (math.inf, 10.0)], 0.99) == 0.1
+        s = summarize([(0.1, 10.0), (math.inf, 10.0)], sum_=0.5)
+        assert s["count"] == 10.0
+        assert s["mean"] == pytest.approx(0.05)
+        assert s["p99"] == pytest.approx(0.099)
+
+
+# ---------------------------------------------------------------------------
+# space-saving top-k
+# ---------------------------------------------------------------------------
+
+class TestSpaceSaving:
+    def test_guaranteed_error_bounds(self):
+        """Metwally guarantees: count over-estimates by at most the
+        recorded per-key error, max error <= N/k, and every key with
+        true weight > N/k is tracked — over a random zipfian stream."""
+        rng = random.Random(7)
+        keys = [f"k{i}" for i in range(200)]
+        weights = [1.0 / (i + 1) for i in range(200)]
+        k = 20
+        for _ in range(5):
+            sk = SpaceSaving(capacity=k)
+            true: dict[str, float] = {}
+            stream = rng.choices(keys, weights=weights, k=4000)
+            for key in stream:
+                sk.offer(key)
+                true[key] = true.get(key, 0.0) + 1.0
+            n = sk.total
+            assert n == len(stream)
+            for item in sk.items():
+                t = true.get(item["key"], 0.0)
+                assert t <= item["count"]
+                assert item["count"] - item["error"] <= t
+                assert item["error"] <= n / k + 1e-9
+            tracked = {i["key"] for i in sk.items()}
+            heavy = {key for key, t in true.items() if t > n / k}
+            assert heavy <= tracked
+
+    def test_weighted_offers_and_order(self):
+        sk = SpaceSaving(capacity=2)
+        sk.offer("a", 10.0)
+        sk.offer("b", 1.0)
+        sk.offer("c", 5.0)  # displaces b, inherits its count as error
+        items = sk.items()
+        assert [i["key"] for i in items] == ["a", "c"]
+        assert items[1] == {"key": "c", "count": 6.0, "error": 1.0}
+        assert sk.items(limit=1) == items[:1]
+        sk.clear()
+        assert len(sk) == 0 and sk.total == 0.0
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+def _qos_labels(tenant="t1", class_="interactive", outcome="ok"):
+    return tuple(sorted({"tenant": tenant, "class": class_,
+                         "outcome": outcome}.items()))
+
+
+TIGHT_POLICY = {
+    "slos": [{"name": "avail", "kind": "availability",
+              "objective": 0.99}],
+    "windows": [{"name": "w", "long_s": 60, "short_s": 10, "burn": 2.0}],
+}
+
+
+class TestSloEngine:
+    def test_burn_then_recover_emits_transitions(self):
+        events.JOURNAL.clear()
+        db = RingTSDB(max_points=256)
+        eng = SloEngine(parse_slo_policy(TIGHT_POLICY), db)
+        ok, shed = _qos_labels(), _qos_labels(outcome="shed")
+        db.add("v1", QOS_FAMILY, ok, 0, 0.0)
+        db.add("v1", QOS_FAMILY, shed, 0, 0.0)
+        db.add("v1", QOS_FAMILY, ok, 5, 50.0)
+        db.add("v1", QOS_FAMILY, shed, 5, 50.0)
+
+        out = eng.evaluate(now=5)
+        (st,) = out["status"]
+        # bad fraction 0.5 / budget 0.01 = burn 50 on both windows
+        assert st["burning"] is True
+        assert st["worst_burn"] == pytest.approx(50.0)
+        assert out["burning"] == ["avail"]
+        burns = events.JOURNAL.snapshot(etype="slo.burn")
+        assert len(burns) == 1
+        assert burns[0]["severity"] == events.WARN
+        assert burns[0]["attrs"]["slo"] == "avail"
+        assert burns[0]["attrs"]["window"] == "w"
+        (item,) = eng.health_items()
+        assert item["kind"] == "slo" and item["id"] == "avail"
+        assert item["severity"] == "DEGRADED"
+
+        # still burning: no duplicate edge event
+        eng.evaluate(now=6)
+        assert len(events.JOURNAL.snapshot(etype="slo.burn")) == 1
+
+        # recovery: only healthy growth inside both windows
+        db.add("v1", QOS_FAMILY, ok, 100, 1050.0)
+        db.add("v1", QOS_FAMILY, shed, 100, 50.0)
+        db.add("v1", QOS_FAMILY, ok, 155, 2000.0)
+        db.add("v1", QOS_FAMILY, shed, 155, 50.0)
+        out = eng.evaluate(now=155)
+        assert out["status"][0]["burning"] is False
+        oks = events.JOURNAL.snapshot(etype="slo.ok")
+        assert len(oks) == 1
+        assert oks[0]["attrs"]["recovered_from"]["window"] == "w"
+        assert eng.health_items() == []
+
+    def test_no_traffic_is_burn_zero(self):
+        eng = SloEngine(parse_slo_policy(TIGHT_POLICY), RingTSDB())
+        (st,) = eng.evaluate(now=100)["status"]
+        assert st["burning"] is False
+        assert st["worst_burn"] == 0.0
+
+    def test_latency_slo_scores_merged_buckets(self):
+        db = RingTSDB(max_points=256)
+        eng = SloEngine(parse_slo_policy({
+            "slos": [{"name": "get-lat", "kind": "latency", "verb": "get",
+                      "threshold_s": 0.1, "objective": 0.9}],
+            "windows": [{"name": "w", "long_s": 60, "short_s": 10,
+                         "burn": 2.0}],
+        }), db)
+        for node in ("v1", "v2"):
+            for le, v in (("0.1", 5.0), ("+Inf", 50.0)):
+                lb = (("le", le), ("type", "get"))
+                db.add(node, LATENCY_FAMILY + "_bucket", lb, 0, 0.0)
+                db.add(node, LATENCY_FAMILY + "_bucket", lb, 5, v)
+        (st,) = eng.evaluate(now=5)["status"]
+        # 90% of pooled growth is slower than 0.1s; budget 0.1 -> burn 9
+        assert st["burning"] is True
+        assert st["worst_burn"] == pytest.approx(9.0)
+
+    def test_burn_gauges_published(self):
+        from seaweedfs_tpu.stats import SLO_BURN_RATE
+        db = RingTSDB()
+        eng = SloEngine(parse_slo_policy(TIGHT_POLICY), db)
+        ok, shed = _qos_labels(), _qos_labels(outcome="shed")
+        for lb, v in ((ok, 90.0), (shed, 10.0)):
+            db.add("v1", QOS_FAMILY, lb, 0, 0.0)
+            db.add("v1", QOS_FAMILY, lb, 5, v)
+        eng.evaluate(now=5)
+        assert SLO_BURN_RATE.value("avail", "w_long") == \
+            pytest.approx(10.0)
+        assert SLO_BURN_RATE.value("avail", "w_short") == \
+            pytest.approx(10.0)
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="missing name"):
+            parse_slo_policy({"slos": [{"kind": "availability"}]})
+        with pytest.raises(ValueError, match="needs threshold_s"):
+            parse_slo_policy({"slos": [{"name": "x", "kind": "latency"}]})
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_slo_policy({"slos": [{"name": "x"}, {"name": "x"}]})
+        pol = parse_slo_policy(json.dumps({"slos": [{"name": "x"}]}))
+        assert [w.name for w in pol.windows] == ["fast", "slow"]
+
+
+# ---------------------------------------------------------------------------
+# health plane: extra-items hook
+# ---------------------------------------------------------------------------
+
+class TestHealthExtraItems:
+    def test_burning_slo_degrades_the_verdict(self):
+        from seaweedfs_tpu.master.health import HealthEngine
+        from seaweedfs_tpu.master.topology import Topology
+        eng = HealthEngine(Topology())
+        base = eng.scan()
+        assert base["verdict"] == "OK"
+        eng.extra_items = lambda: [
+            {"kind": "slo", "id": "avail", "severity": "DEGRADED"}]
+        rep = eng.scan()
+        assert rep["verdict"] == "DEGRADED"
+        assert rep["counts"]["DEGRADED"] == \
+            base["counts"]["DEGRADED"] + 1
+        assert any(it.get("kind") == "slo" for it in rep["items"])
+
+    def test_broken_provider_never_breaks_the_scan(self):
+        from seaweedfs_tpu.master.health import HealthEngine
+        from seaweedfs_tpu.master.topology import Topology
+        eng = HealthEngine(Topology())
+        eng.extra_items = lambda: 1 / 0
+        assert eng.scan()["verdict"] == "OK"
+
+
+# ---------------------------------------------------------------------------
+# collector (scrape loop + merge + staleness + hot keys)
+# ---------------------------------------------------------------------------
+
+class _Exposition(BaseHTTPRequestHandler):
+    """Serves its server's mutable `registry` as /metrics."""
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        body = self.server.registry.gather().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        # no keep-alive: the handler thread would outlive shutdown()
+        # and keep answering the client's pooled connection
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+        self.close_connection = True
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve_registry(reg: Registry):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Exposition)
+    srv.registry = reg
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="test-exposition")
+    t.start()
+    return srv, t
+
+
+class TestCollector:
+    def _volume_registry(self):
+        reg = Registry()
+        h = reg.register(Histogram(
+            "SeaweedFS_volumeServer_request_seconds", "h", ("type",)))
+        qos = reg.register(Counter(
+            "SeaweedFS_qos_requests_total", "h",
+            ("tenant", "class", "outcome")))
+        hot = reg.register(Gauge(
+            "SeaweedFS_hot_requests", "h", ("kind", "key")))
+        return reg, h, qos, hot
+
+    def test_scrape_merge_slo_and_staleness(self):
+        events.JOURNAL.clear()
+        reg, h, qos, hot = self._volume_registry()
+        for v in (0.002, 0.004, 0.008):
+            h.observe("get", value=v)
+        hot.set("volume", "7", value=5.0)
+        qos.inc("t1", "interactive", "ok", amount=10)
+        qos.inc("t1", "interactive", "shed", amount=10)
+        srv, thread = _serve_registry(reg)
+        dead_port = free_port()
+
+        local = Registry()
+        local.register(Counter("SeaweedFS_master_ticks_total", "h"))
+
+        targets = [
+            {"node": "volume@live",
+             "url": f"http://127.0.0.1:{srv.server_port}/metrics"},
+            {"node": "volume@dead",
+             "url": f"http://127.0.0.1:{dead_port}/metrics"},
+        ]
+        col = TelemetryCollector(
+            "master@test", lambda: targets,
+            interval_s=-1,  # no background loop; trigger() drives it
+            slo_policy=parse_slo_policy(TIGHT_POLICY),
+            local_scrape=lambda: local.gather(),
+            stale_after=2, scrape_timeout_s=0.5)
+        try:
+            col.trigger()
+            # one failure is not staleness yet (a blip must not flap)
+            states = {t["node"]: t for t in col.target_states()}
+            assert states["volume@dead"]["consecutive_failures"] == 1
+            assert not states["volume@dead"]["stale"]
+
+            qos.inc("t1", "interactive", "ok", amount=50)
+            qos.inc("t1", "interactive", "shed", amount=50)
+            h.observe("get", value=0.05)
+            col.trigger()
+
+            states = {t["node"]: t for t in col.target_states()}
+            assert states["volume@dead"]["stale"]
+            assert not states["volume@live"]["stale"]
+            assert states["master@test"]["url"] == "(local)"
+            stale_evs = events.JOURNAL.snapshot(etype="telemetry.stale")
+            assert any(e["attrs"]["node"] == "volume@dead"
+                       for e in stale_evs)
+
+            merged = col.merged_histograms()
+            fam = merged["SeaweedFS_volumeServer_request_seconds"]
+            assert fam["type=get"]["count"] == 4.0
+            assert fam["type=get"]["p99"] <= 0.1
+
+            # per-node hot gauge deltas landed in the cluster sketch
+            top = col.top_k()
+            assert top["requests"]["volume"][0] == \
+                {"key": "7", "count": 5.0, "error": 0.0}
+
+            # two cycles of 50% shed -> the availability SLO burns,
+            # and the burn reaches the health plane
+            snap = col.snapshot()
+            assert snap["cycles"] == 2
+            assert snap["slo"]["burning"] == ["avail"]
+            (item,) = col.health_items()
+            assert item["id"] == "avail"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            thread.join(timeout=5)
+
+    def test_recovered_target_goes_live_again(self):
+        events.JOURNAL.clear()
+        reg, h, _, _ = self._volume_registry()
+        h.observe("get", value=0.01)
+        srv, thread = _serve_registry(reg)
+        port = srv.server_port
+        url = f"http://127.0.0.1:{port}/metrics"
+        col = TelemetryCollector(
+            "master@test", lambda: [{"node": "volume@a", "url": url}],
+            interval_s=-1, stale_after=1, scrape_timeout_s=0.5)
+        try:
+            col.trigger()
+            assert not col.tsdb.is_stale("volume@a")
+            srv.shutdown()
+            srv.server_close()
+            thread.join(timeout=5)
+            col.trigger()
+            assert col.tsdb.is_stale("volume@a")
+
+            srv2 = ThreadingHTTPServer(("127.0.0.1", port), _Exposition)
+        except OSError:
+            pytest.skip("port reuse raced")  # extremely rare rebind loss
+        srv2.registry = reg
+        t2 = threading.Thread(target=srv2.serve_forever, daemon=True)
+        t2.start()
+        try:
+            col.trigger()
+            assert not col.tsdb.is_stale("volume@a")
+            lives = events.JOURNAL.snapshot(etype="telemetry.live")
+            assert any(e["attrs"]["node"] == "volume@a" for e in lives)
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
+            t2.join(timeout=5)
+
+    def test_health_stale_feed_unions_in(self):
+        col = TelemetryCollector(
+            "m", lambda: [], interval_s=-1,
+            local_scrape=lambda: "",
+            health_stale_fn=lambda: ["volume@overdue"])
+        col.trigger()
+        assert col.tsdb.is_stale("volume@overdue")
+
+
+# ---------------------------------------------------------------------------
+# shell fetch: 421 leader-redirect following
+# ---------------------------------------------------------------------------
+
+class _MasterStub(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        status, doc = self.server.answer
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def _stub(answer):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _MasterStub)
+    srv.answer = answer
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t
+
+
+class TestFetch421Following:
+    def test_follows_follower_hint_to_leader(self):
+        from seaweedfs_tpu.shell.health_util import fetch_master_json
+        leader, lt = _stub((200, {"who": "leader", "cycles": 3}))
+        follower, ft = _stub((421, {
+            "error": "not the leader",
+            "leader_http": f"127.0.0.1:{leader.server_port}"}))
+        try:
+            doc = fetch_master_json(
+                f"127.0.0.1:{follower.server_port}", "/cluster/telemetry")
+            assert doc == {"who": "leader", "cycles": 3}
+        finally:
+            for srv, t in ((leader, lt), (follower, ft)):
+                srv.shutdown()
+                srv.server_close()
+                t.join(timeout=5)
+
+    def test_hintless_follower_and_hop_loop_raise(self):
+        from seaweedfs_tpu.shell.health_util import fetch_master_json
+        hintless, ht = _stub((421, {"error": "no leader elected"}))
+        try:
+            with pytest.raises(RuntimeError, match="no leader elected"):
+                fetch_master_json(
+                    f"127.0.0.1:{hintless.server_port}", "/x")
+        finally:
+            hintless.shutdown()
+            hintless.server_close()
+            ht.join(timeout=5)
+
+        loop, lt = _stub((421, {"error": "still follower"}))
+        loop.answer = (421, {
+            "error": "still follower",
+            "leader_http": f"127.0.0.1:{loop.server_port}"})
+        try:
+            with pytest.raises(RuntimeError, match="no leader answered"):
+                fetch_master_json(f"127.0.0.1:{loop.server_port}", "/x",
+                                  max_hops=2)
+        finally:
+            loop.shutdown()
+            loop.server_close()
+            lt.join(timeout=5)
+
+    def test_non_json_and_error_statuses_raise(self):
+        from seaweedfs_tpu.shell.health_util import fetch_master_json
+        err, et = _stub((500, {"error": "boom"}))
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                fetch_master_json(f"127.0.0.1:{err.server_port}", "/x")
+        finally:
+            err.shutdown()
+            err.server_close()
+            et.join(timeout=5)
