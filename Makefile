@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos chaos-ha race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-ha bench-telemetry smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos chaos-ha race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-ha bench-telemetry bench-profile smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-telemetry
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-telemetry bench-profile
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -150,6 +150,19 @@ bench-ha:
 # protocol-ceiling teardown
 bench-telemetry:
 	JAX_PLATFORMS=cpu python bench.py --telemetry-only
+
+# continuous-profiling plane gate: on a separate-process master +
+# volume server with a deterministic 10 ms store.read delay, the
+# always-on sampler must cost <= 2% read RPS (hz=0/19/0 A/B/A via the
+# /debug/profile?hz= runtime retune), the new queue_wait stage plus
+# recv_parse must re-add to the pre-split recv_parse proxy within 10%
+# (stage-sum minus e2e-sum — no time lost or double-counted by the
+# split), live ?mode=continuous output must parse as collapsed
+# `stack count` lines with event_loop attribution, and /debug/flight
+# must hold slowest-request entries whose trace ids resolve in
+# /debug/traces
+bench-profile:
+	JAX_PLATFORMS=cpu python bench.py --profile-only
 
 smoke:
 	python bench.py --smoke

@@ -1590,6 +1590,177 @@ def bench_telemetry_smoke(out: dict) -> None:
         _stop_procs_cluster(procs, tmp)
 
 
+def bench_profile_smoke(out: dict) -> None:
+    """`make bench-profile`: the continuous-profiling plane's cost and
+    fidelity gates on a separate-process master + volume topology:
+
+    * sampler overhead <= 2% on delay-dominated read RPS, measured by
+      hot-retuning the SAME volume server between hz=0 and hz=19 via
+      /debug/profile?hz=N (a 10 ms store.read failpoint pins per-read
+      cost, so the only thing that can move throughput is the sampler);
+    * the 5-stage split stays honest: recv_parse + queue_wait must equal
+      the pre-split recv_parse proxy (stage-sum minus e2e-sum, i.e.
+      t0 - t_recv summed) within 10% — the queue_wait stage
+      de-confounded the ROADMAP's 286 us recv_parse number without
+      losing or double-counting any time;
+    * live ?mode=continuous output parses as collapsed-flamegraph
+      `stack count` lines and attributes samples to the event_loop
+      thread class;
+    * /debug/flight on the loaded server returns slowest-request
+      entries with populated stage timelines whose trace ids resolve
+      in /debug/traces.
+    """
+    import threading
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.stats.parse import histogram_series, parse_exposition
+
+    procs, tmp, mport, mhttp, vport = _spawn_procs_cluster(
+        "swtpu_bench_profile_", volume_size_mb=64, vol_max=16,
+        # no read cache: every GET pays the store.read delay, so the
+        # overhead phases measure the sampler, not cache luck
+        extra_env={"SWTPU_READ_CACHE_MB": "0"})
+    try:
+        mc = MasterClient(f"127.0.0.1:{mport}",
+                          http_address=f"127.0.0.1:{mhttp}").start()
+        mc.wait_connected()
+        n_files, conc = 200, 4
+        payloads = [b"p%05d-" % i + b"x" * 2000 for i in range(n_files)]
+        fids = [r.fid for r in operation.submit_batch(
+            mc, payloads, collection="benchprof")]
+
+        errors = [0]
+
+        def read_phase(per_thread: int) -> float:
+            def worker(seed):
+                rng = random.Random(seed)
+                for _ in range(per_thread):
+                    i = rng.randrange(n_files)
+                    try:
+                        assert operation.read(mc, fids[i]) == payloads[i]
+                    except Exception:  # noqa: BLE001
+                        errors[0] += 1
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=worker, args=(9000 + s,))
+                  for s in range(conc)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return per_thread * conc / (time.perf_counter() - t0)
+
+        def set_hz(hz: float) -> None:
+            # the runtime retune knob: same cluster, A/B/A phases
+            r = http_util.get(f"http://127.0.0.1:{vport}/debug/profile",
+                              params={"hz": str(hz)}, timeout=5)
+            assert r.ok, f"hz retune failed: HTTP {r.status}"
+            assert abs(r.json()["hz"] - hz) < 1e-9, r.json()
+
+        # deterministic slow disk: every GET costs 10 ms in store.read
+        http_util.get(f"http://127.0.0.1:{vport}/debug/failpoints",
+                      params={"name": "store.read",
+                              "spec": "pct:100:delay:0.01"})
+
+        # -- overhead gate: A/B/A on one server, sampler off/on/off ----
+        per_thread = 200
+        read_phase(40)  # warm connections + fill the fid lookup cache
+        set_hz(0)
+        rps_off1 = read_phase(per_thread)
+        set_hz(19)
+        rps_on = read_phase(per_thread)
+
+        # -- live collapsed output while the sampler is hot ------------
+        txt = http_util.get(
+            f"http://127.0.0.1:{vport}/debug/profile",
+            params={"mode": "continuous"}, timeout=5).content.decode()
+        lines = [ln for ln in txt.splitlines()
+                 if ln and not ln.startswith("#")]
+        assert lines, "continuous profile had no stacks under load"
+        for ln in lines:
+            stack, _, cnt = ln.rpartition(" ")
+            assert stack and cnt.isdigit(), f"unparseable line {ln!r}"
+            assert stack.count(";") >= 2, f"no class;state prefix: {ln!r}"
+        assert any(ln.startswith("event_loop;") for ln in lines), \
+            "no samples attributed to the event_loop thread class"
+        summary = http_util.get(
+            f"http://127.0.0.1:{vport}/debug/profile",
+            params={"mode": "summary"}, timeout=5).json()
+        assert summary["samples"] > 0, summary
+        out["profile_samples"] = summary["samples"]
+        out["profile_classes"] = sorted(summary["classes"])
+
+        set_hz(0)
+        rps_off2 = read_phase(per_thread)
+        assert errors[0] == 0, f"profile smoke saw {errors[0]} errors"
+        base = (rps_off1 + rps_off2) / 2
+        overhead = 1.0 - rps_on / base
+        out["profile_off_rps"] = round(base, 1)
+        out["profile_on_rps"] = round(rps_on, 1)
+        out["profile_overhead_pct"] = round(overhead * 100, 2)
+        log(f"sampler overhead: {base:.0f} (hz=0) -> {rps_on:.0f} "
+            f"(hz=19) req/s ({overhead * 100:+.1f}%)")
+        assert overhead <= 0.02, \
+            f"sampler overhead {overhead * 100:.1f}% > 2% gate"
+
+        # -- split-honesty gate: recv_parse + queue_wait == old proxy --
+        text = http_util.get(f"http://127.0.0.1:{vport}/metrics",
+                             timeout=5).content.decode()
+        fams = parse_exposition(text)
+        stages: dict = {}
+        counts = 0.0
+        for labels, ent in histogram_series(
+                fams["SeaweedFS_volumeServer_stage_seconds"]).items():
+            ld = dict(labels)
+            if ld.get("type") != "get":
+                continue
+            stages[ld["stage"]] = ent["sum"] or 0.0
+            counts = max(counts, ent["count"] or 0.0)
+        e2e_sum = 0.0
+        for labels, ent in histogram_series(
+                fams["SeaweedFS_volumeServer_request_seconds"]).items():
+            if dict(labels).get("type") == "get":
+                e2e_sum = ent["sum"] or 0.0
+        assert {"recv_parse", "queue_wait"} <= set(stages), stages
+        split = stages["recv_parse"] + stages["queue_wait"]
+        # stage sums cover t_recv..t_end, the e2e histogram t0..t_end:
+        # their difference is exactly the pre-split recv_parse (wire
+        # arrival to handler entry), the confounded number the split
+        # replaced — the two new stages must re-add to it
+        proxy = sum(stages.values()) - e2e_sum
+        rel = abs(split - proxy) / max(proxy, 1e-9)
+        out["split_recv_parse_us"] = round(
+            stages["recv_parse"] / max(counts, 1.0) * 1e6, 1)
+        out["split_queue_wait_us"] = round(
+            stages["queue_wait"] / max(counts, 1.0) * 1e6, 1)
+        out["split_vs_proxy_pct"] = round(rel * 100, 2)
+        log(f"stage split: recv_parse {out['split_recv_parse_us']} us + "
+            f"queue_wait {out['split_queue_wait_us']} us vs pre-split "
+            f"proxy ({rel * 100:.1f}% apart)")
+        assert rel <= 0.10, \
+            f"recv_parse+queue_wait {rel * 100:.1f}% from proxy (gate 10%)"
+
+        # -- flight recorder: slowest requests, trace-resolvable -------
+        fl = http_util.get(f"http://127.0.0.1:{vport}/debug/flight",
+                           params={"min_ms": "5"}, timeout=5).json()
+        entries = fl["entries"]
+        assert entries, "flight ring empty under 10 ms-delayed reads"
+        ent = entries[0]
+        assert ent["duration_ms"] >= 5.0, ent
+        assert ent["stages_ms"].get("store", 0) > 0, ent["stages_ms"]
+        assert ent["trace_id"], "flight entry lost its trace id"
+        tr = http_util.get(f"http://127.0.0.1:{vport}/debug/traces",
+                           params={"trace_id": ent["trace_id"]},
+                           timeout=5).json()
+        assert tr["count"] >= 1, \
+            f"trace {ent['trace_id']} not resolvable in /debug/traces"
+        out["flight_recorded"] = fl["recorded"]
+        mc.stop()
+        out["bench_profile_smoke"] = "ok"
+    finally:
+        _stop_procs_cluster(procs, tmp)
+
+
 _QOS_BENCH_POLICY = {
     # victim: unthrottled, heavy WFQ weight — its latency is the gate
     # antag: tight rate + byte buckets (its bulk frames are 64 KB
@@ -3214,6 +3385,14 @@ def main() -> None:
                          "10% of a direct 2-node merge, stage "
                          "histograms >= 90% of e2e GET time, live "
                          "scrapes lint-clean")
+    ap.add_argument("--profile-only", action="store_true",
+                    dest="profile_only",
+                    help="run only the continuous-profiling smoke (make "
+                         "bench-profile): separate-process master + "
+                         "volume; sampler overhead <= 2% via hz=0/19/0 "
+                         "A/B/A, recv_parse+queue_wait within 10% of "
+                         "the pre-split proxy, live collapsed output "
+                         "parses, /debug/flight trace-resolvable")
     ap.add_argument("--repeats", type=int, default=0)
     ap.add_argument("--e2e-vols", type=int, default=0)
     ap.add_argument("--e2e-mb", type=int, default=0)
@@ -3281,6 +3460,12 @@ def main() -> None:
         out_tm: dict = {"metric": "bench_telemetry_smoke"}
         bench_telemetry_smoke(out_tm)
         print(json.dumps(out_tm))
+        return
+    if args.profile_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_pf: dict = {"metric": "bench_profile_smoke"}
+        bench_profile_smoke(out_pf)
+        print(json.dumps(out_pf))
         return
     smoke = args.smoke
     repeats = args.repeats or (3 if smoke else 5)

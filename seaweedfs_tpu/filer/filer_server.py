@@ -121,6 +121,9 @@ class FilerServer:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "FilerServer":
+        from ..profiling import LoopLagMonitor, acquire_sampler
+        self._sampler = acquire_sampler()
+        self._loop_lag = LoopLagMonitor("filer")
         self.mc.start()
         self.mc.wait_connected(10)
         from . import filer_conf
@@ -164,6 +167,13 @@ class FilerServer:
         self._stream_pool.shutdown(wait=False, cancel_futures=True)
         self.mc.stop()
         self.filer.close()
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.close()
+        if getattr(self, "_sampler", None) is not None:
+            from ..profiling import release_sampler
+            release_sampler()
+            self._sampler = None
 
     def _delete_chunks(self, fids: list[str]) -> None:
         def work():
@@ -476,8 +486,10 @@ class FilerServer:
         from .. import tracing
 
         async def handle(request: web.Request):
+            import time as _time
             kind = request.method.lower()
             resp = None
+            t0 = _time.perf_counter()
             # server span continues the caller's trace; the blob-IO
             # child spans (filer.blob.write/read) land under it even
             # through asyncio.to_thread (contextvars propagate there)
@@ -511,6 +523,13 @@ class FilerServer:
                         resp = web.json_response({"error": str(e)},
                                                  status=500)
                 sp.set_attr("status", resp.status)
+                # slow/errored requests land in the flight ring (no
+                # stage split here — the filer's envelope is one stage)
+                from ..profiling import record_flight
+                record_flight(f"filer.{kind}",
+                              _time.perf_counter() - t0,
+                              status=resp.status, path=request.path,
+                              node=self.url)
             FILER_REQUEST_COUNTER.inc(kind)
             return resp
 
@@ -568,18 +587,30 @@ class FilerServer:
                 locktrack.debug_locks_payload(dict(request.query)))
 
         async def debug_profile(request):
-            # pprof-style sampler (utils/profiling.py) — previously only
-            # master/volume exposed it; sampling runs off the event loop
-            # so an N-second capture can't stall filer IO
+            # shared /debug/profile contract (profiling package):
+            # validated/clamped seconds, continuous/summary modes, hz
+            # retune; capture runs off the event loop so an N-second
+            # capture can't stall filer IO. The filer has no guard
+            # plane — its gate is the method check all four daemons
+            # share (it serves no tenant-credential surface to reuse).
             if request.method != "GET":
                 return web.json_response({"error": "method not allowed"},
                                          status=405)
             import asyncio as _asyncio
 
-            from ..utils import profiling
-            secs = float(request.query.get("seconds", "5"))
-            text = await _asyncio.to_thread(profiling.cpu_profile, secs)
-            return web.Response(text=text, content_type="text/plain")
+            from .. import profiling as prof
+            code, ctype, body = await _asyncio.to_thread(
+                prof.handle_profile_query, dict(request.query))
+            return web.Response(text=body, status=code,
+                                content_type=ctype.split(";")[0])
+
+        async def debug_flight(request):
+            if request.method != "GET":
+                return web.json_response({"error": "method not allowed"},
+                                         status=405)
+            from .. import profiling as prof
+            code, payload = prof.debug_flight_payload(dict(request.query))
+            return web.json_response(payload, status=code)
 
         def routes(app):
             app.router.add_get("/__status__", status)
@@ -593,11 +624,14 @@ class FilerServer:
             app.router.add_route("*", "/debug/events", debug_events)
             app.router.add_route("*", "/debug/locks", debug_locks)
             app.router.add_route("*", "/debug/profile", debug_profile)
+            app.router.add_route("*", "/debug/flight", debug_flight)
             app.router.add_route("*", "/{path:.*}", handle)
 
         from ..utils.webapp import serve_web_app
         serve_web_app(routes, self.ip, self.port, self._stop,
-                      ready=self._http_ready)
+                      ready=self._http_ready,
+                      on_loop=getattr(self, "_loop_lag", None)
+                      and self._loop_lag.attach)
 
     @staticmethod
     def _req_path(request) -> str:

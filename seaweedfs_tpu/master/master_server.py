@@ -91,6 +91,11 @@ class MasterServer:
         self._grpc = None
         self._http = None
         self._http_stop = None
+        # profiling plane: loop-lag probe on the fastweb HTTP loop +
+        # the process-shared continuous sampler (start()/stop())
+        from ..profiling import LoopLagMonitor
+        self._loop_lag = LoopLagMonitor("master")
+        self._sampler = None
         self._stop = threading.Event()
         # optional push-gateway loop; started in start(), joined in stop()
         self.metrics_gateway = metrics_gateway
@@ -329,6 +334,8 @@ class MasterServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        from ..profiling import acquire_sampler
+        self._sampler = acquire_sampler()
         svc = self._build_service()
         services = [svc]
         if len(self.peers) > 1:
@@ -380,6 +387,11 @@ class MasterServer:
             self._grpc.stop(grace=0.5)
         if self._http_stop is not None:
             self._http_stop.set()
+        self._loop_lag.close()
+        if getattr(self, "_sampler", None) is not None:
+            from ..profiling import release_sampler
+            release_sampler()
+            self._sampler = None
 
     def _start_http(self) -> None:
         """Status/metrics HTTP API (reference master_server_handlers.go:
@@ -474,7 +486,11 @@ class MasterServer:
                 top = int(q.get("top", "10") or 10)
             except ValueError:
                 top = 10
-            return json_response(ms.telemetry.snapshot(top_limit=top))
+            # ?profile=1 folds the fleet-merged flamegraph into the
+            # snapshot (cluster.profile's fetch); off by default — the
+            # folded stacks dwarf the rest of the payload
+            return json_response(ms.telemetry.snapshot(
+                top_limit=top, include_profile=bool(q.get("profile"))))
 
         def dir_status(req, q):
             # leader_address, not ms.address: a follower answering here
@@ -655,10 +671,23 @@ class MasterServer:
 
         def debug_profile(req, q):
             # pprof-style CPU profile trigger (reference exposes
-            # net/http/pprof on -debug.port, command/imports.go:4)
-            from ..utils import profiling
-            return fastweb.text_response(
-                profiling.cpu_profile(float(q.get("seconds", "5"))))
+            # net/http/pprof on -debug.port, command/imports.go:4);
+            # shared implementation (profiling.handle_profile_query):
+            # seconds validation/clamp, continuous/summary modes, hz
+            # retune — all four daemons serve the identical contract
+            from .. import profiling as prof
+            code, ctype, body = prof.handle_profile_query(q)
+            return fastweb.Response(body.encode(), status=code,
+                                    content_type=ctype)
+
+        def debug_flight(req, q):
+            # slowest/errored request ring (profiling/flight.py) —
+            # mostly volume-server entries in real deployments, but the
+            # endpoint exists on every daemon so the operator never
+            # guesses which port carries it
+            from .. import profiling as prof
+            code, payload = prof.debug_flight_payload(q)
+            return json_response(payload, status=code)
 
         def debug_locks(req, q):
             # lock-order cycles + long holds from the SWTPU_LOCKCHECK=1
@@ -698,6 +727,10 @@ class MasterServer:
         app.route("/", offloaded(guarded("/", ui)))
         app.route("/debug/profile",
                   offloaded(guarded("/debug/profile", debug_profile)))
+        # guarded like /debug/profile (flight entries carry fids, paths
+        # and admit-time queue state)
+        app.route("/debug/flight",
+                  offloaded(guarded("/debug/flight", debug_flight)))
         # guarded like /debug/profile (spans carry fids and peer
         # addresses) and offloaded: snapshotting + serializing thousands
         # of spans must not head-of-line-block inline assigns
@@ -723,7 +756,8 @@ class MasterServer:
         threading.Thread(
             target=fastweb.serve_fast_app,
             args=(app, self.ip, self.http_port, self._http_stop),
-            kwargs={"logger": log}, daemon=True,
+            kwargs={"logger": log, "on_loop": self._loop_lag.attach},
+            daemon=True,
             name="master-http").start()
         log.info("master http api on %s:%d", self.ip, self.http_port)
 

@@ -107,6 +107,9 @@ class S3Gateway:
         return f"{self.ip}:{self.port}"
 
     def start(self) -> "S3Gateway":
+        from ..profiling import LoopLagMonitor, acquire_sampler
+        self._sampler = acquire_sampler()
+        self._loop_lag = LoopLagMonitor("s3")
         self._http_thread = threading.Thread(target=self._run_http, daemon=True,
                                              name=f"s3-http-{self.port}")
         self._http_ready = threading.Event()
@@ -119,6 +122,13 @@ class S3Gateway:
     def stop(self) -> None:
         self._stop.set()
         self.qos.close()
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.close()
+        if getattr(self, "_sampler", None) is not None:
+            from ..profiling import release_sampler
+            release_sampler()
+            self._sampler = None
 
     # -- HTTP plumbing -------------------------------------------------------
     def _run_http(self) -> None:
@@ -130,8 +140,10 @@ class S3Gateway:
         from ..stats import S3_REQUEST_COUNTER, S3_REQUEST_SECONDS
 
         async def dispatch(request: web.Request):
+            import time as _time
             kind = request.method.lower()
             resp = None
+            t0 = _time.perf_counter()
             # server span continues the caller's trace; the in-process
             # filer + blob-IO child spans land under it
             with tracing.start_span(
@@ -157,6 +169,12 @@ class S3Gateway:
                             S3Error("InternalError", str(e), 500),
                             request.path)
                 sp.set_attr("status", resp.status)
+                # slow/errored requests land in the flight ring (single
+                # stage — the S3 envelope has no wire-level split)
+                from ..profiling import record_flight
+                record_flight(f"s3.{kind}", _time.perf_counter() - t0,
+                              status=resp.status, path=request.path,
+                              node=self.url)
             # Label by bucket only for successful requests — failed probes
             # (scanners, typos) would otherwise mint unbounded label sets.
             bucket = (request.path.lstrip("/").split("/", 1)[0]
@@ -227,19 +245,29 @@ class S3Gateway:
             return web.json_response(self.qos.debug_payload())
 
         async def debug_profile(request):
-            # pprof-style sampler (utils/profiling.py), operator-gated
-            # like /debug/traces (stacks leak paths and peer addresses);
-            # sampling runs off the event loop so a capture can't stall
-            # tenant traffic
+            # shared /debug/profile contract (profiling package):
+            # validated/clamped seconds, continuous/summary modes, hz
+            # retune — operator-gated like /debug/traces (stacks leak
+            # paths and peer addresses); capture runs off the event
+            # loop so it can't stall tenant traffic
             denied = _operator_gate(request)
             if denied is not None:
                 return denied
             import asyncio as _asyncio
 
-            from ..utils import profiling
-            secs = float(request.query.get("seconds", "5"))
-            text = await _asyncio.to_thread(profiling.cpu_profile, secs)
-            return web.Response(text=text, content_type="text/plain")
+            from .. import profiling as prof
+            code, ctype, body = await _asyncio.to_thread(
+                prof.handle_profile_query, dict(request.query))
+            return web.Response(text=body, status=code,
+                                content_type=ctype.split(";")[0])
+
+        async def debug_flight(request):
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            from .. import profiling as prof
+            code, payload = prof.debug_flight_payload(dict(request.query))
+            return web.json_response(payload, status=code)
 
         async def metrics(request):
             denied = _operator_gate(request)
@@ -258,6 +286,7 @@ class S3Gateway:
             app.router.add_route("*", "/debug/locks", debug_locks)
             app.router.add_route("*", "/debug/qos", debug_qos)
             app.router.add_route("*", "/debug/profile", debug_profile)
+            app.router.add_route("*", "/debug/flight", debug_flight)
             app.router.add_route("*", "/metrics", metrics)
             # alias matching the filer's reserved-namespace spelling so
             # the fleet telemetry collector can scrape either daemon
@@ -267,7 +296,9 @@ class S3Gateway:
 
         from ..utils.webapp import serve_web_app
         serve_web_app(routes, self.ip, self.port, self._stop,
-                      ready=getattr(self, "_http_ready", None))
+                      ready=getattr(self, "_http_ready", None),
+                      on_loop=getattr(self, "_loop_lag", None)
+                      and self._loop_lag.attach)
 
     # CORS (reference s3api_server.go cors.AllowAll-style middleware)
     def _cors_preflight(self, request):

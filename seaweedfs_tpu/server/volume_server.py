@@ -32,26 +32,35 @@ from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 log = logger("volume")
 
 
-def _observe_stages(kind: str, t_recv: float, t0: float, t_admit,
-                    t_done, t_end: float) -> None:
+def _observe_stages(kind: str, t_recv: float, t_parsed: float, t0: float,
+                    t_admit, t_done, t_end: float) -> dict:
     """Per-stage timing for the protocol-ceiling teardown (BENCH_r05:
     93-139 us of protocol per hop): contiguous perf_counter segments
-    recv/parse (first wire byte -> handler entry, includes queue wait),
+    recv/parse (first wire byte -> request parsed), queue_wait (parsed
+    -> handler entry: drain-queue + event-loop queueing, the split that
+    de-confounds the old queueing-inflated recv_parse number),
     auth/admit (QoS admission), store (the storage handler itself, jwt
     check included) and serialize/flush (response build + accounting).
-    The four sums cover the full wire-to-wire interval, so per-type
+    The five sums cover the full wire-to-wire interval, so per-type
     stage totals account for >= 100% of VOLUME_REQUEST_SECONDS.
     t_admit/t_done may be None on shed/error paths (stage collapses to
-    zero and the tail lands in serialize_flush)."""
+    zero and the tail lands in serialize_flush). Returns the stage dict
+    so the flight recorder can reuse it without re-deriving."""
     from ..stats import VOLUME_STAGE_SECONDS
     a = t_admit if t_admit is not None else t0
     d = t_done if t_done is not None else a
-    VOLUME_STAGE_SECONDS.observe(kind, "recv_parse",
-                                 value=max(0.0, t0 - (t_recv or t0)))
-    VOLUME_STAGE_SECONDS.observe(kind, "auth_admit", value=max(0.0, a - t0))
-    VOLUME_STAGE_SECONDS.observe(kind, "store", value=max(0.0, d - a))
-    VOLUME_STAGE_SECONDS.observe(kind, "serialize_flush",
-                                 value=max(0.0, t_end - d))
+    r = t_recv or t_parsed or t0
+    p = t_parsed or r
+    stages = {
+        "recv_parse": max(0.0, p - r),
+        "queue_wait": max(0.0, t0 - p),
+        "auth_admit": max(0.0, a - t0),
+        "store": max(0.0, d - a),
+        "serialize_flush": max(0.0, t_end - d),
+    }
+    for stage, v in stages.items():
+        VOLUME_STAGE_SECONDS.observe(kind, stage, value=v)
+    return stages
 
 
 def _vid_of_path(path: str) -> "str | None":
@@ -147,9 +156,10 @@ class VolumeServer:
         self._ec_loc_lock = threading.Lock()
         # replica-set cache for the write fan-out (see _lookup_replicas_cached)
         self._replica_cache: dict[int, tuple[float, list[str]]] = {}
-        from concurrent.futures import ThreadPoolExecutor
-        self._ec_read_pool = ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="ec-degraded-read")
+        from ..profiling import LoopLagMonitor, MonitoredPool
+        self._ec_read_pool = MonitoredPool(
+            "ec_read", max_workers=16,
+            thread_name_prefix="ec-degraded-read")
         # read-path data plane: the hot-needle cache (segmented LRU,
         # storage/read_cache.py; SWTPU_READ_CACHE_MB=0 disables) and the
         # pool GET/bulk-GET storage reads run on. With the seqlock read
@@ -162,9 +172,14 @@ class VolumeServer:
         # server can only attest "quiet for <= uptime" — the planner
         # uses it as the ceiling for volumes with no recorded read
         self._started_mono = time.monotonic()
-        self._read_pool = ThreadPoolExecutor(
-            max_workers=max(1, env_int("SWTPU_READ_THREADS", 8)),
+        self._read_pool = MonitoredPool(
+            "read", max_workers=max(1, env_int("SWTPU_READ_THREADS", 8)),
             thread_name_prefix=f"vs-read-{port}")
+        # profiling plane: loop-lag probe (installed on the HTTP loop by
+        # serve_fast_app's on_loop hook) + the process-shared continuous
+        # sampler (acquired in start(), released in stop())
+        self._loop_lag = LoopLagMonitor("volume")
+        self._sampler = None
         # multi-tenant QoS plane (qos/): tenant = collection, classes
         # interactive (GET) > ingest (PUT/DELETE) > maintenance (tagged
         # repair/rebuild/copy traffic). A dict is a policy document; a
@@ -184,6 +199,8 @@ class VolumeServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        from ..profiling import acquire_sampler
+        self._sampler = acquire_sampler()
         key = self.guard.signing_key if self.guard is not None else ""
         if key:
             from ..utils.rpc import set_cluster_key
@@ -227,6 +244,11 @@ class VolumeServer:
             self._grpc.stop(grace=0.5)
         self._ec_read_pool.shutdown(wait=False, cancel_futures=True)
         self._read_pool.shutdown(wait=False, cancel_futures=True)
+        self._loop_lag.close()
+        if self._sampler is not None:
+            from ..profiling import release_sampler
+            release_sampler()
+            self._sampler = None
         self.qos.close()
         if self.read_cache is not None:
             self.read_cache.clear()
@@ -393,6 +415,24 @@ class VolumeServer:
         return True
 
     # -- HTTP data path (utils/fastweb hand-rolled HTTP/1.1) ----------------
+    def _flight_record(self, kind: str, request, status: int,
+                       stages: dict, sp, t_wire: float,
+                       t_end: float) -> None:
+        """Offer a finished request to the flight recorder with the
+        at-admit context (loop lag, pool queue depths) a postmortem
+        needs to tell 'this request was slow' from 'the node was
+        drowning'. Below-threshold requests cost two float compares."""
+        from ..profiling import record_flight
+        record_flight(
+            kind, t_end - t_wire, status=status, path=request.path,
+            stages=stages,
+            qos_class=str(sp.attrs.get("qos_class", "")),
+            cache=sp.attrs.get("cache"),
+            loop_lag_s=self._loop_lag.last_lag_s,
+            queue_depths={"read": self._read_pool.queued(),
+                          "ec_read": self._ec_read_pool.queued()},
+            node=self.url)
+
     def _run_http(self) -> None:
         import asyncio
 
@@ -491,8 +531,12 @@ class VolumeServer:
                     t_end = time.perf_counter()
                     VOLUME_REQUEST_COUNTER.inc(kind, str(status))
                     VOLUME_REQUEST_SECONDS.observe(kind, value=t_end - t0)
-                    _observe_stages(kind, request.t_recv, t0, t_admit,
-                                    t_done, t_end)
+                    stages = _observe_stages(kind, request.t_recv,
+                                             request.t_parsed, t0,
+                                             t_admit, t_done, t_end)
+                    self._flight_record(f"volume.{kind}", request, status,
+                                        stages, sp,
+                                        request.t_recv or t0, t_end)
                     # heavy hitters: bytes moved = payload in + body out
                     hot_record(
                         volume=_vid_of_path(request.path),
@@ -580,16 +624,45 @@ class VolumeServer:
                                       "destroy_time": at})
             return json_response(self._lifecycle_payload())
 
+        def _operator_gate(request):
+            """Same gate policy as the master's guarded() debug routes:
+            stacks/flight entries leak fids, paths and peer addresses,
+            so the IP whitelist applies (this route shipped unguarded
+            while master/S3 gated theirs — all four daemons now gate
+            identically). Returns an error response, or None."""
+            if request.method != "GET":
+                return json_response({"error": "method not allowed"},
+                                     status=405)
+            if self.guard is not None:
+                ok, why = self.guard.check_ip(request.remote or "")
+                if not ok:
+                    return json_response({"error": why}, status=401)
+            return None
+
         async def debug_profile(request):
             import contextvars
 
-            from ..utils import profiling
-            secs = float(request.query.get("seconds", "5"))
+            from .. import profiling as prof
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            # shared contract (profiling.handle_profile_query): seconds
+            # validation/clamp, continuous/summary modes, hz retune;
+            # offloaded — a capture blocks for `seconds`
             loop = asyncio.get_running_loop()
             ctx = contextvars.copy_context()  # keep the trace span
-            text = await loop.run_in_executor(
-                None, ctx.run, profiling.cpu_profile, secs)
-            return fastweb.text_response(text)
+            code, ctype, body = await loop.run_in_executor(
+                None, ctx.run, prof.handle_profile_query, request.query)
+            return fastweb.Response(body.encode(), status=code,
+                                    content_type=ctype)
+
+        def debug_flight(request):
+            from .. import profiling as prof
+            denied = _operator_gate(request)
+            if denied is not None:
+                return denied
+            code, payload = prof.debug_flight_payload(request.query)
+            return json_response(payload, status=code)
 
         def debug_jax_profiler(request):
             from ..utils import profiling
@@ -698,8 +771,12 @@ class VolumeServer:
                     t_end = time.perf_counter()
                     VOLUME_REQUEST_COUNTER.inc("bulk", str(status))
                     VOLUME_REQUEST_SECONDS.observe("bulk", value=t_end - t0)
-                    _observe_stages("bulk", request.t_recv, t0, t_admit,
-                                    t_done, t_end)
+                    stages = _observe_stages("bulk", request.t_recv,
+                                             request.t_parsed, t0,
+                                             t_admit, t_done, t_end)
+                    self._flight_record("volume.bulk", request, status,
+                                        stages, sp,
+                                        request.t_recv or t0, t_end)
                     hot_record(
                         volume=request.query.get("vid") or None,
                         tenant=self._qos_tenant_of_query(request.query),
@@ -764,8 +841,12 @@ class VolumeServer:
                     VOLUME_REQUEST_COUNTER.inc("bulk-read", str(status))
                     VOLUME_REQUEST_SECONDS.observe("bulk-read",
                                                    value=t_end - t0)
-                    _observe_stages("bulk-read", request.t_recv, t0,
-                                    t_admit, t_done, t_end)
+                    stages = _observe_stages("bulk-read", request.t_recv,
+                                             request.t_parsed, t0,
+                                             t_admit, t_done, t_end)
+                    self._flight_record("volume.bulk-read", request,
+                                        status, stages, sp,
+                                        request.t_recv or t0, t_end)
                     hot_record(
                         volume=request.query.get("vid") or None,
                         tenant=self._qos_tenant_of_query(request.query),
@@ -781,6 +862,7 @@ class VolumeServer:
         app.route("/metrics", metrics)
         # pprof-style triggers (reference -debug.port net/http/pprof)
         app.route("/debug/profile", debug_profile)
+        app.route("/debug/flight", debug_flight)
         app.route("/debug/jax-profiler", debug_jax_profiler)
         app.route("/debug/failpoints", debug_failpoints)
         app.route("/debug/traces", debug_traces)
@@ -790,7 +872,8 @@ class VolumeServer:
         app.route("/debug/lifecycle", debug_lifecycle)
         app.default(handle)
         fastweb.serve_fast_app(app, self.ip, self.port, self._stop,
-                               client_max_size=256 << 20, logger=log)
+                               client_max_size=256 << 20, logger=log,
+                               on_loop=self._loop_lag.attach)
 
     # -- lifecycle heat report ----------------------------------------------
     def _set_destroy_time(self, vid: int, at: float) -> bool:

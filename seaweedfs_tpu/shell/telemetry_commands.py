@@ -6,6 +6,12 @@ latency percentiles, per-stage hot-path breakdown and heavy hitters.
 `-watch N` repaints every N seconds; `-failOn burning` turns it into a
 CI/cron tripwire that exits non-zero while any SLO burns (the telemetry
 mirror of `cluster.check -failOn`).
+
+`cluster.profile` is its flamegraph sibling: the same snapshot fetched
+with ?profile=1, rendering the fleet-merged continuous-profiler view —
+per-node sample counts, thread-class CPU/wait attribution, and the top
+merged folded stacks (`-raw` emits collapsed-flamegraph lines for
+piping into flamegraph.pl / speedscope).
 """
 
 from __future__ import annotations
@@ -145,3 +151,76 @@ def cmd_cluster_top(env: CommandEnv, args):
         except KeyboardInterrupt:
             return
         env.println("")
+
+
+@command("cluster.profile",
+         "-url http://master:port [-top N] [-raw]: fleet-merged "
+         "continuous-profiler flamegraph — thread classes, hot stacks")
+def cmd_cluster_profile(env: CommandEnv, args):
+    """cluster.profile -url http://master:port [-top 20] [-raw]
+    [-noTrigger]
+
+    Fetches /cluster/telemetry?profile=1 from the leader (421-following)
+    and renders the fleet-merged continuous-profiler summary: per-node
+    sample counts, on-CPU vs waiting attribution per thread class, and
+    the hottest merged folded stacks. Per-class totals are exact — the
+    collector rolls truncated stacks into `~other` buckets rather than
+    dropping them — so node counts always sum to the cluster count.
+    -raw prints collapsed `stack count` lines instead of the table
+    (pipe into flamegraph.pl or paste into speedscope)."""
+    from .health_util import fetch_master_json
+
+    p = argparse.ArgumentParser(prog="cluster.profile")
+    p.add_argument("-url", required=True,
+                   help="any master's HTTP base URL (followers redirect)")
+    p.add_argument("-top", type=int, default=20,
+                   help="merged stack rows to show")
+    p.add_argument("-raw", action="store_true",
+                   help="emit collapsed-flamegraph lines, no table")
+    p.add_argument("-noTrigger", action="store_true",
+                   help="serve the last collected cycle instead of "
+                        "forcing a fresh fleet scrape")
+    opt = p.parse_args(args)
+
+    params = {"profile": "1"}
+    if not opt.noTrigger:
+        params["trigger"] = "1"
+    snap = fetch_master_json(opt.url, "/cluster/telemetry", params=params)
+    prof = snap.get("profile") or {}
+    nodes = prof.get("nodes") or {}
+    stacks = prof.get("stacks") or []
+
+    if opt.raw:
+        for it in stacks:
+            env.println(f"{it['stack']} {it['count']}")
+        return
+
+    env.println(f"cluster.profile — {snap.get('node', '?')} "
+                f"({'leader' if snap.get('leader') else 'FOLLOWER'}), "
+                f"{len(nodes)} node(s), "
+                f"{_fmt_n(prof.get('samples', 0))} samples")
+    for node, st in sorted(nodes.items()):
+        hz = st.get("hz")
+        env.println(f"  {node:<32} samples={_fmt_n(st.get('samples', 0)):>7} "
+                    f"hz={hz if hz is not None else '?'}")
+
+    classes = prof.get("classes") or {}
+    if classes:
+        env.println("thread classes (on-CPU vs waiting):")
+    total = sum(c.get("on_cpu", 0) + c.get("waiting", 0)
+                for c in classes.values()) or 1
+    for cls, st in sorted(classes.items(),
+                          key=lambda kv: -(kv[1].get("on_cpu", 0)
+                                           + kv[1].get("waiting", 0))):
+        on, wa = st.get("on_cpu", 0), st.get("waiting", 0)
+        env.println(f"  {cls:<14} on_cpu={_fmt_n(on):>7} "
+                    f"waiting={_fmt_n(wa):>7} "
+                    f"share={100.0 * (on + wa) / total:5.1f}%")
+
+    if stacks:
+        env.println(f"top merged stacks (of {len(stacks)}):")
+    for it in stacks[:max(0, opt.top)]:
+        stack = it.get("stack", "")
+        if len(stack) > 110:
+            stack = stack[:107] + "..."
+        env.println(f"  {_fmt_n(it.get('count', 0)):>7}  {stack}")

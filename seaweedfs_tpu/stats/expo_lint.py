@@ -87,6 +87,14 @@ _BOUNDED_LABELS = ("peer", "bucket", "tenant", "key")
 TIER_CARDINALITY_CEILING = 8
 _TIER_LABELS = ("from", "to", "stage", "window", "kind")
 
+# The continuous-profiling plane's labels are closed sets by
+# construction and ride the tier ceiling too: `thread_class` (the
+# sampler's fixed classification: event_loop/read_pool/writer_pool/
+# grpc/raft/other), `state` (on_cpu/waiting), `pool` (the handful of
+# named executors: read/ec_read/...), and `loop` (one value per daemon
+# kind: volume/master/filer/s3).
+_TIER_LABELS = _TIER_LABELS + ("thread_class", "state", "pool", "loop")
+
 # SLO names come from the operator's policy doc — small by design (a
 # policy with hundreds of objectives is unreviewable), but not a
 # closed set, so they get their own intermediate ceiling.

@@ -537,11 +537,49 @@ QOS_WAIT_SECONDS = _histogram(
 VOLUME_STAGE_SECONDS = _histogram(
     "SeaweedFS_volumeServer_stage_seconds",
     "volume request per-stage seconds (contiguous segments: recv/parse, "
-    "auth/admit, store, serialize/flush)",
+    "queue_wait, auth/admit, store, serialize/flush)",
     ("type", "stage"),
     buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
              0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.5, 1.0))
+# Continuous profiling plane (profiling/): the always-on sampler's
+# thread-sample counts by thread class and run state — the cheap
+# "where do the threads sit" rollup (full folded stacks live at
+# /debug/profile?mode=continuous, not in the registry). thread_class,
+# state, pool and loop are all closed sets capped at the tier ceiling
+# by stats/expo_lint.py.
+PROFILE_SAMPLES = _counter(
+    "SeaweedFS_profile_samples_total",
+    "continuous-profiler thread samples by class and state",
+    ("thread_class", "state"))
+# Event-loop lag: how late a loop.call_later probe fired vs asked —
+# pure event-loop queueing, the number that de-confounds the
+# queueing-inflated recv_parse stage (profiling/lag.py).
+EVENT_LOOP_LAG = _histogram(
+    "SeaweedFS_event_loop_lag_seconds",
+    "scheduled-callback probe lateness per event loop (loop queueing)",
+    ("loop",),
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+             0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0))
+# Executor pool accounting (profiling/lag.MonitoredPool): queue depth
+# (submitted-not-yet-started, gauge deltas so same-labelled pools in
+# one process compose) and queue wait (submit -> worker pickup).
+POOL_QUEUE_DEPTH = _gauge(
+    "SeaweedFS_pool_queue_depth",
+    "executor tasks submitted but not yet picked up, per pool",
+    ("pool",))
+POOL_QUEUE_WAIT = _histogram(
+    "SeaweedFS_pool_queue_wait_seconds",
+    "executor queue wait (submit to worker pickup) per pool",
+    ("pool",),
+    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+             0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0))
+# Flight recorder (profiling/flight.py): admissions into the
+# slow/errored request ring, by admission reason.
+FLIGHT_RECORDS = _counter(
+    "SeaweedFS_flight_records_total",
+    "requests admitted to the flight-recorder ring (slow/error)",
+    ("why",))
 # Heavy hitters: the space-saving sketches' current top-k per dimension
 # (kind: volume/tenant/method), refreshed at scrape time by a
 # pre-scrape hook. Gauges, not counters — sketch keys get evicted and

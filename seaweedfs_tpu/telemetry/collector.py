@@ -55,6 +55,8 @@ MERGE_FAMILIES = (
     "SeaweedFS_filer_request_seconds",
     "SeaweedFS_s3_request_seconds",
     "SeaweedFS_qos_wait_seconds",
+    "SeaweedFS_event_loop_lag_seconds",
+    "SeaweedFS_pool_queue_wait_seconds",
 )
 
 HOT_FAMILIES = ("SeaweedFS_hot_requests", "SeaweedFS_hot_bytes")
@@ -98,6 +100,11 @@ class TelemetryCollector:
         self.top_bytes = {k: SpaceSaving(topk_capacity)
                           for k in ("volume", "tenant", "method")}
         self._hot_prev: dict[tuple, float] = {}
+        # latest per-node continuous-profile summary (profiling/), kept
+        # beside the TSDB: folded stacks are not series — merging them
+        # is a count sum, not a bucket merge
+        self._profiles: dict[str, dict] = {}
+        self.profile_top = 200
         self._failures: dict[str, int] = {}
         self._last_scrape: dict[str, float] = {}
         self._last_slo: dict = {}
@@ -164,6 +171,7 @@ class TelemetryCollector:
             targets = self._targets()
             for tgt in targets:
                 self._scrape_one(tgt, now)
+                self._scrape_profile(tgt)
             self._apply_health_stale()
             self._publish_target_gauges(targets)
             self.tsdb.prune(now)
@@ -213,6 +221,37 @@ class TelemetryCollector:
         TELEMETRY_SCRAPES.inc("ok")
         if was_stale:
             self._emit_stale(node, False)
+
+    def _scrape_profile(self, tgt: dict) -> None:
+        """Latest continuous-profile summary per target, riding the
+        scrape cycle. The profile endpoint shares the metrics port, so
+        the URL is derived by swapping the exposition path suffix. A
+        failed profile fetch never marks the node stale — /metrics is
+        the liveness signal; a daemon with the sampler paused (hz=0)
+        still answers with an empty summary."""
+        node = tgt["node"]
+        try:
+            if not tgt.get("url"):
+                from ..profiling import default_sampler
+                s = default_sampler()
+                if s is None:
+                    self._profiles.pop(node, None)
+                    return
+                self._profiles[node] = s.summary(top=self.profile_top)
+                return
+            base = tgt["url"].rsplit("/", 1)[0]
+            from ..client import http_util
+            resp = http_util.get(
+                f"{base}/debug/profile?mode=summary&top={self.profile_top}",
+                timeout=self.scrape_timeout_s)
+            if not resp.ok:
+                raise RuntimeError(f"HTTP {resp.status}")
+            import json
+            prof = json.loads(resp.content.decode())
+            if isinstance(prof, dict):
+                self._profiles[node] = prof
+        except Exception as e:  # noqa: BLE001 — profile loss is not node loss
+            log.debug("profile scrape %s failed: %s", node, e)
 
     def _emit_stale(self, node: str, stale: bool, why: str = "") -> None:
         from ..ops import events
@@ -339,9 +378,50 @@ class TelemetryCollector:
             return []
         return self.slo_engine.health_items()
 
-    def snapshot(self, top_limit: int = 10) -> dict:
-        """The /cluster/telemetry payload."""
+    def merged_profile(self, top: int = 50) -> dict:
+        """Fleet flamegraph: per-node summaries summed by folded stack.
+        Stacks beyond `top` collapse into their class's `~other` bucket
+        (the same convention the sampler uses for its own bounds), so
+        total counts stay exact — cluster.profile's per-class totals
+        equal the sum of every node's, regardless of truncation."""
+        stale = self.tsdb.stale_nodes()
+        nodes: dict[str, dict] = {}
+        classes: dict[str, dict] = {}
+        stacks: dict[str, int] = {}
+        for node, prof in sorted(self._profiles.items()):
+            if node in stale:
+                continue
+            nodes[node] = {"samples": int(prof.get("samples", 0)),
+                           "hz": prof.get("hz"),
+                           "ticks": int(prof.get("ticks", 0))}
+            for cls, st in (prof.get("classes") or {}).items():
+                agg = classes.setdefault(cls, {"on_cpu": 0, "waiting": 0})
+                agg["on_cpu"] += int(st.get("on_cpu", 0))
+                agg["waiting"] += int(st.get("waiting", 0))
+            for it in prof.get("stacks") or ():
+                key = it.get("stack")
+                if not isinstance(key, str):
+                    continue
+                stacks[key] = stacks.get(key, 0) + int(it.get("count", 0))
+        ordered = sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0]))
+        kept = dict(ordered[:max(0, top)])
+        for key, n in ordered[max(0, top):]:
+            parts = key.split(";", 2)
+            okey = (f"{parts[0]};{parts[1]};~other" if len(parts) == 3
+                    else "other;on_cpu;~other")
+            kept[okey] = kept.get(okey, 0) + n
         return {
+            "nodes": nodes,
+            "samples": sum(n["samples"] for n in nodes.values()),
+            "classes": classes,
+            "stacks": [{"stack": k, "count": v} for k, v in
+                       sorted(kept.items(), key=lambda kv: (-kv[1], kv[0]))],
+        }
+
+    def snapshot(self, top_limit: int = 10,
+                 include_profile: bool = False) -> dict:
+        """The /cluster/telemetry payload."""
+        out = {
             "node": self.node_id,
             "leader": bool(self.is_leader()),
             "interval_s": self.interval_s,
@@ -352,3 +432,6 @@ class TelemetryCollector:
             "slo": self._last_slo or (
                 {"policy": None, "status": [], "burning": []}),
         }
+        if include_profile:
+            out["profile"] = self.merged_profile()
+        return out

@@ -42,7 +42,7 @@ class Headers(dict):
 
 class Request:
     __slots__ = ("method", "path", "query_string", "headers", "body",
-                 "remote", "_query", "t_recv")
+                 "remote", "_query", "t_recv", "t_parsed")
 
     def __init__(self, method: str, path: str, query_string: str,
                  headers: Headers, body: bytes, remote: str):
@@ -55,8 +55,12 @@ class Request:
         self._query = None
         # perf_counter at the request's first wire byte, stamped by the
         # protocol; lets handlers charge a recv/parse profiling stage
-        # (handler-entry minus t_recv covers parse + queue wait too)
         self.t_recv = 0.0
+        # perf_counter when the request finished parsing and was queued
+        # for dispatch: [t_recv, t_parsed] is wire receive + parse,
+        # [t_parsed, handler entry] is pure queueing (drain queue +
+        # event-loop wait) — the split that de-confounds recv_parse
+        self.t_parsed = 0.0
 
     @property
     def query(self) -> dict:
@@ -208,7 +212,8 @@ class _HttpProtocol(asyncio.Protocol):
                           self.remote)
             # pipelined followers in the same buffer get "now" — their
             # bytes arrived with the previous request's, so recv ~ 0
-            req.t_recv = self._t_first or time.perf_counter()
+            req.t_parsed = time.perf_counter()
+            req.t_recv = self._t_first or req.t_parsed
             self._t_first = None
             self._head, self._body = None, None
             self._queue.append(req)
@@ -411,12 +416,17 @@ def parse_multipart_single(body: bytes, content_type: str):
 
 
 def serve_fast_app(app: FastApp, ip: str, port: int, stop: threading.Event,
-                   client_max_size: int = 1 << 30, logger=None) -> None:
+                   client_max_size: int = 1 << 30, logger=None,
+                   on_loop=None) -> None:
     """Blocking serve loop (run on the daemon's HTTP thread), mirroring
-    utils/webapp.serve_web_app's contract."""
+    utils/webapp.serve_web_app's contract. `on_loop(loop)` runs on the
+    loop thread once it exists — the seam the profiling plane's
+    loop-lag probe installs through."""
 
     async def main():
         loop = asyncio.get_running_loop()
+        if on_loop is not None:
+            on_loop(loop)
         server = await loop.create_server(
             lambda: _HttpProtocol(app, client_max_size, logger),
             ip, port, backlog=1024, reuse_address=True)

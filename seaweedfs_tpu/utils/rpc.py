@@ -314,7 +314,9 @@ def serve(bind: str, services: list[RpcService], max_workers: int = 16,
         # clients would talk to the wrong server — fail loudly instead
         raise ValueError(f"invalid port in bind address {bind!r}")
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers),
+        # named so the continuous profiler can class these threads grpc
+        futures.ThreadPoolExecutor(max_workers=max_workers,
+                                   thread_name_prefix="grpc-worker"),
         interceptors=([_AuthInterceptor(derive_cluster_key(auth_key))]
                       if auth_key else []),
         options=[("grpc.max_receive_message_length", 256 << 20),

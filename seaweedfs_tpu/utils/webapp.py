@@ -16,10 +16,15 @@ from typing import Callable
 def serve_web_app(add_routes: Callable, ip: str, port: int,
                   stop: threading.Event,
                   client_max_size: int = 1 << 30,
-                  ready: threading.Event | None = None) -> None:
+                  ready: threading.Event | None = None,
+                  on_loop: Callable | None = None) -> None:
+    """`on_loop(loop)` runs on the loop thread before the site binds —
+    the seam the profiling plane's loop-lag probe installs through."""
     from aiohttp import web
 
     async def main():
+        if on_loop is not None:
+            on_loop(asyncio.get_running_loop())
         app = web.Application(client_max_size=client_max_size)
         add_routes(app)
         runner = web.AppRunner(app, access_log=None)

@@ -22,6 +22,7 @@ Knobs: SWTPU_CHAOS_SCHEDULES (3), SWTPU_CHAOS_SECONDS (4 per window),
 SWTPU_CHAOS_SEED (replay).
 """
 
+import json
 import os
 import random
 import socket
@@ -523,6 +524,16 @@ def test_read_storm_schedule(cluster):
     wait_until(lambda: len(master.topo.nodes) >= 3, timeout=15,
                msg=f"{ctx}: all nodes registered before the window")
 
+    # the profiling plane must run STORM-LONG (ISSUE 18): note the
+    # shared continuous sampler's position before the window — the
+    # session fixture's zero-lock-cycle assertion then covers every
+    # sample it takes under the faults
+    from seaweedfs_tpu.profiling import default_sampler
+    sampler = default_sampler()
+    assert sampler is not None and sampler.running, \
+        f"{ctx}: continuous sampler not running at storm start"
+    storm_samples0 = sampler.summary()["samples"]
+
     # -- seed the hot set ---------------------------------------------------
     # Each fid has ONE owning mutator (hot list partitioned below), so
     # the sequential read-after-ack verifications can't race another
@@ -834,6 +845,35 @@ def test_read_storm_schedule(cluster):
     still_open = {p: s for p, s in retry.all_breakers().items()
                   if s != retry.CLOSED}
     assert not still_open, f"{ctx}: breakers never re-closed: {still_open}"
+
+    # -- flight recorder caught the failpoint-delayed requests (ISSUE 18):
+    # the 20 ms store.read delay is above the 5 ms slow threshold, so a
+    # storm's worth of reads must have left entries whose trace ids
+    # resolve in the trace ring — the postmortem pivot works end to end
+    import urllib.request as _rq
+    with _rq.urlopen(f"http://{servers[0].url}/debug/flight"
+                     "?min_ms=15&limit=50", timeout=10) as r:
+        flight = json.loads(r.read().decode())
+    slow = [e for e in flight["entries"]
+            if e["kind"].startswith("volume.")]
+    assert slow, f"{ctx}: flight ring empty after a 20 ms-delay storm"
+    ent = next((e for e in slow if e["trace_id"]), None)
+    assert ent is not None, f"{ctx}: no flight entry kept a trace id"
+    assert ent["stages_ms"], f"{ctx}: flight entry lost its stage timeline"
+    with _rq.urlopen(f"http://{servers[0].url}/debug/traces"
+                     f"?trace_id={ent['trace_id']}", timeout=10) as r:
+        traces = json.loads(r.read().decode())
+    assert traces["count"] >= 1, \
+        f"{ctx}: flight trace {ent['trace_id']} not in /debug/traces"
+
+    # -- and the sampler sampled right through the storm --------------------
+    storm_samples1 = sampler.summary()["samples"]
+    assert sampler.running and storm_samples1 > storm_samples0, \
+        (f"{ctx}: sampler stalled during the storm "
+         f"({storm_samples0} -> {storm_samples1})")
+    print(f"[chaos] {ctx}: profiling plane live through the storm — "
+          f"{storm_samples1 - storm_samples0} samples, "
+          f"{len(slow)} flight entries >= 15 ms")
 
 
 def test_antagonist_tenant_schedule(cluster):

@@ -104,3 +104,52 @@ def wait_cluster_up(master, servers, timeout: float = 10.0):
                timeout=timeout, msg=f"{len(servers)} servers registered")
     for vs in servers:
         wait_http_up(f"http://{vs.url}/status", timeout=timeout)
+
+
+@pytest.hookimpl(hookwrapper=True, tryfirst=True)
+def pytest_sessionfinish(session, exitstatus):
+    """Leaked-server hang guard. A test that dies mid-setup (e.g. a
+    server constructor raising) leaves live daemons behind, and
+    concurrent.futures joins EVERY executor worker at interpreter
+    shutdown — daemon flag notwithstanding (threading._register_atexit
+    runs before daemon threads are abandoned). A leaked gRPC server
+    always has one worker parked inside a streaming handler
+    (send_heartbeat blocks on the client's next message), so shutdown
+    hangs until the CI timeout kills the run. Replicate the join here
+    with a bounded timeout; if workers survive it they would hang the
+    real shutdown — flush and exit hard with the real status instead.
+    tryfirst + hookwrapper = outermost: the post-yield below runs after
+    the terminal reporter's own wrapper has printed the summary line.
+
+    Green sessions ran every teardown and demonstrably exit clean (gRPC
+    unblocks its own workers during interpreter teardown), so only a
+    failing session — the one case that can leak servers — pays the
+    probe."""
+    yield
+    if not exitstatus:
+        return
+
+    import concurrent.futures.thread as cft
+    import sys
+    import threading
+    import time
+
+    main = threading.main_thread()
+    leaked = [t for t in threading.enumerate()
+              if t is not main and t.is_alive() and not t.daemon]
+    items = [(t, q) for t, q in list(cft._threads_queues.items())
+             if t.is_alive()]
+    for _t, q in items:
+        q.put(None)  # same wake-up sentinel _python_exit would send
+    deadline = time.monotonic() + 5.0
+    for t, _q in items:
+        t.join(max(0.0, deadline - time.monotonic()))
+    hung = [t for t, _q in items if t.is_alive()]
+    if leaked or hung:
+        sys.stdout.write(
+            f"conftest: {len(leaked)} non-daemon / {len(hung)} wedged "
+            f"executor thread(s) leaked at session end — hard exit "
+            f"{int(exitstatus)} to avoid the shutdown join hang\n")
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(int(exitstatus))

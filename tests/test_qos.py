@@ -597,8 +597,10 @@ def test_s3_tier_slowdown_with_retry_after(tmp_path):
                       grpc_port=free_port(), pulse_seconds=0.3)
     vs.start()
     wait_cluster_up(ms, [vs])
-    fs = FilerServer(ms.address, store_spec="memory", port=free_port(),
-                     grpc_port=free_port() + 10000,
+    from conftest import free_port_pair
+    fport = free_port_pair()
+    fs = FilerServer(ms.address, store_spec="memory", port=fport,
+                     grpc_port=fport + 10000,
                      meta_log_path=str(tmp_path / "meta.log"))
     fs.start()
     wait_http_up(f"http://{fs.url}/__status__")
