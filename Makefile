@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos chaos-ha race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-ha bench-telemetry bench-profile smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos chaos-ha chaos-geo race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-geo bench-ha bench-telemetry bench-profile smoke protos lint metrics-lint swtpu-lint
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-telemetry bench-profile
+test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-geo bench-telemetry bench-profile
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -55,6 +55,16 @@ chaos:
 # (tests/chaos discovery); this target runs just the HA lane.
 chaos-ha:
 	SWTPU_CHAOS=1 SWTPU_LOCKCHECK=1 python -m pytest tests/chaos/test_chaos_ha.py -q
+
+# geo chaos lane only: sever one DC of a 2-DC in-process cluster
+# mid-storm (every cross-DC link drops), assert acked reads keep
+# serving from the surviving DC, the health-driven repair converges
+# after the partition heals within the cross-DC byte budget, the
+# geo-replication lag gauge returns under its policy bound, the
+# verdict returns to OK, and the lock-order detector ends with zero
+# cycles. Part of `make chaos` (tests/chaos discovery).
+chaos-geo:
+	SWTPU_CHAOS=1 SWTPU_LOCKCHECK=1 python -m pytest tests/chaos/test_chaos_geo.py -q
 
 bench:
 	python bench.py
@@ -128,6 +138,17 @@ bench-balance:
 # SeaweedFS_lifecycle_bytes_moved_total{from,to} books the move
 bench-tier:
 	JAX_PLATFORMS=cpu python bench.py --tier-only
+
+# geo plane gate: a separate-process 2-DC cluster (dc1: 2 servers, dc2:
+# 4) with `-linkCosts` on the master and deterministic per-link delay
+# failpoints on remote shard reads. MSR repair of a shard whose
+# survivors span DCs must ship <= 0.5x the cross-DC bytes of the
+# locality-blind path (the dc2 relay folds 4 helpers' beta-row
+# fragments into one alpha-row partial; SWTPU_GEO_FOLD=0 is the blind
+# baseline; both rebuilds byte-identical), and the cost-aware balance
+# plan must converge an intra-DC-fixable skew with ZERO cross-DC moves
+bench-geo:
+	JAX_PLATFORMS=cpu python bench.py --geo-only
 
 # HA control-plane gate: closed-loop assign (gRPC, redirect-following)
 # and lookup (HTTP, round-robin across ALL masters) workers drive an

@@ -2046,13 +2046,17 @@ _BALANCE_QOS_POLICY = {
 def _spawn_rack_cluster(tmp_prefix: str, volume_size_mb: int,
                         vol_max: int, racks: "list[str]",
                         extra_env: "dict | None" = None,
-                        extra_volume_args: "list | None" = None):
+                        extra_volume_args: "list | None" = None,
+                        extra_master_args: "list | None" = None):
     """Separate-process master + one volume server PER ENTRY of `racks`
-    (its value is the server's -rack; all in dc1) — the multi-node
-    topology the scale-out plane is benched on. Returns (procs, tmp,
-    mport, mhttp, vports, respawn) where respawn(i) re-launches server
-    i with its original args over the same dir/ports (node death +
-    rejoin). Tear down with _stop_procs_cluster(procs, tmp)."""
+    (an entry is the server's -rack, or "dc/rack" for multi-DC
+    topologies; bare entries default to dc1) — the multi-node topology
+    the scale-out and geo planes are benched on. Returns (procs, tmp,
+    mport, mhttp, vports, respawn) where respawn(i, env_extra=None)
+    re-launches server i with its original args over the same
+    dir/ports (node death + rejoin), optionally with extra environment
+    (the geo bench flips SWTPU_GEO_FOLD on the rebuild target this
+    way). Tear down with _stop_procs_cluster(procs, tmp)."""
     import socket
     import subprocess
 
@@ -2076,9 +2080,10 @@ def _spawn_rack_cluster(tmp_prefix: str, volume_size_mb: int,
     vol_argv = []
     repo_root = os.path.dirname(os.path.abspath(__file__))
 
-    def respawn(i: int):
+    def respawn(i: int, env_extra: "dict | None" = None):
         procs[1 + i] = subprocess.Popen(
-            vol_argv[i], cwd=repo_root, env=env,
+            vol_argv[i], cwd=repo_root,
+            env={**env, **(env_extra or {})},
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         return procs[1 + i]
 
@@ -2086,10 +2091,12 @@ def _spawn_rack_cluster(tmp_prefix: str, volume_size_mb: int,
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "seaweedfs_tpu", "master",
              "-port", str(mport), "-httpPort", str(mhttp),
-             "-volumeSizeLimitMB", str(volume_size_mb)],
+             "-volumeSizeLimitMB", str(volume_size_mb)]
+            + list(extra_master_args or []),
             cwd=repo_root, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
         for i, rack in enumerate(racks):
+            dc, _, rk = rack.rpartition("/")
             vdir = os.path.join(tmp, f"v{i}")
             os.makedirs(vdir, exist_ok=True)
             vport, vgrpc = free_port(), free_port()
@@ -2098,7 +2105,7 @@ def _spawn_rack_cluster(tmp_prefix: str, volume_size_mb: int,
                     "-port", str(vport), "-grpcPort", str(vgrpc),
                     "-mserver", f"127.0.0.1:{mport}", "-dir", vdir,
                     "-max", str(vol_max), "-coder", "numpy",
-                    "-dataCenter", "dc1", "-rack", rack] \
+                    "-dataCenter", dc or "dc1", "-rack", rk] \
                 + list(extra_volume_args or [])
             vol_argv.append(argv)
             procs.append(subprocess.Popen(
@@ -2895,6 +2902,360 @@ def bench_balance_smoke(out: dict) -> None:
         shutil.rmtree(os.path.dirname(policy_path), ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Geo-plane smoke (make bench-geo): bandwidth-topology-aware repair &
+# balance on a real 2-DC cluster. The warehouse-study point the gates
+# encode: a cross-DC byte contends for the thinnest pipe in the fleet,
+# so repair must fold far-side helper traffic and balance must never
+# plan a cross-DC hop an intra-DC one can replace.
+# ---------------------------------------------------------------------------
+
+_GEO_LINK_COSTS = {"intra_rack": 1.0, "cross_rack": 4.0, "cross_dc": 25.0}
+
+
+def bench_geo_smoke(out: dict) -> None:
+    """`make bench-geo`: the geo plane gate (ISSUE 19) on a separate-
+    process 2-DC cluster — dc1 holds 2 servers (racks r1/r2), dc2 holds
+    4 — with the master running `-linkCosts` and deterministic per-link
+    delay failpoints armed on every remote shard read (the emulated
+    thin pipe: 10 ms per cross-DC frame, 2 ms intra-DC).
+
+      1. survivor-locality MSR repair: one RS(4,2) msr stripe spread
+         1 shard/server; the dc1/r1 holder loses its shard and
+         rebuilds IN PLACE twice — locality-blind (SWTPU_GEO_FOLD=0)
+         vs geo-folded. Gates: the folded pass ships <= 0.5x the
+         blind pass's cross-DC bytes (the dc2 relay folds its 4
+         helpers' beta-row fragments into ONE alpha-row partial via
+         ranged-COMPUTE VolumeEcShardRead), both rebuilds
+         byte-identical to the original shard, and the near-link
+         (cross-rack) traffic is unchanged — folding optimizes the
+         far link, it does not re-route reads;
+      2. cost-aware balance: dc2 sits at the fleet mean while dc1-a
+         hoards a skew dataset and dc1-b is empty — an intra-DC fix
+         exists, so the cost-priced plan must converge the skew with
+         ZERO cross-DC moves.
+    """
+    import glob as globmod
+    import io
+
+    from seaweedfs_tpu.client import http_util, operation
+    from seaweedfs_tpu.client.master_client import MasterClient
+    from seaweedfs_tpu.ec import shard_ids as _shard_ids
+    from seaweedfs_tpu.geo import LinkCostModel
+    from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+    from seaweedfs_tpu.placement import snapshot_from_servers
+    from seaweedfs_tpu.placement.plan import build_volume_balance_plan
+    from seaweedfs_tpu.shell import (ec_commands,  # noqa: F401
+                                     volume_commands)
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    from seaweedfs_tpu.shell.ec_commands import _stub
+
+    topo = ["dc1/r1", "dc1/r2", "dc2/r1", "dc2/r2", "dc2/r3", "dc2/r4"]
+    procs, tmp, mport, mhttp, vports, respawn = _spawn_rack_cluster(
+        "swtpu_bench_geo_", volume_size_mb=8, vol_max=16, racks=topo,
+        extra_master_args=["-linkCosts", json.dumps(_GEO_LINK_COSTS)])
+    mc = MasterClient(f"127.0.0.1:{mport}",
+                      http_address=f"127.0.0.1:{mhttp}").start()
+    try:
+        mc.wait_connected()
+        env = CommandEnv(f"127.0.0.1:{mport}", mc=mc, out=io.StringIO())
+
+        def shell(line: str) -> str:
+            env.out = io.StringIO()
+            run_command(env, line)
+            return env.out.getvalue()
+
+        def wait_servers(n: int, deadline_s: float = 60) -> list:
+            stop = time.monotonic() + deadline_s
+            while time.monotonic() < stop:
+                srvs = env.collect_volume_servers()
+                if len(srvs) == n:
+                    return srvs
+                time.sleep(0.3)
+            raise RuntimeError(f"topology never settled at {n} servers")
+
+        wait_servers(6)
+        # the master serves its parsed policy back to shell planners
+        doc = http_util.get(f"http://127.0.0.1:{mhttp}/cluster/linkcosts",
+                            timeout=5).json()
+        assert doc["cross_dc"] == _GEO_LINK_COSTS["cross_dc"], doc
+        idx_of = {f"127.0.0.1:{p}": i for i, p in enumerate(vports)}
+
+        def scrape(port: int, name: str, **labels) -> float:
+            body = http_util.get(f"http://127.0.0.1:{port}/metrics",
+                                 timeout=5).content.decode()
+            total = 0.0
+            for line in body.splitlines():
+                if line.startswith(name + "{") and all(
+                        f'{k}="{v}"' in line for k, v in labels.items()):
+                    total += float(line.split()[-1])
+            return total
+
+        def grow(collection: str, n: int) -> set:
+            grown: set = set()
+            stop = time.monotonic() + 30
+            while len(grown) < n and time.monotonic() < stop:
+                try:
+                    r = http_util.get(
+                        f"http://127.0.0.1:{mhttp}/dir/assign",
+                        params={"collection": collection,
+                                "writableVolumeCount": str(n)},
+                        timeout=5).json()
+                    if "fid" in r:
+                        grown.add(int(r["fid"].split(",")[0]))
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+            assert grown, f"no writable {collection} volume ever grew"
+            return grown
+
+        def pour(collection: str, mib: int, seed: int) -> list:
+            # mib MiB in 256 KiB framed batches; retry-tolerant so a
+            # momentarily stale assign target (mid-prune) only delays
+            rng = random.Random(seed)
+            fids: list = []
+            want = mib * 4
+            stop = time.monotonic() + 120
+            while len(fids) < want * 8 and time.monotonic() < stop:
+                batch = [rng.randbytes(32 << 10) for _ in range(8)]
+                try:
+                    fids += [r.fid for r in operation.submit_batch(
+                        mc, batch, collection=collection)]
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+            assert len(fids) >= want * 8, \
+                f"{collection}: poured only {len(fids)} needles"
+            return fids
+
+        # -- Phase A: one RS(4,2) msr stripe, 1 shard per server ---------
+        vids = grow("geo", 1)
+        vid = min(vids)
+        fids = pour("geo", 6, seed=4242)
+        assert all(int(f.split(",")[0]) == vid for f in fids), \
+            "geo dataset spilled past its single pre-grown volume"
+        shell("lock")
+        text = shell(f"ec.encode -volumeId {vid} -ecShards 4,2 -codec msr")
+        assert "ec encoded 1 volumes" in text, text
+
+        def holder_map() -> dict:
+            h: dict = {}
+            for s in env.collect_volume_servers():
+                for d in s["disks"].values():
+                    for e in d.ec_shard_infos:
+                        if e.id != vid:
+                            continue
+                        for sid in _shard_ids(e.ec_index_bits):
+                            h.setdefault(sid, []).append(s)
+            return h
+
+        def wait_holders(sids: set, deadline_s: float = 45) -> dict:
+            stop = time.monotonic() + deadline_s
+            while time.monotonic() < stop:
+                h = holder_map()
+                if set(h) == sids and all(len(v) == 1 for v in h.values()):
+                    return h
+                time.sleep(0.3)
+            got = {s: [x["id"] for x in v] for s, v in holder_map().items()}
+            raise RuntimeError(f"ec holders never settled at "
+                               f"{sorted(sids)}: {got}")
+
+        holders = wait_holders(set(range(6)))
+        by_dc: dict = {}
+        for sid, (srv,) in holders.items():
+            by_dc.setdefault(srv["dc"], []).append(sid)
+        assert len(by_dc.get("dc1", [])) == 2 \
+            and len(by_dc.get("dc2", [])) == 4, by_dc
+        lost_sid = min(by_dc["dc1"],
+                       key=lambda s: idx_of[holders[s][0]["id"]])
+        target = holders[lost_sid][0]
+        target_idx = idx_of[target["id"]]
+        shard_glob = os.path.join(tmp, f"v{target_idx}", "**",
+                                  f"*.ec{lost_sid:02d}")
+        paths = globmod.glob(shard_glob, recursive=True)
+        assert len(paths) == 1, (shard_glob, paths)
+        with open(paths[0], "rb") as f:
+            original = f.read()
+        shard_size = len(original)
+        log(f"geo: stripe {vid} spread 1 shard/server; losing shard "
+            f"{lost_sid} on {target['id']} (dc1/r1, {shard_size:,} B)")
+
+        # deterministic per-link delay on every survivor's shard reads
+        for i in range(6):
+            if i == target_idx:
+                continue
+            spec = "pct:100:delay:" + ("0.002" if i < 2 else "0.01")
+            r = http_util.get(
+                f"http://127.0.0.1:{vports[i]}/debug/failpoints",
+                params={"name": "ec.shard.read", "spec": spec}, timeout=5)
+            assert r.ok, (i, r.status)
+
+        st = _stub(env, target)
+        st.call("VolumeEcShardsUnmount",
+                vpb.VolumeEcShardsUnmountRequest(volume_id=vid,
+                                                 shard_ids=[lost_sid]),
+                vpb.VolumeEcShardsUnmountResponse)
+        st.call("VolumeEcShardsDelete",
+                vpb.VolumeEcShardsDeleteRequest(volume_id=vid,
+                                                collection="geo",
+                                                shard_ids=[lost_sid]),
+                vpb.VolumeEcShardsDeleteResponse)
+        survivors = set(range(6)) - {lost_sid}
+        wait_holders(survivors)
+
+        def rebuild_pass(tag: str, env_extra: "dict | None"):
+            # the fold switch is read by the REBUILD TARGET's process,
+            # so the A/B flips it by respawning just that server
+            procs[1 + target_idx].terminate()
+            procs[1 + target_idx].wait(timeout=10)
+            for p in globmod.glob(shard_glob, recursive=True):
+                os.remove(p)  # the previous pass's rebuild artifact
+            respawn(target_idx, env_extra)
+            stop = time.monotonic() + 60
+            while time.monotonic() < stop:
+                try:
+                    if http_util.get(
+                            f"http://127.0.0.1:{vports[target_idx]}/status",
+                            timeout=1).ok:
+                        break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.25)
+            wait_servers(6)
+            wait_holders(survivors)
+            name = "SeaweedFS_repair_bytes_by_link_total"
+            before_dc = scrape(vports[target_idx], name,
+                               codec="msr", link="cross_dc")
+            before_cr = scrape(vports[target_idx], name,
+                               codec="msr", link="cross_rack")
+            t0 = time.perf_counter()
+            resp = _stub(env, target).call(
+                "VolumeEcShardsCopyByRebuild",
+                vpb.VolumeEcShardsCopyByRebuildRequest(
+                    volume_id=vid, collection="geo", shard_ids=[lost_sid]),
+                vpb.VolumeEcShardsCopyByRebuildResponse, timeout=600)
+            dt = time.perf_counter() - t0
+            assert list(resp.rebuilt_shard_ids) == [lost_sid], resp
+            got = globmod.glob(shard_glob, recursive=True)
+            assert len(got) == 1, got
+            with open(got[0], "rb") as f:
+                rebuilt = f.read()
+            assert rebuilt == original, \
+                f"{tag}: rebuilt shard {lost_sid} not byte-identical"
+            cross_dc = scrape(vports[target_idx], name,
+                              codec="msr", link="cross_dc") - before_dc
+            cross_rack = scrape(vports[target_idx], name,
+                                codec="msr", link="cross_rack") - before_cr
+            log(f"geo repair [{tag}]: {cross_dc:,.0f} B cross-DC, "
+                f"{cross_rack:,.0f} B cross-rack, {dt:.2f} s, "
+                f"byte-identical")
+            return cross_dc, cross_rack, dt
+
+        blind_dc, blind_cr, blind_t = rebuild_pass(
+            "locality-blind", {"SWTPU_GEO_FOLD": "0"})
+        fold_dc, fold_cr, fold_t = rebuild_pass("geo-folded", None)
+        assert blind_dc > 0, "blind rebuild fetched no cross-DC bytes"
+        ratio = fold_dc / blind_dc
+        out.update(geo_repair_shard_bytes=shard_size,
+                   geo_repair_blind_cross_dc_bytes=int(blind_dc),
+                   geo_repair_folded_cross_dc_bytes=int(fold_dc),
+                   geo_repair_cross_dc_ratio=round(ratio, 3),
+                   geo_repair_blind_s=round(blind_t, 2),
+                   geo_repair_folded_s=round(fold_t, 2))
+        assert ratio <= 0.505, \
+            f"folded repair shipped {ratio:.2f}x the blind cross-DC " \
+            f"bytes (gate 0.5x: one alpha-row fold vs 4 helpers' beta " \
+            f"rows)"
+        assert abs(fold_cr - blind_cr) <= 0.01 * blind_cr + 64, \
+            f"near-link traffic changed: {blind_cr} -> {fold_cr}"
+        log(f"geo repair gate: folded/blind cross-DC = {ratio:.3f} "
+            f"(<= 0.5)")
+        # stripe whole again: mount the folded pass's rebuild
+        st.call("VolumeEcShardsMount",
+                vpb.VolumeEcShardsMountRequest(volume_id=vid,
+                                               collection="geo",
+                                               shard_ids=[lost_sid]),
+                vpb.VolumeEcShardsMountResponse)
+        wait_holders(set(range(6)))
+        for i in range(6):  # disarm the link delays
+            if i == target_idx:
+                continue
+            http_util.get(
+                f"http://127.0.0.1:{vports[i]}/debug/failpoints",
+                params={"name": "ec.shard.read", "spec": ""}, timeout=5)
+
+        # -- Phase B: cost-aware balance, intra-DC fix exists ------------
+        # dc1 dies; the base dataset lands on dc2 alone (~mean load)
+        for i in (0, 1):
+            procs[1 + i].terminate()
+        for i in (0, 1):
+            procs[1 + i].wait(timeout=10)
+        wait_servers(4)
+        grow("geobase", 16)
+        pour("geobase", 8, seed=77)
+        # dc2 goes dark and dc1-a returns alone: the skew dataset
+        for i in range(2, 6):
+            procs[1 + i].terminate()
+        for i in range(2, 6):
+            procs[1 + i].wait(timeout=10)
+        respawn(0)
+        wait_servers(1)
+        grow("geoskew", 8)
+        pour("geoskew", 4, seed=78)
+        for i in range(1, 6):
+            respawn(i)
+        wait_servers(6)
+        wait_holders(set(range(6)))
+
+        def wait_written(col: str, want_bytes: int) -> None:
+            stop = time.monotonic() + 45
+            while time.monotonic() < stop:
+                got = sum(v.size for s in env.collect_volume_servers()
+                          for d in s["disks"].values()
+                          for v in d.volume_infos if v.collection == col)
+                if got >= want_bytes:
+                    return
+                time.sleep(0.3)
+            raise RuntimeError(f"{col} sizes never propagated")
+
+        wait_written("geobase", 8 << 20)
+        wait_written("geoskew", 4 << 20)
+        srvs = env.collect_volume_servers()
+        dc_of = {s["id"]: s["dc"] for s in srvs}
+        snap = snapshot_from_servers(srvs, default_shard_bytes=shard_size)
+        loads = {n.id: n.load_bytes for n in snap.nodes}
+        skew0 = max(loads.values()) / max(1, min(loads.values()))
+        out["geo_balance_skew_before"] = round(skew0, 2)
+        assert skew0 > 1.3, \
+            f"fixture never skewed ({skew0:.2f}) — nothing to prove"
+        plan = build_volume_balance_plan(
+            snap, costs=LinkCostModel(**_GEO_LINK_COSTS), target_skew=1.3)
+        assert plan.moves, "cost-aware plan found nothing to do"
+        for m in plan.moves:
+            assert dc_of[m.src] == dc_of[m.dst], \
+                f"cross-DC move planned with an intra-DC fix available: " \
+                f"{m.describe()}"
+        assert plan.cross_dc_bytes == 0, plan.to_dict()
+        # the shell planner prices with the master-served policy and
+        # reaches the same zero-cross-DC answer
+        text = shell("volume.balance -dryRun -targetSkew 1.3")
+        assert "0 B cross-dc" in text, text
+        out.update(geo_balance_moves=len(plan.moves),
+                   geo_balance_cross_dc_bytes=plan.cross_dc_bytes,
+                   geo_balance_cost_weighted_bytes=plan.cost_weighted_bytes,
+                   geo_balance_planned_skew=round(plan.skew_after, 2))
+        log(f"geo balance gate: {len(plan.moves)} move(s), 0 B cross-DC "
+            f"(skew {skew0:.2f} -> {plan.skew_after:.2f} planned, "
+            f"{plan.cost_weighted_bytes:,} cost-weighted B)")
+        out["geo_topology"] = (
+            "separate-process master (-linkCosts) + 6 volume servers in "
+            "2 DCs (dc1: r1/r2, dc2: r1-r4); RS(4,2) msr stripe 1 "
+            "shard/server; per-link delay failpoints 10 ms cross-DC / "
+            "2 ms intra-DC; fold A/B via SWTPU_GEO_FOLD respawn of the "
+            "rebuild target")
+        out["bench_geo_smoke"] = "ok"
+    finally:
+        mc.stop()
+        _stop_procs_cluster(procs, tmp)
+
+
 def bench_ha_smoke(out: dict) -> None:
     """`make bench-ha`: the HA control-plane gate. An in-process
     3-master raft quorum (gRPC + HTTP) with 2 volume servers, driven by
@@ -3369,6 +3730,15 @@ def main() -> None:
                          "<= 1.3, EC stripes rack-safe, -dryRun "
                          "mutation-free, rebalance maintenance-class "
                          "in qos metrics")
+    ap.add_argument("--geo-only", action="store_true", dest="geo_only",
+                    help="run only the geo-plane smoke (make bench-geo): "
+                         "2-DC separate-process cluster with per-link "
+                         "delay failpoints; MSR repair of a shard whose "
+                         "survivors span DCs must ship <= 0.5x the "
+                         "cross-DC bytes of the locality-blind path "
+                         "(byte-identical rebuild), and the cost-aware "
+                         "balance plan must fix an intra-DC-fixable "
+                         "skew with zero cross-DC moves")
     ap.add_argument("--ha-only", action="store_true", dest="ha_only",
                     help="run only the HA control-plane smoke (make "
                          "bench-ha): in-process 3-master raft quorum, "
@@ -3448,6 +3818,12 @@ def main() -> None:
         out_b: dict = {"metric": "bench_balance_smoke"}
         bench_balance_smoke(out_b)
         print(json.dumps(out_b))
+        return
+    if args.geo_only:
+        # CPU-only child processes: safe for make test's fast path
+        out_geo: dict = {"metric": "bench_geo_smoke"}
+        bench_geo_smoke(out_geo)
+        print(json.dumps(out_geo))
         return
     if args.ha_only:
         # in-process CPU-only quorum: safe for make test's fast path

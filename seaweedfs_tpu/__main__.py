@@ -73,6 +73,13 @@ def _add_master_flags(p):
                    help="fleet telemetry scrape interval seconds; 0 uses "
                         "SWTPU_TELEMETRY_INTERVAL_S (default 15), "
                         "negative disables the collector")
+    p.add_argument("-linkCosts", default="",
+                   help="geo link-cost policy: JSON file path or inline "
+                        "JSON doc pricing intra-rack/cross-rack/cross-DC "
+                        "bytes (plus per-DC-pair overrides, a cross-DC "
+                        "byte budget and the replication lag bound); "
+                        "feeds replica growth, repair planning and the "
+                        "balance planners; served at /cluster/linkcosts")
     _add_security_flags(p)
 
 
@@ -185,6 +192,7 @@ def run_master(argv):
                       ec_parity_shards=_ec_parity(opt),
                       lifecycle_policy=opt.lifecyclePolicy,
                       slo_policy=opt.sloPolicy,
+                      link_costs=opt.linkCosts,
                       telemetry_interval_s=opt.telemetryIntervalS or None)
     ms.admin_cron.repair_max_concurrent = opt.maintenanceMaxConcurrentRepairs
     ms.start()
@@ -240,6 +248,7 @@ def run_server(argv):
                       default_replication=opt.defaultReplication,
                       guard=_make_guard(opt), http_port=opt.httpPort or None,
                       slo_policy=opt.sloPolicy,
+                      link_costs=opt.linkCosts,
                       telemetry_interval_s=opt.telemetryIntervalS or None)
     ms.start()
     store = Store(opt.ip, opt.volumePort, f"{opt.ip}:{opt.volumePort}",
@@ -617,6 +626,46 @@ def run_filer_sync(argv):
     if not opt.isActivePassive:
         FilerSync(fb, fa, path_prefix=opt.path).start()
         print(f"syncing {opt.b} -> {opt.a} under {opt.path}")
+    _wait_forever()
+
+
+def run_geo_sync(argv):
+    """Async cross-cluster replication over an expensive link — the
+    filer.sync analogue of the geo plane (geo/replication.py): distinct
+    resumable offset namespace, maintenance-class applies, and the
+    bounded-lag invariant published as
+    SeaweedFS_geo_replication_lag_seconds{peer}."""
+    from .client.filer_client import FilerClient
+    from .geo.policy import LinkCostModel, load_link_costs
+    from .geo.replication import GeoSync
+    p = argparse.ArgumentParser(prog="geo.sync")
+    p.add_argument("-a", required=True, help="local filer host:port")
+    p.add_argument("-b", required=True, help="remote filer host:port")
+    p.add_argument("-isActivePassive", action="store_true",
+                   help="only replicate A -> B")
+    p.add_argument("-path", default="/", help="path prefix to replicate")
+    p.add_argument("-peerA", default="", help="peer label for the A side "
+                   "(defaults to its address)")
+    p.add_argument("-peerB", default="", help="peer label for the B side")
+    p.add_argument("-linkCosts", default="",
+                   help="link-cost policy (inline JSON or file) supplying "
+                   "replication_lag_bound_s; -lagBound overrides")
+    p.add_argument("-lagBound", type=float, default=-1.0,
+                   help="replication lag bound in seconds (<0: use policy)")
+    opt = p.parse_args(argv)
+    costs = (load_link_costs(opt.linkCosts) if opt.linkCosts
+             else LinkCostModel())
+    bound = (opt.lagBound if opt.lagBound >= 0
+             else costs.replication_lag_bound_s)
+    fa, fb = FilerClient(opt.a), FilerClient(opt.b)
+    GeoSync(fa, fb, peer=opt.peerA or opt.a, lag_bound_s=bound,
+            path_prefix=opt.path).start()
+    print(f"geo-replicating {opt.a} -> {opt.b} under {opt.path} "
+          f"(lag bound {bound}s)")
+    if not opt.isActivePassive:
+        GeoSync(fb, fa, peer=opt.peerB or opt.b, lag_bound_s=bound,
+                path_prefix=opt.path).start()
+        print(f"geo-replicating {opt.b} -> {opt.a} under {opt.path}")
     _wait_forever()
 
 
@@ -1284,6 +1333,7 @@ VERBS = {
     "filer.backup": run_filer_backup,
     "master.follow": run_master_follow,
     "filer.sync": run_filer_sync,
+    "geo.sync": run_geo_sync,
     "filer.copy": run_filer_copy,
     "filer.meta.tail": run_filer_meta_tail,
     "export": run_export,

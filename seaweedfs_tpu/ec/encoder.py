@@ -60,6 +60,7 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
                    remote_shards: Sequence[int] | None = None,
                    stats: "dict | None" = None,
                    fragment_reader=None,
+                   fold_planner=None,
                    ) -> list[int]:
     """Recreate missing shard files from >= d survivors.
 
@@ -70,10 +71,14 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
     byte ranges off the network instead of d full shards;
     `fragment_reader(sid, ranges)` additionally lets a survivor holder
     gather scattered ranges server-side and ship ONE computed fragment
-    (the MSR codec's beta-fragments ride this). Every survivor byte
-    consumed lands in SeaweedFS_repair_bytes_read_total{codec} and in
-    `stats` (bytes_read / bytes_written / codec / path). Returns the
-    shard ids rebuilt (always materialized locally under `base`).
+    (the MSR codec's beta-fragments ride this). `fold_planner(coder, f)
+    -> [(sids, fetch)]` (geo plane) lets the caller group far-DC
+    survivors behind relay holders that fold their plane rows into one
+    partial before crossing the expensive link — only consulted on the
+    single-loss msr fast path. Every survivor byte consumed lands in
+    SeaweedFS_repair_bytes_read_total{codec} and in `stats`
+    (bytes_read / bytes_written / codec / path). Returns the shard ids
+    rebuilt (always materialized locally under `base`).
     """
     from .. import tracing
     present_local = find_shards(base, geo.n)
@@ -106,7 +111,9 @@ def rebuild_shards(base: str, geo: EcGeometry, coder: ErasureCoder,
         try:
             path = _dispatch_rebuild(base, geo, coder, tuple(sorted(present)),
                                      missing, readers, frag_readers,
-                                     shard_size, chunk, batch, counter)
+                                     shard_size, chunk, batch, counter,
+                                     fold_planner=fold_planner,
+                                     local_sids=frozenset(present_local))
         finally:
             close()
         sp.set_attr("bytes_read", counter.bytes_read)
@@ -135,15 +142,26 @@ def _shard_size(base: str, geo: EcGeometry,
 def _dispatch_rebuild(base: str, geo: EcGeometry, coder: ErasureCoder,
                       present: tuple, missing: list[int], readers: dict,
                       frag_readers: dict, shard_size: int, chunk: int,
-                      batch: int, counter) -> str:
+                      batch: int, counter, fold_planner=None,
+                      local_sids: frozenset = frozenset()) -> str:
     """Pick the cheapest reconstruction the codec supports — resolved
     through the repair.REBUILDERS registry, so a new codec plugs in its
     executors without touching this dispatch. Returns the path taken
-    ("ranged" | "general" | "full") for stats/traces."""
+    ("ranged" | "general" | "full" | "ranged-folded") for stats/traces."""
     from . import repair
     ranged, general = repair.REBUILDERS.get(coder.codec, (None, None))
     plan = coder.repair_plan(present, tuple(missing), shard_size)
     if plan is not None and ranged is not None:
+        folds = ()
+        if fold_planner is not None and coder.codec == "msr":
+            # a survivor on THIS disk never folds: local preads beat any
+            # relay hop, and a stale holder list must not reroute them
+            folds = tuple(x for x in (fold_planner(coder, missing[0]) or ())
+                          if not set(x[0]) & local_sids)
+        if folds:
+            ranged(base, coder, missing[0], readers, frag_readers,
+                   shard_size, counter, folds=folds)
+            return "ranged-folded"
         ranged(base, coder, missing[0], readers, frag_readers,
                shard_size, counter)
         return "ranged"

@@ -183,3 +183,70 @@ def update_vif(path: str, updates: "dict | None" = None,
             info.pop(k, None)
         write_vif(path, **info)
         return info
+
+
+# -- offloaded-shard claim surgery (geo rebalance of remote-backed
+# shards moves the .vif `remote_shards` CLAIM between servers, never
+# the remote payload). Claims are per-shard entries of the mapping
+# {"spec":, "keys": {sid: key}, "sizes": {sid: size}}; exactly one
+# server must hold each claim or the fleet double-counts (and a reap
+# double-deletes) the remote object.
+
+def remote_claims(info: dict, sids) -> "dict | None":
+    """Extract the `remote_shards` sub-mapping covering exactly `sids`
+    from a parsed .vif — None when no claim covers any of them."""
+    rem = info.get("remote_shards") or {}
+    keys = {str(s): rem["keys"][str(s)] for s in sids
+            if str(s) in rem.get("keys", {})}
+    if not keys:
+        return None
+    sizes = rem.get("sizes", {})
+    return {"spec": rem.get("spec", ""), "keys": keys,
+            "sizes": {k: sizes[k] for k in keys if k in sizes}}
+
+
+def merge_remote_claims(path: str, claims: "dict | None") -> None:
+    """Fold `claims` (a remote_shards-shaped mapping) into the .vif at
+    `path` under the sidecar lock. A spec mismatch with existing claims
+    is refused — one volume's offloaded shards live under one backend
+    spec by construction (storage/store.py offload seal)."""
+    if not claims or not claims.get("keys"):
+        return
+    with _vif_lock(path):
+        info = read_vif(path)
+        rem = info.get("remote_shards") or \
+            {"spec": claims.get("spec", ""), "keys": {}, "sizes": {}}
+        if rem.get("spec") and claims.get("spec") and \
+                rem["spec"] != claims["spec"]:
+            raise ValueError(
+                f"remote claim spec {claims['spec']!r} conflicts with "
+                f"sealed {rem['spec']!r} in {path}")
+        rem.setdefault("keys", {}).update(claims["keys"])
+        rem.setdefault("sizes", {}).update(claims.get("sizes", {}))
+        info["remote_shards"] = rem
+        write_vif(path, **info)
+
+
+def drop_remote_claims(path: str, sids) -> list[int]:
+    """Remove the claims for `sids` from the .vif (the remote objects
+    themselves are untouched — a move's source-side release, not a
+    delete). Drops the whole mapping when its last claim goes. Returns
+    the shard ids whose claims were actually dropped."""
+    dropped: list[int] = []
+    with _vif_lock(path):
+        info = read_vif(path)
+        rem = info.get("remote_shards")
+        if not rem:
+            return dropped
+        for s in sids:
+            if rem.get("keys", {}).pop(str(s), None) is not None:
+                dropped.append(int(s))
+            rem.get("sizes", {}).pop(str(s), None)
+        if not dropped:
+            return dropped
+        if rem.get("keys"):
+            info["remote_shards"] = rem
+        else:
+            info.pop("remote_shards", None)
+        write_vif(path, **info)
+    return dropped

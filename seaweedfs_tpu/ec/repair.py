@@ -350,27 +350,41 @@ def _msr_window(pm, shard_size: int, window: int) -> int:
 def rebuild_msr_single(base: str, pm, f: int, readers: dict,
                        frag_readers: dict, shard_size: int,
                        counter: RepairCounter,
-                       window: int = REPAIR_WINDOW) -> None:
+                       window: int = REPAIR_WINDOW, folds=()) -> None:
     """Rebuild any single lost shard — data OR parity — from computed
     fragments of ALL n-1 survivors: each ships only its repair-plane
     sub-symbols ((n-1)/p shard-equivalents total, the MSR cut-set
-    bound), one fragment RPC per survivor per window."""
+    bound), one fragment RPC per survivor per window.
+
+    `folds` (geo plane) is a list of (sids, fetch) relay groups: the
+    sids are far-side survivors whose plane rows a single relay holder
+    gathers and folds through the stacked per-helper repair matrix
+    (geo/repair_fold.py) — `fetch(ranges)` returns the group's ONE
+    folded partial of alpha rows per window. Folded survivors skip the
+    per-survivor fetch; their contribution XORs into the near-side
+    decode, which is byte-identical to the flat path because
+    `repair_decode` is GF-linear in the helpers' plane symbols."""
     g = pm.grid
     planes = g.repair_planes(f)
     s = shard_size // pm.alpha
     wl = _msr_window(pm, shard_size, window)
+    folded_sids = {sid for sids, _fetch in folds for sid in sids}
     outs = _open_outputs(base, [f], shard_size)
     try:
         for u in range(0, s, wl):
             w = min(wl, s - u)
+            ranges = [(int(z) * s + u, w) for z in planes]
             c = np.zeros((g.nbar, g.alpha, w), dtype=np.uint8)
             for sid in range(pm.n):
-                if sid == f:
+                if sid == f or sid in folded_sids:
                     continue
-                ranges = [(int(z) * s + u, w) for z in planes]
                 frag = frag_readers[sid](ranges)
                 c[sid, planes] = frag.reshape(len(planes), w)
             row = pm.repair_decode(c, f)
+            for _sids, fetch in folds:
+                part = fetch(ranges)
+                counter.read(part.size)
+                row = row ^ part.reshape(pm.alpha, w)
             for z in range(pm.alpha):
                 _pwrite(outs[f], row[z], z * s + u)
             counter.wrote(pm.alpha * w)

@@ -1,0 +1,30 @@
+"""Geo plane: bandwidth-topology-aware placement, repair & replication.
+
+Ties the existing planes to a per-link cost model (policy.py):
+
+* placement/balance price moves in cost-weighted bytes, so an
+  intra-rack fix always beats a cross-DC one (placement/engine.py,
+  placement/plan.py consume `LinkCostModel`);
+* MSR repair prefers near survivors and folds far-DC helper groups
+  into one relay-aggregated fragment per window (repair_fold.py — the
+  GF-linear decomposition of `repair_decode`);
+* async cross-cluster replication with a bounded-lag invariant
+  (replication.py, the filer.sync analogue).
+"""
+
+from .policy import (  # noqa: F401
+    LINK_CLASSES,
+    LinkCostModel,
+    load_link_costs,
+    parse_link_costs,
+)
+
+
+def __getattr__(name):
+    # GeoSync drags in the replication/filer stack; load it lazily so
+    # `from seaweedfs_tpu.geo import LinkCostModel` stays cheap for the
+    # placement scorer's hot path.
+    if name == "GeoSync":
+        from .replication import GeoSync
+        return GeoSync
+    raise AttributeError(name)

@@ -71,6 +71,13 @@ class RepairItem:
     # (d+|group|)/2 half-shards where plain RS costs d full shards.
     bytes_moved: int = -1
     repair_codec: str = ""
+    # geo plane: the same bytes priced through the link-cost model
+    # (each survivor byte weighted by the link from its holder's DC to
+    # the repair DC); -1 = no cost model or no topology in the report
+    cost_weighted_bytes: int = -1
+    # the DC the repair should land in: the one holding the most
+    # survivors (survivor locality — near helpers are cheap helpers)
+    repair_dc: str = ""
 
     @property
     def key(self) -> tuple[str, int]:
@@ -81,6 +88,11 @@ class RepairItem:
     def describe(self) -> str:
         cost = (f" (~{self.bytes_moved:,} B moved)"
                 if self.bytes_moved > 0 else "")
+        if self.bytes_moved > 0 and self.cost_weighted_bytes > 0:
+            cost = (f" (~{self.bytes_moved:,} B moved, "
+                    f"{self.cost_weighted_bytes:,} cost-weighted"
+                    + (f", repair in {self.repair_dc}" if self.repair_dc
+                       else "") + ")")
         if self.action == ACTION_EC_REMOUNT:
             where = ", ".join(f"{n}:{sids}" for n, sids in
                               sorted(self.remount.items()))
@@ -101,7 +113,9 @@ class RepairItem:
                 "sources": list(self.sources), "targets": list(self.targets),
                 "remount": {n: list(s) for n, s in self.remount.items()},
                 "bytes_moved": self.bytes_moved,
-                "repair_codec": self.repair_codec}
+                "repair_codec": self.repair_codec,
+                "cost_weighted_bytes": self.cost_weighted_bytes,
+                "repair_dc": self.repair_dc}
 
 
 @dataclass
@@ -135,30 +149,85 @@ class RepairPlan:
 
 def _sort_key(it: RepairItem):
     # ties break by network cost, cheapest first (the warehouse-cluster
-    # ordering: most-at-risk, then least repair traffic); unknown cost
-    # (-1) sorts after every known cost rather than before
-    cost = it.bytes_moved if it.bytes_moved >= 0 else float("inf")
+    # ordering: most-at-risk, then least repair traffic); with a geo
+    # cost model the COST-WEIGHTED bytes order — a cheap intra-DC
+    # rebuild beats an equal-size cross-DC one. Unknown cost (-1) sorts
+    # after every known cost rather than before
+    cost = (it.cost_weighted_bytes if it.cost_weighted_bytes >= 0
+            else it.bytes_moved if it.bytes_moved >= 0 else float("inf"))
     return (it.distance, -_RANK[it.severity],
             0 if it.kind == "ec" else 1,
             _ACTION_ORDER.get(it.action, 9), cost, it.vid)
 
 
+def _node_dcs(report: dict) -> dict:
+    return {nd["id"]: nd.get("dc", "") for nd in report.get("nodes", ())}
+
+
 def _pick_replica_targets(report: dict, holders: list[str],
-                          deficit: int) -> list[str]:
+                          deficit: int, costs=None) -> list[str]:
     """Servers that do NOT hold the volume: fresh heartbeats before
     stale (a wedged-but-registered node must not be the landing zone),
-    most free slots first (id breaks ties), then ordered healthy-first
-    through the circuit breakers — deterministically within each
-    breaker class. Stale nodes stay at the tail rather than dropping
-    out entirely: with no fresh candidate a degraded copy beats none."""
+    cheapest copy link from the nearest surviving holder when a geo
+    cost model is given (survivor locality: an intra-DC candidate beats
+    a cross-DC one), most free slots first (id breaks ties), then
+    ordered healthy-first through the circuit breakers —
+    deterministically within each breaker class. Stale nodes stay at
+    the tail rather than dropping out entirely: with no fresh candidate
+    a degraded copy beats none."""
     from ..utils import retry
+    node_dc = _node_dcs(report)
+    holder_dcs = sorted({node_dc.get(h, "") for h in holders} - {""})
+
+    def _link_cost(nd) -> float:
+        if costs is None or not holder_dcs:
+            return 0.0
+        dc = nd.get("dc", "")
+        return min(costs.cost(h, "", dc, "") for h in holder_dcs)
+
     nodes = [nd for nd in report.get("nodes", ())
              if nd["id"] not in set(holders)]
-    nodes.sort(key=lambda nd: (bool(nd.get("stale")),
+    nodes.sort(key=lambda nd: (bool(nd.get("stale")), _link_cost(nd),
                                -(nd.get("max_slots", 0)
                                  - nd.get("used_slots", 0)), nd["id"]))
     ranked = retry.order_by_breaker([nd["id"] for nd in nodes])
     return ranked[:deficit]
+
+
+def _weighted(report: dict, holders, nbytes: int, costs,
+              targets=()) -> tuple[int, str]:
+    """(cost-weighted bytes, repair DC) for moving `nbytes` of survivor
+    reads into the DC holding the most survivors (the near side — the
+    MSR fold then ships ONE folded fragment per far group instead of
+    raw helper fragments, but the planner prices the conservative
+    un-folded fetch). Returns (-1, "") without a model or topology."""
+    if costs is None or nbytes < 0:
+        return -1, ""
+    node_dc = _node_dcs(report)
+    dcs = [node_dc.get(h, "") for h in holders]
+    known = [d for d in dcs if d]
+    if not known:
+        return -1, ""
+    # most survivors, ties to the lexicographically first DC — two
+    # planners over one report must land the repair in the same place
+    tally: dict[str, int] = {}
+    for d in known:
+        tally[d] = tally.get(d, 0) + 1
+    repair_dc = min(tally, key=lambda d: (-tally[d], d))
+    per = nbytes / max(1, len(dcs)) if not targets else nbytes
+    total = 0.0
+    if targets:
+        # replica copies: nbytes per target from the nearest holder
+        for t in targets:
+            tdc = node_dc.get(t, "")
+            total += min(costs.cost(h, "", tdc, "x") for h in known) * per
+    else:
+        # survivor reads: each holder ships its share into repair_dc
+        # (intra-DC helpers price as cross_rack — the planner has no
+        # rack detail, and same-rack survivors are the exception)
+        for d in dcs:
+            total += costs.weighted(per, d or repair_dc, "", repair_dc, "x")
+    return int(total), repair_dc
 
 
 def _ec_rebuild_cost(probe_geometry, vid: int, collection: str,
@@ -186,7 +255,7 @@ def _ec_rebuild_cost(probe_geometry, vid: int, collection: str,
 
 
 def build_plan(report: dict, probe_remountable=None,
-               probe_geometry=None) -> RepairPlan:
+               probe_geometry=None, costs=None) -> RepairPlan:
     """Derive the repair plan from a health report (master/health.py
     evaluate() / HealthEngine.scan() / GET /cluster/health — all three
     produce the same shape).
@@ -203,6 +272,12 @@ def build_plan(report: dict, probe_remountable=None,
     the volume's sealed codec through the coder registry, so a
     piggybacked stripe's 0.65x and an msr stripe's (n-1)/p repair reads
     are what get costed and ordered, not the plain-RS d-full-shards.
+
+    `costs` (a geo LinkCostModel) additionally prices each item in
+    cost-weighted bytes (`cost_weighted_bytes`, `repair_dc`): survivor
+    reads weighted by the link from each holder's DC into the DC with
+    the most survivors, replica copies by the cheapest holder->target
+    link — and replica targets prefer near survivors.
     """
     from ..utils import retry
 
@@ -250,25 +325,34 @@ def build_plan(report: dict, probe_remountable=None,
                 cost, codec = _ec_rebuild_cost(
                     probe_geometry, it["id"], it.get("collection", ""),
                     rebuild)
+                weighted, repair_dc = _weighted(
+                    report, it.get("holders", ()), cost, costs)
                 items.append(RepairItem(
                     action=ACTION_EC_REBUILD, kind="ec", vid=it["id"],
                     collection=it.get("collection", ""), severity=sev,
                     distance=it["distance_to_data_loss"],
                     shard_ids=rebuild, bytes_moved=cost,
-                    repair_codec=codec))
+                    repair_codec=codec, cost_weighted_bytes=weighted,
+                    repair_dc=repair_dc))
         elif kind == "volume":
             deficit = it.get("replica_deficit", 0)
             if not deficit:
                 continue
             holders = sorted(it.get("holders", ()))
             size = it.get("size")  # absent (pre-size reports) != zero
+            targets = _pick_replica_targets(report, holders, deficit,
+                                            costs=costs)
+            weighted, repair_dc = _weighted(
+                report, holders, size if size is not None else -1,
+                costs, targets=targets)
             items.append(RepairItem(
                 action=ACTION_REPLICATE, kind="volume", vid=it["id"],
                 collection=it.get("collection", ""), severity=sev,
                 distance=it["distance_to_data_loss"], deficit=deficit,
                 sources=retry.order_by_breaker(holders),
-                targets=_pick_replica_targets(report, holders, deficit),
-                bytes_moved=(size * deficit if size is not None else -1)))
+                targets=targets,
+                bytes_moved=(size * deficit if size is not None else -1),
+                cost_weighted_bytes=weighted, repair_dc=repair_dc))
         # node/disk items (stale heartbeats, full disks) are operator
         # signals, not volume repairs — the plan leaves them to alerts
     items.sort(key=_sort_key)

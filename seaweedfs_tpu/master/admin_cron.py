@@ -63,7 +63,8 @@ class AdminCron:
                  health_fetch=None,
                  initial_delay_s: float | None = None,
                  repair_max_concurrent: int = 2,
-                 repair_cooldown_s: float = 60.0):
+                 repair_cooldown_s: float = 60.0,
+                 costs_fn=None):
         self.master_address = master_address
         self.scripts = list(DEFAULT_SCRIPTS if scripts is None else scripts)
         self.interval_s = interval_s
@@ -71,6 +72,9 @@ class AdminCron:
         self.vacuum_enabled = vacuum_enabled
         # () -> health report dict; None = legacy scripted repair only
         self.health_fetch = health_fetch
+        # () -> geo LinkCostModel | None: prices planner items in
+        # cost-weighted bytes (the master wires its -linkCosts policy)
+        self.costs_fn = costs_fn
         self.repair_max_concurrent = repair_max_concurrent
         self.repair_cooldown_s = repair_cooldown_s
         # A node dying right after a master restart should not wait a full
@@ -252,8 +256,9 @@ class AdminCron:
         instead of being retried every 17 minutes at full rate."""
         from ..maintenance import RepairExecutor, build_plan, make_probes
         remount_probe, geometry_probe = make_probes(env)
+        costs = self.costs_fn() if self.costs_fn is not None else None
         plan = build_plan(report, probe_remountable=remount_probe,
-                          probe_geometry=geometry_probe)
+                          probe_geometry=geometry_probe, costs=costs)
         if self._repair_exec is None:
             self._repair_exec = RepairExecutor(
                 env, max_concurrent=self.repair_max_concurrent,

@@ -110,6 +110,14 @@ def evaluate(snapshot: dict, parity: int = DEFAULT_PARITY_SHARDS,
     read_only_volumes = 0
     full_volumes = 0
     size_limit = snapshot.get("volume_size_limit") or 0
+    # holder -> DC so DEGRADED/AT_RISK items name the data centers
+    # still holding copies (the geo operator's first question during a
+    # DC sever: "which side has the surviving bytes?")
+    node_dc = {nd["id"]: nd.get("dc", "")
+               for nd in snapshot.get("nodes", ())}
+
+    def _dcs_of(holders) -> list[str]:
+        return sorted({node_dc.get(h, "") for h in holders} - {""})
 
     for v in snapshot.get("volumes", ()):
         sev, dist = score_replicated(v["present"], v["expected"])
@@ -132,6 +140,7 @@ def evaluate(snapshot: dict, parity: int = DEFAULT_PARITY_SHARDS,
                 "read_only": bool(v.get("read_only")), "full": full,
                 "size": v.get("size", 0),
                 "holders": sorted(v.get("holders", ())),
+                "dcs": _dcs_of(v.get("holders", ())),
             })
 
     for e in snapshot.get("ec_volumes", ()):
@@ -153,6 +162,8 @@ def evaluate(snapshot: dict, parity: int = DEFAULT_PARITY_SHARDS,
                 "shards_present": present_ids,
                 "shards_missing": missing,
                 "rs": {"k": k, "n": n},
+                "holders": sorted(e.get("holders", ())),
+                "dcs": _dcs_of(e.get("holders", ())),
             })
 
     nodes_out: list[dict] = []
@@ -167,18 +178,21 @@ def evaluate(snapshot: dict, parity: int = DEFAULT_PARITY_SHARDS,
             stale_nodes += 1
             items.append({"kind": "node", "id": nd["id"],
                           "severity": DEGRADED, "stale": True,
-                          "age_s": round(age, 1)})
+                          "age_s": round(age, 1),
+                          "dc": nd.get("dc", "")})
             counts[DEGRADED] += 1
         if disk_full:
             items.append({"kind": "disk", "id": nd["id"],
                           "severity": DEGRADED, "used_slots": used,
-                          "max_slots": cap})
+                          "max_slots": cap, "dc": nd.get("dc", "")})
             counts[DEGRADED] += 1
         nodes_out.append({"id": nd["id"],
                           "age_s": (round(age, 1) if age is not None
                                     else None),
                           "stale": stale, "used_slots": used,
-                          "max_slots": cap})
+                          "max_slots": cap,
+                          "rack": nd.get("rack", ""),
+                          "dc": nd.get("dc", "")})
 
     verdict = OK
     for it in items:
@@ -213,6 +227,7 @@ def snapshot_from_topology_info(ti, volume_size_limit: int = 0,
     volumes: dict[int, dict] = {}
     ec_present: dict[int, set[int]] = {}
     ec_collection: dict[int, str] = {}
+    ec_holders: dict[int, set[str]] = {}
     nodes: list[dict] = []
     for dc in ti.data_center_infos:
         for rack in dc.rack_infos:
@@ -237,12 +252,15 @@ def snapshot_from_topology_info(ti, volume_size_limit: int = 0,
                         ec_present.setdefault(s.id, set()).update(
                             ec_bits.shard_ids(s.ec_index_bits))
                         ec_collection[s.id] = s.collection
+                        ec_holders.setdefault(s.id, set()).add(node.id)
                 nodes.append({"id": node.id, "age_s": None,
-                              "used_slots": used, "max_slots": cap})
+                              "used_slots": used, "max_slots": cap,
+                              "rack": rack.id, "dc": dc.id})
     ec_volumes = []
     for vid, ids in sorted(ec_present.items()):
         rec = {"id": vid, "collection": ec_collection.get(vid, ""),
                "present_ids": sorted(ids),
+               "holders": ec_holders.get(vid, set()),
                "expected_n": (max(ids) + 1) if ids else 0}
         if expected_n_of is not None:
             got = expected_n_of(vid, sorted(ids))
@@ -310,6 +328,8 @@ class HealthEngine:
                     "id": vid,
                     "collection": topo.ec_collections.get(vid, ""),
                     "present_ids": present,
+                    "holders": set().union(*shard_locs.values())
+                    if shard_locs else set(),
                     "expected_n": max(topo.ec_expected.get(vid, 0),
                                       (max(present) + 1) if present else 0)})
             for node in topo.nodes.values():
@@ -319,7 +339,10 @@ class HealthEngine:
                 free = sum(d.free_slots() for d in node.disks.values())
                 nodes.append({"id": node.id,
                               "age_s": now - node.last_seen,
-                              "used_slots": cap - free, "max_slots": cap})
+                              "used_slots": cap - free, "max_slots": cap,
+                              "rack": node.rack.id if node.rack else "",
+                              "dc": (node.rack.dc.id if node.rack
+                                     else "")})
         return {"volumes": sorted(volumes.values(), key=lambda v: v["id"]),
                 "ec_volumes": sorted(ec_volumes, key=lambda e: e["id"]),
                 "nodes": nodes,
@@ -371,6 +394,13 @@ class HealthEngine:
             EC_SHARDS_MISSING.set(value=report["totals"]["ec_shards_missing"])
             REPLICA_DEFICIT.set(value=report["totals"]["replica_deficit"])
             NODES_STALE.set(value=report["totals"]["nodes_stale"])
+            from ..stats import CLUSTER_NODES_BY_DC
+            by_dc: dict[str, int] = {}
+            for nd in report.get("nodes", ()):
+                by_dc[nd.get("dc") or "-"] = \
+                    by_dc.get(nd.get("dc") or "-", 0) + 1
+            for dc, n in by_dc.items():
+                CLUSTER_NODES_BY_DC.set(dc, value=n)
         except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break the scan)
             pass
 

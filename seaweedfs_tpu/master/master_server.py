@@ -45,13 +45,15 @@ class MasterServer:
                  ec_parity_shards: int | None = None,
                  lifecycle_policy: str = "",
                  slo_policy: str = "",
+                 link_costs: str = "",
                  telemetry_interval_s: float | None = None):
         self.ip = ip
         self.port = port
         self.address = f"{ip}:{port}"
         self.topo = Topology(volume_size_limit_mb * 1024 * 1024)
         self.layouts = LayoutRegistry(self.topo)
-        self.growth = VolumeGrowth(self.topo, allocate_fn=self._allocate_volume)
+        self.growth = VolumeGrowth(self.topo, allocate_fn=self._allocate_volume,
+                                   costs_fn=lambda: self.link_costs)
         # per-layout cooldown after a failed writableVolumeCount grow
         # (monotonic deadline); without it every assign on a full
         # cluster re-runs a doomed topology-wide allocation sweep
@@ -158,7 +160,8 @@ class MasterServer:
             is_leader=lambda: self.is_leader,
             vacuum_enabled=lambda: not self.vacuum_disabled,
             health_fetch=(self.health.scan if maintenance_health_driven
-                          else None))
+                          else None),
+            costs_fn=lambda: self.link_costs)
         # Fleet telemetry & SLO plane (telemetry/): a leader-resident
         # collector scrapes every node's exposition into a ring TSDB,
         # merges histograms into cluster percentiles, tracks heavy
@@ -174,6 +177,16 @@ class MasterServer:
                 with open(slo_policy, encoding="utf-8") as f:
                     doc = f.read()
             policy = parse_slo_policy(doc)
+        # Geo plane (geo/): the per-link cost model prices replica
+        # growth, EC spread, repair fetches and balance moves in
+        # cost-weighted bytes (intra_rack < cross_rack < cross_dc).
+        # Same inline-JSON-or-file convention as -sloPolicy; the parsed
+        # model feeds the placement engine, the raw doc is served at
+        # /cluster/linkcosts so shell planners price moves identically.
+        self.link_costs_source = link_costs
+        from ..geo.policy import LinkCostModel, load_link_costs
+        self.link_costs = (load_link_costs(link_costs) if link_costs
+                           else LinkCostModel())
         self.telemetry = TelemetryCollector(
             node_id=f"master@{self.address}",
             targets_fn=self._telemetry_targets,
@@ -209,7 +222,9 @@ class MasterServer:
         targets = []
         for n in self.topo.all_nodes():
             targets.append({"node": f"volume@{n.id}",
-                            "url": f"http://{n.url}/metrics"})
+                            "url": f"http://{n.url}/metrics",
+                            "dc": n.rack.dc.id if n.rack else "",
+                            "rack": n.rack.id if n.rack else ""})
         with self._sub_lock:
             metas = list(self._sub_meta.values())
         for address, client_type, _ver, _ts, _grpc in metas:
@@ -718,6 +733,12 @@ class MasterServer:
                 "policy_error": err,
                 "recent": events.debug_events_payload(qq)})
 
+        def cluster_linkcosts(req, q):
+            """The master's parsed link-cost model, as a policy doc —
+            shell balance planners fetch it so their cost-weighted plans
+            match what the master's own cron would produce."""
+            return json_response(ms.link_costs.to_doc())
+
         app = fastweb.FastApp()
         app.route("/metrics", metrics)
         app.route("/dir/status", offloaded(guarded("/dir/status", dir_status)))
@@ -747,6 +768,8 @@ class MasterServer:
                   offloaded(guarded("/cluster/health", cluster_health)))
         app.route("/cluster/telemetry",
                   offloaded(guarded("/cluster/telemetry", cluster_telemetry)))
+        app.route("/cluster/linkcosts",
+                  guarded("/cluster/linkcosts", cluster_linkcosts))
         # guarded+offloaded like the other /debug routes (the journal
         # filter walks the whole ring)
         app.route("/debug/lifecycle",
@@ -959,8 +982,12 @@ class MasterServer:
             for sid, nodes in sorted(ms.topo.lookup_ec(req.volume_id).items()):
                 e = resp.shard_id_locations.add(shard_id=sid)
                 for n in nodes:
+                    # data_center lets repair planners classify the link
+                    # to each survivor holder (geo plane fold grouping)
                     e.locations.add(url=n.url, public_url=n.public_url,
-                                    grpc_port=n.grpc_port)
+                                    grpc_port=n.grpc_port,
+                                    data_center=(n.rack.dc.id
+                                                 if n.rack else ""))
             return resp
 
         @svc.unary("Statistics", pb.StatisticsRequest, pb.StatisticsResponse)

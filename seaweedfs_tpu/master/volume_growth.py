@@ -42,13 +42,17 @@ class GrowRequest:
 
 class VolumeGrowth:
     def __init__(self, topo: Topology, allocate_fn=None,
-                 rng: "random.Random | None" = None):
+                 rng: "random.Random | None" = None, costs_fn=None):
         """allocate_fn(node, vid, req) performs the AllocateVolume RPC; tests
         inject a fake. `rng` seeds every shuffle/choice in the pick paths
-        (tests pin it; production uses the module-global stream)."""
+        (tests pin it; production uses the module-global stream).
+        `costs_fn() -> LinkCostModel | None` (geo plane) prices the
+        other-DC replica choice — called lazily so the master can wire
+        it before its policy parses."""
         self.topo = topo
         self.allocate_fn = allocate_fn
         self.rng = rng if rng is not None else random
+        self.costs_fn = costs_fn
 
     def find_slots(self, req: GrowRequest) -> list[DataNode]:
         """Pick a replica set honoring the placement code, or raise."""
@@ -74,7 +78,7 @@ class VolumeGrowth:
                     continue
                 main_dc = dc
                 servers = picked
-                for d in self.rng.sample(others, rp.other_dc):
+                for d in self._order_other_dcs(others, dc, rp.other_dc):
                     n = self._pick_one(self._dc_nodes(d), req)
                     if n is None:
                         break
@@ -86,6 +90,21 @@ class VolumeGrowth:
                     f"no free volume slots for replication {req.replication} "
                     f"disk {req.disk_type}")
             raise RuntimeError("insufficient data centers for replication")
+
+    def _order_other_dcs(self, others: list, main_dc, k: int) -> list:
+        """The `k` other DCs an other_dc replica lands in. Geo-blind:
+        a plain random sample (the historical behavior). With a link
+        cost model: a random permutation stably re-sorted by link cost
+        from the main DC, so the CHEAPEST cross-DC links carry replica
+        traffic first and equal-cost ties stay randomized — on a fleet
+        with uniform cross-DC pricing this degrades to the exact
+        random sample."""
+        costs = self.costs_fn() if self.costs_fn is not None else None
+        if costs is None:
+            return self.rng.sample(others, k)
+        chosen = self.rng.sample(others, len(others))
+        chosen.sort(key=lambda d: costs.cost(main_dc.id, "", d.id, ""))
+        return chosen[:k]
 
     def _dc_nodes(self, dc) -> list[DataNode]:
         return [n for r in dc.racks.values() for n in r.nodes.values()]

@@ -51,6 +51,10 @@ W_LOAD = 0.5
 W_RACK = 1.5
 W_DC = 0.75
 W_BREAKER = 2.0
+# geo link-cost term (PR 19): kept strictly below W_DC so failure-
+# domain spread still beats cheapness — geo only ORDERS candidates that
+# spread equally (the cheapest other-DC wins, never the same DC twice)
+W_GEO = 0.6
 
 # fallback per-shard byte estimate divisor when no geometry probe
 # reached a stripe: a shard of RS(d,p) holds ~1/d of the volume, and
@@ -130,10 +134,25 @@ def _breaker_penalty(node_id: str) -> float:
     return 0.0
 
 
+def geo_penalty(costs, origin, node: NodeView) -> float:
+    """Normalized [0, 1] link-cost penalty of reaching `node` from
+    `origin` = (dc, rack): 0 on the cheapest link class, 1 on the
+    priciest known (cross-DC incl. overrides). None costs/origin -> 0,
+    so geo-blind callers pay nothing."""
+    if costs is None or origin is None:
+        return 0.0
+    c = costs.cost(origin[0], origin[1], node.dc, node.rack)
+    worst = max([costs.cross_dc, *costs.overrides.values()])
+    span = worst - costs.intra_rack
+    return (c - costs.intra_rack) / span if span > 0 else 0.0
+
+
 def score(node: NodeView, cohort_max_load: int = 0,
-          avoid_racks=(), avoid_dcs=()) -> float:
+          avoid_racks=(), avoid_dcs=(), costs=None, origin=None) -> float:
     """The one scoring formula (module docstring). `cohort_max_load`
-    normalizes the byte-load term across the candidate set."""
+    normalizes the byte-load term across the candidate set; `costs` (a
+    geo LinkCostModel) + `origin` (dc, rack) add the W_GEO-weighted
+    link-cost term for placements that copy bytes from somewhere."""
     s = W_FREE * node.free_ratio
     if cohort_max_load > 0:
         s -= W_LOAD * (node.load_bytes / cohort_max_load)
@@ -141,12 +160,13 @@ def score(node: NodeView, cohort_max_load: int = 0,
         s -= W_RACK
     if node.dc and node.dc in avoid_dcs:
         s -= W_DC
+    s -= W_GEO * geo_penalty(costs, origin, node)
     s -= W_BREAKER * _breaker_penalty(node.id)
     return s
 
 
 def rank(nodes: list, rng: "random.Random | None" = None,
-         avoid_racks=(), avoid_dcs=()) -> list:
+         avoid_racks=(), avoid_dcs=(), costs=None, origin=None) -> list:
     """Candidates best-first; exact-score ties shuffled by `rng` (seeded
     by tests, module-global `random` otherwise) then id-ordered so a
     seeded run is fully deterministic."""
@@ -156,13 +176,14 @@ def rank(nodes: list, rng: "random.Random | None" = None,
     cohort_max = max(n.load_bytes for n in nodes)
     jitter = {n.id: rng.random() for n in nodes}
     return sorted(nodes, key=lambda n: (
-        -score(n, cohort_max, avoid_racks, avoid_dcs), jitter[n.id], n.id))
+        -score(n, cohort_max, avoid_racks, avoid_dcs, costs, origin),
+        jitter[n.id], n.id))
 
 
 def pick_best(nodes: list, rng: "random.Random | None" = None,
-              avoid_racks=(), avoid_dcs=()):
+              avoid_racks=(), avoid_dcs=(), costs=None, origin=None):
     """The single best candidate (ties random through rng), or None."""
-    ranked = rank(nodes, rng, avoid_racks, avoid_dcs)
+    ranked = rank(nodes, rng, avoid_racks, avoid_dcs, costs, origin)
     return ranked[0] if ranked else None
 
 
@@ -257,7 +278,7 @@ def snapshot_from_topology(topo, disk_type: str = "") -> Snapshot:
 
 def spread_ec_shards(snapshot: Snapshot, n_shards: int, parity: int,
                      rng: "random.Random | None" = None,
-                     vid: int = 0) -> list:
+                     vid: int = 0, costs=None, origin=None) -> list:
     """Assign each of a stripe's `n_shards` shards to a NodeView such
     that NO RACK holds more than `parity` shards — rack loss then costs
     at most p shards, which RS(d,p) reconstructs: rack loss ≠ data
@@ -299,7 +320,8 @@ def spread_ec_shards(snapshot: Snapshot, n_shards: int, parity: int,
             cands = list(snapshot.nodes)  # cap exhausted: stay even
         best = min(cands, key=lambda n: (
             node_count.get(n.id, 0), rack_count.get(n.rack, 0),
-            -score(n, cohort_max), jitter[n.id], n.id))
+            -score(n, cohort_max, costs=costs, origin=origin),
+            jitter[n.id], n.id))
         out.append(best)
         node_count[best.id] = node_count.get(best.id, 0) + 1
         rack_count[best.rack] = rack_count.get(best.rack, 0) + 1

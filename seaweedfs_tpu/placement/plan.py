@@ -54,7 +54,10 @@ DEFAULT_CROSS_RACK_LIMIT = 30 << 30
 @dataclass
 class Move:
     """One rebalance move: a whole volume, or a group of EC shards of
-    one stripe between one (src, dst) pair."""
+    one stripe between one (src, dst) pair. `link` is the geo link
+    class the bytes cross (policy.LINK_CLASSES) and
+    `cost_weighted_bytes` = bytes_moved * that link's cost multiplier —
+    the currency plans are ordered and budgeted in (PR 19)."""
     kind: str                # "volume" | "ec"
     vid: int
     collection: str
@@ -63,11 +66,14 @@ class Move:
     bytes_moved: int
     cross_rack: bool = False
     shard_ids: list[int] = field(default_factory=list)  # ec only
+    link: str = "intra_rack"
+    cost_weighted_bytes: int = 0
 
     def describe(self) -> str:
         what = (f"volume {self.vid}" if self.kind == MOVE_VOLUME
                 else f"ec {self.vid} shards {self.shard_ids}")
-        hop = "cross-rack" if self.cross_rack else "intra-rack"
+        hop = self.link.replace("_", "-") if self.link else (
+            "cross-rack" if self.cross_rack else "intra-rack")
         return (f"{what} {self.src} -> {self.dst} "
                 f"(~{self.bytes_moved:,} B, {hop})")
 
@@ -75,7 +81,8 @@ class Move:
         return {"kind": self.kind, "vid": self.vid,
                 "collection": self.collection, "src": self.src,
                 "dst": self.dst, "bytes_moved": self.bytes_moved,
-                "cross_rack": self.cross_rack,
+                "cross_rack": self.cross_rack, "link": self.link,
+                "cost_weighted_bytes": self.cost_weighted_bytes,
                 "shard_ids": list(self.shard_ids)}
 
 
@@ -102,19 +109,32 @@ class MovePlan:
     def cross_rack_bytes(self) -> int:
         return sum(m.bytes_moved for m in self.moves if m.cross_rack)
 
+    @property
+    def cross_dc_bytes(self) -> int:
+        return sum(m.bytes_moved for m in self.moves
+                   if m.link == "cross_dc")
+
+    @property
+    def cost_weighted_bytes(self) -> int:
+        return sum(m.cost_weighted_bytes for m in self.moves)
+
     def to_dict(self) -> dict:
         return {"moves": [m.to_dict() for m in self.moves],
                 "skew_before": round(self.skew_before, 3),
                 "skew_after": round(self.skew_after, 3),
                 "total_bytes": self.total_bytes,
                 "cross_rack_bytes": self.cross_rack_bytes,
+                "cross_dc_bytes": self.cross_dc_bytes,
+                "cost_weighted_bytes": self.cost_weighted_bytes,
                 "notes": list(self.notes),
                 "generated_ms": self.generated_ms}
 
     def render(self, println) -> None:
         println(f"balance plan: {len(self.moves)} move(s), "
                 f"{self.total_bytes:,} B total "
-                f"({self.cross_rack_bytes:,} B cross-rack), "
+                f"({self.cross_rack_bytes:,} B cross-rack, "
+                f"{self.cross_dc_bytes:,} B cross-dc, "
+                f"{self.cost_weighted_bytes:,} cost-weighted), "
                 f"byte skew {self.skew_before:.2f} -> "
                 f"{self.skew_after:.2f} (planned)")
         for i, m in enumerate(self.moves, 1):
@@ -138,11 +158,20 @@ def build_volume_balance_plan(
         snap: Snapshot, collection: "str | None" = None,
         target_skew: float = DEFAULT_TARGET_SKEW,
         max_moves: int = DEFAULT_MAX_MOVES,
-        cross_rack_limit_bytes: int = DEFAULT_CROSS_RACK_LIMIT) -> MovePlan:
+        cross_rack_limit_bytes: int = DEFAULT_CROSS_RACK_LIMIT,
+        costs=None) -> MovePlan:
     """Greedy byte balance over one snapshot. Only volumes (optionally
     of one collection) move; EC shard bytes still weigh the load on
     both ends, so a shard-heavy server neither donates volumes it
-    doesn't have nor attracts volumes it can't afford."""
+    doesn't have nor attracts volumes it can't afford.
+
+    `costs` (geo LinkCostModel; default price list when None) prices
+    every candidate hop: the greedy key prefers the cheapest link that
+    closes a gap — a cross-DC move only plans when no intra-DC fix
+    exists — and cross-DC traffic is separately capped by the policy's
+    `cross_dc_budget` (0 = unlimited)."""
+    from ..geo.policy import LinkCostModel
+    costs = costs or LinkCostModel()
     nodes = {n.id: n for n in snap.nodes}
     if len(nodes) < 2:
         return MovePlan([], 1.0, 1.0)
@@ -168,6 +197,7 @@ def build_volume_balance_plan(
     moves: list[Move] = []
     notes: list[str] = []
     cross_budget = cross_rack_limit_bytes
+    dc_budget = costs.cross_dc_budget or float("inf")
     capped = False
     # moves conserve bytes, so the convergence target is fixed up front
     mean = sum(loads.values()) / len(loads)
@@ -194,8 +224,14 @@ def build_volume_balance_plan(
                 dgap = loads[src_id] - loads[dst_id]
                 if dgap <= 0:
                     continue
-                cross = nodes[src_id].rack != nodes[dst_id].rack
+                s_n, d_n = nodes[src_id], nodes[dst_id]
+                link = costs.classify(s_n.dc, s_n.rack, d_n.dc, d_n.rack)
+                mult = costs.cost(s_n.dc, s_n.rack, d_n.dc, d_n.rack)
+                cross = link != "intra_rack"
                 if cross and cross_budget <= 0:
+                    capped = True
+                    continue
+                if link == "cross_dc" and dc_budget <= 0:
                     capped = True
                     continue
                 if free[dst_id] <= 0:
@@ -208,26 +244,37 @@ def build_volume_balance_plan(
                     if cross and v["size"] > cross_budget:
                         capped = True
                         continue
+                    if link == "cross_dc" and v["size"] > dc_budget:
+                        capped = True
+                        continue
                     overshoots = loads[dst_id] + v["size"] > mean
-                    key = (overshoots, cross,
+                    # link-cost multiplier where the old key held the
+                    # cross-rack boolean: identical ordering on a
+                    # single-DC fleet (1 < 4 iff False < True), and the
+                    # cheapest link wins whenever one closes a gap
+                    key = (overshoots, mult,
                            abs(dgap / 2 - v["size"]),
                            v["size"], vid, dst_id)
                     if best is None or key < best[0]:
-                        best = (key, src_id, vid, v, dst_id, cross)
+                        best = (key, src_id, vid, v, dst_id, cross, link,
+                                mult)
             if best is not None:
                 break
         if best is None:
             if capped:
-                notes.append("cross-rack byte budget exhausted; "
+                notes.append("cross-rack/cross-dc byte budget exhausted; "
                              "remaining skew waits for the next run")
             break
-        _, src_id, vid, v, dst_id, cross = best
+        _, src_id, vid, v, dst_id, cross, link, mult = best
         moves.append(Move(kind=MOVE_VOLUME, vid=vid,
                           collection=v["collection"], src=src_id,
                           dst=dst_id, bytes_moved=v["size"],
-                          cross_rack=cross))
+                          cross_rack=cross, link=link,
+                          cost_weighted_bytes=int(v["size"] * mult)))
         if cross:
             cross_budget -= v["size"]
+        if link == "cross_dc":
+            dc_budget -= v["size"]
         del vol_state[src_id][vid]
         vol_state[dst_id][vid] = v
         holders[vid].discard(src_id)
@@ -246,7 +293,7 @@ def build_volume_balance_plan(
 def build_ec_balance_plan(
         snap: Snapshot, collection: "str | None" = None,
         parity_of=None, default_parity: int = 2,
-        max_moves: int = DEFAULT_MAX_MOVES) -> MovePlan:
+        max_moves: int = DEFAULT_MAX_MOVES, costs=None) -> MovePlan:
     """Even each EC stripe's per-server shard counts from ONE snapshot,
     honoring the rack-safety cap (≤ p shards of a stripe per rack).
     `parity_of(vid, collection) -> int|None` probes the sealed
@@ -255,13 +302,24 @@ def build_ec_balance_plan(
     All moves of one stripe between one (src, dst) pair are grouped
     into a single Move — the executor issues one VolumeEcShardsMove per
     pair (the satellite fix: the old loop re-ran the settled-holder
-    poll and a full topology collect per single shard)."""
+    poll and a full topology collect per single shard).
+
+    `costs` (geo LinkCostModel; defaults when None) orders candidate
+    destinations cheapest-link-first within the evenness/rack caps, so
+    a shard never crosses a DC when an intra-DC destination fixes the
+    same imbalance."""
+    from ..geo.policy import LinkCostModel
+    costs = costs or LinkCostModel()
     nodes = {n.id: n for n in snap.nodes}
     if len(nodes) < 2:
         return MovePlan([], 1.0, 1.0)
     loads = {nid: n.load_bytes for nid, n in nodes.items()}
     skew_before = _skew(loads)
     rack_of = {nid: n.rack for nid, n in nodes.items()}
+    dc_of = {nid: n.dc for nid, n in nodes.items()}
+
+    def _mult(a: str, b: str) -> float:
+        return costs.cost(dc_of[a], rack_of[a], dc_of[b], rack_of[b])
     # stripe state: vid -> {node_id: set(shard_ids)}
     stripes: dict[int, dict[str, set]] = {}
     meta: dict[int, dict] = {}
@@ -312,6 +370,10 @@ def build_ec_balance_plan(
                      and rack_counts.get(rack_of[nid], 0) > rack_cap),
                     key=lambda i: (-counts[i], i))
             for src_id in over:
+                # cost multiplier ranks AFTER the evenness/rack terms
+                # (spread is safety, cheapness is preference) but
+                # BEFORE load — an intra-DC destination beats a
+                # cross-DC one whenever both fix the imbalance
                 dsts = sorted(
                     (nid for nid in nodes
                      if nid != src_id and counts[nid] < cap
@@ -320,7 +382,7 @@ def build_ec_balance_plan(
                      and rack_counts.get(rack_of[nid], 0) < rack_cap),
                     key=lambda i: (counts[i],
                                    rack_counts.get(rack_of[i], 0),
-                                   loads[i], i))
+                                   _mult(src_id, i), loads[i], i))
                 # a node that already holds other shards of the stripe
                 # may still take more if it stays under the caps
                 if not dsts:
@@ -332,7 +394,7 @@ def build_ec_balance_plan(
                               < rack_cap)),
                         key=lambda i: (counts[i],
                                        rack_counts.get(rack_of[i], 0),
-                                       loads[i], i))
+                                       _mult(src_id, i), loads[i], i))
                 if not dsts:
                     continue
                 dst_id = dsts[0]
@@ -351,15 +413,23 @@ def build_ec_balance_plan(
                 key = (vid, src_id, dst_id)
                 mv = grouped.get(key)
                 if mv is None:
+                    link = costs.classify(
+                        dc_of[src_id], rack_of[src_id],
+                        dc_of[dst_id], rack_of[dst_id])
                     grouped[key] = Move(
                         kind=MOVE_EC, vid=vid,
                         collection=meta[vid]["collection"],
                         src=src_id, dst=dst_id, bytes_moved=sz,
-                        cross_rack=rack_of[src_id] != rack_of[dst_id],
+                        cross_rack=link != "intra_rack",
+                        link=link,
+                        cost_weighted_bytes=int(
+                            sz * _mult(src_id, dst_id)),
                         shard_ids=[sid])
                 else:
                     mv.shard_ids.append(sid)
                     mv.bytes_moved += sz
+                    mv.cost_weighted_bytes += int(
+                        sz * _mult(src_id, dst_id))
                 moved_any = True
                 break
     moves.extend(sorted(grouped.values(),

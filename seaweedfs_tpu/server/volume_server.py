@@ -154,6 +154,12 @@ class VolumeServer:
         # degraded-read fan-out pool (store_ec.go:367 goroutine fan-out)
         self._ec_loc_cache: dict[int, tuple[dict, float, bool]] = {}
         self._ec_loc_lock = threading.Lock()
+        # geo plane: peer gRPC address -> data center, learned from the
+        # master's LookupEcVolume answers (Location.data_center). Keyed
+        # by address, not volume — a server's DC never changes within a
+        # process lifetime, so single whole-value writes under the GIL
+        # need no lock and staleness is not a failure mode.
+        self._ec_addr_dc: dict[str, str] = {}
         # replica-set cache for the write fan-out (see _lookup_replicas_cached)
         self._replica_cache: dict[int, tuple[float, list[str]]] = {}
         from ..profiling import LoopLagMonitor, MonitoredPool
@@ -2063,13 +2069,20 @@ class VolumeServer:
                     plan.bytes_total())
         return reader
 
-    def _make_repair_reader(self, vid: int):
-        """(shard_reader, fragment_reader, remote_sids) for a rebuild on
-        THIS server: survivors that live elsewhere are fetched by RANGE
-        through VolumeEcShardRead — or, for repair-efficient codecs
-        whose plans name many scattered ranges (msr repair planes), by
-        its ranged-COMPUTE mode, which packs them into one wire fragment
-        per survivor per window.
+    def _make_repair_reader(self, vid: int, codec: "str | None" = None):
+        """(shard_reader, fragment_reader, remote_sids, fold_planner)
+        for a rebuild on THIS server: survivors that live elsewhere are
+        fetched by RANGE through VolumeEcShardRead — or, for repair-
+        efficient codecs whose plans name many scattered ranges (msr
+        repair planes), by its ranged-COMPUTE mode, which packs them
+        into one wire fragment per survivor per window. `fold_planner`
+        (geo plane) additionally groups far-DC msr helpers behind a
+        same-DC relay that folds their plane rows into ONE alpha-row
+        partial before crossing the expensive link.
+
+        Every off-node fetch books SeaweedFS_repair_bytes_by_link_total
+        by the holder's DC vs this server's (the master's answers carry
+        DC, not rack, so same-DC hops book as cross_rack).
 
         The read-path location cache is BYPASSED: its freshest tier is
         still 11 s, and a rebuild planned against a pre-failure holder
@@ -2091,15 +2104,128 @@ class VolumeServer:
         peers = {sid: [a for a in addrs if a != me]
                  for sid, addrs in locs.items()}
         remote = sorted(sid for sid, addrs in peers.items() if addrs)
+        if codec is None:
+            ev = self.store.find_ec_volume(vid)
+            codec = ev.codec if ev is not None else "rs"
+
+        def _book(link: str, n: int) -> None:
+            try:
+                from ..stats import REPAIR_BYTES_BY_LINK
+                REPAIR_BYTES_BY_LINK.inc(codec, link, amount=n)
+            except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (metrics must never break repair)
+                pass
+
+        def _link_of(sid: int) -> "str | None":
+            # attribution by primary holder: the fallback discipline may
+            # serve from a later holder, but the first healthy one is
+            # the overwhelmingly common server and the only defensible
+            # single answer without per-fetch plumbing
+            holders = peers.get(sid)
+            if not holders or not self.data_center:
+                return None
+            dc = self._ec_addr_dc.get(holders[0], "")
+            if not dc:
+                return None
+            return "cross_rack" if dc == self.data_center else "cross_dc"
 
         def reader(sid: int, offset: int, length: int) -> bytes:
-            return self._fetch_range_or_raise(vid, sid, offset, length,
+            data = self._fetch_range_or_raise(vid, sid, offset, length,
                                               peers.get(sid, []))
+            link = _link_of(sid)
+            if link:
+                _book(link, len(data))
+            return data
 
         def fragment_reader(sid: int, ranges) -> bytes:
-            return self._fetch_fragment_or_raise(vid, sid, ranges,
-                                                 peers.get(sid, []))
-        return reader, fragment_reader, remote
+            buf = self._fetch_fragment_or_raise(vid, sid, ranges,
+                                                peers.get(sid, []))
+            link = _link_of(sid)
+            if link:
+                _book(link, len(buf))
+            return buf
+
+        def _fold_fetch(f, sids, srcs, mat, alpha):
+            """One relay group's fetch(ranges) -> folded partial of
+            alpha rows. sids[0]/srcs[0] is the relay; it gathers the
+            rest of the group's plane rows DC-locally (gather_* request
+            fields) and applies the stacked combine matrix, so only
+            alpha rows cross the thin link instead of |group|*beta."""
+            import numpy as np
+            relay_sid, relay = sids[0], srcs[0]
+
+            def fetch(ranges) -> "np.ndarray":
+                want = alpha * ranges[0][1]
+                try:
+                    stub = Stub(relay, VOLUME_SERVICE)
+                    parts = [r.data for r in stub.call_stream(
+                        "VolumeEcShardRead",
+                        vpb.VolumeEcShardReadRequest(
+                            volume_id=vid, shard_id=relay_sid,
+                            fragment_offsets=[o for o, _ in ranges],
+                            fragment_lengths=[ln for _, ln in ranges],
+                            combine_rows=alpha,
+                            combine_matrix=mat.tobytes(),
+                            gather_shard_ids=list(sids[1:]),
+                            gather_sources=list(srcs[1:])),
+                        vpb.VolumeEcShardReadResponse)]
+                    buf = b"".join(parts)
+                    if len(buf) != want:
+                        raise OSError(f"folded partial {len(buf)} bytes "
+                                      f"!= {want}")
+                    _book("cross_dc", want)
+                    return np.frombuffer(buf, dtype=np.uint8)
+                except Exception as e:  # noqa: BLE001
+                    log.warning("folded fetch vid=%d f=%d relay=%s: %s; "
+                                "shipping raw fragments", vid, f, relay, e)
+                # relay down or legacy: ship the raw rows (no geo
+                # saving) and fold locally — repair still converges
+                from ..ops import gf8
+                w = ranges[0][1]
+                rows = []
+                for s in sids:
+                    buf = fragment_reader(s, list(ranges))
+                    arr = np.frombuffer(buf, dtype=np.uint8)
+                    rows.extend(arr.reshape(len(ranges), w))
+                return gf8.np_gf_apply(mat, np.stack(rows))
+            return fetch
+
+        def fold_planner(coder, f: int):
+            """[(sids, fetch)] relay groups for rebuild_msr_single: one
+            per far DC holding > q helpers (geo/repair_fold.py). Empty
+            when geo folding is off (SWTPU_GEO_FOLD=0), topology is
+            unknown, or no far group is big enough to pay for a relay
+            hop."""
+            if os.environ.get("SWTPU_GEO_FOLD", "1") == "0" or \
+                    not self.data_center or coder.codec != "msr":
+                return []
+            g = coder.grid
+            if g.q < 2:
+                return []
+            from ..geo import repair_fold
+            helper_dcs = {}
+            for sid, addrs in peers.items():
+                if sid == f or not addrs:
+                    continue
+                dc = self._ec_addr_dc.get(addrs[0], "")
+                if dc:
+                    helper_dcs[sid] = dc
+            folds = []
+            for dc, sids in repair_fold.fold_groups(
+                    helper_dcs, self.data_center, g.q):
+                srcs = []
+                for s in sids:
+                    cands = [a for a in peers.get(s, ())
+                             if self._ec_addr_dc.get(a) == dc]
+                    if not cands:
+                        break
+                    srcs.append(cands[0])
+                if len(srcs) != len(sids):
+                    continue  # a member lost its in-DC holder
+                mat = repair_fold.stacked_matrix(g.d, g.p, f, sids)
+                folds.append((sids, _fold_fetch(f, sids, srcs, mat,
+                                                g.alpha)))
+            return folds
+        return reader, fragment_reader, remote, fold_planner
 
     def _fetch_range_or_raise(self, vid: int, sid: int, offset: int,
                               length: int, holders: "list[str]") -> bytes:
@@ -2211,10 +2337,16 @@ class VolumeServer:
             resp = stub.call("LookupEcVolume",
                              mpb.LookupEcVolumeRequest(volume_id=vid),
                              mpb.LookupEcVolumeResponse, timeout=5)
-            return {e.shard_id:
-                    [f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}"
-                     for l in e.locations]
-                    for e in resp.shard_id_locations}
+            locs: dict[int, list[str]] = {}
+            for e in resp.shard_id_locations:
+                addrs = []
+                for l in e.locations:
+                    addr = f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}"
+                    addrs.append(addr)
+                    if l.data_center:
+                        self._ec_addr_dc[addr] = l.data_center
+                locs[e.shard_id] = addrs
+            return locs
         except Exception as e:  # noqa: BLE001
             log.warning("ec lookup vid=%d: %s", vid, e)
             return None
@@ -2611,12 +2743,14 @@ class VolumeServer:
                     dat_size=ev.dat_size or 0,
                     codec=ev.codec, shard_size=ev.shard_size,
                     local_shard_ids=sorted(set(ev.shards)
-                                           | set(on_disk(ev.base))))
+                                           | set(on_disk(ev.base))),
+                    remote_shard_ids=ev.remote_shard_ids())
             for loc in store.locations:
                 base = loc.base_name(req.collection, req.volume_id)
                 if os.path.exists(base + ".vif"):
                     info = ec_files.read_vif(base + ".vif")
                     geo = EcGeometry.from_vif(info, store.ec_geometry)
+                    rem = info.get("remote_shards") or {}
                     return vpb.VolumeEcShardsInfoResponse(
                         data_shards=info.get("d", 0),
                         parity_shards=info.get("p", 0),
@@ -2624,7 +2758,9 @@ class VolumeServer:
                         codec=info.get("codec", "rs"),
                         shard_size=geo.shard_file_size(
                             info.get("dat_size", 0)),
-                        local_shard_ids=on_disk(base))
+                        local_shard_ids=on_disk(base),
+                        remote_shard_ids=sorted(
+                            int(k) for k in rem.get("keys", {})))
             raise KeyError(f"ec volume {req.volume_id} not found")
 
         @svc.unary("VolumeEcShardsRebuild", vpb.VolumeEcShardsRebuildRequest,
@@ -2638,14 +2774,16 @@ class VolumeServer:
             t0 = time.perf_counter()
             stats: dict = {}
             try:
-                reader, frag, remote = vs._make_repair_reader(req.volume_id)
+                reader, frag, remote, fold = \
+                    vs._make_repair_reader(req.volume_id)
                 _ensure_vif(req.volume_id, req.collection)
                 rebuilt = store.rebuild_ec_shards(req.volume_id,
                                                   req.collection,
                                                   shard_reader=reader,
                                                   remote_shards=remote,
                                                   stats=stats,
-                                                  fragment_reader=frag)
+                                                  fragment_reader=frag,
+                                                  fold_planner=fold)
             except Exception as e:  # noqa: BLE001
                 events.emit("ec.rebuild.finish", severity=events.ERROR,
                             vid=req.volume_id, node=vs.url, ok=False,
@@ -2719,13 +2857,15 @@ class VolumeServer:
             _ensure_vif(req.volume_id, req.collection, base)
             info = ec_files.read_vif(base + ".vif")
             geo = EcGeometry.from_vif(info, store.ec_geometry)
-            reader, frag, remote = vs._make_repair_reader(req.volume_id)
+            reader, frag, remote, fold = vs._make_repair_reader(
+                req.volume_id, codec=info.get("codec", "rs"))
             stats: dict = {}
             rebuilt = rebuild_shards(
                 base, geo,
                 store.coder(geo.d, geo.p, codec=info.get("codec", "rs")),
                 wanted=list(req.shard_ids), shard_reader=reader,
-                remote_shards=remote, stats=stats, fragment_reader=frag)
+                remote_shards=remote, stats=stats, fragment_reader=frag,
+                fold_planner=fold)
             return vpb.VolumeEcShardsCopyByRebuildResponse(
                 rebuilt_shard_ids=rebuilt,
                 bytes_read=stats.get("bytes_read", 0),
@@ -2766,6 +2906,14 @@ class VolumeServer:
                     p = base + ec_files.shard_ext(s)
                     if os.path.exists(p):
                         os.remove(p)
+                # a remote-backed shard has no payload file here:
+                # release its .vif claim instead. The remote OBJECT is
+                # untouched — a move's target has already merged the
+                # claim, and a plain delete leaves cleanup to the
+                # lifecycle reaper that owns the remote tier.
+                if os.path.exists(base + ".vif"):
+                    ec_files.drop_remote_claims(base + ".vif",
+                                                list(req.shard_ids))
             vs.flush_heartbeat()
             return vpb.VolumeEcShardsDeleteResponse()
 
@@ -2782,13 +2930,57 @@ class VolumeServer:
                 os.path.exists(loc.base_name(req.collection,
                                              req.volume_id) + ".ecx")
                 for loc in store.locations)
+            src = Stub(req.source_data_node, VOLUME_SERVICE)
+            # a shard whose payload lives on the remote tier moves its
+            # .vif CLAIM, not bytes: probe which of the requested sids
+            # the source holds only as offloaded claims
+            try:
+                sinfo = src.call("VolumeEcShardsInfo",
+                                 vpb.VolumeEcShardsInfoRequest(
+                                     volume_id=req.volume_id,
+                                     collection=req.collection),
+                                 vpb.VolumeEcShardsInfoResponse)
+                claim_sids = [s for s in req.shard_ids
+                              if s in set(sinfo.remote_shard_ids)]
+            except Exception:  # noqa: BLE001 — legacy peer: payload-only
+                claim_sids = []
+            payload_sids = [s for s in req.shard_ids
+                            if s not in set(claim_sids)]
             ec_copy(vpb.VolumeEcShardsCopyRequest(
                 volume_id=req.volume_id, collection=req.collection,
-                shard_ids=req.shard_ids,
+                shard_ids=payload_sids,
                 copy_ecx_file=need_sidecars, copy_ecj_file=need_sidecars,
                 copy_vif_file=need_sidecars,
                 source_data_node=req.source_data_node), context)
-            src = Stub(req.source_data_node, VOLUME_SERVICE)
+            if claim_sids or need_sidecars:
+                loc = next((l for l in store.locations
+                            if os.path.exists(
+                                l.base_name(req.collection,
+                                            req.volume_id) + ".ecx")),
+                           None) or store._location_for(None)
+                base = loc.base_name(req.collection, req.volume_id)
+            if claim_sids:
+                parts = [r.file_content for r in src.call_stream(
+                    "CopyFile",
+                    vpb.CopyFileRequest(volume_id=req.volume_id,
+                                        collection=req.collection,
+                                        ext=".vif", is_ec_volume=True),
+                    vpb.CopyFileResponse)]
+                claims = ec_files.remote_claims(
+                    json.loads(b"".join(parts)), claim_sids)
+                if claims is None:
+                    context.abort(9, f"source holds no remote claim "
+                                     f"for shards {list(claim_sids)}")
+            if need_sidecars and os.path.exists(base + ".vif"):
+                # the whole-sidecar copy brought claims for shards NOT
+                # moving here; exactly one server may hold each claim
+                here = ec_files.read_vif(base + ".vif")
+                stray = [int(k) for k in (here.get("remote_shards")
+                                          or {}).get("keys", {})
+                         if int(k) not in set(req.shard_ids)]
+                ec_files.drop_remote_claims(base + ".vif", stray)
+            if claim_sids:
+                ec_files.merge_remote_claims(base + ".vif", claims)
             src.call("VolumeEcShardsDelete",
                      vpb.VolumeEcShardsDeleteRequest(
                          volume_id=req.volume_id, collection=req.collection,
@@ -2850,6 +3042,10 @@ class VolumeServer:
         def _serve_fragment(sh, req, frag_ranges, context):
             import numpy as np
             if not req.combine_rows:
+                if req.gather_shard_ids:
+                    # a relay gather without a combine matrix would ship
+                    # MORE bytes than the callers fetching directly
+                    context.abort(3, "gather requires combine_rows")
                 # pack-only: stream straight from disk, range by range
                 # in 1 MB chunks — a request-controlled fragment size
                 # must never materialize whole in the holder's RSS
@@ -2871,14 +3067,18 @@ class VolumeServer:
             # executors window fragments to ~window/q (ec/repair.py),
             # far below this
             from ..ops import gf8
-            if sum(ln for _, ln in frag_ranges) > (64 << 20):
+            gather = list(zip(req.gather_shard_ids, req.gather_sources))
+            if len(req.gather_shard_ids) != len(req.gather_sources):
+                context.abort(3, "gather ids/sources length mismatch")
+            if sum(ln for _, ln in frag_ranges) * (1 + len(gather)) \
+                    > (64 << 20):
                 context.abort(3, "combine fragment exceeds 64 MB; "
                                  "window the request")
             lens = {ln for _, ln in frag_ranges}
             if len(lens) != 1:
                 context.abort(3, "combine needs equal-length ranges")
-            if len(req.combine_matrix) != \
-                    req.combine_rows * len(frag_ranges):
+            total_rows = len(frag_ranges) * (1 + len(gather))
+            if len(req.combine_matrix) != req.combine_rows * total_rows:
                 context.abort(3, "combine_matrix shape mismatch")
             rows = []
             for off, ln in frag_ranges:
@@ -2887,8 +3087,21 @@ class VolumeServer:
                     context.abort(3, f"fragment range [{off}, +{ln}) "
                                      "beyond shard")
                 rows.append(np.frombuffer(buf, dtype=np.uint8))
+            # geo relay: gather the SAME ranges from DC-local peers so
+            # the fold below covers the whole far-side group — matrix
+            # columns run sid-major (own rows first, then each gathered
+            # shard's) matching geo/repair_fold.stacked_matrix
+            for gsid, gsrc in gather:
+                try:
+                    buf = vs._fetch_fragment_or_raise(
+                        req.volume_id, gsid, frag_ranges, [gsrc])
+                except OSError as e:
+                    context.abort(14, f"gather shard {gsid} from "
+                                      f"{gsrc}: {e}")
+                arr = np.frombuffer(buf, dtype=np.uint8)
+                rows.extend(arr.reshape(len(frag_ranges), frag_ranges[0][1]))
             mat = np.frombuffer(req.combine_matrix, dtype=np.uint8)
-            mat = mat.reshape(req.combine_rows, len(frag_ranges))
+            mat = mat.reshape(req.combine_rows, total_rows)
             data = gf8.np_gf_apply(mat, np.stack(rows)).tobytes()
             for i in range(0, len(data), 1 << 20):
                 yield vpb.VolumeEcShardReadResponse(

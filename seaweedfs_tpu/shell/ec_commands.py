@@ -427,6 +427,12 @@ def cmd_ec_balance(env: CommandEnv, args):
     p.add_argument("-collection", default=None,
                    help="balance only this collection's stripes")
     p.add_argument("-maxMoves", type=int, default=64)
+    p.add_argument("-url", default="",
+                   help="master HTTP base URL (fetches its -linkCosts "
+                        "policy so plans price moves like the cron)")
+    p.add_argument("-linkCosts", default="",
+                   help="geo link-cost policy (inline JSON or file); "
+                        "overrides the master's")
     opt = p.parse_args(args)
 
     # stripes can drift for a pulse after encode/rebuild RPCs; settle
@@ -454,9 +460,12 @@ def cmd_ec_balance(env: CommandEnv, args):
     snap = snapshot_from_servers(
         env.collect_volume_servers(), shard_bytes_of=shard_bytes_of,
         default_shard_bytes=(limit_mb << 20) // 10)
+    from .health_util import fetch_link_costs
     plan = build_ec_balance_plan(snap, collection=opt.collection,
                                  parity_of=parity_of,
-                                 max_moves=opt.maxMoves)
+                                 max_moves=opt.maxMoves,
+                                 costs=fetch_link_costs(opt.url,
+                                                        opt.linkCosts))
     plan.render(env.println)
     if opt.dryRun:
         BalanceExecutor(env).execute(plan, dry_run=True)

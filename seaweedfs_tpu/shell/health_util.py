@@ -52,6 +52,26 @@ def fetch_master_json(base_url: str, path: str, params: "dict | None" = None,
     raise RuntimeError(f"no leader answered {path}: {last_err}")
 
 
+def fetch_link_costs(url: str = "", override: str = "",
+                     timeout: float = 5.0):
+    """The geo LinkCostModel a shell planner should price moves with:
+    an explicit `-linkCosts` override (inline JSON or file) wins, else
+    the master's policy from /cluster/linkcosts (so shell plans match
+    the cron's), else the defaults. The fetch is best-effort — a master
+    too old to serve the route must not break volume.balance."""
+    from ..geo.policy import LinkCostModel, load_link_costs, parse_link_costs
+    if override:
+        return load_link_costs(override)
+    if url:
+        try:
+            return parse_link_costs(
+                fetch_master_json(url, "/cluster/linkcosts",
+                                  timeout=timeout))
+        except Exception:  # noqa: BLE001  # swtpu-lint: disable=silent-except (older master: default pricing, not a failed plan)
+            pass
+    return LinkCostModel()
+
+
 def fetch_or_compute_health(env, url: str = "", timeout: float = 10.0) -> dict:
     """The health report, from the master's engine (`url`) or recomputed
     locally from a topology dump. Raises on an unreachable -url (the

@@ -49,14 +49,28 @@ def _render(env: CommandEnv, snap: dict, now: float) -> list[str]:
                 f"({'leader' if snap.get('leader') else 'FOLLOWER'}), "
                 f"cycle {snap.get('cycles', 0)}, "
                 f"every {snap.get('interval_s', '?')}s")
-    env.println(f"targets: {len(live)}/{len(targets)} live")
+    # group by DC when the fleet spans more than one — the geo
+    # operator's view: which SITE is live/stale, then which node
+    by_dc: dict[str, list] = {}
+    for t in targets:
+        by_dc.setdefault(t.get("dc") or "", []).append(t)
+    multi_dc = len([d for d in by_dc if d]) > 1
+    if multi_dc:
+        site = ", ".join(
+            f"{dc or '-'}:{sum(1 for t in ts if not t.get('stale'))}"
+            f"/{len(ts)}" for dc, ts in sorted(by_dc.items()))
+        env.println(f"targets: {len(live)}/{len(targets)} live ({site})")
+    else:
+        env.println(f"targets: {len(live)}/{len(targets)} live")
     for t in targets:
         ago = (f"{now - t['last_scrape_ts']:.1f}s ago"
                if t.get("last_scrape_ts") else "never")
         flag = ("STALE" if t.get("stale") else
                 f"fails={t['consecutive_failures']}"
                 if t.get("consecutive_failures") else "ok")
-        env.println(f"  {t.get('node', '?'):<32} {flag:<10} scraped {ago}")
+        where = f" dc={t['dc']}" if multi_dc and t.get("dc") else ""
+        env.println(f"  {t.get('node', '?'):<32} {flag:<10} scraped "
+                    f"{ago}{where}")
 
     burning: list[str] = []
     status = (snap.get("slo") or {}).get("status") or []

@@ -198,6 +198,14 @@ def cmd_cluster_check(env: CommandEnv, args):
                 f"ec shards missing {totals.get('ec_shards_missing', 0)}, "
                 f"stale nodes {totals.get('nodes_stale', 0)}, "
                 f"read-only volumes {totals.get('volumes_read_only', 0)})")
+    # DC annotations (geo plane): which site still holds copies of a
+    # degraded item, and which site a stale node sits in — only shown
+    # when the report actually carries topology (multi-DC fleet or a
+    # master new enough to report it)
+    def _dcs(it) -> str:
+        dcs = it.get("dcs") or ()
+        return f" dcs={','.join(dcs)}" if dcs else ""
+
     for it in report.get("items", ()):
         if it["severity"] == "OK":
             continue
@@ -207,7 +215,7 @@ def cmd_cluster_check(env: CommandEnv, args):
                 f"col={it.get('collection', '')!r}: "
                 f"{it['replicas_present']}/{it['replicas_expected']} "
                 f"replicas, distance_to_data_loss="
-                f"{it['distance_to_data_loss']}")
+                f"{it['distance_to_data_loss']}{_dcs(it)}")
         elif it["kind"] == "ec":
             rs = it.get("rs", {})
             env.println(
@@ -215,18 +223,23 @@ def cmd_cluster_check(env: CommandEnv, args):
                 f"col={it.get('collection', '')!r}: "
                 f"{len(it['shards_present'])}/{rs.get('n', '?')} shards "
                 f"(missing {it['shards_missing']}), "
-                f"distance_to_data_loss={it['distance_to_data_loss']}")
+                f"distance_to_data_loss={it['distance_to_data_loss']}"
+                f"{_dcs(it)}")
         elif it["kind"] == "node":
+            where = f" dc={it['dc']}" if it.get("dc") else ""
             env.println(f"  [{it['severity']}] node {it['id']}: stale "
-                        f"(last heartbeat {it.get('age_s', '?')}s ago)")
+                        f"(last heartbeat {it.get('age_s', '?')}s "
+                        f"ago){where}")
         else:
+            where = f" dc={it['dc']}" if it.get("dc") else ""
             env.println(f"  [{it['severity']}] {it['kind']} {it['id']}: "
                         f"{it.get('used_slots')}/{it.get('max_slots')} "
-                        "slots used")
+                        f"slots used{where}")
     if opt.verbose:
         for nd in report.get("nodes", ()):
+            where = f" dc={nd['dc']}" if nd.get("dc") else ""
             env.println(f"  node {nd['id']}: {nd['used_slots']}/"
-                        f"{nd['max_slots']} slots"
+                        f"{nd['max_slots']} slots{where}"
                         + (" STALE" if nd.get("stale") else ""))
     verdict = report.get("verdict", "OK")
     if opt.failOn != "never" and _RANK.get(verdict, 0) >= _RANK[opt.failOn]:
@@ -257,7 +270,7 @@ def cmd_cluster_repair(env: CommandEnv, args):
 
     from ..maintenance import RepairExecutor, build_plan, make_probes
     from ..master.health import _RANK
-    from .health_util import fetch_or_compute_health
+    from .health_util import fetch_link_costs, fetch_or_compute_health
 
     p = argparse.ArgumentParser(prog="cluster.repair")
     p.add_argument("-url", default="",
@@ -270,6 +283,9 @@ def cmd_cluster_repair(env: CommandEnv, args):
     p.add_argument("-maxRepairs", type=int, default=64,
                    help="repairs admitted this run; the rest journal "
                         "repair.skipped reason=budget")
+    p.add_argument("-linkCosts", default="",
+                   help="geo link-cost policy (inline JSON or file); "
+                        "default: the master's /cluster/linkcosts")
     p.add_argument("-failOn", default="AT_RISK",
                    choices=["DEGRADED", "AT_RISK", "DATA_LOSS", "never"])
     opt = p.parse_args(args)
@@ -277,7 +293,8 @@ def cmd_cluster_repair(env: CommandEnv, args):
     report = fetch_or_compute_health(env, opt.url)
     remount_probe, geometry_probe = make_probes(env)
     plan = build_plan(report, probe_remountable=remount_probe,
-                      probe_geometry=geometry_probe)
+                      probe_geometry=geometry_probe,
+                      costs=fetch_link_costs(opt.url, opt.linkCosts))
     plan.render(env.println)
 
     def check_verdict(verdict):
@@ -574,7 +591,15 @@ def cmd_volume_balance(env: CommandEnv, args):
     p.add_argument("-crossRackLimitMB", type=int, default=0,
                    help="cap on cross-rack bytes this run "
                         "(0 = default 30 GB)")
+    p.add_argument("-url", default="",
+                   help="master HTTP base URL (fetches its -linkCosts "
+                        "policy so plans price moves like the cron)")
+    p.add_argument("-linkCosts", default="",
+                   help="geo link-cost policy (inline JSON or file); "
+                        "overrides the master's")
     opt = p.parse_args(args)
+
+    from .health_util import fetch_link_costs
 
     _remount_probe, geometry_probe = make_probes(env)
 
@@ -590,7 +615,8 @@ def cmd_volume_balance(env: CommandEnv, args):
         snap, collection=opt.collection, target_skew=opt.targetSkew,
         max_moves=opt.maxMoves,
         cross_rack_limit_bytes=(opt.crossRackLimitMB << 20
-                                or DEFAULT_CROSS_RACK_LIMIT))
+                                or DEFAULT_CROSS_RACK_LIMIT),
+        costs=fetch_link_costs(opt.url, opt.linkCosts))
     plan.render(env.println)
     if opt.dryRun:
         BalanceExecutor(env).execute(plan, dry_run=True)

@@ -92,8 +92,17 @@ _TIER_LABELS = ("from", "to", "stage", "window", "kind")
 # sampler's fixed classification: event_loop/read_pool/writer_pool/
 # grpc/raft/other), `state` (on_cpu/waiting), `pool` (the handful of
 # named executors: read/ec_read/...), and `loop` (one value per daemon
-# kind: volume/master/filer/s3).
-_TIER_LABELS = _TIER_LABELS + ("thread_class", "state", "pool", "loop")
+# kind: volume/master/filer/s3). The geo plane's `link` is the closed
+# geo/policy.LINK_CLASSES triple (intra_rack/cross_rack/cross_dc).
+_TIER_LABELS = _TIER_LABELS + ("thread_class", "state", "pool", "loop",
+                               "link")
+
+# Data-center names come from operator topology flags — bounded by the
+# fleet's DC count, which is more than the tier sets but far under the
+# address-shaped families. A `dc` label minting dozens of values means
+# a node is misreporting its topology, not a real new site.
+DC_CARDINALITY_CEILING = 32
+_DC_LABELS = ("dc",)
 
 # SLO names come from the operator's policy doc — small by design (a
 # policy with hundreds of objectives is unreviewable), but not a
@@ -105,7 +114,8 @@ _SLO_LABELS = ("slo",)
 def lint_registry(registry=None,
                   ceiling: int = DEFAULT_CARDINALITY_CEILING,
                   tier_ceiling: int = TIER_CARDINALITY_CEILING,
-                  slo_ceiling: int = SLO_CARDINALITY_CEILING
+                  slo_ceiling: int = SLO_CARDINALITY_CEILING,
+                  dc_ceiling: int = DC_CARDINALITY_CEILING
                   ) -> list[str]:
     """Registry-level problems: duplicate family names and per-label
     cardinality over the ceiling on `peer`/`bucket`/`tenant`/`key`
@@ -125,6 +135,8 @@ def lint_registry(registry=None,
                 cap = tier_ceiling
             elif lname in _SLO_LABELS:
                 cap = slo_ceiling
+            elif lname in _DC_LABELS:
+                cap = dc_ceiling
             elif lname in _BOUNDED_LABELS:
                 cap = ceiling
             else:

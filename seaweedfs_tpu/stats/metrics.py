@@ -434,6 +434,33 @@ REPAIR_BYTES_READ = _counter(
 REPAIR_BYTES_WRITTEN = _counter(
     "SeaweedFS_repair_bytes_written_total",
     "shard bytes written by repairs", ("codec",))
+# Geo plane (geo/): the same repair traffic split by the LINK CLASS the
+# fetch crossed — the warehouse-study point is that a cross-DC byte
+# contends for the thinnest pipe in the fleet, so operators graph the
+# cross_dc series against the link-cost policy's budget. Off-node
+# fetches are attributed by the holder's data center vs this server's
+# (same-DC remote hops book as cross_rack: the master's shard-location
+# answers carry DC, not rack); local disk reads never book here.
+# `link` is the closed geo/policy.LINK_CLASSES set (tier ceiling).
+REPAIR_BYTES_BY_LINK = _counter(
+    "SeaweedFS_repair_bytes_by_link_total",
+    "off-node survivor bytes fetched by repairs, by link class "
+    "(intra_rack/cross_rack/cross_dc)", ("codec", "link"))
+# Cross-cluster async replication (geo/replication.py): age of the
+# oldest filer metadata event not yet applied on the remote cluster.
+# The bounded-lag invariant (link-cost policy replication_lag_bound_s,
+# slo-able) is evaluated over this gauge; the chaos DC-sever lane
+# asserts it returns under bound after a partition heals.
+GEO_REPLICATION_LAG = _gauge(
+    "SeaweedFS_geo_replication_lag_seconds",
+    "cross-cluster replication lag per peer (newest unreplicated "
+    "filer event age)", ("peer",))
+# Per-DC fleet census from the master's health engine — the `dc` label
+# family is bounded by the fleet's data-center count and gets its own
+# lint ceiling (stats/expo_lint.py DC_CARDINALITY_CEILING).
+CLUSTER_NODES_BY_DC = _gauge(
+    "SeaweedFS_cluster_nodes",
+    "registered volume servers per data center", ("dc",))
 # Rebalance plane (placement/): moves executed by kind (volume / ec
 # shard group) and the bytes they dragged across the fleet, split by
 # rack locality — the warehouse-cluster lesson is that CROSS-RACK

@@ -417,12 +417,15 @@ class Store:
                           shard_reader=None,
                           remote_shards: "list[int] | None" = None,
                           stats: "dict | None" = None,
-                          fragment_reader=None) -> list[int]:
+                          fragment_reader=None,
+                          fold_planner=None) -> list[int]:
         """Rebuild missing shards locally, decoding with the codec the
         .vif seal says encoded them. Survivors not on this disk are
         fetched by RANGE through `shard_reader` (the volume server wires
         it to VolumeEcShardRead), so a repair-efficient codec moves only
-        its plan's byte ranges instead of d full shards."""
+        its plan's byte ranges instead of d full shards; `fold_planner`
+        (geo plane, ec/encoder.py contract) lets far-DC survivors fold
+        behind a relay before crossing expensive links."""
         ev = self.find_ec_volume(vid)
         base = ev.base if ev else None
         if base is None:
@@ -441,7 +444,8 @@ class Store:
         rebuilt = rebuild_shards(base, geo, coder,
                                  shard_reader=shard_reader,
                                  remote_shards=remote_shards, stats=stats,
-                                 fragment_reader=fragment_reader)
+                                 fragment_reader=fragment_reader,
+                                 fold_planner=fold_planner)
         if ev:
             for loc in self.locations:
                 if loc.ec_volumes.get(vid) is ev:

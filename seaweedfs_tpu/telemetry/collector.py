@@ -366,6 +366,7 @@ class TelemetryCollector:
             node = tgt["node"]
             out.append({
                 "node": node, "url": tgt.get("url") or "(local)",
+                "dc": tgt.get("dc", ""), "rack": tgt.get("rack", ""),
                 "stale": node in stale,
                 "consecutive_failures": self._failures.get(node, 0),
                 "last_scrape_ts": self._last_scrape.get(node),

@@ -217,17 +217,46 @@ def _current_task_id() -> "int | None":
     return id(task) if task is not None else None
 
 
+def _enter_tracker() -> bool:
+    """Per-thread reentrancy gate around every instrumentation section.
+
+    Recording allocates (stack captures, edge dicts), and any
+    allocation can trigger a GC that runs arbitrary `__del__` code —
+    grpc's channel destructor, for one — which acquires locks of its
+    own. Those locks are tracked too, so without this gate the nested
+    instrumentation re-enters the NON-reentrant `_state.guard` the
+    outer section still holds and the thread self-deadlocks (then the
+    whole process convoys behind it). While the gate is closed the
+    real lock operations proceed untouched; only the recording is
+    skipped — an acquisition the tracker never saw is already a legal
+    state everywhere below (depth-0 releases record nothing, unmatched
+    releases ride the orphan machinery)."""
+    if getattr(_tls, "busy", False):
+        return False
+    _tls.busy = True
+    return True
+
+
+def _exit_tracker() -> None:
+    _tls.busy = False
+
+
 def _record_acquired(lock_id: int, name: str) -> None:
     """Called with the real lock already held (success path only)."""
-    held = _held_stack()
-    _purge_orphans(held)
-    # a sync lock taken while THIS task holds an asyncio lock orders
-    # after it (same execution flow, different stack); the acquisition
-    # is tagged with the owning task so the reverse direction can tell
-    # this task's sync locks from another task's held-across-an-await
-    _note_acquired(held, lock_id, name,
-                   cross_held=_async_stack(create=False),
-                   tag=_current_task_id())
+    if not _enter_tracker():
+        return
+    try:
+        held = _held_stack()
+        _purge_orphans(held)
+        # a sync lock taken while THIS task holds an asyncio lock orders
+        # after it (same execution flow, different stack); the acquisition
+        # is tagged with the owning task so the reverse direction can tell
+        # this task's sync locks from another task's held-across-an-await
+        _note_acquired(held, lock_id, name,
+                       cross_held=_async_stack(create=False),
+                       tag=_current_task_id())
+    finally:
+        _exit_tracker()
 
 
 def _add_edge(prev_id: int, prev_name: str, lock_id: int,
@@ -290,14 +319,19 @@ def _note_acquired(held: list, lock_id: int, name: str,
 
 
 def _record_released(lock_id: int) -> None:
-    held = _held_stack()
-    _purge_orphans(held)
-    if _note_released(held, lock_id):
+    if not _enter_tracker():
         return
-    # not held by this thread: a handoff release — flag it so the
-    # acquiring thread clears its stale entry at its next lock op
-    with _state.guard:
-        _state.orphans[lock_id] = _state.orphans.get(lock_id, 0) + 1
+    try:
+        held = _held_stack()
+        _purge_orphans(held)
+        if _note_released(held, lock_id):
+            return
+        # not held by this thread: a handoff release — flag it so the
+        # acquiring thread clears its stale entry at its next lock op
+        with _state.guard:
+            _state.orphans[lock_id] = _state.orphans.get(lock_id, 0) + 1
+    finally:
+        _exit_tracker()
 
 
 def _note_released(held: list, lock_id: int) -> bool:
@@ -337,17 +371,24 @@ def _creator_is_ours() -> bool:
 def _register_node(name: str, own: bool) -> "tuple[int, bool]":
     """Allot a graph node. The key is a serial, not id(): a collected
     lock's id gets recycled and would inherit the dead lock's history."""
-    with _state.guard:
-        _state.locks_created += 1
-        node_id = _state.locks_created
-        tracked = _state.locks_created <= _state.max_locks
-        if tracked:
-            _state.names[node_id] = name
-            if own:
-                _state.own.add(node_id)
-        else:
-            _state.locks_dropped += 1
-    return node_id, tracked
+    if not _enter_tracker():
+        # minted from inside a tracker section (a GC-run destructor):
+        # taking the guard here would deadlock — leave it untracked
+        return 0, False
+    try:
+        with _state.guard:
+            _state.locks_created += 1
+            node_id = _state.locks_created
+            tracked = _state.locks_created <= _state.max_locks
+            if tracked:
+                _state.names[node_id] = name
+                if own:
+                    _state.own.add(node_id)
+            else:
+                _state.locks_dropped += 1
+        return node_id, tracked
+    finally:
+        _exit_tracker()
 
 
 class TrackedLock:
@@ -475,29 +516,36 @@ class TrackedAsyncLock:
 
     async def acquire(self):
         got = await self._lock.acquire()
-        if got and self._tracked:
-            held = _async_stack()
-            if held is not None:
-                # only sync locks THIS task acquired are predecessors:
-                # a lock another task holds across an await sits on the
-                # same thread stack but belongs to a different flow —
-                # borrowing it would fabricate ordering edges (and
-                # phantom deadlock findings)
-                tid = _current_task_id()
-                mine = [e for e in _held_stack() if e[4] == tid]
-                _note_acquired(held, self._id, self._name,
-                               cross_held=mine)
+        if got and self._tracked and _enter_tracker():
+            try:
+                held = _async_stack()
+                if held is not None:
+                    # only sync locks THIS task acquired are
+                    # predecessors: a lock another task holds across an
+                    # await sits on the same thread stack but belongs
+                    # to a different flow — borrowing it would
+                    # fabricate ordering edges (and phantom deadlock
+                    # findings)
+                    tid = _current_task_id()
+                    mine = [e for e in _held_stack() if e[4] == tid]
+                    _note_acquired(held, self._id, self._name,
+                                   cross_held=mine)
+            finally:
+                _exit_tracker()
         return got
 
     def release(self):
-        if self._tracked:
-            held = _async_stack()
-            if held is not None:
-                # a release from a task that never acquired (legal for
-                # asyncio.Lock) records nothing — no cross-task orphan
-                # machinery needed, the acquirer's entry dies with its
-                # task's weakref
-                _note_released(held, self._id)
+        if self._tracked and _enter_tracker():
+            try:
+                held = _async_stack(create=False)
+                if held is not None:
+                    # a release from a task that never acquired (legal
+                    # for asyncio.Lock) records nothing — no cross-task
+                    # orphan machinery needed, the acquirer's entry
+                    # dies with its task's weakref
+                    _note_released(held, self._id)
+            finally:
+                _exit_tracker()
         self._lock.release()
 
     def locked(self):

@@ -1,0 +1,418 @@
+"""DC-sever chaos lane: one data center of a 2-DC in-process cluster
+drops mid-storm (every cross-DC link severed at once), and the geo
+plane's promises hold end-to-end:
+
+  * every ACKED write keeps serving byte-identical from the surviving
+    DC while the partition is open — replication "100" pinned a copy
+    on each side, and EC needle reads on the severed DC's data shard
+    reconstruct from the d survivors that remain;
+  * the geo-replication lag gauge grows PAST the policy bound while
+    the link is down (the bounded-lag invariant is violated, visibly)
+    and returns under it after the partition heals — without replaying
+    or dead-lettering a single event;
+  * after the heal, the master's health-driven repair loop alone (the
+    AdminCron sweep: ec.rebuild + volume.fix.replication) converges
+    the verdict back to OK, the rebuilt MSR shard is byte-identical to
+    the one lost with the dead-for-good node, and the cross-DC bytes
+    the repair moved stay under the link-cost policy's
+    cross_dc_budget (SeaweedFS_repair_bytes_by_link_total);
+  * the lock-order detector ends the session with zero cycles.
+
+One dc2 node resurrects over its old directories (the partition
+healing); the other stays dead FOR GOOD, so the repair plane must
+actually rebuild — a heal that only waits for reboots would pass a
+weaker test. Opt-in like the rest of the chaos suite:
+    SWTPU_CHAOS=1 python -m pytest tests/chaos/test_chaos_geo.py -q
+"""
+
+import json
+import os
+import random
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if not os.environ.get("SWTPU_CHAOS"):
+    pytest.skip("chaos suite is opt-in: set SWTPU_CHAOS=1",
+                allow_module_level=True)
+
+# Same tracker budget the HA lane needs: the storm's grpc churn mints
+# library locks at a high rate, and with locktrack's default 4096-lock
+# budget every new TRACKED lock acquired under another captures a stack
+# and walks the order graph under one global guard — the sever/resurrect
+# cycle livelocks behind it. 512 still covers every repo-created lock.
+# Must be set before the first seaweedfs_tpu import builds the tracker.
+os.environ.setdefault("SWTPU_LOCKCHECK_MAX_LOCKS", "512")
+
+from seaweedfs_tpu.client import operation  # noqa: E402
+from seaweedfs_tpu.client.master_client import MasterClient  # noqa: E402
+from seaweedfs_tpu.master.master_server import MasterServer  # noqa: E402
+from seaweedfs_tpu.pb import volume_server_pb2 as vpb  # noqa: E402
+from seaweedfs_tpu.server.volume_server import VolumeServer  # noqa: E402
+from seaweedfs_tpu.storage.disk_location import DiskLocation  # noqa: E402
+from seaweedfs_tpu.storage.store import Store  # noqa: E402
+from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE  # noqa: E402
+
+LAG_BOUND_S = 2.0
+# the fleet policy under test: cross-DC bytes are 25x an intra-rack
+# byte, the repair sweep may spend at most 1 MiB on the thin pipe, and
+# geo replication must stay within LAG_BOUND_S of the source
+LINK_COSTS = {
+    "intra_rack": 1.0, "cross_rack": 4.0, "cross_dc": 25.0,
+    "cross_dc_budget": "1MiB", "replication_lag_bound_s": LAG_BOUND_S,
+}
+# dc1: 2 servers (survivors), dc2: 2 servers (the severed DC)
+TOPO = [("dc1", "r1"), ("dc1", "r2"), ("dc2", "r1"), ("dc2", "r2")]
+
+
+@pytest.fixture(scope="session", autouse=True)
+def no_lock_order_cycles():
+    """`make chaos` runs with SWTPU_LOCKCHECK=1: every threading
+    primitive in the mini-cluster is wrapped by utils/locktrack, so a
+    DC-sever + repair session doubles as a lock-order fuzzer over the
+    topology / health / repair-planner lock hierarchy. The session
+    must end with ZERO ordering cycles."""
+    yield
+    if os.environ.get("SWTPU_LOCKCHECK") != "1":
+        return
+    from seaweedfs_tpu.utils import locktrack
+
+    rep = locktrack.findings()
+    assert rep["cycles"] == [], (
+        "lock-order cycles observed during the geo chaos session "
+        "(potential ABBA deadlocks): "
+        + "; ".join(" -> ".join(c["locks"]) for c in rep["cycles"]))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _MiniFS:
+    """Filer-server stand-in for the geo-sync pair (the unit-test shim
+    from tests/test_geo.py): a bare Filer over a memory store plus a
+    blob dict in place of the volume cluster."""
+
+    def __init__(self):
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.filer.store import MemoryStore
+        self.filer = Filer(MemoryStore())
+        self.blobs = {}
+
+    def write_file(self, path, data, mime="", signatures=None):
+        from seaweedfs_tpu.filer.filer import split_path
+        from seaweedfs_tpu.pb import filer_pb2 as fpb
+        d, n = split_path(path)
+        e = fpb.Entry(name=n)
+        e.attributes.file_size = len(data)
+        self.blobs[n] = bytes(data)
+        self.filer.create_entry(d, e, signatures=signatures)
+
+    def read_entry_bytes(self, entry):
+        return self.blobs.get(entry.name, b"")
+
+
+@pytest.fixture()
+def geo_cluster(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(port=mport, volume_size_limit_mb=64,
+                          pulse_seconds=0.3, ec_parity_shards=2,
+                          link_costs=json.dumps(LINK_COSTS))
+    master.start()
+    servers, dirs = [], []
+    for i, (dc, rack) in enumerate(TOPO):
+        d = tmp_path_factory.mktemp(f"geo{i}")
+        dirs.append(str(d))
+        port = _free_port()
+        store = Store("127.0.0.1", port, "",
+                      [DiskLocation(str(d), max_volume_count=20)],
+                      coder_name="numpy")
+        vs = VolumeServer(store, f"127.0.0.1:{mport}", port=port,
+                          grpc_port=_free_port(), pulse_seconds=0.3,
+                          data_center=dc, rack=rack)
+        vs.start()
+        servers.append(vs)
+    from conftest import wait_cluster_up
+    wait_cluster_up(master, servers)
+    mc = MasterClient(f"127.0.0.1:{mport}").start()
+    yield master, servers, dirs, mc
+    mc.stop()
+    for vs in servers:
+        try:
+            vs.stop()
+        except Exception:  # noqa: BLE001
+            pass
+    master.stop()
+
+
+def _seed_msr_stripe(master, servers, mc, want):
+    """An msr RS(4,2) stripe spread per `want` (server -> shard ids):
+    submit payloads, generate on the source holder, copy/mount to the
+    spread, drop the extras + the original volume — the manual-place
+    idiom from tests/chaos/test_chaos.py's node-death schedule."""
+    import numpy as np
+    from conftest import wait_until
+    from seaweedfs_tpu.ec import files as ec_files
+
+    rng = np.random.default_rng(97)
+    payloads = {}
+    for _ in range(12):
+        data = rng.integers(0, 256, int(rng.integers(600, 7000)),
+                            dtype=np.uint8).tobytes()
+        r = operation.submit(mc, data, collection="geomsr")
+        payloads[r.fid] = data
+    vid = int(next(iter(payloads)).split(",")[0])
+    src_vs = next(vs for vs in servers
+                  if vs.store.find_volume(vid) is not None)
+    src = Stub(f"127.0.0.1:{src_vs.grpc_port}", VOLUME_SERVICE)
+    src.call("VolumeMarkReadonly",
+             vpb.VolumeMarkReadonlyRequest(volume_id=vid),
+             vpb.VolumeMarkReadonlyResponse)
+    src.call("VolumeEcShardsGenerate",
+             vpb.VolumeEcShardsGenerateRequest(
+                 volume_id=vid, collection="geomsr", data_shards=4,
+                 parity_shards=2, codec="msr"),
+             vpb.VolumeEcShardsGenerateResponse, timeout=120)
+    for vs, sids in want.items():
+        if vs is not src_vs:
+            Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+                "VolumeEcShardsCopy",
+                vpb.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection="geomsr", shard_ids=sids,
+                    copy_ecx_file=True, copy_vif_file=True,
+                    copy_ecj_file=True,
+                    source_data_node=f"127.0.0.1:{src_vs.grpc_port}"),
+                vpb.VolumeEcShardsCopyResponse, timeout=60)
+        Stub(f"127.0.0.1:{vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMount",
+            vpb.VolumeEcShardsMountRequest(volume_id=vid,
+                                           collection="geomsr",
+                                           shard_ids=sids),
+            vpb.VolumeEcShardsMountResponse)
+    src_base = src_vs.store.find_ec_volume(vid).base
+    drop = sorted(set(range(6)) - set(want[src_vs]))
+    src.call("VolumeEcShardsUnmount",
+             vpb.VolumeEcShardsUnmountRequest(volume_id=vid,
+                                              shard_ids=drop),
+             vpb.VolumeEcShardsUnmountResponse)
+    for sid in drop:
+        os.remove(src_base + ec_files.shard_ext(sid))
+    src.call("VolumeDelete", vpb.VolumeDeleteRequest(volume_id=vid),
+             vpb.VolumeDeleteResponse)
+    wait_until(lambda: sorted(master.topo.lookup_ec(vid)) ==
+               list(range(6)), timeout=20,
+               msg="all 6 msr shards registered on the geo spread")
+    return vid, payloads
+
+
+def test_dc_sever_mid_storm_heals_within_budgets(geo_cluster):
+    from conftest import wait_until
+    from seaweedfs_tpu.ec import files as ec_files
+    from seaweedfs_tpu.geo.replication import GeoSync
+    from seaweedfs_tpu.stats import REPAIR_BYTES_BY_LINK
+
+    master, servers, dirs, mc = geo_cluster
+    dc1a, dc1b, dc2a, dc2b = servers
+    seed = int(os.environ.get("SWTPU_CHAOS_SEED", "0")) \
+        or random.randrange(1 << 30)
+    rng = random.Random(seed)
+    ctx = f"geo sever seed={seed}"
+    print(f"[chaos-geo] {ctx}")
+
+    # the policy the master parsed from -linkCosts is the one priced in
+    costs = master.link_costs
+    assert costs.cross_dc == 25.0
+    assert costs.cross_dc_budget == 1 << 20
+    assert costs.replication_lag_bound_s == LAG_BOUND_S
+
+    # -- fixture data: msr stripe with data shard 3 ONLY in dc2 -------------
+    # shards 0,1,2,4 live in dc1, so reads on shard 3's needle ranges
+    # must RECONSTRUCT while dc2 is dark (d=4 survivors, 2 losses);
+    # shard 3's holder (dc2a) later dies for good to force the rebuild
+    want = {dc1a: [0, 1], dc1b: [2, 4], dc2a: [3], dc2b: [5]}
+    vid, ec_payloads = _seed_msr_stripe(master, servers, mc, want)
+    lost_shard = open(
+        dc2a.store.find_ec_volume(vid).base + ec_files.shard_ext(3),
+        "rb").read()
+
+    # -- the cross-cluster replication pair, gated by the partition ---------
+    fs_a, fs_b = _MiniFS(), _MiniFS()
+    severed = threading.Event()
+    sync = GeoSync(fs_a, fs_b, peer="west", lag_bound_s=LAG_BOUND_S,
+                   max_retries=10_000, retry_base_delay=0.05)
+    real_replicate = sync.replicator.replicate
+
+    def gated_replicate(directory, ev):
+        if severed.is_set():
+            raise ConnectionError("cross-dc link severed")
+        return real_replicate(directory, ev)
+
+    sync.replicator.replicate = gated_replicate
+    sync.start()
+
+    # -- the storm: dc-spread writers ("100": one copy per DC) --------------
+    acked: dict[str, bytes] = {}
+    ledger_lock = threading.Lock()
+    failed = [0]
+    stop = threading.Event()
+
+    def put_writer(wseed: int) -> None:
+        wrng = random.Random(wseed)
+        while not stop.is_set():
+            payload = b"geo-%d-" % wseed + wrng.randbytes(
+                wrng.randint(100, 4000))
+            try:
+                res = operation.submit(mc, payload, replication="100")
+            except Exception:  # noqa: BLE001 — unacked during the sever
+                failed[0] += 1
+                continue
+            with ledger_lock:
+                acked[res.fid] = payload
+
+    threads = [threading.Thread(target=put_writer, daemon=True,
+                                args=(rng.randrange(1 << 30),))
+               for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        wait_until(lambda: len(acked) >= 20, timeout=30,
+                   msg=f"{ctx}: storm established before the sever")
+        fs_a.write_file("/geo/pre-sever.txt", b"crossed while link up")
+        wait_until(lambda: sync.applied >= 1, timeout=10,
+                   msg=f"{ctx}: replication healthy before the sever")
+        assert sync.lag_ok()
+        dc_bytes_before = REPAIR_BYTES_BY_LINK.value("msr", "cross_dc")
+
+        # -- SEVER: every dc2 node drops mid-storm --------------------------
+        severed.set()
+        dc2a.stop()
+        dc2b.stop()
+        wait_until(lambda: all(f"127.0.0.1:{vs.port}" not in
+                               master.topo.nodes for vs in (dc2a, dc2b)),
+                   timeout=15, msg=f"{ctx}: dc2 dropped from topology")
+        print(f"[chaos-geo] {ctx}: dc2 severed with "
+              f"{len(acked)} acked writes")
+        fs_a.write_file("/geo/during-sever.txt", b"stuck behind the cut")
+
+        # acked reads keep serving from the surviving DC — replicated
+        # needles from their dc1 copy, EC needles by reconstruction
+        with ledger_lock:
+            sample = list(acked.items())
+        for fid, payload in sample[:25]:
+            assert operation.read(mc, fid) == payload, \
+                f"{ctx}: acked {fid} unreadable during the sever"
+        for fid, data in ec_payloads.items():
+            assert operation.read(mc, fid) == data, \
+                f"{ctx}: ec payload {fid} unreadable during the sever"
+        assert master.health.scan()["verdict"] != "OK"
+
+        # the bounded-lag invariant is visibly violated while severed
+        wait_until(lambda: sync.lag_seconds() > LAG_BOUND_S,
+                   timeout=LAG_BOUND_S * 10 + 10,
+                   msg=f"{ctx}: replication lag grows past the bound")
+        assert not sync.lag_ok()
+
+        # -- HEAL: dc2b resurrects over its old dirs; dc2a is gone ----------
+        idx = servers.index(dc2b)
+        store = Store("127.0.0.1", dc2b.port, "",
+                      [DiskLocation(dirs[idx], max_volume_count=20)],
+                      coder_name="numpy")
+        reborn = VolumeServer(store, f"127.0.0.1:{master.port}",
+                              port=dc2b.port, grpc_port=dc2b.grpc_port,
+                              pulse_seconds=0.3,
+                              data_center="dc2", rack="r2")
+        reborn.start()
+        servers[idx] = reborn
+        severed.clear()
+        wait_until(lambda: len(master.topo.nodes) == 3, timeout=20,
+                   msg=f"{ctx}: dc2b re-registered after the heal")
+        wait_until(lambda: sorted(master.topo.lookup_ec(vid)) ==
+                   [0, 1, 2, 4, 5], timeout=20,
+                   msg=f"{ctx}: surviving shards re-registered")
+
+        # replication catches up under the policy bound: no replay, no
+        # dead letters, gauge back under LAG_BOUND_S
+        wait_until(lambda: sync.lag_seconds() == 0.0, timeout=30,
+                   msg=f"{ctx}: replication lag back to zero post-heal")
+        assert sync.lag_ok()
+        assert sync.dead_lettered == 0
+        assert fs_b.filer.find_entry("/geo", "during-sever.txt") \
+            is not None, f"{ctx}: severed-window event never applied"
+
+        # writers make progress again (dc-spread placement possible)
+        before_n = len(acked)
+        wait_until(lambda: len(acked) > before_n, timeout=30,
+                   msg=f"{ctx}: writers progress after the heal")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        sync.stop()
+    assert not any(t.is_alive() for t in threads), \
+        f"{ctx}: writer thread hung past the sever"
+    print(f"[chaos-geo] {ctx}: {len(acked)} acked writes, "
+          f"{failed[0]} unacked attempts across the sever window")
+
+    # -- health-driven repair converges under the cross-DC byte budget ------
+    assert master.health.scan()["verdict"] != "OK"
+    master.admin_cron.scripts = ["ec.rebuild", "volume.fix.replication"]
+    master.admin_cron.trigger()
+    assert "health-driven repair" in master.admin_cron.last_output
+    deadline = time.monotonic() + 60
+    while master.health.scan()["verdict"] != "OK":
+        assert time.monotonic() < deadline, \
+            f"{ctx}: verdict never converged to OK: " \
+            f"{master.health.scan()}"
+        time.sleep(1.0)
+        master.admin_cron.trigger()
+    dc_bytes = REPAIR_BYTES_BY_LINK.value("msr", "cross_dc") \
+        - dc_bytes_before
+    assert dc_bytes > 0, \
+        f"{ctx}: repair with survivors in both DCs booked no cross-DC bytes"
+    assert dc_bytes <= costs.cross_dc_budget, \
+        f"{ctx}: repair moved {dc_bytes} B cross-DC, over the " \
+        f"{costs.cross_dc_budget} B policy budget"
+    print(f"[chaos-geo] {ctx}: repair spent {dc_bytes} B cross-DC "
+          f"(budget {costs.cross_dc_budget} B)")
+
+    # the rebuilt shard is byte-identical to the one that died with dc2a
+    wait_until(lambda: sorted(master.topo.lookup_ec(vid)) ==
+               list(range(6)), timeout=20,
+               msg=f"{ctx}: all 6 shards registered post-repair")
+    rebuilt = None
+    for vs in servers:
+        if vs is dc2a:
+            continue
+        ev = vs.store.find_ec_volume(vid)
+        if ev is not None and os.path.exists(
+                ev.base + ec_files.shard_ext(3)):
+            rebuilt = open(ev.base + ec_files.shard_ext(3), "rb").read()
+            break
+    assert rebuilt is not None, \
+        f"{ctx}: rebuilt shard 3 not found on any live server"
+    assert rebuilt == lost_shard, \
+        f"{ctx}: rebuilt shard 3 not byte-identical"
+
+    # -- final ledger read-back: every acked write survived the storm -------
+    for fid, payload in acked.items():
+        read_deadline = time.monotonic() + 20
+        while True:
+            try:
+                got = operation.read(mc, fid)
+                break
+            except Exception as e:  # noqa: BLE001 — replica warming up
+                if time.monotonic() >= read_deadline:
+                    raise AssertionError(
+                        f"{ctx}: acked {fid} unreadable post-heal: {e}"
+                    ) from e
+                time.sleep(0.2)
+        assert got == payload, f"{ctx}: acked {fid} corrupt post-heal"
+    for fid, data in ec_payloads.items():
+        assert operation.read(mc, fid) == data

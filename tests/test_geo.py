@@ -1,0 +1,584 @@
+"""Geo plane (PR 19): link-cost policy, MSR fold math, topology-aware
+placement/balance/repair preferences, and bounded-lag geo replication.
+
+The property tests pin the invariant the whole plane exists to create:
+with everything else equal, intra-rack < cross-rack < cross-DC — in
+candidate ranking, in balance plans, and in repair target selection —
+and that cost-weighted plans stay deterministic (same snapshot in,
+byte-identical plan out).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.geo.policy import (LinkCostModel, load_link_costs,
+                                      parse_link_costs)
+from seaweedfs_tpu.geo.repair_fold import (fold_groups, helper_matrices,
+                                           stacked_matrix)
+from seaweedfs_tpu.placement.engine import (NodeView, Snapshot, geo_penalty,
+                                            rank, spread_ec_shards)
+from seaweedfs_tpu.placement.plan import (build_ec_balance_plan,
+                                          build_volume_balance_plan)
+
+
+class TestLinkCostPolicy:
+    def test_defaults_ordered(self):
+        m = LinkCostModel()
+        assert m.intra_rack < m.cross_rack < m.cross_dc
+
+    def test_classify_and_cost(self):
+        m = parse_link_costs({"intra_rack": 1, "cross_rack": 5,
+                              "cross_dc": 20,
+                              "overrides": [{"a": "dc1", "b": "dc3",
+                                             "cost": 40}]})
+        assert m.cost("dc1", "r1", "dc1", "r1") == 1
+        assert m.cost("dc1", "r1", "dc1", "r2") == 5
+        assert m.cost("dc1", "r1", "dc2", "r1") == 20
+        # overrides are unordered pairs
+        assert m.cost("dc1", "r1", "dc3", "r9") == 40
+        assert m.cost("dc3", "r9", "dc1", "r1") == 40
+
+    def test_unknown_locations_compare_equal(self):
+        # absence of topology info must never surcharge a single-site
+        # fleet: two unknown endpoints are intra-rack
+        m = LinkCostModel()
+        assert m.classify("", "", "", "") == "intra_rack"
+        assert m.cost("", "", "", "") == m.intra_rack
+
+    def test_validation_rejects_misordered(self):
+        with pytest.raises(ValueError, match="order"):
+            parse_link_costs({"intra_rack": 10, "cross_rack": 2})
+        with pytest.raises(ValueError, match="unknown key"):
+            parse_link_costs({"intrarack": 1})
+        with pytest.raises(ValueError, match="must be > 0"):
+            parse_link_costs({"cross_dc": 0})
+        with pytest.raises(ValueError, match="misorder"):
+            parse_link_costs({"cross_rack": 4,
+                              "overrides": [{"a": "x", "b": "y",
+                                             "cost": 2}]})
+        with pytest.raises(ValueError, match="distinct"):
+            parse_link_costs({"overrides": [{"a": "x", "b": "x",
+                                             "cost": 30}]})
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_link_costs({"overrides": [
+                {"a": "x", "b": "y", "cost": 30},
+                {"a": "y", "b": "x", "cost": 31}]})
+        with pytest.raises(ValueError, match="replication_lag_bound_s"):
+            parse_link_costs({"replication_lag_bound_s": -1})
+
+    def test_to_doc_roundtrip(self):
+        m = parse_link_costs({"intra_rack": 2, "cross_rack": 8,
+                              "cross_dc": 30, "cross_dc_budget": "1MiB",
+                              "replication_lag_bound_s": 45,
+                              "overrides": [{"a": "east", "b": "west",
+                                             "cost": 60}]})
+        again = parse_link_costs(m.to_doc())
+        assert again == m
+        assert again.cross_dc_budget == 1 << 20
+
+    def test_load_inline_and_file(self, tmp_path):
+        inline = load_link_costs('{"cross_dc": 99}')
+        assert inline.cross_dc == 99
+        p = tmp_path / "costs.json"
+        p.write_text(json.dumps({"cross_dc": 77}))
+        assert load_link_costs(str(p)).cross_dc == 77
+        assert load_link_costs("") == LinkCostModel()
+
+
+class TestFoldMath:
+    """The GF-linear identity the folded-fragment repair rides on."""
+
+    def test_helper_matrix_identity_rs42(self):
+        from seaweedfs_tpu.ops.gf8 import gf_matmul
+        from seaweedfs_tpu.ops.product_matrix import ProductMatrixCoder
+        d, p, W = 4, 2, 16
+        coder = ProductMatrixCoder(d, p, backend="numpy")
+        g = coder.grid
+        rng = np.random.default_rng(19)
+        for f in (0, 3, coder.n - 1):
+            c = rng.integers(0, 256, (g.nbar, g.alpha, W), dtype=np.uint8)
+            c[f] = 0  # the failed node's symbols are gone
+            want = coder.repair_decode(c, f)
+            planes = g.repair_planes(f)
+            mats = helper_matrices(d, p, f)
+            got = np.zeros_like(want)
+            for sid, m in mats.items():
+                got ^= gf_matmul(m, c[sid, planes, :])
+            assert np.array_equal(got, want), f"fold identity broke f={f}"
+
+    def test_stacked_matrix_folds_a_group(self):
+        from seaweedfs_tpu.ops.gf8 import gf_matmul
+        from seaweedfs_tpu.ops.product_matrix import ProductMatrixCoder
+        d, p, W, f = 4, 2, 8, 1
+        coder = ProductMatrixCoder(d, p, backend="numpy")
+        g = coder.grid
+        planes = g.repair_planes(f)
+        rng = np.random.default_rng(7)
+        c = rng.integers(0, 256, (g.nbar, g.alpha, W), dtype=np.uint8)
+        group = (2, 4, 5)
+        # relay side: stack the group's plane rows sid-major and apply
+        # the one combined matrix
+        rows = np.concatenate([c[sid, planes, :] for sid in group], axis=0)
+        folded = gf_matmul(stacked_matrix(d, p, f, group), rows)
+        # must equal the XOR of the per-helper partials
+        mats = helper_matrices(d, p, f)
+        want = np.zeros_like(folded)
+        for sid in group:
+            want ^= gf_matmul(mats[sid], c[sid, planes, :])
+        assert np.array_equal(folded, want)
+        # the fold's whole point: alpha rows cross the link instead of
+        # |group| * beta raw rows
+        assert folded.shape[0] == g.alpha < rows.shape[0]
+
+    def test_fold_groups_only_when_it_pays(self):
+        helper_dcs = {0: "east", 1: "east", 2: "west", 3: "west",
+                      4: "west", 5: "north", 6: "north", 7: ""}
+        # q=2: west (3 helpers) folds, north (2) ships raw, unknown
+        # never folds, the local DC never folds
+        assert fold_groups(helper_dcs, "east", q=2) == [("west", (2, 3, 4))]
+        # q=1: both remote DCs fold, sorted for a deterministic wire plan
+        assert fold_groups(helper_dcs, "east", q=1) == [
+            ("north", (5, 6)), ("west", (2, 3, 4))]
+        # unknown local DC: no folding at all
+        assert fold_groups(helper_dcs, "", q=1) == []
+
+
+def _topology(rng, n_dcs, racks_per_dc, nodes_per_rack):
+    nodes = []
+    for di in range(n_dcs):
+        for ri in range(racks_per_dc):
+            for ni in range(nodes_per_rack):
+                nodes.append(NodeView(
+                    id=f"d{di}r{ri}n{ni}", dc=f"dc{di}", rack=f"d{di}r{ri}",
+                    max_slots=10, free_slots=5))
+    rng.shuffle(nodes)
+    return nodes
+
+
+class TestGeoPlacement:
+    def test_penalty_normalized(self):
+        m = parse_link_costs({"overrides": [{"a": "east", "b": "west",
+                                             "cost": 50}]})
+        origin = ("east", "r1")
+        assert geo_penalty(m, origin,
+                           NodeView(id="a", dc="east", rack="r1")) == 0.0
+        assert geo_penalty(m, origin,
+                           NodeView(id="b", dc="west", rack="r9")) == 1.0
+        mid = geo_penalty(m, origin, NodeView(id="c", dc="east", rack="r2"))
+        assert 0.0 < mid < 1.0
+        assert geo_penalty(None, origin,
+                           NodeView(id="d", dc="far", rack="r")) == 0.0
+
+    def test_rank_prefers_near_links_property(self):
+        """Seeded property: over randomized multi-DC topologies with all
+        capacity terms equal, rank() orders candidates by ascending link
+        cost from the origin — every intra-rack node before every
+        cross-rack node before every cross-DC node."""
+        costs = LinkCostModel()
+        for seed in range(12):
+            rng = random.Random(seed)
+            nodes = _topology(rng, n_dcs=rng.randint(2, 4),
+                              racks_per_dc=rng.randint(1, 3),
+                              nodes_per_rack=rng.randint(1, 3))
+            origin_node = rng.choice(nodes)
+            origin = (origin_node.dc, origin_node.rack)
+            ranked = rank(nodes, rng=random.Random(seed + 1), costs=costs,
+                          origin=origin)
+            link_costs = [costs.cost(origin[0], origin[1], n.dc, n.rack)
+                          for n in ranked]
+            assert link_costs == sorted(link_costs), \
+                f"seed {seed}: rank not cheapest-link-first: {link_costs}"
+
+    def test_spread_still_caps_racks_with_costs(self):
+        rng = random.Random(3)
+        nodes = _topology(rng, n_dcs=2, racks_per_dc=4, nodes_per_rack=2)
+        snap = Snapshot(nodes=sorted(nodes, key=lambda n: n.id))
+        picked = spread_ec_shards(snap, n_shards=6, parity=2,
+                                  rng=random.Random(4), costs=LinkCostModel(),
+                                  origin=("dc0", "d0r0"))
+        per_rack = {}
+        for n in picked:
+            per_rack[n.rack] = per_rack.get(n.rack, 0) + 1
+        assert max(per_rack.values()) <= 2
+
+
+def _loaded_snapshot(spec):
+    """spec: [(id, dc, rack, free_slots, [(vid, size_mb)])]"""
+    nodes = []
+    for nid, dc, rack, free, vols in spec:
+        n = NodeView(id=nid, dc=dc, rack=rack, max_slots=10,
+                     free_slots=free)
+        for vid, mb in vols:
+            n.volumes[vid] = {"size": mb << 20, "collection": ""}
+        nodes.append(n)
+    return Snapshot(nodes=sorted(nodes, key=lambda n: n.id))
+
+
+class TestGeoBalance:
+    def test_zero_cross_dc_when_intra_fix_exists(self):
+        # dc1 can fix its own skew; the lighter dc2 node must not attract
+        snap = _loaded_snapshot([
+            ("a", "dc1", "r1", 5, [(1, 100), (2, 100)]),
+            ("b", "dc1", "r1", 8, []),
+            ("c", "dc2", "r9", 8, [(3, 100)]),
+        ])
+        plan = build_volume_balance_plan(snap, costs=LinkCostModel())
+        assert plan.moves, "skewed snapshot must produce moves"
+        assert plan.cross_dc_bytes == 0
+        assert all(m.link != "cross_dc" for m in plan.moves)
+
+    def test_cross_dc_used_when_it_is_the_only_fix(self):
+        costs = LinkCostModel()
+        snap = _loaded_snapshot([
+            ("a", "dc1", "r1", 5, [(1, 100), (2, 100)]),
+            ("b", "dc1", "r1", 0, []),   # no slots: can't take anything
+            ("c", "dc2", "r9", 8, [(3, 50)]),
+        ])
+        plan = build_volume_balance_plan(snap, costs=costs)
+        assert plan.cross_dc_bytes > 0
+        mv = next(m for m in plan.moves if m.link == "cross_dc")
+        assert mv.cost_weighted_bytes == int(mv.bytes_moved * costs.cross_dc)
+
+    def test_cross_dc_budget_caps_plan(self):
+        costs = parse_link_costs({"cross_dc_budget": "1MiB"})
+        snap = _loaded_snapshot([
+            ("a", "dc1", "r1", 5, [(1, 100), (2, 100)]),
+            ("b", "dc1", "r1", 0, []),
+            ("c", "dc2", "r9", 8, [(3, 50)]),
+        ])
+        plan = build_volume_balance_plan(snap, costs=costs)
+        assert plan.cross_dc_bytes == 0
+        assert any("budget" in n for n in plan.notes)
+
+    def test_plan_determinism_property(self):
+        """Seeded property: cost-weighted plans are deterministic —
+        same snapshot in, byte-identical plan out (modulo timestamp)."""
+        costs = parse_link_costs({"overrides": [{"a": "dc0", "b": "dc1",
+                                                 "cost": 40}]})
+        for seed in range(8):
+            rng = random.Random(100 + seed)
+            spec = []
+            vid = 1
+            for di in range(rng.randint(2, 3)):
+                for ni in range(rng.randint(2, 4)):
+                    vols = []
+                    for _ in range(rng.randint(0, 5)):
+                        vols.append((vid, rng.randint(10, 200)))
+                        vid += 1
+                    spec.append((f"d{di}n{ni}", f"dc{di}", f"d{di}r0",
+                                 rng.randint(0, 8), vols))
+
+            def strip(plan):
+                d = plan.to_dict()
+                d.pop("generated_ms")
+                return d
+
+            p1 = build_volume_balance_plan(_loaded_snapshot(spec),
+                                           costs=costs)
+            p2 = build_volume_balance_plan(_loaded_snapshot(spec),
+                                           costs=costs)
+            assert strip(p1) == strip(p2), f"seed {seed}: plan not stable"
+
+    def test_ec_balance_prefers_intra_dc(self):
+        # one node hoards 3 shards of a stripe and must shed 2; with two
+        # intra-DC candidates and one cross-DC one all equally empty,
+        # the link cost is the tiebreak — the near ones win every move
+        nodes = []
+        hoard = NodeView(id="a", dc="dc1", rack="r1", max_slots=10,
+                         free_slots=5)
+        hoard.ec_shards[9] = {"collection": "", "shard_ids": [0, 1, 2],
+                              "shard_bytes": 1 << 20}
+        nodes.append(hoard)
+        nodes.append(NodeView(id="b", dc="dc1", rack="r2", max_slots=10,
+                              free_slots=5))
+        nodes.append(NodeView(id="b2", dc="dc1", rack="r3", max_slots=10,
+                              free_slots=5))
+        nodes.append(NodeView(id="c", dc="dc2", rack="r9", max_slots=10,
+                              free_slots=5))
+        snap = Snapshot(nodes=nodes)
+        plan = build_ec_balance_plan(snap, costs=LinkCostModel())
+        assert plan.moves
+        assert all(m.link != "cross_dc" for m in plan.moves), \
+            [m.to_dict() for m in plan.moves]
+
+
+class TestPlannerGeo:
+    def _report(self, items):
+        return {
+            "verdict": "DEGRADED",
+            "nodes": [
+                {"id": "e1", "dc": "east", "max_slots": 10, "used_slots": 2},
+                {"id": "e2", "dc": "east", "max_slots": 10, "used_slots": 2},
+                {"id": "e3", "dc": "east", "max_slots": 10, "used_slots": 2},
+                {"id": "w1", "dc": "west", "max_slots": 10, "used_slots": 2},
+                {"id": "w2", "dc": "west", "max_slots": 10, "used_slots": 2},
+            ],
+            "items": items,
+        }
+
+    def _geom(self, vid, collection):
+        return {"codec": "rs", "d": 4, "p": 2, "shard_size": 1000}
+
+    def test_rebuild_priced_into_survivor_dc(self):
+        from seaweedfs_tpu.maintenance.planner import build_plan
+        costs = LinkCostModel()
+        report = self._report([{
+            "kind": "ec", "severity": "DEGRADED", "id": 7,
+            "collection": "", "shards_missing": [5],
+            "distance_to_data_loss": 1,
+            "holders": ["e1", "e2", "e3", "w1"]}])
+        plan = build_plan(report, probe_geometry=self._geom, costs=costs)
+        [it] = plan.items
+        assert it.repair_dc == "east"  # most survivors live there
+        # conservative un-folded pricing: each holder ships its share
+        # into the repair DC (intra-DC priced as cross_rack)
+        per = it.bytes_moved / 4
+        want = int(3 * per * costs.cross_rack + per * costs.cross_dc)
+        assert it.cost_weighted_bytes == want
+
+    def test_replica_targets_prefer_survivor_dc(self):
+        from seaweedfs_tpu.maintenance.planner import build_plan
+        report = self._report([{
+            "kind": "volume", "severity": "AT_RISK", "id": 3,
+            "collection": "", "replica_deficit": 1, "size": 4096,
+            "distance_to_data_loss": 1, "holders": ["e1"]}])
+        plan = build_plan(report, costs=LinkCostModel())
+        [it] = plan.items
+        assert it.targets and it.targets[0].startswith("e"), \
+            f"cross-DC target chosen over near one: {it.targets}"
+
+    def test_cheaper_repair_sorts_first(self):
+        from seaweedfs_tpu.maintenance.planner import build_plan
+        report = self._report([
+            {"kind": "ec", "severity": "DEGRADED", "id": 11,
+             "collection": "", "shards_missing": [5],
+             "distance_to_data_loss": 1,
+             "holders": ["e1", "e2", "w1", "w2"]},   # split: pricier
+            {"kind": "ec", "severity": "DEGRADED", "id": 12,
+             "collection": "", "shards_missing": [5],
+             "distance_to_data_loss": 1,
+             "holders": ["e1", "e2", "e3", "w1"]},   # east-heavy: cheap
+        ])
+        plan = build_plan(report, probe_geometry=self._geom,
+                          costs=LinkCostModel())
+        assert [it.vid for it in plan.items] == [12, 11]
+
+    def test_no_costs_means_no_weighting(self):
+        from seaweedfs_tpu.maintenance.planner import build_plan
+        report = self._report([{
+            "kind": "ec", "severity": "DEGRADED", "id": 7,
+            "collection": "", "shards_missing": [5],
+            "distance_to_data_loss": 1,
+            "holders": ["e1", "e2", "e3", "w1"]}])
+        plan = build_plan(report, probe_geometry=self._geom)
+        [it] = plan.items
+        assert it.cost_weighted_bytes == -1 and it.repair_dc == ""
+
+
+class _MiniFS:
+    """A filer-server stand-in just rich enough for the sync machinery:
+    a bare Filer (meta log + signature + KV store) and a blob dict in
+    place of the volume cluster."""
+
+    def __init__(self):
+        from seaweedfs_tpu.filer.filer import Filer
+        from seaweedfs_tpu.filer.store import MemoryStore
+        self.filer = Filer(MemoryStore())
+        self.blobs = {}
+
+    def write_file(self, path, data, mime="", signatures=None):
+        from seaweedfs_tpu.filer.filer import split_path
+        from seaweedfs_tpu.pb import filer_pb2 as fpb
+        d, n = split_path(path)
+        e = fpb.Entry(name=n)
+        e.attributes.file_size = len(data)
+        self.blobs[n] = bytes(data)
+        self.filer.create_entry(d, e, signatures=signatures)
+
+    def read_entry_bytes(self, entry):
+        return self.blobs.get(entry.name, b"")
+
+
+class TestGeoSync:
+    def _pair(self):
+        return _MiniFS(), _MiniFS()
+
+    def test_offset_namespace_distinct_from_filer_sync(self):
+        from seaweedfs_tpu.geo.replication import GeoSync
+        from seaweedfs_tpu.replication.filer_sync import FilerSync
+        a, b = self._pair()
+        plain = FilerSync(a, b)
+        geo = GeoSync(a, b, peer="west")
+        assert plain._offset_key.startswith(b"sync.offset.")
+        assert geo._offset_key.startswith(b"geo.sync.offset.")
+        assert plain._offset_key != geo._offset_key
+
+    def test_replicates_and_lag_returns_to_zero(self):
+        from conftest import wait_until
+
+        from seaweedfs_tpu.geo.replication import GeoSync
+        from seaweedfs_tpu.stats import GEO_REPLICATION_LAG
+        a, b = self._pair()
+        sync = GeoSync(a, b, peer="west", lag_bound_s=30.0).start()
+        try:
+            a.write_file("/geo/one.txt", b"cross the dc")
+            wait_until(lambda: b.filer.find_entry("/geo", "one.txt")
+                       is not None, msg="entry geo-replicated")
+            wait_until(lambda: sync.lag_seconds() == 0.0,
+                       msg="lag back to zero after catch-up")
+            assert sync.lag_ok()
+            assert GEO_REPLICATION_LAG.value("west") == 0.0
+            assert b.read_entry_bytes(
+                b.filer.find_entry("/geo", "one.txt")) == b"cross the dc"
+        finally:
+            sync.stop()
+
+    def test_resumes_from_persisted_offset(self):
+        from conftest import wait_until
+
+        from seaweedfs_tpu.geo.replication import GeoSync
+        a, b = self._pair()
+        s1 = GeoSync(a, b, peer="west").start()
+        a.write_file("/geo/first.txt", b"x")
+        wait_until(lambda: s1.applied >= 1, msg="first event applied")
+        s1.stop()
+        # a restart resumes past everything already applied: nothing
+        # replays, and the cursor starts at the persisted offset
+        s2 = GeoSync(a, b, peer="west")
+        assert s2.from_ns > 0
+        assert s2.from_ns == s1._applied_ts_ns
+
+    def test_applies_run_maintenance_class(self):
+        from conftest import wait_until
+
+        from seaweedfs_tpu import qos
+        from seaweedfs_tpu.geo.replication import GeoSync
+        a, b = self._pair()
+        sync = GeoSync(a, b, peer="west")
+        seen = []
+        real = sync.replicator.replicate
+
+        def spy(directory, ev):
+            seen.append(qos.current_class())
+            return real(directory, ev)
+
+        sync.replicator.replicate = spy
+        sync.start()
+        try:
+            a.write_file("/geo/tagged.txt", b"y")
+            wait_until(lambda: seen, msg="apply observed")
+            assert seen[0] == qos.CLASS_MAINTENANCE
+        finally:
+            sync.stop()
+
+    def test_lag_bound_violated_while_wedged(self):
+        from conftest import wait_until
+
+        from seaweedfs_tpu.geo.replication import GeoSync
+        a, b = self._pair()
+        sync = GeoSync(a, b, peer="west", lag_bound_s=0.01,
+                       max_retries=1000, retry_base_delay=0.02)
+        sync.replicator.replicate = lambda *aa: (_ for _ in ()).throw(
+            ConnectionError("link severed"))
+        sync.start()
+        try:
+            a.write_file("/geo/stuck.txt", b"z")
+            wait_until(lambda: sync.lag_seconds() > 0.01,
+                       msg="lag grows while the link is down")
+            assert not sync.lag_ok()
+        finally:
+            sync.stop()
+
+
+class TestOffloadedShardMove:
+    """PR 15 gap regression: VolumeEcShardsMove of a remote-backed
+    (offloaded) shard migrates the .vif sidecar CLAIM to the target
+    instead of refusing — and exactly one server holds each claim
+    afterwards (the remote object itself never moves)."""
+
+    @pytest.fixture()
+    def two_servers(self, tmp_path):
+        import socket
+
+        from seaweedfs_tpu.master.master_server import MasterServer
+        from seaweedfs_tpu.server.volume_server import VolumeServer
+        from seaweedfs_tpu.storage.disk_location import DiskLocation
+        from seaweedfs_tpu.storage.store import Store
+
+        def _fp():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        ms = MasterServer(port=_fp(), volume_size_limit_mb=64,
+                          pulse_seconds=0.5)
+        ms.start()
+        servers = []
+        for name in ("src", "dst"):
+            d = tmp_path / name
+            d.mkdir()
+            store = Store("127.0.0.1", 0, "",
+                          [DiskLocation(str(d), max_volume_count=8)],
+                          coder_name="numpy")
+            vs = VolumeServer(store, ms.address, port=_fp(),
+                              grpc_port=_fp(), pulse_seconds=0.5)
+            vs.start()
+            servers.append((vs, store))
+        from conftest import wait_until
+        wait_until(lambda: len(ms.topo.nodes) >= 2, msg="servers registered")
+        yield servers
+        for vs, _ in servers:
+            vs.stop()
+        ms.stop()
+
+    def test_claim_moves_with_the_shard(self, tmp_path, two_servers):
+        from seaweedfs_tpu.ec import files as ec_files
+        from seaweedfs_tpu.pb import volume_server_pb2 as vpb
+        from seaweedfs_tpu.storage.needle import Needle
+        from seaweedfs_tpu.utils.rpc import Stub, VOLUME_SERVICE
+
+        (src_vs, src_store), (dst_vs, dst_store) = two_servers
+        v = src_store.add_volume(9, collection="geo")
+        for i in range(1, 12):
+            v.write_needle(Needle(id=i, cookie=3, data=b"g" * (500 + i)))
+        v.sync()
+        src_store.generate_ec_shards(9, collection="geo", d=4, p=2)
+        src_store.delete_volume(9)
+        src_store.mount_ec_shards(9, "geo")
+        remote = str(tmp_path / "remote-tier")
+        assert src_store.offload_ec_shards(9, f"local:{remote}",
+                                           collection="geo") > 0
+        src_ev = src_store.find_ec_volume(9)
+        offloaded = src_ev.remote_shard_ids()
+        assert offloaded, "offload left no remote-backed shards"
+        moving = offloaded[:2]
+
+        # the move is driven from the TARGET (fork RPC semantics)
+        Stub(f"127.0.0.1:{dst_vs.grpc_port}", VOLUME_SERVICE).call(
+            "VolumeEcShardsMove",
+            vpb.VolumeEcShardsMoveRequest(
+                volume_id=9, collection="geo", shard_ids=moving,
+                source_data_node=f"127.0.0.1:{src_vs.grpc_port}"),
+            vpb.VolumeEcShardsMoveResponse, timeout=30)
+
+        dst_ev = dst_store.find_ec_volume(9)
+        assert dst_ev is not None
+        assert sorted(dst_ev.remote_shard_ids()) == sorted(moving)
+        # exactly one claim holder per shard: the source released its
+        # claims on the moved sids and kept the rest
+        src_ev = src_store.mount_ec_shards(9, "geo")
+        assert set(src_ev.remote_shard_ids()) == \
+            set(offloaded) - set(moving)
+        # both .vifs agree on the remote spec, and the target's claim
+        # carries real keys for the moved shards only
+        dst_vif = ec_files.read_vif(dst_ev.base + ".vif")
+        claim = dst_vif["remote_shards"]
+        assert sorted(int(k) for k in claim["keys"]) == sorted(moving)
+        assert claim["spec"] == f"local:{remote}"
+        # the payload still lives on the remote tier, readable from the
+        # target through its migrated claim
+        for sid in moving:
+            assert dst_ev.shards[sid] is not None
