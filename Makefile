@@ -1,13 +1,13 @@
 # seaweedfs_tpu delivery loop
 
-.PHONY: test stress chaos chaos-ha chaos-geo race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-geo bench-ha bench-telemetry bench-profile smoke protos lint metrics-lint swtpu-lint
+.PHONY: test stress chaos chaos-ha chaos-geo race bench bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-geo bench-ha bench-telemetry bench-profile smoke protos lint metrics-lint swtpu-lint crashsim
 
 # lint and the EC pipeline + bulk-ingest smokes run FIRST so a
 # concurrency-rule, exposition-grammar, encode-pipeline, or ingest-plane
 # regression fails the default path before the suite spends minutes; the
 # suite itself includes the cluster.check-against-mini-cluster smoke
 # (tests/test_health.py) so health regressions fail tier-1 too
-test: lint bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-geo bench-telemetry bench-profile
+test: lint crashsim bench-ec bench-ingest bench-repair bench-read bench-filer bench-qos bench-balance bench-tier bench-geo bench-telemetry bench-profile
 	python -m pytest tests/ -q
 
 # static analysis gate: the repo-specific AST rules (blocking calls in
@@ -22,6 +22,19 @@ swtpu-lint:
 
 metrics-lint:
 	python -m seaweedfs_tpu.stats.expo_lint
+
+# crash-consistency gate (devtools/crashsim.py): record every fs op a
+# real write path performs (utils/fstrack.py shim), enumerate the legal
+# ext4-data=ordered crash states (dropped un-fsynced suffixes, torn
+# final writes, un-pinned renames), and run the REAL recovery + invariant
+# driver on each — acked needles readable, no torn needle served, the
+# .vif seal implies synced shards, committed raft entries survive, the
+# filer meta log recovers an exact prefix. >= 500 distinct states across
+# the volume/ec/raft/filer surfaces or the gate fails; the static mirror
+# of the same contract is swtpu-lint's ack-before-fsync /
+# rename-no-dir-fsync / vif-write-bypass rules
+crashsim:
+	JAX_PLATFORMS=cpu python -m seaweedfs_tpu.devtools.crashsim --artifact CRASHSIM.json --min-states 500
 
 # race/stress harness with artifact (tests/stress/run_stress.py);
 # bounded ~60s total at 6 s/scenario on an idle box

@@ -18,6 +18,7 @@ from __future__ import annotations
 import os
 import argparse
 import sys
+import tempfile
 import time
 
 
@@ -392,10 +393,16 @@ def run_filer(argv):
     # per-port defaults: two filers started from one cwd (the obvious
     # way to try the peer mesh) must not share a meta log or store; a
     # pre-existing legacy ./filer-meta.log keeps its name on the
-    # default port only (same rule as the store above)
+    # default port only (same rule as the store above). The log lives
+    # NEXT TO the store's db file, not in the cwd — a filer pointed at
+    # a scratch store (every test harness) must not shed meta logs
+    # wherever it was launched from
+    spec_path = store.partition(":")[2]
+    meta_dir = (os.path.dirname(os.path.abspath(spec_path)) if spec_path
+                else tempfile.mkdtemp(prefix=f"swtpu-filer-{opt.port}-"))
     meta_log = ("./filer-meta.log"
                 if opt.port == 8888 and os.path.exists("./filer-meta.log")
-                else f"./filer-meta-{opt.port}.log")
+                else os.path.join(meta_dir, f"filer-meta-{opt.port}.log"))
     fs = FilerServer(opt.master, store_spec=store, ip=opt.ip, port=opt.port,
                      grpc_port=opt.grpcPort or None,
                      meta_log_path=meta_log,

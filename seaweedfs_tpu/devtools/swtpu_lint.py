@@ -41,6 +41,22 @@ Rules (suppress per line with `# swtpu-lint: disable=<rule>[,<rule>]`):
                        reads never queue behind a writer's fsync; a
                        pread inside a critical section re-serializes
                        every reader behind that lock's writers
+  ack-before-fsync     an ack/response call between a write and the
+                       fsync of the SAME fd in one function — the ack
+                       stands on data still in page cache; a crash
+                       after the reply loses acked bytes (the dynamic
+                       mirror is devtools/crashsim.py)
+  rename-no-dir-fsync  os.rename/os.replace with no directory fsync
+                       (utils/fsutil.fsync_dir) afterwards in the same
+                       function — POSIX makes the rename durable only
+                       once the PARENT DIRECTORY is fsynced; without
+                       it a crash resurrects the old name
+  vif-write-bypass     opening a `.vif` for writing outside
+                       ec/files.py — every sidecar mutation must go
+                       through write_vif/update_vif (atomic tmp+fsync+
+                       rename under the per-sidecar lock); a raw write
+                       can leave a torn JSON that makes an intact
+                       volume unmountable
 
 Output: human `path:line:col: rule: message` lines, or `--json` for the
 machine-readable document CI consumes. Exit 0 = clean, 1 = findings,
@@ -70,6 +86,13 @@ RULES: dict[str, str] = {
     "pread-under-lock": "blocking os.pread inside a `with <lock>:` block "
                         "(the lock-free read path must not serialize "
                         "behind writers)",
+    "ack-before-fsync": "ack/response call between a write and the fsync "
+                        "of the same fd (the ack stands on page cache)",
+    "rename-no-dir-fsync": "os.rename/os.replace with no later directory "
+                           "fsync in the function (the rename itself can "
+                           "be lost in a crash)",
+    "vif-write-bypass": ".vif opened for writing outside ec/files.py "
+                        "(use write_vif/update_vif)",
     "parse-error": "file does not parse",
 }
 
@@ -104,6 +127,20 @@ _FILE_CALLS = {"open", "io.open"}
 # pread specifically marks a LOCK-FREE read path — one issued while
 # holding a lock means reads re-serialize behind writers again.
 _PREAD_CALLS = {"os.pread", "os.preadv"}
+# callee names that acknowledge data to a client/peer. Deliberately a
+# closed list of explicit ack verbs: a generic name ("send", "reply_to")
+# would drown the rule in false positives, and this codebase's ack
+# surfaces (needle PUT, raft commit, filer meta) all go through helpers
+# that can adopt one of these names.
+_ACK_NAMES = {
+    "ack", "send_ack", "send_response", "write_response", "respond",
+    "reply", "send_reply", "ack_frame", "mark_acked",
+}
+# callee names that fsync a *directory* (making a rename durable):
+# utils/fsutil.fsync_dir and module-local `_fsync_dir` helpers
+_DIRFSYNC_RE = re.compile(r"(?:^|_)(?:fsync_dir|dir_fsync)$")
+# identifier that names a .vif sidecar path (`vif_path`, `self.vif`, ...)
+_VIF_NAME_RE = re.compile(r"(?i)(?:^|_)vif(?:_path)?$")
 
 
 @dataclass
@@ -170,6 +207,12 @@ class _FileLinter(ast.NodeVisitor):
         self._thread_creates: list[tuple[ast.Call, str | None, bool]] = []
         self._joined: set[str] = set()
         self._stored: set[str] = set()
+        # per-function durability-ordering events, resolved on fn exit:
+        # frames of (line, kind, key, node) where kind is one of
+        # write/fsync/ack/rename/dirfsync and key is the fd identifier
+        # (write/fsync), the callee name (ack/dirfsync), or the
+        # normalized os.rename/os.replace name (rename)
+        self._dur_stack: list[list[tuple[int, str, str, ast.Call]]] = []
         self._parents: dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(tree):
             for child in ast.iter_child_nodes(parent):
@@ -216,7 +259,9 @@ class _FileLinter(ast.NodeVisitor):
         # a nested def's body does not run inside the enclosing with-lock
         saved_locks, self._lock_stack = self._lock_stack, []
         self._wallclock_names.append({})
+        self._dur_stack.append([])
         self.generic_visit(node)
+        self._resolve_durability(self._dur_stack.pop())
         self._wallclock_names.pop()
         self._lock_stack = saved_locks
         self._async_depth -= 1 if is_async else 0
@@ -292,6 +337,8 @@ class _FileLinter(ast.NodeVisitor):
         self._check_executor_hop(node, name)
         self._check_thread_create(node, name)
         self._check_wallclock_call(node)
+        self._check_durability(node, name)
+        self._check_vif_write(node, name)
         self.generic_visit(node)
 
     def _check_executor_hop(self, node: ast.Call, name: str | None) -> None:
@@ -311,6 +358,112 @@ class _FileLinter(ast.NodeVisitor):
                    f"{f.attr}() drops contextvars (the active trace "
                    "span); wrap the callable with "
                    "contextvars.copy_context().run")
+
+    # -- durability ordering ---------------------------------------------------
+    @staticmethod
+    def _fd_key(arg: ast.AST) -> str:
+        """Identifier behind an fd expression: `f.fileno()` and `f` both
+        key as "f" so `os.fsync(f.fileno())` matches `f.write(...)`."""
+        if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "fileno"):
+            return _final_id(arg.func.value)
+        return _final_id(arg)
+
+    def _check_durability(self, node: ast.Call, name: str | None) -> None:
+        if not self._dur_stack:
+            return
+        ev = self._dur_stack[-1]
+        fid = _final_id(node.func)
+        if name in ("os.write", "os.pwrite") and node.args:
+            ev.append((node.lineno, "write", self._fd_key(node.args[0]),
+                       node))
+        elif (fid == "write" and isinstance(node.func, ast.Attribute)):
+            key = _final_id(node.func.value)
+            if key:
+                ev.append((node.lineno, "write", key, node))
+        elif name in ("os.fsync", "os.fdatasync") and node.args:
+            ev.append((node.lineno, "fsync", self._fd_key(node.args[0]),
+                       node))
+        elif fid in _ACK_NAMES:
+            ev.append((node.lineno, "ack", fid, node))
+        if name in ("os.rename", "os.replace"):
+            ev.append((node.lineno, "rename", name, node))
+        elif _DIRFSYNC_RE.search(fid):
+            ev.append((node.lineno, "dirfsync", fid, node))
+
+    def _resolve_durability(
+            self, events: list[tuple[int, str, str, ast.Call]]) -> None:
+        # ack-before-fsync: ack strictly between write(K) and fsync(K)
+        first_write: dict[str, int] = {}
+        for line, kind, key, _ in events:
+            if kind == "write" and key and key not in first_write:
+                first_write[key] = line
+        for line, kind, key, _ in events:
+            if kind != "fsync" or not key:
+                continue
+            w = first_write.get(key)
+            if w is None or w >= line:
+                continue
+            for aline, akind, aname, anode in events:
+                if akind == "ack" and w < aline < line:
+                    self._emit(anode, "ack-before-fsync",
+                               f"{aname}() acknowledges data written to "
+                               f"{key!r} (line {w}) before its fsync "
+                               f"(line {line}); a crash in between loses "
+                               "acked bytes — ack after the fsync (the "
+                               "crashsim mutant scenario demonstrates "
+                               "the loss)")
+        # rename-no-dir-fsync: every rename needs a later dir fsync
+        last_dirfsync = max(
+            (line for line, kind, _, _ in events if kind == "dirfsync"),
+            default=-1)
+        for line, kind, key, node in events:
+            if kind == "rename" and line > last_dirfsync:
+                self._emit(node, "rename-no-dir-fsync",
+                           f"{key} with no later fsync_dir() in this "
+                           "function; the rename is only durable once the "
+                           "parent directory is fsynced — call "
+                           "utils/fsutil.fsync_dir(dst) after it")
+
+    def _check_vif_write(self, node: ast.Call, name: str | None) -> None:
+        if self.path.replace(os.sep, "/").endswith("ec/files.py"):
+            return  # the sanctioned writer (write_vif/update_vif)
+        if name in _FILE_CALLS:
+            mode = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if not (isinstance(mode, str)
+                    and any(c in mode for c in "wax+")):
+                return
+        elif name == "os.open":
+            if len(node.args) < 2 or not _mentions(
+                    node.args[1], "O_WRONLY", "O_RDWR"):
+                return
+        else:
+            return
+        if node.args and self._mentions_vif(node.args[0]):
+            self._emit(node, "vif-write-bypass",
+                       ".vif sidecar opened for writing; go through "
+                       "ec/files.write_vif/update_vif (atomic tmp + fsync "
+                       "+ rename under the sidecar lock) so a crash can "
+                       "never leave a torn sidecar")
+
+    @staticmethod
+    def _mentions_vif(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Constant)
+                    and isinstance(sub.value, str)
+                    and ".vif" in sub.value):
+                return True
+            if isinstance(sub, ast.Name) and _VIF_NAME_RE.search(sub.id):
+                return True
+            if (isinstance(sub, ast.Attribute)
+                    and _VIF_NAME_RE.search(sub.attr)):
+                return True
+        return False
 
     def _check_thread_create(self, node: ast.Call, name: str | None) -> None:
         if name not in ("threading.Thread", "threading.Timer"):

@@ -17,6 +17,7 @@ import numpy as np
 
 from ..storage import types as t
 from ..storage.needle_map import idx_entries_numpy, write_idx_entries
+from ..utils.fsutil import fsync_dir
 
 
 def shard_ext(i: int) -> str:
@@ -143,6 +144,10 @@ def write_vif(path: str, **info) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # the rename itself is durable only once the parent directory is
+    # fsynced — without this a crash can resurrect the OLD sidecar
+    # after the caller acked the seal/stamp
+    fsync_dir(path)
 
 
 def read_vif(path: str) -> dict:

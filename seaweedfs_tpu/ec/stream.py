@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..ops.coder import ErasureCoder
+from ..utils import fsutil
 from ..utils.env import env_int
 from ..utils.log import logger
 from . import files
@@ -528,8 +529,16 @@ class _VolumePlan:
             apply_codec_overlay(self.out_base, self.overlay,
                                 self.shard_size)
             codec = self.overlay.codec
+            # the overlay rewrote parity bytes AFTER the writer-pool
+            # fsyncs above — re-pin them before the seal claims them
+            for i in range(geo.d, geo.n):
+                fsutil.fsync_path(self.out_base + files.shard_ext(i))
         if self.idx_path and os.path.exists(self.idx_path):
             files.write_ecx_from_idx(self.idx_path, self.out_base + ".ecx")
+            # the .ecx must be durable BEFORE the .vif seals the volume
+            # for the same reason as the shard fsyncs: a sealed .vif
+            # over a torn .ecx serves no needle at all
+            fsutil.fsync_path(self.out_base + ".ecx")
         files.write_vif(self.out_base + ".vif", version=3,
                         dat_size=self.dat_size, d=geo.d, p=geo.p,
                         large_block=geo.large_block,

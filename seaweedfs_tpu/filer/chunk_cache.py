@@ -204,7 +204,9 @@ class ChunkCache:
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(data)
-            os.replace(tmp, path)
+            # cache tier: losing an entry to a crash just re-fetches from
+            # the volume server; durability costs would defeat the cache
+            os.replace(tmp, path)  # swtpu-lint: disable=rename-no-dir-fsync
         except OSError as e:  # cache dir full/unwritable: degrade
             log.warning("disk cache write %s: %s", fid, e)
             return

@@ -16,6 +16,7 @@ import time
 from collections import deque
 
 from ..pb import filer_pb2 as fpb
+from ..utils import fsutil
 from ..utils.log import logger
 
 log = logger("meta-log")
@@ -93,6 +94,11 @@ class MetaLog:
             if self._f:
                 self._f.close()
             os.replace(tmp, self._path)
+            # subscribers resume from offsets into the purged file; if a
+            # crash rolled the rename back they would replay pre-purge
+            # bytes at those offsets — pin the swap before handing out
+            # positions from the new generation
+            fsutil.fsync_dir(self._path)
             self._purge_gen += 1
             if self._f:
                 self._f = open(self._path, "ab")

@@ -18,6 +18,7 @@ from bisect import bisect_left, bisect_right, insort
 from typing import Iterator
 
 from ..pb import filer_pb2 as fpb
+from ..utils import fsutil
 
 
 class FilerStore:
@@ -202,8 +203,13 @@ class LogDbStore(MemoryStore):
             for k, v in list(self._kv.items()):
                 f.write(self._REC.pack(self.OP_KV, len(k), 0, len(v)))
                 f.write(k + v)
+            # the compacted log REPLACES the only copy of this metadata:
+            # pin its bytes before the rename makes it authoritative
+            f.flush()
+            os.fsync(f.fileno())
         self._f.close()
         os.replace(tmp, self._path)
+        fsutil.fsync_dir(self._path)
         self._f = open(self._path, "ab")
         self._written = len(self._blobs)
 
@@ -453,6 +459,9 @@ class LsmStore(FilerStore):
         tmp = self._sst_path(seq) + ".tmp"
         self._write_sst(tmp, ((k, self._mem[k]) for k in sorted(self._mem)))
         os.replace(tmp, self._sst_path(seq))
+        # the WAL is truncated right below on the strength of this SST
+        # existing; the rename must therefore survive the same crash
+        fsutil.fsync_dir(self._sst_path(seq))
         self._ssts.append((seq, _Sst(self._sst_path(seq))))
         self._mem.clear()
         self._mem_bytes = 0
@@ -500,6 +509,9 @@ class LsmStore(FilerStore):
         self._write_sst(tmp, self._stream_merge(tables,
                                                 drop_tombstones=full))
         os.replace(tmp, self._sst_path(seq))
+        # inputs are unlinked below — the merged output's rename must be
+        # durable before the only other copies of its keys disappear
+        fsutil.fsync_dir(self._sst_path(seq))
         new_sst = (seq, _Sst(self._sst_path(seq)))
         self._ssts = [new_sst] if full else [base, new_sst]
         for oseq, osst in tables:

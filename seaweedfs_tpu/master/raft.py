@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor, as_completed
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..utils import fsutil
 from ..utils.log import logger
 from ..utils.rpc import RpcService, Stub
 
@@ -74,15 +75,7 @@ def _fsync_dir(path: str) -> None:
     itself can be lost, resurrecting a stale voted_for — which lets the
     node vote twice in one term (the exact double-vote raft §5.2
     forbids)."""
-    d = os.path.dirname(path) or "."
-    try:
-        fd = os.open(d, os.O_RDONLY)
-    except OSError:
-        return  # platform without directory fds; best effort
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+    fsutil.fsync_dir(path)
 
 
 class RaftNode:
@@ -168,7 +161,18 @@ class RaftNode:
     #                         fsync'd per append; O(1) disk work per entry
     #                         instead of rewriting the whole log (r2 weak #6)
     def _load(self) -> None:
-        if not self.state_path or not os.path.exists(self.state_path):
+        if not self.state_path:
+            return
+        if not os.path.exists(self.state_path):
+            # no metadata yet, but a crash before the FIRST metadata
+            # rewrite can still leave fsynced (= acked) WAL appends;
+            # ignoring the WAL here would lose committed entries
+            try:
+                wal_start, self.log = self._read_wal()
+                if wal_start is not None:
+                    self.log_start = wal_start
+            except Exception as e:  # noqa: BLE001
+                log.warning("raft wal load: %s", e)
             return
         try:
             with open(self.state_path) as f:
@@ -182,8 +186,10 @@ class RaftNode:
                 self.log = [LogEntry(e["term"], e["command"])
                             for e in st.get("log", [])]
                 # migrate NOW: the next metadata-only persist would drop
-                # the inline log and orphan every entry
-                self._persist()
+                # the inline log and orphan every entry. WAL first: the
+                # entries must land in their new home before the
+                # metadata rewrite drops the inline copy
+                self._persist(wal_first=True)
             else:
                 wal_start, self.log = self._read_wal()
                 if wal_start is not None:
@@ -267,11 +273,23 @@ class RaftNode:
         f.flush()
         os.fsync(f.fileno())
 
-    def _persist(self) -> None:
-        """Full rewrite: WAL (with its log_start header) first, metadata
-        second — a crash in between leaves a consistent WAL whose header
-        overrides the stale metadata on reload. Needed after truncation/
-        compaction/snapshot-install; appends use _wal_append instead."""
+    def _persist(self, wal_first: bool = False) -> None:
+        """Full rewrite of metadata + WAL. Ordering is load-bearing:
+        every committed entry must exist in (snapshot ∪ WAL) at EVERY
+        crash point, so whichever file is gaining entries is written
+        before the file losing them is rewritten. Compaction folds
+        entries WAL→snapshot, hence metadata first by default — a
+        wal-first swap would leave a WAL whose header says log_start=N
+        next to metadata whose snapshot still ends below N, and the
+        folded committed entries would exist NOWHERE on disk
+        (crashsim's raft-commit scenario catches exactly this). The
+        reverse window (new snapshot + old longer WAL) merely replays
+        folded entries twice, and _fold is idempotent (monotonic
+        maxes) by design. The pre-WAL format migration in _load moves
+        entries the OTHER way (inline metadata log → WAL) and passes
+        wal_first=True for the same reason mirrored. Needed after
+        truncation/compaction/snapshot-install; appends use
+        _wal_append instead."""
         if not self.state_path:
             return
         d = os.path.dirname(self.state_path)
@@ -280,6 +298,8 @@ class RaftNode:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
+        if not wal_first:
+            self._persist_meta()
         tmp = self.state_path + ".wal.tmp"
         with open(tmp, "wb") as f:
             f.write(json.dumps({"log_start": self.log_start}).encode()
@@ -291,7 +311,8 @@ class RaftNode:
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path + ".wal")
         _fsync_dir(self.state_path)
-        self._persist_meta()
+        if wal_first:
+            self._persist_meta()
 
     def _maybe_compact(self) -> None:
         """Fold committed prefix into the snapshot (caller holds lock).

@@ -23,6 +23,7 @@ import hashlib
 
 from ..client.master_client import MasterClient
 from ..pb import mq_pb2 as mq
+from ..utils import fsutil
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, serve
 from .sub_coordinator import Coordinator
@@ -264,6 +265,9 @@ class LocalSegmentStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path(d, name))
+        # the flush path acks the batch once this entry lands: pin the
+        # rename so a crash can't un-publish acked messages
+        fsutil.fsync_dir(self._path(d, name))
 
 
 class BrokerServer:

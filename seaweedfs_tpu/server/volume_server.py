@@ -25,7 +25,7 @@ from ..storage.store import Store
 from ..storage.types import TTL, parse_file_id
 from ..storage.vacuum import commit_compact, compact
 from ..telemetry.hot import record as hot_record
-from ..utils import failpoints, retry
+from ..utils import failpoints, fsutil, retry
 from ..utils.log import logger
 from ..utils.rpc import MASTER_SERVICE, RpcService, Stub, VOLUME_SERVICE, serve
 
@@ -2663,9 +2663,14 @@ class VolumeServer:
                 except Exception:  # noqa: BLE001 — peer may lack it too
                     continue
                 if any(parts):
-                    with open(base + ".vif", "wb") as f:
-                        for pc in parts:
-                            f.write(pc)
+                    # parse before installing, and install through the
+                    # one sanctioned .vif writer: a torn peer copy must
+                    # never land as a valid-looking sidecar
+                    try:
+                        info = json.loads(b"".join(parts))
+                    except ValueError:
+                        continue  # peer's copy is torn; try the next
+                    ec_files.write_vif(base + ".vif", **info)
                     return base
             return base
 
@@ -3333,7 +3338,12 @@ class VolumeServer:
                     pass
                 context.abort(13, f"tier download: {e}")
             v.close()
+            # the remote object may be deleted below: the downloaded .dat
+            # and its rename must be durable before the last other copy
+            # of the volume's data goes away
+            fsutil.fsync_path(tmp)
             os.replace(tmp, v.dat_path)
+            fsutil.fsync_dir(v.dat_path)
             ec_files.update_vif(v.vif_path, remove=("remote",))
             nv = store.reload_volume(req.volume_id)
             if not req.keep_remote_dat_file and nv is not None:

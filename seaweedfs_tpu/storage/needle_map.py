@@ -260,7 +260,11 @@ def write_idx_entries(path: str, keys, stored_offsets, sizes) -> None:
     arr[:, 0:8] = np.asarray(keys, dtype="<u8").reshape(-1, 1).view(np.uint8).reshape(-1, 8)
     arr[:, 8:12] = np.asarray(stored_offsets, dtype="<u4").reshape(-1, 1).view(np.uint8).reshape(-1, 4)
     arr[:, 12:16] = np.asarray(sizes, dtype="<u4").reshape(-1, 1).view(np.uint8).reshape(-1, 4)
-    arr.tofile(path)
+    # plain open+write rather than ndarray.tofile: tofile bypasses the
+    # io layer entirely, which both skips the crash-consistency shim
+    # (utils/fstrack) and cannot be buffered/proxied consistently
+    with open(path, "wb") as f:
+        f.write(arr.tobytes())
 
 
 class SqliteMap:

@@ -16,7 +16,7 @@ from ..ec.encoder import decode_volume, encode_volume, rebuild_shards
 from ..ec.locate import EcGeometry
 from ..ec.volume import EcVolume
 from ..ops.coder import ErasureCoder, get_coder
-from ..utils import failpoints
+from ..utils import failpoints, fsutil
 from ..utils.log import logger
 from . import types as t
 from .disk_location import DiskLocation
@@ -577,9 +577,14 @@ class Store:
                 if sh.size and got != sh.size:
                     raise OSError(f"short promote of shard {sid}: "
                                   f"{got} != {sh.size}")
+                # the remote copy may be deleted below (keep_remote
+                # False): the local bytes and their rename must be
+                # durable before the last other copy goes away
+                fsutil.fsync_path(tmp)
                 os.replace(tmp, path)
                 landed.append((sid, sh.key))
                 moved += got
+            fsutil.fsync_dir(ev.base + ".vif")
         except Exception:
             for sid, _key in landed:
                 try:
@@ -648,6 +653,9 @@ class Store:
                     os.fsync(f.fileno())
                 os.replace(tmp, dst_base + ext)
                 copied.append(dst_base + ext)
+            # the source files are removed once the swap commits: the
+            # destination's directory entries must survive first
+            fsutil.fsync_dir(dst_base + ".dat")
             # build the replacement FULLY (needle-map load, integrity
             # scan) before touching the mapping: reads must never find
             # the vid unmapped mid-move
@@ -734,7 +742,9 @@ class Store:
             for fn in os.listdir(trash):
                 stem, ext = os.path.splitext(fn)
                 if stem == base:
-                    os.replace(os.path.join(trash, fn),
+                    # trash restore: a crash rolling the move back leaves
+                    # the shard in .trash, restorable by re-running
+                    os.replace(os.path.join(trash, fn),  # swtpu-lint: disable=rename-no-dir-fsync
                                os.path.join(loc.directory, fn))
                     moved = True
             if moved:

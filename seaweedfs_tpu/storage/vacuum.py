@@ -25,6 +25,7 @@ from .needle import record_size_from_header
 from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 from .needle_map import write_idx_entries, _ENTRY
 from .volume import Volume, iter_records
+from ..utils import fsutil
 
 import numpy as np
 
@@ -112,6 +113,10 @@ def commit_compact(vol: Volume) -> Volume:
         vol.close()
         os.replace(cpd, base + ".dat")
         os.replace(cpx, base + ".idx")
+        # the compacted files replace the live volume: a crash before the
+        # directory entries hit disk would resurrect the pre-compaction
+        # .dat/.idx (stale offsets for every replayed needle)
+        fsutil.fsync_dir(base + ".dat")
     # every live needle moved to a new offset: the whole volume's cached
     # entries are stale (close() already invalidated; this covers the
     # swap explicitly so the coherence story reads at the chokepoint)

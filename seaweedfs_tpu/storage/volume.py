@@ -181,9 +181,12 @@ class Volume:
         end = self._scan_forward(start, dat_size)
         if end < dat_size:
             self._dat.truncate(end)
-            # drop idx entries pointing past the truncation point
-            for key in list(self._keys_past(end)):
-                self.nm.delete(key)
+        # drop idx entries pointing at or past the valid end — even when
+        # nothing was truncated: a crash can persist the .idx append while
+        # the .dat append is lost entirely (end == dat_size), and a stale
+        # entry at EOF would serve garbage reads instead of not-found
+        for key in list(self._keys_past(end)):
+            self.nm.delete(key)
         self._append_offset = max(end, SUPER_BLOCK_SIZE)
         self._commit_offset = self._append_offset
 

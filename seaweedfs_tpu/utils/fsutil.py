@@ -1,0 +1,45 @@
+"""Shared durability primitives (crash-consistency plane).
+
+One canonical `fsync_dir` for every tmp+fsync+rename commit point in
+the tree: POSIX makes a rename durable only once the *parent
+directory* is fsynced — fsyncing the renamed file alone can leave the
+old name resurrected after a crash (the raft double-vote scenario that
+master/raft.py first fixed locally). The `rename-no-dir-fsync` lint
+rule (devtools/swtpu_lint.py) recognizes a call to this helper as the
+barrier that closes that gap, and utils/fstrack.py records it as a
+`fsync_dir` op so devtools/crashsim.py pins the rename in its crash
+states.
+"""
+from __future__ import annotations
+
+import os
+
+
+def fsync_path(path: str) -> None:
+    """fsync an already-written file by path — for writers that closed
+    (or never held) the fd, e.g. numpy-written sidecars that must be
+    durable before a seal references them."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the parent directory of `path` (or `path` itself when it
+    IS a directory) so a just-completed os.replace / file creation
+    survives a crash. Best effort: platforms without directory fds
+    (or read-only dirs) degrade to a no-op, same as the reference's
+    util.Fsync on Windows."""
+    d = path if os.path.isdir(path) else (os.path.dirname(path) or ".")
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -1,0 +1,55 @@
+"""Chaos crashsim lane: the recording VFS shim under the lock detector.
+
+fstrack patches builtins.open and the os.* write/sync/rename surface for
+EVERY thread in the process, and crashsim's recovery drivers open real
+Volume/EcVolume/RaftNode objects (their own locks, pools, heartbeat
+machinery) while the shim is live. This lane runs a scenario pass with
+SWTPU_LOCKCHECK=1 to prove the shim introduces no lock-order edges: its
+internal guard is a raw `_thread.allocate_lock()` deliberately invisible
+to locktrack's graph (PR 19's GC-reentrancy lesson — a tracked lock
+taken inside arbitrary __del__-triggered writes would manufacture
+cycles), so the session must end with ZERO ordering cycles and the
+traced scenarios must still enumerate violation-free.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if not os.environ.get("SWTPU_CHAOS"):
+    pytest.skip("chaos suite is opt-in: set SWTPU_CHAOS=1",
+                allow_module_level=True)
+
+from seaweedfs_tpu.devtools import crashsim  # noqa: E402
+from seaweedfs_tpu.utils import fstrack  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def no_lock_order_cycles():
+    """Same contract as every chaos lane: zero ordering cycles at
+    session end — here specifically exercising the fstrack patch
+    window, whose writes run under volume/raft locks."""
+    yield
+    if os.environ.get("SWTPU_LOCKCHECK") != "1":
+        return
+    from seaweedfs_tpu.utils import locktrack
+
+    rep = locktrack.findings()
+    assert rep["cycles"] == [], (
+        "lock-order cycles observed with the fstrack shim installed "
+        "(potential ABBA deadlocks): "
+        + "; ".join(" -> ".join(c["locks"]) for c in rep["cycles"]))
+
+
+@pytest.mark.parametrize("name", ["single-put", "raft-commit"])
+def test_crashsim_pass_under_lockcheck(name):
+    sc = next(s for s in crashsim.SCENARIOS if s.name == name)
+    rep = crashsim.run_scenario(sc, seed=3, max_states=150)
+    assert rep["violations"] == []
+    assert rep["states"] > 10
+    # the shim must be fully withdrawn between scenarios — a leaked
+    # patch would shadow every later lane's file I/O
+    assert not fstrack.installed()

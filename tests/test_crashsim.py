@@ -1,0 +1,223 @@
+"""Crash-state enumerator + recovery drivers (devtools/crashsim.py):
+enumeration semantics on hand-built traces (fsync pins a prefix,
+un-fsynced suffixes drop, torn final writes, un-pinned renames roll
+back), torn-rename recovery for the .vif and raft metadata surfaces
+(old sealed state stays authoritative, the tmp is never loaded), fast
+scenario passes, and the seeded ack-before-fsync mutant being caught by
+BOTH the crash simulator and the swtpu-lint rule."""
+
+import json
+import os
+import random
+import textwrap
+
+import pytest
+
+from seaweedfs_tpu.devtools import crashsim, swtpu_lint
+from seaweedfs_tpu.utils.fstrack import FsOp
+
+
+def _op(seq, kind, path="/w/f", **kw):
+    return FsOp(seq, kind, path=path, **kw)
+
+
+def _states(ops, snapshot=None, **kw):
+    return list(crashsim.enumerate_states(
+        ops, snapshot or {}, random.Random(0), **kw))
+
+
+def _contents(states):
+    return {tuple(sorted((p, bytes(b)) for p, b in files.items()))
+            for files, _, _ in states}
+
+
+# -- enumeration semantics ----------------------------------------------------
+
+def test_fsync_pins_earlier_writes():
+    # droppable families: before the fsync everything on the file is
+    # loose; after it, nothing is
+    loose = [_op(1, "create"), _op(2, "write", offset=0, data=b"abcd")]
+    fams, _ = crashsim._families(loose)
+    assert fams and {s for fam in fams for s in fam} == {1, 2}
+    pinned, _ = crashsim._families(loose + [_op(3, "fsync")])
+    assert pinned == []
+    # and no crash state can hold later bytes without the earlier ones:
+    # every reachable content is a prefix of the full write sequence
+    ops = loose + [_op(3, "fsync"), _op(4, "write", offset=4, data=b"ef")]
+    for variant in _contents(_states(ops, torn_cuts=4)):
+        if variant:  # dropping the create leaves no file at all
+            (_, body), = variant
+            assert b"abcdef".startswith(body)
+
+
+def test_unsynced_suffix_droppable_and_torn():
+    ops = [_op(1, "create"), _op(2, "write", offset=0, data=b"abcdef")]
+    variants = _contents(_states(ops, torn_cuts=4))
+    # full write, dropped write (empty file), and at least one tear
+    assert (("/w/f", b"abcdef"),) in variants
+    assert (("/w/f", b""),) in variants
+    assert any(v[0][1] and len(v[0][1]) < 6 for v in variants)
+
+
+def test_tear_only_on_final_surviving_write():
+    # the first write is followed by a second: tearing the FIRST would
+    # violate per-file prefix ordering, so every torn state tears w2
+    ops = [_op(1, "create"), _op(2, "write", offset=0, data=b"aaaa"),
+           _op(3, "write", offset=4, data=b"bbbb")]
+    for files, _, why in _states(ops, torn_cuts=4):
+        if "torn" in why and why.startswith("crash after op3"):
+            assert files["/w/f"][:4] == b"aaaa"
+
+
+def test_unpinned_rename_rolls_back():
+    ops = [_op(1, "create", path="/w/t"),
+           _op(2, "write", path="/w/t", offset=0, data=b"v2"),
+           _op(3, "fsync", path="/w/t"),
+           _op(4, "rename", path="/w/t", dst="/w/f")]
+    snap = {"/w/f": b"v1"}
+    variants = _contents(_states(ops, snap))
+    # the rename can be lost (old name back) or kept; never a torn mix
+    assert (("/w/f", b"v2"),) in variants
+    assert (("/w/f", b"v1"), ("/w/t", b"v2")) in variants
+
+
+def test_dir_fsync_pins_rename():
+    ops = [_op(1, "create", path="/w/t"),
+           _op(2, "write", path="/w/t", offset=0, data=b"v2"),
+           _op(3, "fsync", path="/w/t"),
+           _op(4, "rename", path="/w/t", dst="/w/f")]
+    fams, _ = crashsim._families(ops)
+    assert fams == [[4]]  # the rename is the only loose op
+    pinned, _ = crashsim._families(ops + [_op(5, "fsync_dir", path="/w")])
+    assert pinned == []
+
+
+def test_acked_marks_follow_prefix():
+    ops = [_op(1, "create"), _op(2, "write", offset=0, data=b"x"),
+           _op(3, "fsync"),
+           FsOp(4, "mark", label="ack", meta={"key": 1}),
+           _op(5, "write", offset=1, data=b"y")]
+    by_why = {why: acked for _, acked, why in _states(ops)}
+    assert by_why["crash after op2:write"] == []
+    assert [m.meta["key"] for m in by_why["crash after op5:write"]] == [1]
+
+
+def test_states_deduplicated():
+    ops = [_op(1, "create"), _op(2, "write", offset=0, data=b"q")]
+    states = _states(ops)
+    seen = _contents(states)
+    assert len(seen) == len(states)
+
+
+# -- torn-rename recovery (kill between tmp write and os.replace) -------------
+
+def test_vif_torn_rename_old_sidecar_authoritative(tmp_path):
+    from seaweedfs_tpu.ec import files as ec_files
+    vif = str(tmp_path / "1.vif")
+    old = {"version": 3, "dat_size": 4096, "d": 4, "p": 2}
+    ec_files.write_vif(vif, **old)
+    # crash between the tmp write and os.replace: a complete tmp exists
+    # but never landed; recovery must serve the OLD sealed sidecar
+    with open(vif + ".tmp", "w") as f:
+        f.write(json.dumps({"version": 4, "dat_size": 9999}))
+    assert ec_files.read_vif(vif) == old
+    # and a TORN tmp (truncated JSON) must be just as invisible
+    with open(vif + ".tmp", "w") as f:
+        f.write('{"version": 4, "dat_si')
+    assert ec_files.read_vif(vif) == old
+
+
+def test_raft_torn_rename_old_metadata_authoritative(tmp_path):
+    from seaweedfs_tpu.master.raft import LogEntry, RaftNode
+    sp = str(tmp_path / "raft" / "state.json")
+    n = RaftNode("n1:1", ["n1:1"], lambda _c: None, state_path=sp)
+    n.current_term = 3
+    n.voted_for = "n1:1"
+    n.log.append(LogEntry(3, {"op": "set", "key": "a", "val": 1}))
+    n._wal_append(n.log[-1:])
+    n._persist_meta()
+    n.stop()
+    # crash mid-rewrite: a stray tmp (complete or torn) next to the
+    # sealed metadata — recovery loads the sealed file, never the tmp
+    for tmp_body in (json.dumps({"term": 99, "voted_for": "evil",
+                                 "log_start": 7}),
+                     '{"term": 99, "voted_'):
+        with open(sp + ".tmp", "w") as f:
+            f.write(tmp_body)
+        r = RaftNode("n1:1", ["n1:1"], lambda _c: None, state_path=sp)
+        assert r.current_term == 3
+        assert r.voted_for == "n1:1"
+        assert [e.command for e in r.log] == \
+            [{"op": "set", "key": "a", "val": 1}]
+        r.stop()
+
+
+def test_raft_wal_without_metadata_still_loads(tmp_path):
+    # a crash before the FIRST metadata rewrite leaves only the WAL;
+    # its fsynced (= acked) entries must survive recovery
+    from seaweedfs_tpu.master.raft import LogEntry, RaftNode
+    sp = str(tmp_path / "raft" / "state.json")
+    n = RaftNode("n1:1", ["n1:1"], lambda _c: None, state_path=sp)
+    n.log.append(LogEntry(1, {"op": "set", "key": "k", "val": 5}))
+    n._wal_append(n.log[-1:])
+    n.stop()
+    os.unlink(sp) if os.path.exists(sp) else None
+    r = RaftNode("n1:1", ["n1:1"], lambda _c: None, state_path=sp)
+    assert [e.command for e in r.log] == [{"op": "set", "key": "k",
+                                          "val": 5}]
+    r.stop()
+
+
+# -- scenario drivers ---------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["single-put", "vif-stamp", "meta-log"])
+def test_fast_scenarios_clean(name):
+    sc = next(s for s in crashsim.SCENARIOS if s.name == name)
+    rep = crashsim.run_scenario(sc, seed=1, max_states=200)
+    assert rep["violations"] == []
+    assert rep["states"] > 10
+
+
+def test_scenario_seed_reproducible():
+    sc = next(s for s in crashsim.SCENARIOS if s.name == "single-put")
+    a = crashsim.run_scenario(sc, seed=7, max_states=50)
+    b = crashsim.run_scenario(sc, seed=7, max_states=50)
+    assert (a["states"], a["ops"]) == (b["states"], b["ops"])
+
+
+# -- the seeded mutant is caught by BOTH halves of the plane ------------------
+
+def test_mutant_caught_by_crashsim():
+    sc = crashsim.MUTANTS["mutant-ack-before-fsync"]
+    rep = crashsim.run_scenario(sc, seed=0, max_states=400)
+    assert rep["violations"], "ack-before-fsync mutant must trip crashsim"
+    assert any("acked" in v or "crashed" in v
+               for st in rep["violations"] for v in st["errors"])
+
+
+def test_mutant_caught_by_lint(tmp_path):
+    # the same bug class, static half: the shape the mutant scenario
+    # executes (write, ack, fsync later) as source
+    p = tmp_path / "mutant.py"
+    p.write_text(textwrap.dedent("""\
+        import os
+        def bulk_put(dat, frames, conn):
+            for frame in frames:
+                dat.write(frame)
+                conn.send_ack(b"ok")
+            os.fsync(dat.fileno())
+        """))
+    findings = swtpu_lint.lint_file(str(p))
+    assert {f.rule for f in findings} == {"ack-before-fsync"}
+
+
+def test_cli_artifact_and_exit(tmp_path, capsys):
+    art = tmp_path / "CRASHSIM.json"
+    rc = crashsim.main(["--scenario", "vif-stamp", "--artifact", str(art),
+                        "--max-states", "120"])
+    assert rc == 0
+    doc = json.loads(art.read_text())
+    assert doc["total_violations"] == 0
+    assert doc["scenarios"][0]["scenario"] == "vif-stamp"
+    capsys.readouterr()
+    assert crashsim.main(["--scenario", "no-such"]) == 2

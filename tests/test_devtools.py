@@ -247,6 +247,80 @@ def test_rule_executor_with_context_not_flagged(tmp_path):
     assert fs == []
 
 
+def test_rule_ack_before_fsync(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import os
+        def put(f, data, conn):
+            f.write(data)
+            conn.send_response(b"ok")
+            os.fsync(f.fileno())
+        """)
+    assert _rules(fs) == {"ack-before-fsync"}
+
+
+def test_rule_ack_after_fsync_not_flagged(tmp_path):
+    # ack AFTER the fsync, and an ack between a write and the fsync of a
+    # DIFFERENT fd, are both fine
+    fs = _lint_src(tmp_path, """\
+        import os
+        def put(f, data, conn):
+            f.write(data)
+            os.fsync(f.fileno())
+            conn.send_response(b"ok")
+        def put2(f, g, data, conn):
+            f.write(data)
+            conn.send_response(b"ok")
+            os.fsync(g.fileno())
+        """)
+    assert fs == []
+
+
+def test_rule_rename_no_dir_fsync(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import os
+        def swap(tmp, dst):
+            os.replace(tmp, dst)
+        """)
+    assert _rules(fs) == {"rename-no-dir-fsync"}
+
+
+def test_rule_rename_with_dir_fsync_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import os
+        from seaweedfs_tpu.utils import fsutil
+        def swap(tmp, dst):
+            os.replace(tmp, dst)
+            fsutil.fsync_dir(dst)
+        def swap2(tmp, dst):
+            os.replace(tmp, dst)
+            _fsync_dir(dst)
+        """)
+    assert fs == []
+
+
+def test_rule_vif_write_bypass(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        def stamp(base, blob):
+            with open(base + ".vif", "wb") as f:
+                f.write(blob)
+        def stamp2(vif_path, blob):
+            with open(vif_path, "w") as f:
+                f.write(blob)
+        """)
+    assert _rules(fs) == {"vif-write-bypass"}
+    assert len(fs) == 2
+
+
+def test_rule_vif_read_not_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import json
+        def read(base):
+            with open(base + ".vif") as f:
+                return json.load(f)
+        """)
+    assert fs == []
+
+
 def test_rule_parse_error(tmp_path):
     fs = _lint_src(tmp_path, "def broken(:\n")
     assert _rules(fs) == {"parse-error"}

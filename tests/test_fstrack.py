@@ -1,0 +1,132 @@
+"""Recording VFS shim (utils/fstrack.py): op capture fidelity for both
+the builtins.open file-object path and the raw os.* fd path, scope
+filtering, fsync/fsync_dir classification, mark annotations, and
+install/uninstall restoring the patched functions byte-identical."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.utils import fstrack
+
+
+@pytest.fixture
+def traced(tmp_path):
+    fstrack.install()
+    fstrack.start_trace(str(tmp_path))
+    yield str(tmp_path)
+    if fstrack.installed():
+        fstrack.stop_trace()
+        fstrack.uninstall()
+
+
+def _ops(kind=None):
+    ops = fstrack.stop_trace()
+    fstrack.uninstall()
+    return [o for o in ops if kind is None or o.kind == kind]
+
+
+def test_install_uninstall_restores_os_functions():
+    before = (os.write, os.fsync, os.rename, os.replace)
+    fstrack.install()
+    assert fstrack.installed()
+    assert os.write is not before[0]
+    fstrack.uninstall()
+    assert not fstrack.installed()
+    assert (os.write, os.fsync, os.rename, os.replace) == before
+    fstrack.uninstall()  # idempotent
+
+
+def test_builtin_open_write_ops(traced):
+    p = os.path.join(traced, "a.bin")
+    with open(p, "wb") as f:
+        f.write(b"hello")
+        f.write(b"world")
+        f.flush()
+        os.fsync(f.fileno())
+    ops = _ops()
+    kinds = [(o.kind, o.offset, bytes(o.data)) for o in ops
+             if o.kind in ("create", "write")]
+    assert kinds == [("create", 0, b""), ("write", 0, b"hello"),
+                     ("write", 5, b"world")]
+    syncs = [o for o in ops if o.kind == "fsync"]
+    assert [os.path.basename(s.path) for s in syncs] == ["a.bin"]
+
+
+def test_text_mode_byte_offsets(traced):
+    p = os.path.join(traced, "t.txt")
+    with open(p, "w") as f:
+        f.write("ab")
+        f.write("cd")
+    writes = _ops("write")
+    assert [(w.offset, bytes(w.data)) for w in writes] == \
+        [(0, b"ab"), (2, b"cd")]
+
+
+def test_append_mode_starts_at_size(traced):
+    p = os.path.join(traced, "log")
+    with open(p, "wb") as f:
+        f.write(b"xxxx")
+    with open(p, "ab") as f:
+        f.write(b"yy")
+    writes = _ops("write")
+    assert (writes[-1].offset, bytes(writes[-1].data)) == (4, b"yy")
+
+
+def test_os_fd_path_tracked(traced):
+    p = os.path.join(traced, "fd.bin")
+    fd = os.open(p, os.O_CREAT | os.O_WRONLY)
+    os.write(fd, b"abc")
+    os.write(fd, b"def")
+    os.fsync(fd)
+    os.close(fd)
+    ops = _ops()
+    writes = [(o.offset, bytes(o.data)) for o in ops if o.kind == "write"]
+    assert writes == [(0, b"abc"), (3, b"def")]
+    assert any(o.kind == "create" for o in ops)
+    assert any(o.kind == "fsync" and o.path == p for o in ops)
+
+
+def test_rename_unlink_and_dir_fsync(traced):
+    src = os.path.join(traced, "x.tmp")
+    dst = os.path.join(traced, "x")
+    with open(src, "wb") as f:
+        f.write(b"v")
+    os.replace(src, dst)
+    dfd = os.open(traced, os.O_RDONLY)
+    os.fsync(dfd)
+    os.close(dfd)
+    os.unlink(dst)
+    ops = _ops()
+    ren = [o for o in ops if o.kind == "rename"]
+    assert [(r.path, r.dst) for r in ren] == [(src, dst)]
+    assert any(o.kind == "fsync_dir" and o.path == traced for o in ops)
+    assert any(o.kind == "unlink" and o.path == dst for o in ops)
+
+
+def test_out_of_scope_paths_ignored(traced, tmp_path_factory):
+    other = tmp_path_factory.mktemp("elsewhere")
+    with open(os.path.join(str(other), "o.bin"), "wb") as f:
+        f.write(b"zz")
+    assert _ops() == []
+
+
+def test_mark_carries_meta(traced):
+    with open(os.path.join(traced, "d"), "wb") as f:
+        f.write(b"p")
+        os.fsync(f.fileno())
+    fstrack.mark("ack", key=7, sha="cafe")
+    marks = _ops("mark")
+    assert len(marks) == 1
+    assert marks[0].label == "ack"
+    assert marks[0].meta == {"key": 7, "sha": "cafe"}
+
+
+def test_seq_totally_ordered(traced):
+    p = os.path.join(traced, "s")
+    with open(p, "wb") as f:
+        f.write(b"1")
+    fstrack.mark("m")
+    ops = _ops()
+    seqs = [o.seq for o in ops]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
